@@ -1,0 +1,48 @@
+"""deepspeed_tpu: a TPU-native training & inference framework.
+
+From-scratch JAX/XLA/Pallas re-design of the capabilities of the DeepSpeed
+reference (see SURVEY.md): config-driven engine, ZeRO-equivalent sharded
+optimization, 3D/4D parallelism on a device mesh, sequence & expert
+parallelism, host/NVMe offload, universal checkpointing, ragged inference,
+and first-class observability.
+
+Public entry points (parity with reference deepspeed/__init__.py):
+
+  initialize(...)      -> (engine, optimizer, dataloader, lr_scheduler)
+  init_inference(...)  -> InferenceEngine
+  comm                 -> collectives facade (deepspeed.comm analog)
+"""
+
+from deepspeed_tpu.version import __version__, git_hash, git_branch
+
+from deepspeed_tpu import comm  # noqa: F401
+from deepspeed_tpu.config.config import Config, load_config  # noqa: F401
+from deepspeed_tpu.parallel.topology import TopologyConfig, build_mesh  # noqa: F401
+
+
+def initialize(*args, **kwargs):
+    """Build a training Engine (reference deepspeed.initialize __init__.py:93).
+
+    Lazy import keeps `import deepspeed_tpu` cheap (no engine deps)."""
+    from deepspeed_tpu.runtime.engine import initialize as _initialize
+
+    return _initialize(*args, **kwargs)
+
+
+def init_inference(*args, **kwargs):
+    """Build an inference engine (reference deepspeed.init_inference
+    __init__.py:328)."""
+    from deepspeed_tpu.inference.engine import init_inference as _init_inference
+
+    return _init_inference(*args, **kwargs)
+
+
+def add_config_arguments(parser):
+    """Augment an argparse parser with --deepspeed flags (reference
+    __init__.py:305)."""
+    group = parser.add_argument_group("DeepSpeed-TPU", "configuration")
+    group.add_argument("--deepspeed_config", default=None, type=str,
+                       help="Path to the framework JSON config file.")
+    group.add_argument("--local_rank", type=int, default=-1,
+                       help="Accepted for launcher compatibility; unused.")
+    return parser

@@ -1,0 +1,2 @@
+from deepspeed_tpu.comm.comm import *  # noqa: F401,F403
+from deepspeed_tpu.comm import comm  # noqa: F401

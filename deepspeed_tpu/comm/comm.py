@@ -1,0 +1,238 @@
+"""Communication facade: named-axis collectives over ICI/DCN.
+
+TPU-native analog of ``deepspeed.comm`` (reference: deepspeed/comm/comm.py —
+the torch.distributed-shaped module API at :227-682, ``init_distributed``
+:792, ``timed_op`` wrappers :106). Three deltas from the reference design:
+
+  1. There is no backend zoo (NCCL/gloo/CCL/...) — XLA emits the collectives
+     for the platform; the "backend" is the compiler. Capability probes like
+     ``has_all_gather_into_tensor`` become trivially true.
+  2. Collectives are *named-axis* ops usable inside jit/shard_map bodies
+     (they wrap ``jax.lax`` primitives). Outside jit, GSPMD usually inserts
+     them from sharding annotations and user code never calls these.
+  3. Per-op logging happens at trace time (see utils/comms_logging.py),
+     because timing individual ops inside a compiled program from Python is
+     meaningless.
+
+``init_distributed`` performs the multi-host rendezvous
+(``jax.distributed.initialize``), the analog of joining the job-wide
+process group the reference launcher creates (comm/comm.py:792 →
+torch.distributed.init_process_group).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from deepspeed_tpu.utils.comms_logging import get_comms_logger
+from deepspeed_tpu.utils.logging import log_dist, logger
+
+__all__ = [
+    "init_distributed", "is_initialized", "get_world_size", "get_rank",
+    "get_local_rank", "get_process_count", "barrier",
+    "has_all_gather_into_tensor", "has_reduce_scatter_tensor",
+    "has_coalescing_manager", "all_reduce", "all_gather", "reduce_scatter",
+    "all_to_all", "ppermute", "broadcast", "axis_index", "axis_size",
+    "configure", "log_summary",
+]
+
+_INITIALIZED = False
+
+
+def is_initialized() -> bool:
+    return _INITIALIZED
+
+
+def init_distributed(
+    dist_backend: str = "xla",
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+    timeout: Optional[int] = None,
+    dist_init_required: Optional[bool] = None,
+) -> None:
+    """Join the multi-host rendezvous (analog of comm/comm.py:792).
+
+    Single-host (or already-initialized) is a no-op. Multi-host parameters
+    come from args or the standard env autodiscovery the reference performs
+    (comm/comm.py:861-953): COORDINATOR_ADDRESS / NUM_PROCESSES / PROCESS_ID,
+    plus TPU pod metadata which jax.distributed discovers natively.
+    """
+    global _INITIALIZED
+    if _INITIALIZED or dist_init_required is False:
+        return
+    coordinator_address = coordinator_address or os.environ.get("COORDINATOR_ADDRESS")
+    num_processes = num_processes or _env_int("NUM_PROCESSES")
+    process_id = process_id if process_id is not None else _env_int("PROCESS_ID")
+    try:
+        if coordinator_address or os.environ.get("TPU_WORKER_HOSTNAMES"):
+            jax.distributed.initialize(
+                coordinator_address=coordinator_address,
+                num_processes=num_processes,
+                process_id=process_id,
+            )
+            log_dist(
+                f"initialized distributed runtime: {jax.process_count()} processes",
+                ranks=[0],
+            )
+    except RuntimeError as e:
+        # already initialized by the launcher — fine
+        logger.debug(f"jax.distributed.initialize skipped: {e}")
+    _INITIALIZED = True
+
+
+def _env_int(name: str) -> Optional[int]:
+    v = os.environ.get(name)
+    return int(v) if v is not None else None
+
+
+# -- world/rank queries (process granularity on TPU) ------------------------
+
+
+def get_world_size(group: Any = None) -> int:
+    """Total **device** count (the reference's world = one rank per device).
+
+    NOTE the granularity split vs the reference: on TPU one controller
+    process drives many devices, so there is no per-device Python rank.
+    ``get_world_size`` is device-granular (matches comm-volume math);
+    ``get_rank`` is process-granular (matches "who does host-side work").
+    Reference-style ``rank == world_size - 1`` loops do not port; use
+    mesh-axis logic (lax.axis_index) inside compiled code instead.
+    """
+    return jax.device_count()
+
+
+def get_rank(group: Any = None) -> int:
+    """Host **process** index (see granularity note on get_world_size)."""
+    return jax.process_index()
+
+
+def get_local_rank() -> int:
+    return 0  # one controller process per host drives all local devices
+
+
+def get_process_count() -> int:
+    return jax.process_count()
+
+
+def barrier(group: Any = None) -> None:
+    """Cross-host barrier: tiny psum over all devices."""
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+
+        multihost_utils.sync_global_devices("deepspeed_tpu.barrier")
+
+
+# -- capability probes (reference comm/comm.py:325,629) ---------------------
+
+
+def has_all_gather_into_tensor() -> bool:
+    return True
+
+
+def has_reduce_scatter_tensor() -> bool:
+    return True
+
+
+def has_coalescing_manager() -> bool:
+    return True  # XLA coalesces/fuses collectives during scheduling
+
+
+# -- in-jit named-axis collectives ------------------------------------------
+# These are usable inside shard_map/pjit bodies. `axis` is a mesh axis name
+# or tuple of names. Each records traced bytes with the CommsLogger.
+
+
+def _nbytes(x) -> int:
+    aval = jax.core.get_aval(x) if not hasattr(x, "nbytes") else x
+    try:
+        return int(aval.nbytes)
+    except Exception:
+        import numpy as np
+
+        return int(np.prod(aval.shape) * jnp.dtype(aval.dtype).itemsize)
+
+
+def _record(op: str, x, axis, log_name=None):
+    try:
+        get_comms_logger().record(op, _nbytes(x), axis, log_name)
+    except Exception:
+        pass
+
+
+def all_reduce(x, axis, op: str = "sum", log_name: Optional[str] = None):
+    """lax.psum/pmean/pmax over a named mesh axis (reference all_reduce
+    comm/comm.py:497)."""
+    _record("all_reduce", x, axis, log_name)
+    if op == "sum":
+        return lax.psum(x, axis)
+    if op in ("avg", "mean"):
+        return lax.pmean(x, axis)
+    if op == "max":
+        return lax.pmax(x, axis)
+    if op == "min":
+        return lax.pmin(x, axis)
+    raise ValueError(f"unsupported reduce op: {op}")
+
+
+def all_gather(x, axis, *, tiled: bool = True, gather_dim: int = 0,
+               log_name: Optional[str] = None):
+    """all_gather_into_tensor analog (comm/comm.py:320)."""
+    _record("all_gather", x, axis, log_name)
+    return lax.all_gather(x, axis, axis=gather_dim, tiled=tiled)
+
+
+def reduce_scatter(x, axis, *, scatter_dim: int = 0, op: str = "sum",
+                   log_name: Optional[str] = None):
+    """reduce_scatter_tensor analog (comm/comm.py:257)."""
+    _record("reduce_scatter", x, axis, log_name)
+    out = lax.psum_scatter(x, axis, scatter_dimension=scatter_dim, tiled=True)
+    if op in ("avg", "mean"):
+        out = out / lax.axis_size(axis)
+    return out
+
+
+def all_to_all(x, axis, *, split_dim: int, concat_dim: int,
+               log_name: Optional[str] = None):
+    """all_to_all_single analog (comm/comm.py:392); the Ulysses primitive."""
+    _record("all_to_all", x, axis, log_name)
+    return lax.all_to_all(x, axis, split_axis=split_dim, concat_axis=concat_dim,
+                          tiled=True)
+
+
+def ppermute(x, axis, perm, log_name: Optional[str] = None):
+    """Point-to-point ring shift (the reference's p2p send/recv
+    pipe/p2p.py:46,67 becomes a collective-permute on TPU)."""
+    _record("ppermute", x, axis, log_name)
+    return lax.ppermute(x, axis, perm)
+
+
+def broadcast(x, axis, root: int = 0, log_name: Optional[str] = None):
+    """Broadcast from `root` along a named axis (comm/comm.py:227)."""
+    _record("broadcast", x, axis, log_name)
+    idx = lax.axis_index(axis)
+    masked = jnp.where(idx == root, x, jnp.zeros_like(x))
+    return lax.psum(masked, axis)
+
+
+def axis_index(axis):
+    return lax.axis_index(axis)
+
+
+def axis_size(axis):
+    return lax.axis_size(axis)
+
+
+def configure(config=None) -> None:
+    """Wire the comms logger (reference dist.configure engine.py:323)."""
+    if config is not None:
+        get_comms_logger().configure(config.comms_logger)
+
+
+def log_summary(show_straggler: bool = False) -> str:
+    return get_comms_logger().log_summary()

@@ -1,0 +1,2 @@
+from deepspeed_tpu.config.config import Config, load_config  # noqa: F401
+from deepspeed_tpu.config.config_utils import AUTO, ConfigModel, is_auto  # noqa: F401

@@ -1,0 +1,486 @@
+"""The framework config tree.
+
+TPU-native analog of the reference's ``DeepSpeedConfig``
+(reference: deepspeed/runtime/config.py:676) and its nested sub-configs.
+A single JSON file / dict configures the whole engine. Key parity points:
+
+  - batch-size triple solver: ``train_batch_size`` =
+    ``train_micro_batch_size_per_chip`` × ``gradient_accumulation_steps`` ×
+    data-parallel world size (reference ``_configure_train_batch_size``
+    runtime/config.py:971);
+  - ``"auto"`` values resolved by the engine or autotuner;
+  - deprecated-key aliasing (e.g. ``train_micro_batch_size_per_gpu``).
+
+TPU-first deltas: fp16 loss-scaling exists for parity but bf16 is the
+default compute dtype; ZeRO stages map to sharding declarations instead of
+runtime partitioning (see runtime/zero.py); parallel topology (dp/fsdp/
+tp/sp/ep/pp) is part of the config because on TPU it compiles into the
+program rather than being wired at runtime.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from dataclasses import dataclass, field
+from typing import Any, Dict, Optional
+
+from deepspeed_tpu.config.config_utils import (
+    AUTO,
+    ConfigModel,
+    is_auto,
+    register_config_model,
+)
+from deepspeed_tpu.utils.logging import logger
+
+
+# ---------------------------------------------------------------------------
+# sub-configs
+# ---------------------------------------------------------------------------
+
+
+@register_config_model
+@dataclass
+class OptimizerConfig(ConfigModel):
+    """Reference: ``optimizer`` block (runtime/config.py:90-127)."""
+
+    type: str = "adamw"
+    params: Dict[str, Any] = field(default_factory=dict)
+
+
+@register_config_model
+@dataclass
+class SchedulerConfig(ConfigModel):
+    """Reference: ``scheduler`` block → runtime/lr_schedules.py."""
+
+    type: Optional[str] = None
+    params: Dict[str, Any] = field(default_factory=dict)
+
+
+@register_config_model
+@dataclass
+class BF16Config(ConfigModel):
+    """Reference: ``bf16`` block (runtime/config.py:157). Default on TPU."""
+
+    enabled: bool = True
+
+
+@register_config_model
+@dataclass
+class FP16Config(ConfigModel):
+    """Reference: ``fp16`` block with dynamic loss scaling
+    (runtime/fp16/loss_scaler.py:187). Rarely wanted on TPU (bf16-native),
+    kept for API parity and for accelerators without bf16."""
+
+    enabled: bool = False
+    loss_scale: float = 0.0  # 0 = dynamic
+    initial_scale_power: int = 16
+    loss_scale_window: int = 1000
+    hysteresis: int = 2
+    min_loss_scale: float = 1.0
+
+
+@register_config_model
+@dataclass
+class OffloadParamConfig(ConfigModel):
+    """Reference: DeepSpeedZeroOffloadParamConfig (runtime/zero/offload_config.py:21)."""
+
+    device: str = "none"  # none | cpu | nvme
+    nvme_path: Optional[str] = None
+    buffer_count: int = 5
+    buffer_size: int = 100_000_000
+    pin_memory: bool = False
+
+
+@register_config_model
+@dataclass
+class OffloadOptimizerConfig(ConfigModel):
+    """Reference: DeepSpeedZeroOffloadOptimizerConfig (runtime/zero/offload_config.py:52)."""
+
+    device: str = "none"  # none | cpu | nvme
+    nvme_path: Optional[str] = None
+    buffer_count: int = 4
+    pin_memory: bool = False
+    pipeline_read: bool = False
+    pipeline_write: bool = False
+    ratio: float = 1.0
+
+
+@register_config_model
+@dataclass
+class ZeroConfig(ConfigModel):
+    """Reference: DeepSpeedZeroConfig (runtime/zero/config.py:90).
+
+    On TPU the stages are declarative sharding choices (runtime/zero.py):
+      0: replicate params/grads/opt-state over dp;
+      1: shard optimizer state over dp;
+      2: + reduce-scatter grads (grads land sharded);
+      3: + shard parameters over dp (XLA all-gathers on use).
+    """
+
+    stage: int = 0
+    # bucket knobs kept for parity; on TPU XLA handles bucketing, but they
+    # bound host-side flattening in the offload path.
+    reduce_bucket_size: int = 500_000_000
+    allgather_bucket_size: int = 500_000_000
+    overlap_comm: bool = True
+    contiguous_gradients: bool = True
+    reduce_scatter: bool = True
+    round_robin_gradients: bool = False
+    offload_param: Optional[OffloadParamConfig] = None
+    offload_optimizer: Optional[OffloadOptimizerConfig] = None
+    sub_group_size: int = 1_000_000_000
+    # ZeRO++ (reference docs/_tutorials/zeropp.md): hierarchical partitioning
+    # and quantized collectives.
+    zero_hpz_partition_size: int = 1  # 1 = off; >1 = shard within ICI slice
+    zero_quantized_weights: bool = False  # qwZ: int8 all-gather of params
+    zero_quantized_gradients: bool = False  # qgZ: quantized grad reduce
+    # MiCS (runtime/zero/mics.py): sub-world shard groups.
+    mics_shard_size: int = -1
+    mics_hierarchical_params_gather: bool = False
+    log_trace_cache_warnings: bool = False
+    model_persistence_threshold: int = 0  # params below stay replicated
+    param_persistence_threshold: int = 0
+
+    def validate(self) -> None:
+        if self.stage not in (0, 1, 2, 3):
+            raise ValueError(f"zero_optimization.stage must be 0-3, got {self.stage}")
+        if self.zero_hpz_partition_size < 1:
+            raise ValueError("zero_hpz_partition_size must be >= 1")
+
+
+@register_config_model
+@dataclass
+class TensorParallelConfig(ConfigModel):
+    """Reference: ``tensor_parallel`` block (runtime/tensor_parallel/config.py,
+    autotp_size engine.py:624)."""
+
+    autotp_size: int = 1
+    tp_grain_size: int = 1
+
+    @property
+    def size(self) -> int:
+        return max(1, self.autotp_size)
+
+
+@register_config_model
+@dataclass
+class SequenceParallelConfig(ConfigModel):
+    """Ulysses-style sequence parallelism (reference: deepspeed/sequence/layer.py:351,
+    runtime/sequence_parallel/ulysses_sp.py). ``mode='ring'`` adds the
+    ring-attention option the reference lacks (SURVEY §5: head-count < chips)."""
+
+    size: int = 1
+    mode: str = "ulysses"  # ulysses | ring
+    tiled_mlp: bool = False
+    tiled_logits: bool = False
+    tile_size: int = 0  # 0 = auto
+
+
+@register_config_model
+@dataclass
+class MoEConfig(ConfigModel):
+    """Expert parallelism defaults used by our model zoo (reference MoE layer
+    args: deepspeed/moe/layer.py:17)."""
+
+    enabled: bool = False
+    ep_size: int = 1
+    capacity_factor: float = 1.25
+    eval_capacity_factor: float = 2.0
+    min_capacity: int = 4
+    top_k: int = 2
+    drop_tokens: bool = True
+    use_rts: bool = False
+    aux_loss_weight: float = 0.01
+    z_loss_weight: float = 0.0
+
+
+@register_config_model
+@dataclass
+class PipelineConfig(ConfigModel):
+    """Reference: PipelineModule/PipelineEngine (runtime/pipe/). On TPU the
+    1F1B interpreter becomes a collective-permute microbatch pipeline
+    (parallel/pipeline.py)."""
+
+    stages: int = 1
+    partition_method: str = "uniform"  # uniform | parameters
+    activation_checkpoint_interval: int = 0
+
+
+@register_config_model
+@dataclass
+class ActivationCheckpointingConfig(ConfigModel):
+    """Reference: runtime/activation_checkpointing/checkpointing.py:1029.
+    On TPU this selects the jax.checkpoint (remat) policy applied to the
+    scanned layer stack."""
+
+    partition_activations: bool = False
+    cpu_checkpointing: bool = False
+    contiguous_memory_optimization: bool = False
+    number_checkpoints: Optional[int] = None
+    synchronize_checkpoint_boundary: bool = False
+    profile: bool = False
+    # TPU-native knob: which remat policy to use for the layer scan.
+    policy: str = "nothing_saveable"  # nothing_saveable | dots_saveable | save_dot_except_mlp | none
+
+
+@register_config_model
+@dataclass
+class CommsLoggerConfig(ConfigModel):
+    """Reference: comms_logger block (utils/comms_logging.py:67)."""
+
+    enabled: bool = False
+    verbose: bool = False
+    prof_all: bool = True
+    debug: bool = False
+    prof_ops: list = field(default_factory=list)
+
+
+@register_config_model
+@dataclass
+class MonitorBackendConfig(ConfigModel):
+    enabled: bool = False
+    output_path: str = ""
+    job_name: str = "DeepSpeedTPUJob"
+    team: Optional[str] = None
+    project: Optional[str] = None
+    group: Optional[str] = None
+
+
+@register_config_model
+@dataclass
+class MonitorConfig(ConfigModel):
+    """Reference: deepspeed/monitor/config.py; MonitorMaster (monitor/monitor.py:30)."""
+
+    tensorboard: MonitorBackendConfig = field(default_factory=MonitorBackendConfig)
+    csv_monitor: MonitorBackendConfig = field(default_factory=MonitorBackendConfig)
+    wandb: MonitorBackendConfig = field(default_factory=MonitorBackendConfig)
+
+
+@register_config_model
+@dataclass
+class FlopsProfilerConfig(ConfigModel):
+    """Reference: deepspeed/profiling/config.py. On TPU we read XLA's
+    ``Compiled.cost_analysis()`` instead of monkey-patching ops."""
+
+    enabled: bool = False
+    profile_step: int = 1
+    module_depth: int = -1
+    top_modules: int = 1
+    detailed: bool = True
+    output_file: Optional[str] = None
+
+
+@register_config_model
+@dataclass
+class CheckpointConfig(ConfigModel):
+    """Reference: checkpoint block (runtime/config.py:439-471)."""
+
+    tag_validation: str = "Warn"  # Ignore | Warn | Fail
+    load_universal: bool = False
+    use_node_local_storage: bool = False
+    parallel_write_pipeline: bool = False
+    async_save: bool = False
+
+
+@register_config_model
+@dataclass
+class CompileConfig(ConfigModel):
+    """Reference: deepspeed/compile/config.py. On TPU everything is compiled;
+    these knobs tune donation/remat instead."""
+
+    enabled: bool = True
+    donate_params: bool = True
+    scan_layers: bool = True
+
+
+@register_config_model
+@dataclass
+class DataEfficiencyConfig(ConfigModel):
+    """Reference: runtime/data_pipeline/config.py (curriculum etc.)."""
+
+    enabled: bool = False
+    seed: int = 1234
+    curriculum_metrics: Dict[str, Any] = field(default_factory=dict)
+
+
+# ---------------------------------------------------------------------------
+# top-level config
+# ---------------------------------------------------------------------------
+
+_TOP_LEVEL_DEPRECATED = {
+    "train_micro_batch_size_per_gpu": "train_micro_batch_size_per_chip",
+}
+
+
+@register_config_model
+@dataclass
+class Config(ConfigModel):
+    """Top-level typed config (reference: DeepSpeedConfig runtime/config.py:676).
+
+    Build with :func:`load_config` / ``Config.from_dict``; the batch triple is
+    solved against the data-parallel world size by :meth:`resolve_batch_size`.
+    """
+
+    _deprecated_keys = _TOP_LEVEL_DEPRECATED
+
+    # batch triple (any subset; solver fills the rest)
+    train_batch_size: Any = None
+    train_micro_batch_size_per_chip: Any = None
+    gradient_accumulation_steps: Any = None
+
+    steps_per_print: int = 10
+    wall_clock_breakdown: bool = False
+    memory_breakdown: bool = False
+    dump_state: bool = False
+    gradient_clipping: float = 0.0
+    prescale_gradients: bool = False
+    gradient_predivide_factor: float = 1.0
+    communication_data_type: Optional[str] = None
+    seed: int = 42
+
+    # dtype blocks
+    bf16: BF16Config = field(default_factory=BF16Config)
+    fp16: FP16Config = field(default_factory=FP16Config)
+
+    optimizer: Optional[OptimizerConfig] = None
+    scheduler: Optional[SchedulerConfig] = None
+    zero_optimization: ZeroConfig = field(default_factory=ZeroConfig)
+    tensor_parallel: TensorParallelConfig = field(default_factory=TensorParallelConfig)
+    sequence_parallel: SequenceParallelConfig = field(default_factory=SequenceParallelConfig)
+    moe: MoEConfig = field(default_factory=MoEConfig)
+    pipeline: PipelineConfig = field(default_factory=PipelineConfig)
+    activation_checkpointing: ActivationCheckpointingConfig = field(
+        default_factory=ActivationCheckpointingConfig
+    )
+    comms_logger: CommsLoggerConfig = field(default_factory=CommsLoggerConfig)
+    monitor: MonitorConfig = field(default_factory=MonitorConfig)
+    flops_profiler: FlopsProfilerConfig = field(default_factory=FlopsProfilerConfig)
+    checkpoint: CheckpointConfig = field(default_factory=CheckpointConfig)
+    compile: CompileConfig = field(default_factory=CompileConfig)
+    data_efficiency: DataEfficiencyConfig = field(default_factory=DataEfficiencyConfig)
+
+    # monitor blocks may also appear top-level in reference configs
+    tensorboard: Optional[MonitorBackendConfig] = None
+    csv_monitor: Optional[MonitorBackendConfig] = None
+    wandb: Optional[MonitorBackendConfig] = None
+
+    def __post_init__(self):
+        # a JSON null for a block means "defaults", not "no block"
+        defaultable = {
+            "bf16": BF16Config, "fp16": FP16Config, "zero_optimization": ZeroConfig,
+            "tensor_parallel": TensorParallelConfig,
+            "sequence_parallel": SequenceParallelConfig, "moe": MoEConfig,
+            "pipeline": PipelineConfig, "monitor": MonitorConfig,
+            "activation_checkpointing": ActivationCheckpointingConfig,
+            "comms_logger": CommsLoggerConfig, "flops_profiler": FlopsProfilerConfig,
+            "checkpoint": CheckpointConfig, "compile": CompileConfig,
+            "data_efficiency": DataEfficiencyConfig,
+        }
+        for name, klass in defaultable.items():
+            if getattr(self, name) is None:
+                setattr(self, name, klass())
+        # hoist top-level monitor blocks into .monitor (reference accepts both)
+        for name in ("tensorboard", "csv_monitor", "wandb"):
+            blk = getattr(self, name)
+            if blk is not None:
+                setattr(self.monitor, name, blk)
+
+    # -- dtypes ------------------------------------------------------------
+    @property
+    def compute_dtype(self):
+        import jax.numpy as jnp
+
+        if self.fp16.enabled:
+            return jnp.float16
+        if self.bf16.enabled:
+            return jnp.bfloat16
+        return jnp.float32
+
+    @property
+    def loss_scaling_enabled(self) -> bool:
+        return self.fp16.enabled
+
+    def validate(self) -> None:
+        if self.fp16.enabled and self.bf16 is not None and self.bf16.enabled:
+            # reference errors on both; bf16 defaults on, so fp16 wins if
+            # explicitly requested.
+            self.bf16.enabled = False
+        if self.gradient_clipping < 0:
+            raise ValueError("gradient_clipping must be >= 0")
+
+    # -- batch triple solver ----------------------------------------------
+    def resolve_batch_size(self, dp_world_size: int) -> None:
+        """Solve train_batch = micro × GAS × dp (reference
+        runtime/config.py:971 ``_configure_train_batch_size``)."""
+        tb = None if is_auto(self.train_batch_size) else self.train_batch_size
+        mb = (
+            None
+            if is_auto(self.train_micro_batch_size_per_chip)
+            else self.train_micro_batch_size_per_chip
+        )
+        gas = (
+            None
+            if is_auto(self.gradient_accumulation_steps)
+            else self.gradient_accumulation_steps
+        )
+
+        if tb is not None and mb is not None and gas is not None:
+            if tb != mb * gas * dp_world_size:
+                raise ValueError(
+                    f"Inconsistent batch config: train_batch_size={tb} != "
+                    f"micro({mb}) * gas({gas}) * dp({dp_world_size})"
+                )
+        elif tb is not None and mb is not None:
+            if tb % (mb * dp_world_size) != 0:
+                raise ValueError(
+                    f"train_batch_size={tb} not divisible by micro*dp="
+                    f"{mb * dp_world_size}"
+                )
+            gas = tb // (mb * dp_world_size)
+        elif tb is not None and gas is not None:
+            if tb % (gas * dp_world_size) != 0:
+                raise ValueError(
+                    f"train_batch_size={tb} not divisible by gas*dp="
+                    f"{gas * dp_world_size}"
+                )
+            mb = tb // (gas * dp_world_size)
+        elif mb is not None:
+            gas = gas if gas is not None else 1
+            tb = mb * gas * dp_world_size
+        elif tb is not None:
+            mb = max(1, tb // dp_world_size)
+            gas = tb // (mb * dp_world_size)
+            if tb != mb * gas * dp_world_size:
+                raise ValueError(
+                    f"train_batch_size={tb} not divisible by dp={dp_world_size}"
+                )
+        elif gas is not None:
+            mb = 1
+            tb = mb * gas * dp_world_size
+        else:
+            mb, gas = 1, 1
+            tb = dp_world_size
+
+        self.train_batch_size = int(tb)
+        self.train_micro_batch_size_per_chip = int(mb)
+        self.gradient_accumulation_steps = int(gas)
+        if self.gradient_accumulation_steps < 1:
+            raise ValueError("gradient_accumulation_steps must be >= 1")
+
+
+def load_config(config: str | Dict[str, Any] | Config | None) -> Config:
+    """Accept a path to JSON, a dict, an existing Config, or None."""
+    if config is None:
+        return Config.from_dict({})
+    if isinstance(config, Config):
+        return config
+    if isinstance(config, str):
+        if not os.path.exists(config):
+            raise FileNotFoundError(f"config file not found: {config}")
+        with open(config) as f:
+            config = json.load(f)
+    if not isinstance(config, dict):
+        raise TypeError(f"config must be a path, dict, or Config; got {type(config)}")
+    return Config.from_dict(config)

@@ -1,0 +1,137 @@
+"""Typed config-tree machinery.
+
+TPU-native analog of the reference's pydantic-style ``DeepSpeedConfigModel``
+(reference: deepspeed/runtime/config_utils.py) without a pydantic dependency:
+dataclass-backed models with unknown-key warnings, deprecated-field aliasing,
+and an ``"auto"`` sentinel resolved later by the engine/autotuner.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Type, TypeVar, get_args, get_origin, Union
+
+from deepspeed_tpu.utils.logging import logger
+
+AUTO = "auto"
+
+T = TypeVar("T", bound="ConfigModel")
+
+
+def is_auto(value: Any) -> bool:
+    return isinstance(value, str) and value.lower() == AUTO
+
+
+@dataclasses.dataclass
+class ConfigModel:
+    """Base class for all config sub-models.
+
+    Subclasses are plain dataclasses. ``from_dict`` performs:
+      - deprecated-key aliasing via the class attr ``_deprecated_keys``
+        ({old_key: new_key}), warning on use (parity with the reference's
+        ``deprecated`` field metadata, config_utils.py);
+      - recursion into nested ConfigModel fields;
+      - unknown-key warnings (the reference errors or warns depending on
+        model; we warn and ignore to stay permissive);
+      - light type coercion (int/float/bool from JSON strings).
+    """
+
+    @classmethod
+    def from_dict(cls: Type[T], data: Dict[str, Any] | None) -> T:
+        data = dict(data or {})
+        deprecated = getattr(cls, "_deprecated_keys", {})
+        for old, new in deprecated.items():
+            if old in data:
+                logger.warning(
+                    f"Config key '{old}' is deprecated; use '{new}' instead."
+                )
+                data.setdefault(new, data.pop(old))
+
+        field_map = {f.name: f for f in dataclasses.fields(cls) if f.init}
+        kwargs: Dict[str, Any] = {}
+        for key, value in data.items():
+            if key not in field_map:
+                logger.warning(f"{cls.__name__}: ignoring unknown config key '{key}'")
+                continue
+            kwargs[key] = _coerce(field_map[key].type, value, f"{cls.__name__}.{key}")
+        obj = cls(**kwargs)
+        obj.validate()
+        return obj
+
+    def validate(self) -> None:
+        """Override for cross-field checks. Raise ValueError on bad configs."""
+
+    def to_dict(self) -> Dict[str, Any]:
+        out = {}
+        for f in dataclasses.fields(self):
+            if f.name.startswith("_"):
+                continue
+            v = getattr(self, f.name)
+            out[f.name] = v.to_dict() if isinstance(v, ConfigModel) else v
+        return out
+
+    def __repr__(self) -> str:  # compact, hide internals
+        body = ", ".join(
+            f"{f.name}={getattr(self, f.name)!r}"
+            for f in dataclasses.fields(self)
+            if not f.name.startswith("_")
+        )
+        return f"{self.__class__.__name__}({body})"
+
+
+def _unwrap_optional(tp):
+    if get_origin(tp) is Union:
+        args = [a for a in get_args(tp) if a is not type(None)]
+        if len(args) == 1:
+            return args[0]
+    return tp
+
+
+def _coerce(tp, value, where: str):
+    """Best-effort coercion of JSON-ish values to the declared field type."""
+    if isinstance(tp, str):
+        # string annotations (from __future__ annotations) — look up lazily
+        tp = _resolve_annotation(tp)
+        if tp is None:
+            return value
+    tp = _unwrap_optional(tp)
+    if value is None or is_auto(value):
+        return value
+    if isinstance(tp, type) and issubclass(tp, ConfigModel):
+        if isinstance(tp, type) and isinstance(value, tp):
+            return value
+        if not isinstance(value, dict):
+            raise ValueError(f"{where}: expected a dict, got {type(value).__name__}")
+        return tp.from_dict(value)
+    if tp is bool and isinstance(value, str):
+        return value.lower() in ("true", "1", "yes", "on")
+    if tp in (int, float) and isinstance(value, (str, int, float, bool)):
+        try:
+            return tp(value)
+        except (TypeError, ValueError):
+            raise ValueError(f"{where}: cannot convert {value!r} to {tp.__name__}")
+    return value
+
+
+_ANNOTATION_REGISTRY: Dict[str, type] = {}
+
+
+def register_config_model(cls):
+    """Class decorator: make a ConfigModel resolvable from string annotations."""
+    _ANNOTATION_REGISTRY[cls.__name__] = cls
+    return cls
+
+
+def _resolve_annotation(name: str):
+    name = name.strip()
+    for prefix in ("Optional[", "typing.Optional["):
+        if name.startswith(prefix) and name.endswith("]"):
+            name = name[len(prefix):-1].strip()
+    if name in _ANNOTATION_REGISTRY:
+        return _ANNOTATION_REGISTRY[name]
+    return {"int": int, "float": float, "bool": bool, "str": str}.get(name)
+
+
+def get_scalar_param(config_dict: Dict[str, Any], key: str, default):
+    """Reference-parity helper (deepspeed/runtime/config_utils.py)."""
+    return config_dict.get(key, default)

@@ -1,0 +1,1 @@
+from deepspeed_tpu.utils.logging import logger, log_dist  # noqa: F401

@@ -1,0 +1,77 @@
+"""Rank-aware logging utilities.
+
+TPU-native analog of the reference's ``deepspeed/utils/logging.py``
+(``logger``, ``log_dist`` — reference: deepspeed/utils/logging.py:52,104).
+On TPU multi-host, "rank" means ``jax.process_index()``.
+"""
+
+from __future__ import annotations
+
+import functools
+import logging
+import os
+import sys
+
+LOG_LEVEL_ENV = "DSTPU_LOG_LEVEL"
+
+log_levels = {
+    "debug": logging.DEBUG,
+    "info": logging.INFO,
+    "warning": logging.WARNING,
+    "error": logging.ERROR,
+    "critical": logging.CRITICAL,
+}
+
+
+@functools.lru_cache(None)
+def _create_logger(name: str = "deepspeed_tpu") -> logging.Logger:
+    logger_ = logging.getLogger(name)
+    logger_.propagate = False
+    level = log_levels.get(os.environ.get(LOG_LEVEL_ENV, "info").lower(), logging.INFO)
+    logger_.setLevel(level)
+    if not logger_.handlers:
+        handler = logging.StreamHandler(stream=sys.stdout)
+        handler.setFormatter(
+            logging.Formatter(
+                "[%(asctime)s] [%(levelname)s] [%(name)s:%(lineno)d] %(message)s"
+            )
+        )
+        logger_.addHandler(handler)
+    return logger_
+
+
+logger = _create_logger()
+
+
+def _process_index() -> int:
+    """Current host index; 0 before jax.distributed init or single-host."""
+    try:
+        import jax
+
+        return jax.process_index()
+    except Exception:  # pragma: no cover - jax always importable in practice
+        return 0
+
+
+def log_dist(message: str, ranks=None, level: int = logging.INFO) -> None:
+    """Log ``message`` only on the listed host ranks (None/-1 = all).
+
+    Parity with reference ``log_dist`` (deepspeed/utils/logging.py:104).
+    """
+    my_rank = _process_index()
+    if ranks is None or -1 in ranks or my_rank in ranks:
+        logger.log(level, f"[Rank {my_rank}] {message}")
+
+
+def print_rank_0(message: str) -> None:
+    if _process_index() == 0:
+        print(message, flush=True)
+
+
+def warning_once(message: str) -> None:
+    if message not in _seen_warnings:
+        _seen_warnings.add(message)
+        logger.warning(message)
+
+
+_seen_warnings: set = set()

@@ -1,0 +1,50 @@
+"""Test harness configuration.
+
+The reference simulates multi-node by spawning real processes per test
+(tests/unit/common.py:139 DistributedExec). The JAX analog is cheaper and
+exercises the same compiled collectives: force the host platform to expose
+8 virtual CPU devices (XLA_FLAGS=--xla_force_host_platform_device_count=8)
+so every test runs real GSPMD partitioning + collectives on one process.
+Must run before jax is imported anywhere.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"  # force: tests never touch the real TPU
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+
+# jax may already be imported by the interpreter's sitecustomize with the
+# real-TPU platform selected; override before any backend is initialized.
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def devices():
+    devs = jax.devices()
+    assert len(devs) == 8, f"expected 8 simulated devices, got {len(devs)}"
+    return devs
+
+
+@pytest.fixture()
+def mesh8():
+    """8-way fsdp mesh — the common ZeRO test topology."""
+    from deepspeed_tpu.parallel.topology import TopologyConfig, build_mesh
+
+    return build_mesh(TopologyConfig(dp=1, fsdp=8))
+
+
+@pytest.fixture()
+def mesh_2x4():
+    """fsdp=2 × tp=4 — the common 2D test topology."""
+    from deepspeed_tpu.parallel.topology import TopologyConfig, build_mesh
+
+    return build_mesh(TopologyConfig(dp=1, fsdp=2, tp=4))

@@ -1,0 +1,115 @@
+"""Config-system tests (reference analog: tests/unit/runtime/test_ds_config_dict.py)."""
+
+import json
+
+import pytest
+
+from deepspeed_tpu.config.config import Config, load_config
+from deepspeed_tpu.config.config_utils import is_auto
+
+
+def test_default_config():
+    cfg = load_config(None)
+    assert cfg.zero_optimization.stage == 0
+    assert cfg.bf16.enabled
+    assert not cfg.fp16.enabled
+    cfg.resolve_batch_size(dp_world_size=4)
+    assert cfg.train_batch_size == 4
+    assert cfg.train_micro_batch_size_per_chip == 1
+    assert cfg.gradient_accumulation_steps == 1
+
+
+def test_batch_triple_all_given_consistent():
+    cfg = load_config({
+        "train_batch_size": 32,
+        "train_micro_batch_size_per_chip": 2,
+        "gradient_accumulation_steps": 2,
+    })
+    cfg.resolve_batch_size(dp_world_size=8)
+    assert cfg.train_batch_size == 32
+
+
+def test_batch_triple_inconsistent_raises():
+    cfg = load_config({
+        "train_batch_size": 33,
+        "train_micro_batch_size_per_chip": 2,
+        "gradient_accumulation_steps": 2,
+    })
+    with pytest.raises(ValueError):
+        cfg.resolve_batch_size(dp_world_size=8)
+
+
+def test_batch_triple_solver_fills_gas():
+    cfg = load_config({"train_batch_size": 64, "train_micro_batch_size_per_chip": 2})
+    cfg.resolve_batch_size(dp_world_size=8)
+    assert cfg.gradient_accumulation_steps == 4
+
+
+def test_batch_triple_only_micro():
+    cfg = load_config({"train_micro_batch_size_per_chip": 3})
+    cfg.resolve_batch_size(dp_world_size=8)
+    assert cfg.train_batch_size == 24
+    assert cfg.gradient_accumulation_steps == 1
+
+
+def test_deprecated_per_gpu_alias():
+    cfg = load_config({"train_micro_batch_size_per_gpu": 2})
+    cfg.resolve_batch_size(dp_world_size=2)
+    assert cfg.train_micro_batch_size_per_chip == 2
+
+
+def test_auto_values_pass_through():
+    cfg = load_config({"train_batch_size": "auto"})
+    assert is_auto(cfg.train_batch_size) or cfg.train_batch_size == "auto"
+    cfg.resolve_batch_size(dp_world_size=2)
+    assert cfg.train_batch_size == 2
+
+
+def test_zero_config_nested():
+    cfg = load_config({
+        "zero_optimization": {
+            "stage": 3,
+            "offload_optimizer": {"device": "cpu", "pin_memory": True},
+            "zero_hpz_partition_size": 4,
+        }
+    })
+    assert cfg.zero_optimization.stage == 3
+    assert cfg.zero_optimization.offload_optimizer.device == "cpu"
+    assert cfg.zero_optimization.zero_hpz_partition_size == 4
+
+
+def test_invalid_zero_stage():
+    with pytest.raises(ValueError):
+        load_config({"zero_optimization": {"stage": 5}})
+
+
+def test_json_file_roundtrip(tmp_path):
+    path = tmp_path / "ds_config.json"
+    path.write_text(json.dumps({
+        "train_batch_size": 16,
+        "optimizer": {"type": "adamw", "params": {"lr": 1e-4}},
+        "fp16": {"enabled": False},
+        "gradient_clipping": 1.0,
+    }))
+    cfg = load_config(str(path))
+    assert cfg.optimizer.type == "adamw"
+    assert cfg.optimizer.params["lr"] == 1e-4
+    assert cfg.gradient_clipping == 1.0
+
+
+def test_fp16_overrides_bf16():
+    cfg = load_config({"fp16": {"enabled": True}})
+    assert cfg.fp16.enabled and not cfg.bf16.enabled
+    import jax.numpy as jnp
+
+    assert cfg.compute_dtype == jnp.float16
+
+
+def test_unknown_key_warns_not_raises():
+    cfg = load_config({"definitely_not_a_key": 1})
+    assert cfg is not None
+
+
+def test_null_dtype_block_means_defaults():
+    cfg = load_config({"fp16": None, "bf16": None})
+    assert cfg.bf16.enabled and not cfg.fp16.enabled

@@ -1,0 +1,55 @@
+"""Mesh topology tests (reference analog: tests/unit/test_topology.py)."""
+
+import pytest
+
+from deepspeed_tpu.parallel import topology
+from deepspeed_tpu.parallel.topology import MESH_AXES, TopologyConfig, build_mesh
+
+
+def test_default_absorbs_all_into_dp(devices):
+    mesh = build_mesh()
+    assert mesh.shape["dp"] == 8
+    assert all(mesh.shape[a] == 1 for a in MESH_AXES if a != "dp")
+
+
+def test_explicit_sizes(devices):
+    mesh = build_mesh(TopologyConfig(dp=1, fsdp=2, tp=4))
+    assert mesh.shape["fsdp"] == 2 and mesh.shape["tp"] == 4
+
+
+def test_free_axis_solver(devices):
+    mesh = build_mesh(TopologyConfig(dp=1, fsdp=-1, tp=2))
+    assert mesh.shape["fsdp"] == 4
+
+
+def test_bad_product_raises(devices):
+    with pytest.raises(ValueError):
+        build_mesh(TopologyConfig(dp=3, fsdp=1, tp=1))
+
+
+def test_two_free_axes_raises(devices):
+    with pytest.raises(ValueError):
+        build_mesh(TopologyConfig(dp=-1, fsdp=-1))
+
+
+def test_group_size_queries(devices):
+    mesh = build_mesh(TopologyConfig(dp=2, fsdp=2, tp=2))
+    topology.set_global_mesh(mesh)
+    assert topology.get_data_parallel_world_size() == 4  # dp*fsdp*ep
+    assert topology.get_tensor_parallel_world_size() == 2
+    assert topology.get_pipeline_parallel_world_size() == 1
+
+
+def test_dict_topology(devices):
+    mesh = build_mesh({"dp": 1, "fsdp": 8})
+    assert mesh.shape["fsdp"] == 8
+
+
+def test_dict_topology_unknown_key_raises(devices):
+    with pytest.raises(ValueError):
+        build_mesh({"tensor_parallel": 8})
+
+
+def test_zero_axis_size_raises(devices):
+    with pytest.raises(ValueError):
+        build_mesh(TopologyConfig(tp=0))
