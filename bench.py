@@ -1,0 +1,127 @@
+"""Headline benchmark: training throughput on the available TPU chip(s).
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+
+The configuration follows BASELINE.json's first config (GPT-2 125M class,
+ZeRO-1 single chip). ``vs_baseline`` is measured tokens/sec/chip divided
+by the recorded baseline in BASELINE.json's ``published`` dict when
+present, else MFU-normalized 1.0x (no published number exists yet — first
+runs establish it).
+
+Env knobs: BENCH_MODEL (zoo name), BENCH_SEQ, BENCH_MICRO, BENCH_STEPS,
+BENCH_PEAK_TFLOPS (defaults to the detected chip's bf16 peak).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+
+# per-chip dense bf16 peak TFLOPS by TPU generation
+PEAK_TFLOPS = {
+    "v4": 275.0,
+    "v5 lite": 197.0,  # v5e
+    "v5e": 197.0,
+    "v5p": 459.0,
+    "v6 lite": 918.0,  # v6e (Trillium)
+    "v6e": 918.0,
+}
+
+
+def detect_peak_tflops(device) -> float:
+    if "BENCH_PEAK_TFLOPS" in os.environ:
+        return float(os.environ["BENCH_PEAK_TFLOPS"])
+    kind = getattr(device, "device_kind", "").lower()
+    for key, val in PEAK_TFLOPS.items():
+        if key in kind:
+            return val
+    return 197.0
+
+
+def main():
+    import jax
+    import numpy as np
+
+    import deepspeed_tpu as dstpu
+    from deepspeed_tpu.models.zoo import get_model
+
+    n_chips = len(jax.devices())
+    on_tpu = jax.default_backend() == "tpu"
+
+    model_name = os.environ.get("BENCH_MODEL", "gpt2-125m")
+    seq = int(os.environ.get("BENCH_SEQ", 1024 if on_tpu else 128))
+    micro = int(os.environ.get("BENCH_MICRO", 8 if on_tpu else 1))
+    steps = int(os.environ.get("BENCH_STEPS", 10 if on_tpu else 3))
+    warmup = 3 if on_tpu else 1
+
+    overrides = dict(max_seq_len=seq, remat=on_tpu)  # remat: fits HBM at seq 1k
+    if not on_tpu:  # CPU smoke: shrink the model
+        overrides.update(num_layers=2, hidden_size=256, num_heads=8,
+                         vocab_size=2048)
+    model = get_model(model_name, **overrides)
+
+    config = {
+        "train_micro_batch_size_per_chip": micro,
+        "gradient_accumulation_steps": 1,
+        "optimizer": {"type": "adamw",
+                      "params": {"lr": 1e-4, "weight_decay": 0.1}},
+        "zero_optimization": {"stage": 1 if n_chips > 1 else 0},
+        "bf16": {"enabled": True},
+        "steps_per_print": 1_000_000,
+    }
+    topology = {"dp": 1, "fsdp": -1} if n_chips > 1 else None
+    engine, _, _, _ = dstpu.initialize(model=model, config=config,
+                                       topology=topology)
+
+    rng = np.random.default_rng(0)
+    B = engine.micro_batch_size * engine.dp_world_size
+    batch = {"input_ids": rng.integers(
+        0, model.config.vocab_size, (B, seq + 1)).astype(np.int32)}
+
+    def it():
+        while True:
+            yield batch
+
+    data = it()
+    for _ in range(warmup):
+        loss = engine.train_batch(data)
+    jax.block_until_ready(loss)
+
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        loss = engine.train_batch(data)
+    jax.block_until_ready(loss)
+    dt = time.perf_counter() - t0
+
+    tokens = B * seq * steps
+    tok_per_sec_chip = tokens / dt / n_chips
+    flops_per_token = model.flops_per_token()
+    peak = detect_peak_tflops(jax.devices()[0])
+    mfu = tok_per_sec_chip * flops_per_token / (peak * 1e12)
+
+    baseline = {}
+    try:
+        with open(os.path.join(os.path.dirname(__file__), "BASELINE.json")) as f:
+            baseline = json.load(f).get("published", {}) or {}
+    except Exception:
+        pass
+    base_tps = baseline.get("gpt2_125m_tokens_per_sec_per_chip")
+    vs_baseline = (tok_per_sec_chip / base_tps) if base_tps else 1.0
+
+    print(json.dumps({
+        "metric": f"{model_name} zero1 train tokens/sec/chip "
+                  f"(seq={seq}, micro={micro}, {'tpu' if on_tpu else 'cpu-sim'})",
+        "value": round(tok_per_sec_chip, 1),
+        "unit": "tokens/s/chip",
+        "vs_baseline": round(vs_baseline, 3),
+        "mfu": round(mfu, 4),
+        "loss": round(float(loss), 4),
+        "chips": n_chips,
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,161 @@
+"""Engine checkpoint save/load.
+
+Analog of the reference's engine checkpoint path (engine.py:4557
+``save_checkpoint``, :4079 ``load_checkpoint``) with the same on-disk
+contract: a ``latest`` tag file, per-tag directories, tag-validation, and
+client state. The tensor payload uses orbax (sharded, multi-host-safe,
+async-capable) instead of per-rank torch.save files.
+
+**Elastic + universal checkpointing are inherent here**: orbax stores
+*global* arrays with their shardings, and restore takes an abstract tree
+with *target* shardings — so resuming on a different dp/fsdp/tp topology
+is just a restore with the new plan's shardings. The reference needs
+offset arithmetic across flat partitions for this
+(ds_to_universal.py:121-249, stage_1_and_2.py:2567 elastic load); here it
+is a property of named sharding. See checkpoint/universal.py for the
+inspection/conversion CLIs.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+
+from deepspeed_tpu.utils.logging import log_dist, logger
+from deepspeed_tpu.version import __version__
+
+LATEST_FILE = "latest"
+METADATA_FILE = "metadata.json"
+STATE_DIR = "state"
+
+
+def _is_primary() -> bool:
+    return jax.process_index() == 0
+
+
+class CheckpointIO:
+    """Bound to an Engine; owns its save/load."""
+
+    def __init__(self, engine):
+        self.engine = engine
+
+    # -- state tree ----------------------------------------------------
+    def _state(self) -> Dict[str, Any]:
+        e = self.engine
+        return {
+            "params": e.params,
+            "opt_master": e.opt_state.master,
+            "opt_inner": e.opt_state.inner,
+            "step_count": e.step_count,
+            "loss_scale": e.loss_scale_state,
+        }
+
+    def _abstract_state(self) -> Dict[str, Any]:
+        def absify(x):
+            if isinstance(x, jax.Array):
+                return jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=x.sharding)
+            return x
+
+        return jax.tree.map(absify, self._state())
+
+    # -- save ----------------------------------------------------------
+    def save(self, save_dir: str, tag: Optional[str] = None,
+             client_state: Optional[Dict] = None, save_latest: bool = True):
+        import orbax.checkpoint as ocp
+
+        e = self.engine
+        tag = tag or f"global_step{e.global_steps}"
+        ckpt_dir = os.path.join(os.path.abspath(save_dir), str(tag))
+        os.makedirs(ckpt_dir, exist_ok=True)
+
+        with ocp.StandardCheckpointer() as ckptr:
+            ckptr.save(os.path.join(ckpt_dir, STATE_DIR), self._state(),
+                       force=True)
+
+        if _is_primary():
+            meta = {
+                "tag": str(tag),
+                "framework_version": __version__,
+                "saved_at": time.time(),
+                "global_steps": e.global_steps,
+                "global_samples": e.global_samples,
+                "skipped_steps": e.skipped_steps,
+                "mesh_shape": {k: int(v) for k, v in e.mesh.shape.items()},
+                "zero_stage": e.config.zero_optimization.stage,
+                "config": e.config.to_dict(),
+                "client_state": client_state or {},
+            }
+            with open(os.path.join(ckpt_dir, METADATA_FILE), "w") as f:
+                json.dump(meta, f, indent=2, default=str)
+            if save_latest:
+                with open(os.path.join(os.path.abspath(save_dir),
+                                       LATEST_FILE), "w") as f:
+                    f.write(str(tag))
+        log_dist(f"saved checkpoint: {ckpt_dir}", ranks=[0])
+        return ckpt_dir
+
+    # -- load ----------------------------------------------------------
+    def load(self, load_dir: str, tag: Optional[str] = None,
+             load_optimizer_states: bool = True
+             ) -> Tuple[Optional[str], Optional[Dict]]:
+        import orbax.checkpoint as ocp
+
+        e = self.engine
+        load_dir = os.path.abspath(load_dir)
+        if tag is None:
+            latest = os.path.join(load_dir, LATEST_FILE)
+            if not os.path.exists(latest):
+                logger.warning(f"no '{LATEST_FILE}' file at {load_dir}; "
+                               "nothing loaded")
+                return None, None
+            with open(latest) as f:
+                tag = f.read().strip()
+        ckpt_dir = os.path.join(load_dir, str(tag))
+        if not os.path.isdir(ckpt_dir):
+            raise FileNotFoundError(f"checkpoint not found: {ckpt_dir}")
+
+        meta: Dict[str, Any] = {}
+        meta_path = os.path.join(ckpt_dir, METADATA_FILE)
+        if os.path.exists(meta_path):
+            with open(meta_path) as f:
+                meta = json.load(f)
+        self._validate_tag(meta, tag)
+
+        abstract = self._abstract_state()
+        with ocp.StandardCheckpointer() as ckptr:
+            restored = ckptr.restore(os.path.join(ckpt_dir, STATE_DIR),
+                                     abstract)
+
+        e.params = restored["params"]
+        if load_optimizer_states:
+            from deepspeed_tpu.runtime.optimizer import MixedPrecisionState
+
+            e.opt_state = MixedPrecisionState(
+                master=restored["opt_master"], inner=restored["opt_inner"])
+        e.step_count = restored["step_count"]
+        e.loss_scale_state = restored["loss_scale"]
+        e.global_steps = int(meta.get("global_steps", int(e.step_count)))
+        e.global_samples = int(meta.get("global_samples", 0))
+        e.skipped_steps = int(meta.get("skipped_steps", 0))
+        log_dist(f"loaded checkpoint: {ckpt_dir} (tag={tag})", ranks=[0])
+        return ckpt_dir, meta.get("client_state", {})
+
+    def _validate_tag(self, meta: Dict, tag: str):
+        """Reference _checkpoint_tag_validation (engine.py:4540): ensure
+        the tag is consistent; here also warn on topology change (which is
+        legal — orbax reshards — but worth surfacing)."""
+        mode = self.engine.config.checkpoint.tag_validation.lower()
+        if mode == "ignore" or not meta:
+            return
+        saved_mesh = meta.get("mesh_shape")
+        cur_mesh = {k: int(v) for k, v in self.engine.mesh.shape.items()}
+        if saved_mesh and saved_mesh != cur_mesh:
+            msg = (f"checkpoint '{tag}' was saved on mesh {saved_mesh}, "
+                   f"loading onto {cur_mesh}: state will be resharded")
+            if mode == "fail":
+                raise ValueError(msg)
+            logger.warning(msg)

@@ -66,11 +66,14 @@ def init_distributed(
     global _INITIALIZED
     if _INITIALIZED or dist_init_required is False:
         return
-    coordinator_address = coordinator_address or os.environ.get("COORDINATOR_ADDRESS")
+    coordinator_address = coordinator_address or os.environ.get(
+        "COORDINATOR_ADDRESS") or os.environ.get("JAX_COORDINATOR_ADDRESS")
     num_processes = num_processes or _env_int("NUM_PROCESSES")
     process_id = process_id if process_id is not None else _env_int("PROCESS_ID")
     try:
-        if coordinator_address or os.environ.get("TPU_WORKER_HOSTNAMES"):
+        # Only rendezvous when multi-host is explicitly configured; never
+        # infer from TPU_* env alone (single-host sandboxes set those).
+        if coordinator_address or (num_processes or 0) > 1 or dist_init_required:
             jax.distributed.initialize(
                 coordinator_address=coordinator_address,
                 num_processes=num_processes,

@@ -1,1 +1,3 @@
-
+from deepspeed_tpu.models.transformer import (  # noqa: F401
+    TransformerConfig, TransformerLM)
+from deepspeed_tpu.models.zoo import CONFIGS, get_model  # noqa: F401

@@ -1,0 +1,384 @@
+"""Decoder-only transformer LM, TPU-first.
+
+This is the framework's model substrate — the role the user's ``nn.Module``
+plays in the reference (and what its model zoo under
+``inference/v2/model_implementations`` + ``module_inject/containers``
+covers). One generic implementation expresses the GPT-2 / Llama / Mistral /
+Qwen families via config switches (positional encoding, norm, activation,
+GQA, tied embeddings); MoE variants live in models/moe_transformer.py.
+
+TPU-first design choices:
+  * functional: ``init(rng) -> params`` pytree, ``apply(params, tokens) ->
+    logits``; no module objects at runtime, everything jit-traceable;
+  * every param leaf has a tuple of logical axis names (see
+    runtime/sharding.py) — this single annotation drives ZeRO-3 / TP / PP
+    sharding instead of the reference's AutoTP layer surgery
+    (module_inject/auto_tp.py:194);
+  * layers are **stacked and scanned** (``lax.scan`` over a [L, ...] params
+    tree): one compiled layer body regardless of depth — XLA compile time
+    stays flat at 70B scale, and remat policy applies per scan step
+    (reference analog: activation checkpointing
+    runtime/activation_checkpointing/checkpointing.py:948);
+  * bf16 compute, fp32 logits for the softmax-xent;
+  * attention goes through ops/attention.py (Pallas flash kernel on TPU,
+    XLA fallback elsewhere) and parallel/ulysses.py when sp > 1.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from functools import partial
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from deepspeed_tpu.runtime.sharding import constrain_activation
+
+
+@dataclasses.dataclass(frozen=True)
+class TransformerConfig:
+    """Architecture switches covering the GPT-2/Llama families."""
+
+    vocab_size: int = 50257
+    hidden_size: int = 768
+    num_layers: int = 12
+    num_heads: int = 12
+    num_kv_heads: Optional[int] = None  # None = MHA; < num_heads = GQA
+    ffn_size: Optional[int] = None  # None = 4*hidden (gelu) or 8/3*hidden (swiglu)
+    max_seq_len: int = 1024
+    pos_emb: str = "learned"  # learned | rope | none
+    norm: str = "layernorm"  # layernorm | rmsnorm
+    activation: str = "gelu"  # gelu | swiglu
+    tie_embeddings: bool = True
+    rope_theta: float = 10000.0
+    norm_eps: float = 1e-5
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+    remat: bool = True
+    remat_policy: str = "nothing_saveable"
+    attn_impl: str = "auto"  # auto | xla | flash
+    sequence_parallel: bool = False  # Ulysses all-to-all inside attention
+
+    @property
+    def kv_heads(self) -> int:
+        return self.num_kv_heads or self.num_heads
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_size // self.num_heads
+
+    @property
+    def ffn(self) -> int:
+        if self.ffn_size:
+            return self.ffn_size
+        if self.activation == "swiglu":
+            # Llama convention: 2/3 * 4h rounded to multiple of 256
+            d = int(8 * self.hidden_size / 3)
+            return 256 * ((d + 255) // 256)
+        return 4 * self.hidden_size
+
+    def flops_per_token(self) -> float:
+        """Approximate training FLOPs/token (fwd+bwd ≈ 6·N params + attn)."""
+        n = self.num_params()
+        attn = 12 * self.num_layers * self.hidden_size * self.max_seq_len
+        return 6 * n + attn
+
+    def num_params(self) -> int:
+        h, L, f, v = self.hidden_size, self.num_layers, self.ffn, self.vocab_size
+        hd, nh, nkv = self.head_dim, self.num_heads, self.kv_heads
+        attn = h * nh * hd + 2 * h * nkv * hd + nh * hd * h
+        mlp = (3 if self.activation == "swiglu" else 2) * h * f
+        norm_width = 2 * h if self.norm == "layernorm" else h  # scale(+bias)
+        per_layer = attn + mlp + 2 * norm_width
+        emb = v * h + (0 if self.tie_embeddings else v * h)
+        pos = self.max_seq_len * h if self.pos_emb == "learned" else 0
+        return L * per_layer + emb + pos + norm_width
+
+
+# ---------------------------------------------------------------------------
+# parameter init + logical axes
+# ---------------------------------------------------------------------------
+
+
+def _dense_init(rng, shape, scale=None, dtype=jnp.float32):
+    scale = scale if scale is not None else 1.0 / math.sqrt(shape[0])
+    return jax.random.normal(rng, shape, dtype) * scale
+
+
+def init_params(cfg: TransformerConfig, rng: jax.Array) -> Dict[str, Any]:
+    """Build the full parameter pytree (layer weights stacked on dim 0)."""
+    h, L, f = cfg.hidden_size, cfg.num_layers, cfg.ffn
+    nh, nkv, hd = cfg.num_heads, cfg.kv_heads, cfg.head_dim
+    keys = jax.random.split(rng, 12)
+    pd = cfg.param_dtype
+
+    def stack(fn, key):
+        return jax.vmap(fn)(jax.random.split(key, L))
+
+    params: Dict[str, Any] = {
+        "embed": {"tokens": _dense_init(keys[0], (cfg.vocab_size, h), 0.02, pd)},
+        "layers": {
+            "attn": {
+                "wq": stack(lambda k: _dense_init(k, (h, nh, hd), dtype=pd), keys[1]),
+                "wk": stack(lambda k: _dense_init(k, (h, nkv, hd), dtype=pd), keys[2]),
+                "wv": stack(lambda k: _dense_init(k, (h, nkv, hd), dtype=pd), keys[3]),
+                "wo": stack(
+                    lambda k: _dense_init(k, (nh, hd, h), 1.0 / math.sqrt(nh * hd), pd),
+                    keys[4],
+                ),
+            },
+            "mlp": _init_mlp(cfg, keys[5], L),
+            "ln1": {"scale": jnp.ones((L, h), pd)},
+            "ln2": {"scale": jnp.ones((L, h), pd)},
+        },
+        "final_norm": {"scale": jnp.ones((h,), pd)},
+    }
+    if cfg.norm == "layernorm":
+        params["layers"]["ln1"]["bias"] = jnp.zeros((L, h), pd)
+        params["layers"]["ln2"]["bias"] = jnp.zeros((L, h), pd)
+        params["final_norm"]["bias"] = jnp.zeros((h,), pd)
+    if cfg.pos_emb == "learned":
+        params["embed"]["positions"] = _dense_init(
+            keys[6], (cfg.max_seq_len, h), 0.01, pd
+        )
+    if not cfg.tie_embeddings:
+        params["unembed"] = {"kernel": _dense_init(keys[7], (h, cfg.vocab_size), 0.02, pd)}
+    return params
+
+
+def _init_mlp(cfg, key, L):
+    h, f = cfg.hidden_size, cfg.ffn
+    ks = jax.random.split(key, 3)
+    pd = cfg.param_dtype
+
+    def stack(fn, k):
+        return jax.vmap(fn)(jax.random.split(k, L))
+
+    mlp = {
+        "wi": stack(lambda k: _dense_init(k, (h, f), dtype=pd), ks[0]),
+        "wo": stack(lambda k: _dense_init(k, (f, h), dtype=pd), ks[1]),
+    }
+    if cfg.activation == "swiglu":
+        mlp["wg"] = stack(lambda k: _dense_init(k, (h, f), dtype=pd), ks[2])
+    return mlp
+
+
+def logical_axes(cfg: TransformerConfig) -> Dict[str, Any]:
+    """Logical axis names per param leaf (drives all sharding; see
+    runtime/sharding.py rule tables)."""
+    axes: Dict[str, Any] = {
+        "embed": {"tokens": ("vocab", "embed")},
+        "layers": {
+            "attn": {
+                "wq": ("layers", "embed", "heads", "head_dim"),
+                "wk": ("layers", "embed", "kv_heads", "head_dim"),
+                "wv": ("layers", "embed", "kv_heads", "head_dim"),
+                "wo": ("layers", "heads", "head_dim", "embed"),
+            },
+            "mlp": {
+                "wi": ("layers", "embed", "mlp"),
+                "wo": ("layers", "mlp", "embed"),
+            },
+            "ln1": {"scale": ("layers", "embed")},
+            "ln2": {"scale": ("layers", "embed")},
+        },
+        "final_norm": {"scale": ("embed",)},
+    }
+    if cfg.norm == "layernorm":
+        axes["layers"]["ln1"]["bias"] = ("layers", "embed")
+        axes["layers"]["ln2"]["bias"] = ("layers", "embed")
+        axes["final_norm"]["bias"] = ("embed",)
+    if cfg.pos_emb == "learned":
+        axes["embed"]["positions"] = ("seq", "embed")
+    if cfg.activation == "swiglu":
+        axes["layers"]["mlp"]["wg"] = ("layers", "embed", "mlp")
+    if not cfg.tie_embeddings:
+        axes["unembed"] = {"kernel": ("embed", "vocab")}
+    return axes
+
+
+# ---------------------------------------------------------------------------
+# forward
+# ---------------------------------------------------------------------------
+
+
+def _norm(x, p, kind: str, eps: float):
+    x32 = x.astype(jnp.float32)
+    if kind == "rmsnorm":
+        rms = jnp.sqrt(jnp.mean(x32 * x32, axis=-1, keepdims=True) + eps)
+        out = x32 / rms * p["scale"].astype(jnp.float32)
+    else:
+        mean = jnp.mean(x32, axis=-1, keepdims=True)
+        var = jnp.var(x32, axis=-1, keepdims=True)
+        out = (x32 - mean) / jnp.sqrt(var + eps)
+        out = out * p["scale"].astype(jnp.float32) + p["bias"].astype(jnp.float32)
+    return out.astype(x.dtype)
+
+
+def _rope(x, positions, theta: float):
+    """Rotary embedding on [..., seq, heads, head_dim]."""
+    hd = x.shape[-1]
+    half = hd // 2
+    freqs = 1.0 / (theta ** (jnp.arange(0, half, dtype=jnp.float32) / half))
+    angles = positions[..., None].astype(jnp.float32) * freqs  # [B?, S, half]
+    cos = jnp.cos(angles)[..., None, :]  # broadcast over heads
+    sin = jnp.sin(angles)[..., None, :]
+    x1, x2 = x[..., :half], x[..., half:]
+    out = jnp.concatenate(
+        [x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1
+    )
+    return out.astype(x.dtype)
+
+
+def _attention(q, k, v, cfg: TransformerConfig, causal: bool = True):
+    """Dispatch to the attention impl (Pallas flash on TPU when available)."""
+    from deepspeed_tpu.ops.attention import multi_head_attention
+
+    if cfg.sequence_parallel:
+        from deepspeed_tpu.parallel.ulysses import ulysses_attention
+
+        return ulysses_attention(q, k, v, causal=causal, impl=cfg.attn_impl)
+    return multi_head_attention(q, k, v, causal=causal, impl=cfg.attn_impl)
+
+
+def _layer(cfg: TransformerConfig, x, layer_params, positions):
+    """One transformer block. x: [B, S, H] in cfg.dtype."""
+    ap, mp = layer_params["attn"], layer_params["mlp"]
+    dt = cfg.dtype
+
+    # attention
+    y = _norm(x, layer_params["ln1"], cfg.norm, cfg.norm_eps)
+    q = jnp.einsum("bsh,hnd->bsnd", y, ap["wq"].astype(dt))
+    k = jnp.einsum("bsh,hnd->bsnd", y, ap["wk"].astype(dt))
+    v = jnp.einsum("bsh,hnd->bsnd", y, ap["wv"].astype(dt))
+    if cfg.pos_emb == "rope":
+        q = _rope(q, positions, cfg.rope_theta)
+        k = _rope(k, positions, cfg.rope_theta)
+    q = constrain_activation(q, ("batch", "seq", "heads", None))
+    k = constrain_activation(k, ("batch", "seq", "heads", None))
+    v = constrain_activation(v, ("batch", "seq", "heads", None))
+    if cfg.kv_heads < cfg.num_heads:  # GQA: repeat kv heads
+        rep = cfg.num_heads // cfg.kv_heads
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
+    attn = _attention(q, k, v, cfg)
+    attn = jnp.einsum("bsnd,ndh->bsh", attn, ap["wo"].astype(dt))
+    x = x + constrain_activation(attn, ("batch", "seq", "embed"))
+
+    # mlp
+    y = _norm(x, layer_params["ln2"], cfg.norm, cfg.norm_eps)
+    if cfg.activation == "swiglu":
+        g = jnp.einsum("bsh,hf->bsf", y, mp["wg"].astype(dt))
+        u = jnp.einsum("bsh,hf->bsf", y, mp["wi"].astype(dt))
+        z = jax.nn.silu(g) * u
+    else:
+        z = jax.nn.gelu(jnp.einsum("bsh,hf->bsf", y, mp["wi"].astype(dt)))
+    z = constrain_activation(z, ("batch", "seq", "mlp"))
+    z = jnp.einsum("bsf,fh->bsh", z, mp["wo"].astype(dt))
+    return x + constrain_activation(z, ("batch", "seq", "embed"))
+
+
+_REMAT_POLICIES = {
+    "nothing_saveable": None,  # default jax.checkpoint = save nothing
+    "dots_saveable": "dots_saveable",
+    "dots_with_no_batch_dims_saveable": "dots_with_no_batch_dims_saveable",
+    "none": "everything",
+}
+
+
+def apply(cfg: TransformerConfig, params: Dict[str, Any], tokens: jax.Array,
+          positions: Optional[jax.Array] = None) -> jax.Array:
+    """Forward pass: tokens [B, S] int32 → logits [B, S, V] float32."""
+    B, S = tokens.shape
+    dt = cfg.dtype
+    if positions is None:
+        positions = jnp.arange(S)[None, :]
+
+    x = params["embed"]["tokens"].astype(dt)[tokens]
+    if cfg.pos_emb == "learned":
+        x = x + params["embed"]["positions"].astype(dt)[positions]
+    x = constrain_activation(x, ("batch", "seq", "embed"))
+
+    layer_fn = partial(_layer, cfg)
+    if cfg.remat:
+        policy_name = _REMAT_POLICIES.get(cfg.remat_policy)
+        if policy_name == "everything":
+            pass  # no remat
+        elif policy_name is None:
+            layer_fn = jax.checkpoint(layer_fn)
+        else:
+            layer_fn = jax.checkpoint(
+                layer_fn, policy=getattr(jax.checkpoint_policies, policy_name)
+            )
+
+    def scan_body(carry, layer_params):
+        return layer_fn(carry, layer_params, positions), None
+
+    x, _ = lax.scan(scan_body, x, params["layers"])
+
+    x = _norm(x, params["final_norm"], cfg.norm, cfg.norm_eps)
+    if cfg.tie_embeddings:
+        logits = jnp.einsum("bsh,vh->bsv", x, params["embed"]["tokens"].astype(dt))
+    else:
+        logits = jnp.einsum("bsh,hv->bsv", x, params["unembed"]["kernel"].astype(dt))
+    logits = constrain_activation(logits, ("batch", "seq", "vocab"))
+    return logits.astype(jnp.float32)
+
+
+def loss_fn(cfg: TransformerConfig, params, batch) -> Tuple[jax.Array, Dict]:
+    """Causal-LM cross-entropy. batch: {input_ids [B,S]} or
+    {input_ids, labels, loss_mask}."""
+    tokens = batch["input_ids"]
+    if "labels" in batch:
+        inputs, labels = tokens, batch["labels"]
+    else:
+        inputs, labels = tokens[:, :-1], tokens[:, 1:]
+    logits = apply(cfg, params, inputs)
+    logz = jax.nn.logsumexp(logits, axis=-1)
+    gold = jnp.take_along_axis(logits, labels[..., None], axis=-1)[..., 0]
+    nll = logz - gold
+    mask = batch.get("loss_mask")
+    if mask is None:
+        mask = jnp.ones_like(nll)
+    else:
+        mask = mask.astype(nll.dtype)
+        if mask.shape[1] == tokens.shape[1] and "labels" not in batch:
+            mask = mask[:, 1:]
+    total = jnp.maximum(mask.sum(), 1.0)
+    loss = (nll * mask).sum() / total
+    return loss, {"loss": loss, "ntokens": total}
+
+
+class TransformerLM:
+    """Thin object bundling (config, init, apply, loss, logical_axes) — the
+    'model' handed to deepspeed_tpu.initialize()."""
+
+    def __init__(self, config: TransformerConfig):
+        self.config = config
+
+    def init(self, rng) -> Dict[str, Any]:
+        return init_params(self.config, rng)
+
+    def abstract_params(self, rng=None):
+        """Shapes/dtypes without materializing (the zero.Init analog's
+        first half; see runtime/zero_init.py)."""
+        rng = rng if rng is not None else jax.random.PRNGKey(0)
+        return jax.eval_shape(lambda r: init_params(self.config, r), rng)
+
+    def logical_axes(self) -> Dict[str, Any]:
+        return logical_axes(self.config)
+
+    def apply(self, params, tokens, positions=None):
+        return apply(self.config, params, tokens, positions)
+
+    def loss(self, params, batch):
+        return loss_fn(self.config, params, batch)
+
+    def flops_per_token(self) -> float:
+        return self.config.flops_per_token()
+
+    def num_params(self) -> int:
+        return self.config.num_params()

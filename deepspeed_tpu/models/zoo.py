@@ -1,0 +1,68 @@
+"""Model zoo presets.
+
+Named configurations for the model families the reference ships policies
+for (module_inject/containers/*, inference/v2/model_implementations/*):
+GPT-2 sizes, Llama-2/3, Mistral, Qwen2, Phi-3 — all instances of the
+generic TransformerLM; Mixtral/Qwen-MoE live in models/moe_transformer.py.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+from deepspeed_tpu.models.transformer import TransformerConfig, TransformerLM
+
+
+def _gpt2(h, L, heads, vocab=50257, ctx=1024):
+    return TransformerConfig(
+        vocab_size=vocab, hidden_size=h, num_layers=L, num_heads=heads,
+        max_seq_len=ctx, pos_emb="learned", norm="layernorm",
+        activation="gelu", tie_embeddings=True)
+
+
+def _llama(h, L, heads, kv_heads, ffn, vocab=128256, ctx=8192,
+           theta=500000.0):
+    return TransformerConfig(
+        vocab_size=vocab, hidden_size=h, num_layers=L, num_heads=heads,
+        num_kv_heads=kv_heads, ffn_size=ffn, max_seq_len=ctx, pos_emb="rope",
+        norm="rmsnorm", activation="swiglu", tie_embeddings=False,
+        rope_theta=theta, norm_eps=1e-5)
+
+
+CONFIGS = {
+    # GPT-2 family (reference policy: module_inject/containers/gpt2.py)
+    "gpt2-125m": _gpt2(768, 12, 12),
+    "gpt2-350m": _gpt2(1024, 24, 16),
+    "gpt2-1.3b": _gpt2(2048, 24, 16),
+    # Llama-3 family (reference: inference/v2/model_implementations/llama_v2,
+    # module_inject/containers/llama.py)
+    "llama3-8b": _llama(4096, 32, 32, 8, 14336),
+    "llama3-70b": _llama(8192, 80, 64, 8, 28672),
+    # Llama-2 (32k vocab, theta 1e4)
+    "llama2-7b": _llama(4096, 32, 32, 32, 11008, vocab=32000, ctx=4096,
+                        theta=10000.0),
+    # Mistral-7B (reference: inference/v2/model_implementations/mistral)
+    "mistral-7b": _llama(4096, 32, 32, 8, 14336, vocab=32000, ctx=8192,
+                         theta=10000.0),
+    # Qwen2-7B (reference: inference/v2/model_implementations/qwen_v2)
+    "qwen2-7b": _llama(3584, 28, 28, 4, 18944, vocab=152064, ctx=8192,
+                       theta=1000000.0),
+    # Phi-3-mini (reference: inference/v2/model_implementations/phi)
+    "phi3-mini": _llama(3072, 32, 32, 32, 8192, vocab=32064, ctx=4096,
+                        theta=10000.0),
+    # tiny debug config (reference tests/unit/simple_model.py role)
+    "tiny": TransformerConfig(vocab_size=256, hidden_size=64, num_layers=2,
+                              num_heads=4, max_seq_len=128, remat=False),
+}
+
+
+def get_model(name: str, **overrides) -> TransformerLM:
+    """Instantiate a preset, optionally overriding config fields
+    (e.g. max_seq_len, remat_policy, sequence_parallel)."""
+    if name not in CONFIGS:
+        raise ValueError(f"unknown model '{name}'; known: {sorted(CONFIGS)}")
+    cfg = CONFIGS[name]
+    if overrides:
+        cfg = dataclasses.replace(cfg, **overrides)
+    return TransformerLM(cfg)

@@ -1,0 +1,118 @@
+"""Experiment monitoring backends.
+
+Analog of the reference monitor subsystem (deepspeed/monitor/monitor.py:30
+``MonitorMaster`` fanning out to TensorBoard/WandB/Comet/CSV). Events are
+``(label, value, step)`` triples written only from process 0 (the
+reference writes from rank 0 of each relevant group).
+"""
+
+from __future__ import annotations
+
+import csv
+import os
+from typing import List, Optional, Tuple
+
+import jax
+
+from deepspeed_tpu.utils.logging import logger
+
+Event = Tuple[str, float, int]
+
+
+class _Backend:
+    enabled = False
+
+    def write_events(self, events: List[Event]):
+        raise NotImplementedError
+
+
+class CSVMonitor(_Backend):
+    """reference: monitor/csv_monitor.py"""
+
+    def __init__(self, cfg):
+        self.enabled = cfg.enabled
+        self.output_path = cfg.output_path or "./csv_monitor"
+        self.job_name = cfg.job_name
+        self._files = {}
+        if self.enabled:
+            os.makedirs(os.path.join(self.output_path, self.job_name),
+                        exist_ok=True)
+
+    def write_events(self, events: List[Event]):
+        for label, value, step in events:
+            fname = os.path.join(self.output_path, self.job_name,
+                                 label.replace("/", "_") + ".csv")
+            new = not os.path.exists(fname)
+            with open(fname, "a", newline="") as f:
+                w = csv.writer(f)
+                if new:
+                    w.writerow(["step", label])
+                w.writerow([step, value])
+
+
+class TensorBoardMonitor(_Backend):
+    """reference: monitor/tensorboard.py — uses torch's pure-python
+    SummaryWriter (torch-cpu is available on TPU hosts)."""
+
+    def __init__(self, cfg):
+        self.enabled = False
+        if not cfg.enabled:
+            return
+        try:
+            from torch.utils.tensorboard import SummaryWriter
+
+            path = os.path.join(cfg.output_path or "./runs", cfg.job_name)
+            self.writer = SummaryWriter(log_dir=path)
+            self.enabled = True
+        except Exception as e:
+            logger.warning(f"tensorboard monitor unavailable: {e}")
+
+    def write_events(self, events: List[Event]):
+        for label, value, step in events:
+            self.writer.add_scalar(label, value, step)
+        self.writer.flush()
+
+
+class WandbMonitor(_Backend):
+    """reference: monitor/wandb.py — wandb is not in the image; gated."""
+
+    def __init__(self, cfg):
+        self.enabled = False
+        if not cfg.enabled:
+            return
+        try:
+            import wandb
+
+            wandb.init(project=cfg.project, group=cfg.group, name=cfg.job_name)
+            self._wandb = wandb
+            self.enabled = True
+        except Exception as e:
+            logger.warning(f"wandb monitor unavailable: {e}")
+
+    def write_events(self, events: List[Event]):
+        for label, value, step in events:
+            self._wandb.log({label: value}, step=step)
+
+
+class MonitorMaster:
+    """Fan-out writer (reference monitor/monitor.py:30)."""
+
+    def __init__(self, monitor_config):
+        self.backends: List[_Backend] = []
+        if jax.process_index() == 0:
+            for backend_cls, cfg in (
+                (TensorBoardMonitor, monitor_config.tensorboard),
+                (CSVMonitor, monitor_config.csv_monitor),
+                (WandbMonitor, monitor_config.wandb),
+            ):
+                b = backend_cls(cfg)
+                if b.enabled:
+                    self.backends.append(b)
+
+    @property
+    def enabled(self) -> bool:
+        return bool(self.backends)
+
+    def write_events(self, events: List[Event]):
+        for b in self.backends:
+            b.write_events(events)

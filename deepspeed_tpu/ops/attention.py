@@ -1,0 +1,69 @@
+"""Attention ops: XLA reference impl + Pallas flash kernel dispatch.
+
+Covers the role of the reference's fused attention kernels
+(csrc/transformer/*softmax*.cu, inference flash kernels
+inference/v2/kernels/ragged_ops/blocked_flash). The ``impl='auto'`` path
+picks the Pallas flash kernel on TPU (ops/pallas/flash_attention.py) and
+falls back to the XLA einsum implementation elsewhere — the op-builder
+``is_compatible`` pattern (op_builder/builder.py:116) reduced to a runtime
+platform probe.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -1e30
+
+
+@functools.lru_cache(None)
+def _flash_available() -> bool:
+    if jax.default_backend() != "tpu":
+        return False
+    try:
+        from deepspeed_tpu.ops.pallas import flash_attention  # noqa: F401
+
+        return True
+    except Exception:
+        return False
+
+
+def xla_attention(q, k, v, causal: bool = True,
+                  segment_ids: Optional[jax.Array] = None) -> jax.Array:
+    """Reference attention. q,k,v: [B, S, N, D] (kv heads already repeated).
+
+    Softmax in fp32 regardless of input dtype (numerics parity with the
+    reference's attn_softmax kernels, csrc/transformer/softmax_kernels.cu).
+    """
+    dt = q.dtype
+    d = q.shape[-1]
+    scores = jnp.einsum("bqnd,bknd->bnqk", q, k).astype(jnp.float32)
+    scores = scores / jnp.sqrt(jnp.asarray(d, jnp.float32))
+    Sq, Sk = scores.shape[-2], scores.shape[-1]
+    if causal:
+        qpos = jnp.arange(Sq)[:, None] + (Sk - Sq)
+        kpos = jnp.arange(Sk)[None, :]
+        scores = jnp.where(kpos <= qpos, scores, NEG_INF)
+    if segment_ids is not None:
+        same = segment_ids[:, :, None] == segment_ids[:, None, :]
+        scores = jnp.where(same[:, None, :, :], scores, NEG_INF)
+    probs = jax.nn.softmax(scores, axis=-1).astype(dt)
+    return jnp.einsum("bnqk,bknd->bqnd", probs, v)
+
+
+def multi_head_attention(q, k, v, causal: bool = True, impl: str = "auto",
+                         segment_ids: Optional[jax.Array] = None) -> jax.Array:
+    """Dispatching entry point used by the model zoo."""
+    if impl == "flash" or (impl == "auto" and _flash_available()):
+        try:
+            from deepspeed_tpu.ops.pallas.flash_attention import flash_attention
+
+            return flash_attention(q, k, v, causal=causal, segment_ids=segment_ids)
+        except Exception:
+            if impl == "flash":
+                raise
+    return xla_attention(q, k, v, causal=causal, segment_ids=segment_ids)

@@ -1,0 +1,72 @@
+"""Ulysses sequence parallelism, the GSPMD way.
+
+The reference implements Ulysses (deepspeed/sequence/layer.py:351
+``DistributedAttention``) with two explicit all-to-alls: qkv arrive
+sequence-sharded [s/p, h]; an all-to-all regroups to head-sharded [s, h/p];
+local attention runs over the full sequence; a second all-to-all restores
+sequence sharding (``_SeqAllToAll`` sequence/layer.py:297,
+``single_all_to_all`` :241).
+
+On TPU the same dataflow is expressed as two sharding constraints: change
+the activation's PartitionSpec from seq-sharded to head-sharded and GSPMD
+emits the all-to-all on the sp axis of the ICI mesh — including the
+comm/compute overlap the reference builds by hand with side streams
+(sequence/layer.py fwd :387), courtesy of XLA's latency-hiding scheduler.
+
+Uneven head counts (reference uneven_heads_all2all sequence/layer.py:131)
+need no special casing: GSPMD handles non-divisible shardings by padding.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from deepspeed_tpu.parallel import topology
+from deepspeed_tpu.utils.comms_logging import get_comms_logger
+
+BATCH = ("dp", "fsdp", "ep")
+
+
+def _constrain(x, spec: P):
+    mesh = topology._GLOBAL_MESH
+    if mesh is None:
+        return x
+    return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, spec))
+
+
+def ulysses_attention(q, k, v, causal: bool = True, impl: str = "auto",
+                      segment_ids: Optional[jax.Array] = None):
+    """Attention over a sequence-sharded input.
+
+    q,k,v: [B, S, N, D] logically; physically S is sharded over sp on entry
+    and exit. Inside, heads are sharded over (tp, sp) and S is full — the
+    head-scatter layout of the reference's DistributedAttention.forward.
+    """
+    from deepspeed_tpu.ops.attention import multi_head_attention
+
+    mesh = topology._GLOBAL_MESH
+    if mesh is None or mesh.shape["sp"] == 1:
+        return multi_head_attention(q, k, v, causal=causal, impl=impl,
+                                    segment_ids=segment_ids)
+
+    logger = get_comms_logger()
+    for t in (q, k, v):
+        logger.record("all_to_all", t.size * t.dtype.itemsize, "sp",
+                      "ulysses_qkv")
+
+    # seq-sharded -> head-sharded (all-to-all #1, on ICI)
+    inner = P(BATCH, None, ("tp", "sp"), None)
+    q = _constrain(q, inner)
+    k = _constrain(k, inner)
+    v = _constrain(v, inner)
+
+    out = multi_head_attention(q, k, v, causal=causal, impl=impl,
+                               segment_ids=segment_ids)
+
+    logger.record("all_to_all", out.size * out.dtype.itemsize, "sp",
+                  "ulysses_out")
+    # head-sharded -> seq-sharded (all-to-all #2)
+    return _constrain(out, P(BATCH, "sp", "tp", None))

@@ -1,0 +1,116 @@
+"""Data loading.
+
+Analog of the reference's ``DeepSpeedDataLoader`` + ``RepeatingLoader``
+(runtime/dataloader.py) without a torch dependency: batches are numpy
+pytrees; each host loads only its process's slice of the global batch and
+the engine assembles the global sharded array
+(jax.make_array_from_process_local_data).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Callable, Iterable, Iterator, Optional, Sequence
+
+import numpy as np
+
+
+def default_collate(samples: Sequence[Any]):
+    """Stack a list of sample pytrees into a batch pytree."""
+    import jax
+
+    return jax.tree.map(lambda *xs: np.stack([np.asarray(x) for x in xs]),
+                        *samples)
+
+
+class DeepSpeedDataLoader:
+    """Batches an indexable or iterable dataset for this host.
+
+    With multiple processes, each host reads its contiguous shard of the
+    sample space (data-parallel sharding, reference
+    DistributedSampler-equivalent behavior in runtime/dataloader.py).
+    """
+
+    def __init__(self, dataset, batch_size: int,
+                 collate_fn: Optional[Callable] = None,
+                 shuffle: bool = False, seed: int = 0,
+                 drop_last: bool = True):
+        import jax
+
+        self.dataset = dataset
+        self.batch_size = batch_size
+        self.collate_fn = collate_fn or default_collate
+        self.shuffle = shuffle
+        self.seed = seed
+        self.drop_last = drop_last
+        self._epoch = 0
+        self._num_procs = jax.process_count()
+        self._proc_id = jax.process_index()
+        try:
+            self._len = len(dataset)
+        except TypeError:
+            self._len = None
+
+    def __len__(self):
+        if self._len is None:
+            raise TypeError("iterable dataset has no length")
+        per_proc = self._len // self._num_procs
+        n = per_proc // self.batch_size
+        if not self.drop_last and per_proc % self.batch_size:
+            n += 1
+        return n
+
+    def set_epoch(self, epoch: int):
+        self._epoch = epoch
+
+    def __iter__(self) -> Iterator:
+        if self._len is None:
+            return self._iter_stream()
+        return self._iter_indexed()
+
+    def _iter_indexed(self):
+        idx = np.arange(self._len)
+        if self.shuffle:
+            rng = np.random.default_rng(self.seed + self._epoch)
+            rng.shuffle(idx)
+        per_proc = self._len // self._num_procs
+        idx = idx[self._proc_id * per_proc:(self._proc_id + 1) * per_proc]
+        end = per_proc - (per_proc % self.batch_size) if self.drop_last else per_proc
+        for start in range(0, end, self.batch_size):
+            chunk = idx[start:start + self.batch_size]
+            yield self.collate_fn([self.dataset[int(i)] for i in chunk])
+
+    def _iter_stream(self):
+        buf = []
+        for i, sample in enumerate(self.dataset):
+            if i % self._num_procs != self._proc_id:
+                continue
+            buf.append(sample)
+            if len(buf) == self.batch_size:
+                yield self.collate_fn(buf)
+                buf = []
+        if buf and not self.drop_last:
+            yield self.collate_fn(buf)
+
+
+class RepeatingLoader:
+    """Wraps an iterator to restart on StopIteration (reference
+    runtime/dataloader.py RepeatingLoader)."""
+
+    def __init__(self, loader):
+        self.loader = loader
+        self.data_iter = iter(self.loader)
+        self._epoch = 0
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        try:
+            return next(self.data_iter)
+        except StopIteration:
+            self._epoch += 1
+            if hasattr(self.loader, "set_epoch"):
+                self.loader.set_epoch(self._epoch)
+            self.data_iter = iter(self.loader)
+            return next(self.data_iter)

@@ -1,0 +1,59 @@
+"""Dynamic loss scaling for fp16 (reference: runtime/fp16/loss_scaler.py:187
+``DynamicLossScaler``). bf16 training doesn't need this; it exists for
+fp16 parity and engages only when ``fp16.enabled`` is set.
+
+Implemented as a pure state transition so it lives inside the compiled
+train step: scale the loss up, unscale grads, detect inf/nan, and on
+overflow skip the update and halve the scale; after ``scale_window``
+clean steps, double it.
+"""
+
+from __future__ import annotations
+
+from typing import Any, NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+class LossScaleState(NamedTuple):
+    scale: jax.Array  # f32 scalar
+    good_steps: jax.Array  # i32 scalar
+
+
+def init_loss_scale(config) -> LossScaleState:
+    """From the fp16 config block (reference fp16/loss_scaler.py:238)."""
+    if config.loss_scale and config.loss_scale > 0:
+        scale = float(config.loss_scale)  # static scale
+    else:
+        scale = float(2.0 ** config.initial_scale_power)
+    return LossScaleState(
+        scale=jnp.asarray(scale, jnp.float32),
+        good_steps=jnp.asarray(0, jnp.int32),
+    )
+
+
+def has_overflow(grads) -> jax.Array:
+    """Global inf/nan scan (reference _has_inf_or_nan stage3.py:2704)."""
+    leaves = jax.tree.leaves(grads)
+    flags = [jnp.logical_not(jnp.all(jnp.isfinite(g))) for g in leaves]
+    out = flags[0]
+    for f in flags[1:]:
+        out = jnp.logical_or(out, f)
+    return out
+
+
+def update_loss_scale(state: LossScaleState, overflow: jax.Array, config
+                      ) -> LossScaleState:
+    if config.loss_scale and config.loss_scale > 0:
+        return state  # static scaling never adjusts
+    window = config.loss_scale_window
+    min_scale = config.min_loss_scale
+    shrunk = jnp.maximum(state.scale / 2.0, min_scale)
+    grown = jnp.where(state.good_steps + 1 >= window, state.scale * 2.0,
+                      state.scale)
+    new_scale = jnp.where(overflow, shrunk, grown)
+    new_good = jnp.where(
+        overflow, 0, jnp.where(state.good_steps + 1 >= window, 0,
+                               state.good_steps + 1))
+    return LossScaleState(new_scale, new_good.astype(jnp.int32))

@@ -191,3 +191,19 @@ def make_sharding_plan(config: Config, mesh: Mesh) -> ShardingPlan:
 
 def named_sharding(mesh: Mesh, *spec) -> NamedSharding:
     return NamedSharding(mesh, PartitionSpec(*spec))
+
+
+def constrain_activation(x, logical_axes: Sequence[Optional[str]]):
+    """Apply the activation sharding rules to an intermediate value.
+
+    Usable inside jit-compiled model code; a no-op when no global mesh is
+    set (e.g. plain single-device unit tests). This is how models declare
+    batch/sequence sharding (dp/fsdp/ep × sp) without knowing the topology.
+    """
+    from deepspeed_tpu.parallel import topology
+
+    mesh = topology._GLOBAL_MESH
+    if mesh is None or all(s == 1 for s in mesh.shape.values()):
+        return x
+    spec = spec_from_logical(logical_axes, ACT_RULES + TP_RULES)
+    return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, spec))

@@ -1,0 +1,101 @@
+"""Model zoo tests (reference analog: tests/unit/simple_model.py fixtures
++ model correctness checks)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.models.transformer import (
+    TransformerConfig, TransformerLM, init_params, logical_axes)
+
+
+GPT2_TINY = TransformerConfig(
+    vocab_size=64, hidden_size=32, num_layers=2, num_heads=4,
+    max_seq_len=32, pos_emb="learned", norm="layernorm",
+    activation="gelu", tie_embeddings=True, remat=False)
+
+LLAMA_TINY = TransformerConfig(
+    vocab_size=64, hidden_size=32, num_layers=2, num_heads=4, num_kv_heads=2,
+    max_seq_len=32, pos_emb="rope", norm="rmsnorm", activation="swiglu",
+    tie_embeddings=False, remat=False)
+
+
+@pytest.mark.parametrize("cfg", [GPT2_TINY, LLAMA_TINY], ids=["gpt2", "llama"])
+def test_init_and_axes_structure_match(cfg):
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    axes = logical_axes(cfg)
+    jax.tree.map(lambda p, a: None, params, axes)  # same structure or raises
+    for leaf, ax in zip(jax.tree.leaves(params), jax.tree.leaves(
+            axes, is_leaf=lambda x: isinstance(x, tuple))):
+        assert leaf.ndim == len(ax), f"{leaf.shape} vs {ax}"
+
+
+@pytest.mark.parametrize("cfg", [GPT2_TINY, LLAMA_TINY], ids=["gpt2", "llama"])
+def test_forward_shapes_and_finite(cfg):
+    model = TransformerLM(cfg)
+    params = model.init(jax.random.PRNGKey(0))
+    tokens = jnp.zeros((2, 16), jnp.int32)
+    logits = model.apply(params, tokens)
+    assert logits.shape == (2, 16, cfg.vocab_size)
+    assert logits.dtype == jnp.float32
+    assert bool(jnp.all(jnp.isfinite(logits)))
+
+
+def test_loss_decreases_under_sgd():
+    model = TransformerLM(GPT2_TINY)
+    params = model.init(jax.random.PRNGKey(0))
+    rng = np.random.default_rng(0)
+    batch = {"input_ids": jnp.asarray(rng.integers(0, 64, (4, 17)), jnp.int32)}
+
+    @jax.jit
+    def step(params):
+        (loss, _), grads = jax.value_and_grad(model.loss, has_aux=True)(params, batch)
+        params = jax.tree.map(lambda p, g: p - 0.1 * g.astype(p.dtype), params, grads)
+        return params, loss
+
+    losses = []
+    for _ in range(10):
+        params, loss = step(params)
+        losses.append(float(loss))
+    assert losses[-1] < losses[0] - 0.5, losses
+
+
+def test_causality():
+    """Changing a future token must not change past logits."""
+    model = TransformerLM(GPT2_TINY)
+    params = model.init(jax.random.PRNGKey(0))
+    t1 = jnp.zeros((1, 8), jnp.int32)
+    t2 = t1.at[0, 7].set(5)
+    l1 = model.apply(params, t1)
+    l2 = model.apply(params, t2)
+    np.testing.assert_allclose(np.asarray(l1[0, :7]), np.asarray(l2[0, :7]),
+                               atol=1e-5)
+    assert not np.allclose(np.asarray(l1[0, 7]), np.asarray(l2[0, 7]))
+
+
+def test_gqa_repeat_matches_full_heads():
+    cfg = LLAMA_TINY
+    assert cfg.kv_heads == 2 and cfg.num_heads == 4
+    model = TransformerLM(cfg)
+    params = model.init(jax.random.PRNGKey(1))
+    tokens = jnp.arange(16, dtype=jnp.int32).reshape(1, 16) % 64
+    logits = model.apply(params, tokens)
+    assert bool(jnp.all(jnp.isfinite(logits)))
+
+
+def test_remat_matches_no_remat():
+    cfg_r = TransformerConfig(**{**GPT2_TINY.__dict__, "remat": True})
+    model_r, model_n = TransformerLM(cfg_r), TransformerLM(GPT2_TINY)
+    params = model_n.init(jax.random.PRNGKey(0))
+    tokens = jnp.arange(16, dtype=jnp.int32).reshape(1, 16) % 64
+    np.testing.assert_allclose(
+        np.asarray(model_r.apply(params, tokens)),
+        np.asarray(model_n.apply(params, tokens)), atol=1e-5)
+
+
+def test_num_params_matches_tree():
+    for cfg in (GPT2_TINY, LLAMA_TINY):
+        params = init_params(cfg, jax.random.PRNGKey(0))
+        actual = sum(int(np.prod(p.shape)) for p in jax.tree.leaves(params))
+        assert actual == cfg.num_params(), (actual, cfg.num_params())
