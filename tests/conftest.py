@@ -27,6 +27,16 @@ jax.config.update("jax_num_cpu_devices", 8)
 import pytest  # noqa: E402
 
 
+@pytest.fixture(autouse=True)
+def _reset_global_mesh():
+    """Tests must not inherit another test's mesh (engine sets a global)."""
+    from deepspeed_tpu.parallel import topology
+
+    topology._GLOBAL_MESH = None
+    yield
+    topology._GLOBAL_MESH = None
+
+
 @pytest.fixture(scope="session")
 def devices():
     devs = jax.devices()
