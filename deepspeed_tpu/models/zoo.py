@@ -57,6 +57,33 @@ CONFIGS = {
 }
 
 
+def _register_moe():
+    from deepspeed_tpu.models.moe_transformer import MoETransformerConfig
+
+    def _moe(h, L, heads, kv, ffn, E, k, vocab, ctx, theta):
+        return MoETransformerConfig(
+            vocab_size=vocab, hidden_size=h, num_layers=L, num_heads=heads,
+            num_kv_heads=kv, ffn_size=ffn, max_seq_len=ctx, pos_emb="rope",
+            norm="rmsnorm", activation="swiglu", tie_embeddings=False,
+            rope_theta=theta, num_experts=E, top_k=k)
+
+    CONFIGS.update({
+        # Mixtral-8x7B (reference: inference/v2/model_implementations/mixtral)
+        "mixtral-8x7b": _moe(4096, 32, 32, 8, 14336, E=8, k=2,
+                             vocab=32000, ctx=32768, theta=1000000.0),
+        # Qwen2-MoE-A14B-style (reference: .../qwen_v2_moe)
+        "qwen2-moe-a14b": _moe(3584, 28, 28, 4, 2560, E=64, k=8,
+                               vocab=151936, ctx=8192, theta=1000000.0),
+        "tiny-moe": MoETransformerConfig(
+            vocab_size=256, hidden_size=64, num_layers=2, num_heads=4,
+            max_seq_len=128, pos_emb="rope", norm="rmsnorm",
+            activation="swiglu", remat=False, num_experts=4, top_k=2),
+    })
+
+
+_register_moe()
+
+
 def get_model(name: str, **overrides) -> TransformerLM:
     """Instantiate a preset, optionally overriding config fields
     (e.g. max_seq_len, remat_policy, sequence_parallel)."""
@@ -65,4 +92,9 @@ def get_model(name: str, **overrides) -> TransformerLM:
     cfg = CONFIGS[name]
     if overrides:
         cfg = dataclasses.replace(cfg, **overrides)
+    from deepspeed_tpu.models.moe_transformer import (
+        MoETransformerConfig, MoETransformerLM)
+
+    if isinstance(cfg, MoETransformerConfig):
+        return MoETransformerLM(cfg)
     return TransformerLM(cfg)
