@@ -55,15 +55,30 @@ def xla_attention(q, k, v, causal: bool = True,
     return jnp.einsum("bnqk,bknd->bqnd", probs, v)
 
 
+# Below this sequence length XLA's fused attention beats the Pallas kernel
+# on-chip (measured on v5e: 2048 → XLA ~2.5x faster; 8192 → flash ~5x
+# faster and XLA's [S,S] scores OOM at batch ≥ 2).
+FLASH_MIN_SEQ = 4096
+
+
 def multi_head_attention(q, k, v, causal: bool = True, impl: str = "auto",
                          segment_ids: Optional[jax.Array] = None) -> jax.Array:
     """Dispatching entry point used by the model zoo."""
-    if impl == "flash" or (impl == "auto" and _flash_available()):
+    seq = q.shape[1]
+    want_flash = (
+        impl == "flash"
+        or (impl == "auto" and _flash_available() and seq >= FLASH_MIN_SEQ
+            and causal and segment_ids is None)
+    )
+    if want_flash:
         try:
             from deepspeed_tpu.ops.pallas.flash_attention import flash_attention
 
-            return flash_attention(q, k, v, causal=causal, segment_ids=segment_ids)
-        except Exception:
+            block = 512 if seq >= FLASH_MIN_SEQ else 128
+            return flash_attention(q, k, v, causal=causal,
+                                   segment_ids=segment_ids,
+                                   block_q=block, block_k=block)
+        except NotImplementedError:
             if impl == "flash":
                 raise
     return xla_attention(q, k, v, causal=causal, segment_ids=segment_ids)
