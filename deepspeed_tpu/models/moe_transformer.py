@@ -112,8 +112,11 @@ def logical_axes(cfg: MoETransformerConfig) -> Dict[str, Any]:
 def _moe_layer(cfg: MoETransformerConfig, x, layer_params, positions,
                train: bool):
     """Transformer block with MoE FFN. Returns (x, l_aux_sum)."""
+    from deepspeed_tpu.runtime.sharding import effective_dtype
+
     ap = layer_params["attn"]
-    dt = cfg.dtype
+    dt = effective_dtype(cfg.dtype)
+    x = x.astype(dt)
 
     y = tfm._norm(x, layer_params["ln1"], cfg.norm, cfg.norm_eps)
     q = jnp.einsum("bsh,hnd->bsnd", y, ap["wq"].astype(dt))

@@ -246,8 +246,11 @@ def _attention(q, k, v, cfg: TransformerConfig, causal: bool = True):
 
 def _layer(cfg: TransformerConfig, x, layer_params, positions):
     """One transformer block. x: [B, S, H] in cfg.dtype."""
+    from deepspeed_tpu.runtime.sharding import effective_dtype
+
     ap, mp = layer_params["attn"], layer_params["mlp"]
-    dt = cfg.dtype
+    dt = effective_dtype(cfg.dtype)
+    x = x.astype(dt)
 
     # attention
     y = _norm(x, layer_params["ln1"], cfg.norm, cfg.norm_eps)
@@ -303,21 +306,31 @@ def apply(cfg: TransformerConfig, params: Dict[str, Any], tokens: jax.Array,
     x = constrain_activation(x, ("batch", "seq", "embed"))
 
     layer_fn = partial(_layer, cfg)
-    if cfg.remat:
-        policy_name = _REMAT_POLICIES.get(cfg.remat_policy)
-        if policy_name == "everything":
-            pass  # no remat
-        elif policy_name is None:
-            layer_fn = jax.checkpoint(layer_fn)
-        else:
-            layer_fn = jax.checkpoint(
-                layer_fn, policy=getattr(jax.checkpoint_policies, policy_name)
-            )
 
-    def scan_body(carry, layer_params):
-        return layer_fn(carry, layer_params, positions), None
+    from deepspeed_tpu.parallel import topology as _topo
+    from deepspeed_tpu.parallel.pipeline import pipeline_enabled, pipelined_layers
 
-    x, _ = lax.scan(scan_body, x, params["layers"])
+    if pipeline_enabled(_topo._GLOBAL_MESH):
+        # pp > 1: run the layer stack as a microbatched stage pipeline
+        # (remat is applied per stage inside pipelined_layers)
+        x = pipelined_layers(
+            lambda c, lp: layer_fn(c, lp, positions), params["layers"], x)
+    else:
+        if cfg.remat:
+            policy_name = _REMAT_POLICIES.get(cfg.remat_policy)
+            if policy_name == "everything":
+                pass  # no remat
+            elif policy_name is None:
+                layer_fn = jax.checkpoint(layer_fn)
+            else:
+                layer_fn = jax.checkpoint(
+                    layer_fn, policy=getattr(jax.checkpoint_policies, policy_name)
+                )
+
+        def scan_body(carry, layer_params):
+            return layer_fn(carry, layer_params, positions), None
+
+        x, _ = lax.scan(scan_body, x, params["layers"])
 
     x = _norm(x, params["final_norm"], cfg.norm, cfg.norm_eps)
     if cfg.tie_embeddings:

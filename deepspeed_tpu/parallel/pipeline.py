@@ -1,0 +1,128 @@
+"""Pipeline parallelism: microbatched stage pipeline over the pp mesh axis.
+
+Reference: runtime/pipe/ — ``PipelineModule`` (module.py:86) partitions
+layers into stages, ``PipelineEngine`` (engine.py:60) interprets a 1F1B
+instruction schedule (schedule.py:189) with explicit P2P send/recv
+(p2p.py:46,67).
+
+TPU-native redesign: the schedule is a ``lax.scan`` over
+``M + P - 1`` pipeline steps inside a shard_map that is *manual only over
+pp* (other mesh axes stay under GSPMD, so fsdp/tp/sp sharding of each
+stage's weights keeps working inside). Stage-to-stage transfer is a
+``ppermute`` ring shift — the P2P of p2p.py as an ICI/DCN collective.
+Autodiff through scan+ppermute yields the backward pipeline (reverse
+schedule, reversed ring) with no instruction interpreter; remat on the
+stage body keeps per-microbatch liveness at the stage boundary, the role
+of the reference's activation-checkpoint interval (pipe/module.py:340).
+
+GPipe-flavored: all M forward steps run before backward begins (autodiff
+order), so weight versioning/interleaving issues don't arise; bubble
+fraction is (P-1)/(M+P-1) per direction — choose M >= 2P.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from deepspeed_tpu.parallel import topology as topo
+
+
+def pipeline_enabled(mesh: Optional[Mesh]) -> bool:
+    return mesh is not None and mesh.shape.get("pp", 1) > 1
+
+
+def pipelined_layers(layer_fn: Callable, stacked_params: Any, x: jax.Array,
+                     num_microbatches: Optional[int] = None) -> jax.Array:
+    """Run ``scan(layer_fn)`` over [L, ...]-stacked params as a pp-stage
+    pipeline.
+
+    layer_fn(carry, layer_params) -> carry, with carry [mb, S, H].
+    x: [B, S, H]; B must divide into num_microbatches (default 2*pp).
+    Returns [B, S, H] replicated over pp.
+    """
+    mesh = topo.get_global_mesh()
+    PP = mesh.shape["pp"]
+    B = x.shape[0]
+    M = num_microbatches or min(B, 2 * PP)
+    while B % M != 0:
+        M -= 1
+    assert M >= 1
+
+    L = jax.tree.leaves(stacked_params)[0].shape[0]
+    assert L % PP == 0, f"num_layers {L} must divide pp {PP}"
+
+    xs = x.reshape(M, B // M, *x.shape[1:])  # [M, mb, S, H]
+
+    def per_stage(params_stage, xs_local):
+        # params_stage leaves: [L/PP, ...]; xs_local: [M, mb, S, H]
+        stage = lax.axis_index("pp")
+        steps = M + PP - 1
+        fwd_perm = [(i, (i + 1) % PP) for i in range(PP)]
+
+        def stage_fn(inp, params_stage):
+            out, _ = lax.scan(lambda c, p: (layer_fn(c, p), None),
+                              inp, params_stage)
+            return out
+
+        stage_fn = jax.checkpoint(stage_fn)
+
+        def body(carry, t):
+            buf = carry  # activations arriving from the previous stage
+            mb_idx = jnp.clip(t, 0, M - 1)
+            inp = jnp.where(stage == 0, xs_local[mb_idx], buf)
+            out = stage_fn(inp, params_stage)
+            nxt = lax.ppermute(out, "pp", fwd_perm)
+            is_valid = jnp.logical_and(stage == PP - 1, t >= PP - 1)
+            y = jnp.where(is_valid, out, jnp.zeros_like(out))
+            return nxt, y
+
+        _, ys = lax.scan(body, jnp.zeros_like(xs_local[0]),
+                         jnp.arange(steps))
+        ys = ys[PP - 1:]  # [M, mb, S, H] — real only on the last stage
+        # replicate the last stage's result to every stage (out_specs P())
+        return lax.psum(jnp.where(stage == PP - 1, ys,
+                                  jnp.zeros_like(ys)), "pp")
+
+    from deepspeed_tpu.runtime.sharding import disable_constraints, force_f32
+
+    # XLA's CPU backend crashes ("Invalid binary instruction opcode copy")
+    # on bf16 inside a partial-manual shard_map; upcast the pipeline region
+    # to f32 on CPU only (simulation/tests). TPU runs native bf16.
+    cast_f32 = (jax.default_backend() == "cpu"
+                and any(l.dtype == jnp.bfloat16
+                        for l in jax.tree.leaves((stacked_params, x))))
+    orig_dtype = x.dtype
+    if cast_f32:
+        to32 = lambda t: (t.astype(jnp.float32)
+                          if t.dtype == jnp.bfloat16 else t)
+        stacked_params = jax.tree.map(to32, stacked_params)
+        x = to32(x)
+        xs = x.reshape(M, B // M, *x.shape[1:])
+
+    param_specs = jax.tree.map(lambda _: P("pp"), stacked_params)
+    ctx2 = force_f32() if cast_f32 else _null()
+    with disable_constraints(), ctx2:
+        out = jax.shard_map(
+            per_stage,
+            mesh=mesh,
+            in_specs=(param_specs, P()),
+            out_specs=P(),
+            axis_names=frozenset({"pp"}),
+            check_vma=False,
+        )(stacked_params, xs)
+    out = out.reshape(B, *x.shape[1:])
+    return out.astype(orig_dtype) if cast_f32 else out
+
+
+class _null:
+    def __enter__(self):
+        return None
+
+    def __exit__(self, *a):
+        return False

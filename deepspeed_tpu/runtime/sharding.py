@@ -193,6 +193,47 @@ def named_sharding(mesh: Mesh, *spec) -> NamedSharding:
     return NamedSharding(mesh, PartitionSpec(*spec))
 
 
+# trace-time switch: inside partial-manual shard_map regions (the pp
+# pipeline) sharding constraints on auto axes trip an XLA SPMD bug
+# ("Invalid binary instruction opcode copy"); the pipeline disables them
+# while tracing its body — batch/tp shardings still propagate from inputs.
+_CONSTRAINTS_DISABLED = False
+_FORCE_F32 = False
+
+
+class disable_constraints:
+    def __enter__(self):
+        global _CONSTRAINTS_DISABLED
+        self._prev = _CONSTRAINTS_DISABLED
+        _CONSTRAINTS_DISABLED = True
+
+    def __exit__(self, *a):
+        global _CONSTRAINTS_DISABLED
+        _CONSTRAINTS_DISABLED = self._prev
+        return False
+
+
+class force_f32:
+    """Trace-time override: model bodies compute in f32 (CPU shard_map
+    bf16 workaround — see parallel/pipeline.py)."""
+
+    def __enter__(self):
+        global _FORCE_F32
+        self._prev = _FORCE_F32
+        _FORCE_F32 = True
+
+    def __exit__(self, *a):
+        global _FORCE_F32
+        _FORCE_F32 = self._prev
+        return False
+
+
+def effective_dtype(requested):
+    import jax.numpy as jnp
+
+    return jnp.float32 if _FORCE_F32 else requested
+
+
 def constrain_activation(x, logical_axes: Sequence[Optional[str]]):
     """Apply the activation sharding rules to an intermediate value.
 
@@ -202,6 +243,8 @@ def constrain_activation(x, logical_axes: Sequence[Optional[str]]):
     """
     from deepspeed_tpu.parallel import topology
 
+    if _CONSTRAINTS_DISABLED:
+        return x
     mesh = topology._GLOBAL_MESH
     if mesh is None or all(s == 1 for s in mesh.shape.values()):
         return x
