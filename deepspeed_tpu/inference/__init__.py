@@ -1,1 +1,10 @@
+"""Inference stack: v1-style TP engine + FastGen-style ragged engine.
 
+Reference: deepspeed/inference/ (engine.py:40 InferenceEngine,
+v2/engine_v2.py:30 InferenceEngineV2).
+"""
+
+from deepspeed_tpu.inference.engine import InferenceEngine, init_inference
+from deepspeed_tpu.inference.engine_v2 import InferenceEngineV2
+
+__all__ = ["InferenceEngine", "InferenceEngineV2", "init_inference"]

@@ -1,0 +1,203 @@
+"""Compiled inference forwards over the TransformerLM param tree.
+
+Two paths, both reusing models/transformer.py weights unchanged:
+
+  * ``forward_with_cache`` — dense per-batch KV cache, for the v1-style
+    engine (reference: fused inference kernels consuming a contiguous
+    cache, csrc/transformer/inference).
+  * ``ragged_forward`` — paged/blocked KV with flat-token ragged batches,
+    for the FastGen-style engine (reference: inference/v2 ragged kernels:
+    blocked flash attention + fused rotary/KV-append,
+    inference/v2/kernels/ragged_ops/). On TPU the KV append is an XLA
+    scatter fused into the step, and attention runs over gathered pages;
+    a Pallas paged-attention kernel can swap in behind the same signature.
+
+Both are pure functions: (params, cache, metadata) -> (logits, cache'),
+jitted once per shape bucket (the CUDA-graph analog, engine.py:497).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from deepspeed_tpu.models.transformer import (
+    TransformerConfig, _norm, _rope)
+from deepspeed_tpu.runtime.sharding import effective_dtype
+
+
+def _qkv(cfg: TransformerConfig, layer_params, y, positions):
+    """Project y [..., H] to q/k/v with rope applied. Returns q [.., nh, hd],
+    k/v [.., nkv, hd] (GQA heads NOT repeated — cache stays small)."""
+    ap = layer_params["attn"]
+    dt = y.dtype
+    q = jnp.einsum("...h,hnd->...nd", y, ap["wq"].astype(dt))
+    k = jnp.einsum("...h,hnd->...nd", y, ap["wk"].astype(dt))
+    v = jnp.einsum("...h,hnd->...nd", y, ap["wv"].astype(dt))
+    if cfg.pos_emb == "rope":
+        q = _rope(q, positions, cfg.rope_theta)
+        k = _rope(k, positions, cfg.rope_theta)
+    return q, k, v
+
+
+def _mlp(cfg: TransformerConfig, layer_params, x):
+    mp = layer_params["mlp"]
+    dt = x.dtype
+    y = _norm(x, layer_params["ln2"], cfg.norm, cfg.norm_eps)
+    if cfg.activation == "swiglu":
+        g = jnp.einsum("...h,hf->...f", y, mp["wg"].astype(dt))
+        u = jnp.einsum("...h,hf->...f", y, mp["wi"].astype(dt))
+        z = jax.nn.silu(g) * u
+    else:
+        z = jax.nn.gelu(jnp.einsum("...h,hf->...f", y, mp["wi"].astype(dt)))
+    return x + jnp.einsum("...f,fh->...h", z, mp["wo"].astype(dt))
+
+
+def _unembed(cfg: TransformerConfig, params, x):
+    if cfg.tie_embeddings:
+        logits = jnp.einsum("...h,vh->...v", x,
+                            params["embed"]["tokens"].astype(x.dtype))
+    else:
+        logits = jnp.einsum("...h,hv->...v", x,
+                            params["unembed"]["kernel"].astype(x.dtype))
+    return logits.astype(jnp.float32)
+
+
+# ---------------------------------------------------------------------------
+# dense-cache path (v1 engine)
+# ---------------------------------------------------------------------------
+
+
+def init_dense_cache(cfg: TransformerConfig, batch: int, max_len: int,
+                     dtype=None):
+    """cache: [L, B, max_len, 2, kv_heads, head_dim]."""
+    dtype = dtype or effective_dtype(cfg.dtype)
+    return jnp.zeros((cfg.num_layers, batch, max_len, 2, cfg.kv_heads,
+                      cfg.head_dim), dtype)
+
+
+def forward_with_cache(cfg: TransformerConfig, params, tokens: jax.Array,
+                       cache: jax.Array, start_pos) -> Tuple[jax.Array, jax.Array]:
+    """tokens [B, S] starting at absolute position start_pos (scalar);
+    returns (logits [B, S, V] fp32, updated cache). Works for prefill
+    (S = prompt len, start_pos = 0) and decode (S = 1)."""
+    B, S = tokens.shape
+    dt = effective_dtype(cfg.dtype)
+    max_len = cache.shape[2]
+    positions = start_pos + jnp.arange(S)[None, :]  # [1, S] broadcasts to B
+
+    x = params["embed"]["tokens"].astype(dt)[tokens]
+    if cfg.pos_emb == "learned":
+        x = x + params["embed"]["positions"].astype(dt)[positions]
+
+    key_pos = jnp.arange(max_len)  # absolute position of each cache row
+    rep = cfg.num_heads // cfg.kv_heads
+
+    def layer_body(x, inputs):
+        layer_params, kv_layer = inputs  # kv_layer [B, max_len, 2, nkv, hd]
+        y = _norm(x, layer_params["ln1"], cfg.norm, cfg.norm_eps)
+        q, k, v = _qkv(cfg, layer_params, y, positions)
+        # append this step's kv at rows [start_pos, start_pos+S)
+        kv_new = jnp.stack([k, v], axis=2).astype(kv_layer.dtype)  # [B,S,2,nkv,hd]
+        kv_layer = lax.dynamic_update_slice(
+            kv_layer, kv_new, (0, start_pos, 0, 0, 0))
+        k_all = kv_layer[:, :, 0]  # [B, max_len, nkv, hd]
+        v_all = kv_layer[:, :, 1]
+        if rep > 1:
+            k_all = jnp.repeat(k_all, rep, axis=2)
+            v_all = jnp.repeat(v_all, rep, axis=2)
+        scores = jnp.einsum("bsnd,bmnd->bnsm", q, k_all.astype(dt))
+        scores = scores / jnp.sqrt(jnp.float32(cfg.head_dim)).astype(dt)
+        mask = key_pos[None, None, None, :] <= positions[:, None, :, None]
+        scores = jnp.where(mask, scores.astype(jnp.float32), -1e30)
+        probs = jax.nn.softmax(scores, axis=-1).astype(dt)
+        attn = jnp.einsum("bnsm,bmnd->bsnd", probs, v_all.astype(dt))
+        attn = jnp.einsum("bsnd,ndh->bsh", attn,
+                          layer_params["attn"]["wo"].astype(dt))
+        x = x + attn
+        return _mlp(cfg, layer_params, x), kv_layer
+
+    x, new_cache = lax.scan(layer_body, x, (params["layers"], cache))
+    x = _norm(x, params["final_norm"], cfg.norm, cfg.norm_eps)
+    return _unembed(cfg, params, x), new_cache
+
+
+# ---------------------------------------------------------------------------
+# ragged paged-KV path (v2 engine)
+# ---------------------------------------------------------------------------
+
+
+def ragged_forward(cfg: TransformerConfig, params, kv_data: jax.Array,
+                   token_ids: jax.Array, token_seq: jax.Array,
+                   token_pos: jax.Array, block_table: jax.Array,
+                   num_tokens) -> Tuple[jax.Array, jax.Array]:
+    """One ragged step over flat tokens.
+
+    kv_data     [L, num_blocks, bs, 2, nkv, hd]
+    token_ids   [T] int32 (padded); token_seq [T] slot ids; token_pos [T]
+    block_table [S, Bm]; num_tokens scalar (true T, rest is padding)
+
+    Returns (logits [T, V] fp32, kv_data'). Causal masking derives solely
+    from token_pos: a query at position p attends cache rows 0..p of its
+    sequence, which are exactly the rows written so far (plus this step's
+    scatter, which lands before the attention reads). Padding tokens are
+    routed to write into the reserved scratch block (last block id) so
+    they never corrupt live pages.
+    """
+    T = token_ids.shape[0]
+    Smax, Bm = block_table.shape
+    bs = kv_data.shape[2]
+    dt = effective_dtype(cfg.dtype)
+    rep = cfg.num_heads // cfg.kv_heads
+    is_real = jnp.arange(T) < num_tokens  # [T]
+
+    x = params["embed"]["tokens"].astype(dt)[token_ids]  # [T, H]
+    if cfg.pos_emb == "learned":
+        x = x + params["embed"]["positions"].astype(dt)[token_pos]
+
+    # destination page/offset per token; padded tokens write to the last
+    # block's last row (block num_blocks-1 is reserved as scratch by the
+    # engine) so they never corrupt live pages.
+    page = block_table[token_seq, token_pos // bs]  # [T]
+    offset = token_pos % bs
+    scratch = kv_data.shape[1] - 1
+    page = jnp.where(is_real, page, scratch)
+    offset = jnp.where(is_real, offset, bs - 1)
+
+    # context length per token's sequence, for causal masking
+    max_ctx = Bm * bs
+    key_pos = jnp.arange(max_ctx)  # [Lmax]
+
+    def layer_body(x, inputs):
+        layer_params, kv_layer = inputs  # [num_blocks, bs, 2, nkv, hd]
+        y = _norm(x, layer_params["ln1"], cfg.norm, cfg.norm_eps)
+        q, k, v = _qkv(cfg, layer_params, y, token_pos)  # q [T,nh,hd] k/v [T,nkv,hd]
+        kv_layer = kv_layer.at[page, offset, 0].set(k.astype(kv_layer.dtype))
+        kv_layer = kv_layer.at[page, offset, 1].set(v.astype(kv_layer.dtype))
+        # gather each slot's pages into dense [S, Lmax, nkv, hd]
+        gathered = kv_layer[block_table]  # [S, Bm, bs, 2, nkv, hd]
+        gathered = gathered.reshape(Smax, max_ctx, 2, cfg.kv_heads,
+                                    cfg.head_dim)
+        k_seq = gathered[:, :, 0][token_seq]  # [T, Lmax, nkv, hd]
+        v_seq = gathered[:, :, 1][token_seq]
+        if rep > 1:
+            k_seq = jnp.repeat(k_seq, rep, axis=2)
+            v_seq = jnp.repeat(v_seq, rep, axis=2)
+        scores = jnp.einsum("tnd,tmnd->tnm", q, k_seq.astype(dt))
+        scores = scores / jnp.sqrt(jnp.float32(cfg.head_dim)).astype(dt)
+        mask = key_pos[None, None, :] <= token_pos[:, None, None]
+        scores = jnp.where(mask, scores.astype(jnp.float32), -1e30)
+        probs = jax.nn.softmax(scores, axis=-1).astype(dt)
+        attn = jnp.einsum("tnm,tmnd->tnd", probs, v_seq.astype(dt))
+        attn = jnp.einsum("tnd,ndh->th", attn,
+                          layer_params["attn"]["wo"].astype(dt))
+        x = x + attn
+        return _mlp(cfg, layer_params, x), kv_layer
+
+    x, new_kv = lax.scan(layer_body, x, (params["layers"], kv_data))
+    x = _norm(x, params["final_norm"], cfg.norm, cfg.norm_eps)
+    return _unembed(cfg, params, x), new_kv

@@ -1,0 +1,16 @@
+"""Ragged-batching state management (reference: inference/v2/ragged/)."""
+
+from deepspeed_tpu.inference.ragged.blocked_allocator import BlockedAllocator
+from deepspeed_tpu.inference.ragged.kv_cache import BlockedKVCache, KVCacheConfig
+from deepspeed_tpu.inference.ragged.sequence import (
+    SequenceDescriptor, StateManager)
+from deepspeed_tpu.inference.ragged.ragged_batch import RaggedBatch
+
+__all__ = [
+    "BlockedAllocator",
+    "BlockedKVCache",
+    "KVCacheConfig",
+    "SequenceDescriptor",
+    "StateManager",
+    "RaggedBatch",
+]

@@ -1,0 +1,74 @@
+"""Blocked (paged) KV cache.
+
+Reference: ``BlockedKVCache`` (inference/v2/ragged/kv_cache.py:40) backs a
+paged KV pool consumed by CUDA blocked-flash kernels. TPU re-design: the
+pool is ONE jax array per model,
+
+    kv[L, num_blocks, block_size, 2, kv_heads, head_dim]
+
+sharded over the tp axis on ``kv_heads``. Pages are appended inside the
+compiled step via scatter (see inference/model_runner.py); the host only
+manages block ids (blocked_allocator.py). Static pool shape keeps every
+step the same compiled program — the XLA analog of the reference
+preallocating the cache up front.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deepspeed_tpu.inference.ragged.blocked_allocator import BlockedAllocator
+
+
+@dataclasses.dataclass(frozen=True)
+class KVCacheConfig:
+    num_layers: int
+    kv_heads: int
+    head_dim: int
+    block_size: int = 16
+    num_blocks: int = 256
+    dtype: object = jnp.bfloat16
+
+    @property
+    def bytes_per_block(self) -> int:
+        itemsize = jnp.dtype(self.dtype).itemsize
+        return (self.num_layers * self.block_size * 2 * self.kv_heads
+                * self.head_dim * itemsize)
+
+
+class BlockedKVCache:
+    """Device pool + host allocator (reference kv_cache.py:40 contract:
+    reserve/free by block count; here also owns the device buffer)."""
+
+    def __init__(self, config: KVCacheConfig, mesh=None, tp_axis: str = "tp"):
+        self.config = config
+        self.allocator = BlockedAllocator(config.num_blocks)
+        shape = (config.num_layers, config.num_blocks, config.block_size,
+                 2, config.kv_heads, config.head_dim)
+        if mesh is not None and tp_axis in mesh.axis_names and (
+                mesh.shape[tp_axis] > 1):
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            sharding = NamedSharding(
+                mesh, P(None, None, None, None, tp_axis, None))
+            self.data = jax.device_put(
+                jnp.zeros(shape, config.dtype), sharding)
+        else:
+            self.data = jnp.zeros(shape, config.dtype)
+
+    def blocks_needed(self, num_tokens: int) -> int:
+        bs = self.config.block_size
+        return (num_tokens + bs - 1) // bs
+
+    def free(self, blocks) -> None:
+        if len(blocks):
+            self.allocator.free(blocks)
+
+    @property
+    def free_blocks(self) -> int:
+        return self.allocator.free_blocks

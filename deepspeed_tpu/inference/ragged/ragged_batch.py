@@ -1,0 +1,101 @@
+"""Dense metadata for a ragged forward step.
+
+Reference: ``RaggedBatchWrapper`` (inference/v2/ragged/ragged_wrapper.py)
+plus the native atom-builder (inference/v2/ragged/csrc/) that packs batch
+metadata for the CUDA kernels. XLA needs static shapes, so the TPU design
+pads every step to a (max_tokens, max_seqs) *bucket*: one compiled program
+per bucket serves every batch composition (the reference's CUDA-graph-like
+replay falls out of jit caching).
+
+Layout (all int32, device-bound each step):
+  token_ids   [T]     flattened new tokens across sequences
+  token_seq   [T]     local slot (0..S-1) of the owning sequence
+  token_pos   [T]     absolute position of the token in its sequence
+  block_table [S, Bm] KV block ids per slot (padded with 0)
+  ctx_lens    [S]     tokens in cache *after* this step per slot
+  num_tokens  []      true token count (rest is padding)
+  slot_uid    host-side: uid per slot (for gathering logits)
+  last_token_index [S] index into [T] of each slot's final token (for
+                      next-token logits), 0 for empty slots
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from deepspeed_tpu.inference.ragged.sequence import SequenceDescriptor
+
+
+@dataclasses.dataclass
+class RaggedBatch:
+    token_ids: np.ndarray
+    token_seq: np.ndarray
+    token_pos: np.ndarray
+    block_table: np.ndarray
+    ctx_lens: np.ndarray
+    num_tokens: int
+    last_token_index: np.ndarray
+    slot_uids: List[Optional[int]]
+    slot_is_live: np.ndarray  # bool [S]: slot has a real sequence
+
+    @property
+    def max_tokens(self) -> int:
+        return len(self.token_ids)
+
+    @property
+    def max_seqs(self) -> int:
+        return len(self.ctx_lens)
+
+
+def build_ragged_batch(
+    scheduled: List[Tuple[SequenceDescriptor, np.ndarray, int]],
+    max_tokens: int,
+    max_seqs: int,
+    max_blocks_per_seq: int,
+) -> RaggedBatch:
+    """Pack (sequence, new_tokens, start_pos) triples into dense arrays.
+
+    ``scheduled`` comes from the SplitFuse scheduler: each entry is a chunk
+    of a sequence's tokens to run this step (full/partial prefill or a
+    single decode token).
+    """
+    if len(scheduled) > max_seqs:
+        raise ValueError(f"{len(scheduled)} sequences > bucket max {max_seqs}")
+    token_ids = np.zeros(max_tokens, np.int32)
+    token_seq = np.zeros(max_tokens, np.int32)
+    token_pos = np.zeros(max_tokens, np.int32)
+    block_table = np.zeros((max_seqs, max_blocks_per_seq), np.int32)
+    ctx_lens = np.zeros(max_seqs, np.int32)
+    last_token_index = np.zeros(max_seqs, np.int32)
+    slot_uids: List[Optional[int]] = [None] * max_seqs
+    slot_is_live = np.zeros(max_seqs, bool)
+
+    cursor = 0
+    for slot, (seq, new_tokens, start_pos) in enumerate(scheduled):
+        n = len(new_tokens)
+        if cursor + n > max_tokens:
+            raise ValueError("token budget overflow; scheduler bug")
+        token_ids[cursor:cursor + n] = new_tokens
+        token_seq[cursor:cursor + n] = slot
+        token_pos[cursor:cursor + n] = np.arange(start_pos, start_pos + n)
+        nb = len(seq.kv_blocks)
+        if nb > max_blocks_per_seq:
+            raise ValueError(
+                f"sequence needs {nb} blocks > bucket max {max_blocks_per_seq}")
+        block_table[slot, :nb] = seq.kv_blocks
+        ctx_lens[slot] = start_pos + n
+        last_token_index[slot] = cursor + n - 1
+        slot_uids[slot] = seq.uid
+        slot_is_live[slot] = True
+        cursor += n
+
+    # padding tokens point at slot 0 with pos 0; they are masked out by
+    # comparing token index against num_tokens in the runner.
+    return RaggedBatch(
+        token_ids=token_ids, token_seq=token_seq, token_pos=token_pos,
+        block_table=block_table, ctx_lens=ctx_lens, num_tokens=cursor,
+        last_token_index=last_token_index, slot_uids=slot_uids,
+        slot_is_live=slot_is_live)

@@ -1,0 +1,94 @@
+"""Sequence descriptors + state manager for ragged batching.
+
+Reference: ``DSSequenceDescriptor`` / ``DSStateManager``
+(inference/v2/ragged/{sequence_descriptor,ragged_manager}.py). Tracks each
+live sequence's token history, KV blocks, and scheduling state. All host
+side — the compiled step only sees the dense metadata RaggedBatch builds.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from deepspeed_tpu.inference.ragged.kv_cache import BlockedKVCache
+
+
+@dataclasses.dataclass
+class SequenceDescriptor:
+    uid: int
+    input_tokens: np.ndarray            # full prompt
+    seen_tokens: int = 0                # tokens already in the KV cache
+    kv_blocks: np.ndarray = dataclasses.field(
+        default_factory=lambda: np.empty(0, dtype=np.int64))
+    generated: List[int] = dataclasses.field(default_factory=list)
+    max_new_tokens: int = 64
+    done: bool = False
+    truncated: bool = False  # ended early (per-seq KV cap or preemption)
+
+    @property
+    def total_tokens(self) -> int:
+        return len(self.input_tokens) + len(self.generated)
+
+    @property
+    def pending_prefill(self) -> int:
+        """Prompt tokens not yet through the model."""
+        return max(0, len(self.input_tokens) - self.seen_tokens)
+
+    @property
+    def in_decode(self) -> bool:
+        return self.pending_prefill == 0 and not self.done
+
+
+class StateManager:
+    """Owns live sequences + their KV blocks (reference
+    ragged_manager.py:19: tracks sequences, allocates KV on demand)."""
+
+    def __init__(self, kv_cache: BlockedKVCache, max_tracked_sequences: int = 64,
+                 max_blocks_per_seq: Optional[int] = None):
+        self.kv_cache = kv_cache
+        self.max_tracked_sequences = max_tracked_sequences
+        self.max_blocks_per_seq = max_blocks_per_seq
+        self.seqs: Dict[int, SequenceDescriptor] = {}
+
+    def get_or_create(self, uid: int, tokens: np.ndarray,
+                      max_new_tokens: int = 64) -> SequenceDescriptor:
+        if uid in self.seqs:
+            return self.seqs[uid]
+        if len(self.seqs) >= self.max_tracked_sequences:
+            raise RuntimeError("max_tracked_sequences exceeded")
+        seq = SequenceDescriptor(uid=uid,
+                                 input_tokens=np.asarray(tokens, np.int32),
+                                 max_new_tokens=max_new_tokens)
+        self.seqs[uid] = seq
+        return seq
+
+    def ensure_capacity(self, seq: SequenceDescriptor, new_total: int) -> bool:
+        """Grow seq's block list to fit new_total tokens. False if the pool
+        is exhausted. A sequence that hits the per-seq block cap is ENDED
+        (truncated) rather than grown — growing past the cap would crash
+        the dense batch metadata (build_ragged_batch bucket bound)."""
+        total_needed = self.kv_cache.blocks_needed(new_total)
+        need = total_needed - len(seq.kv_blocks)
+        if need <= 0:
+            return True
+        if (self.max_blocks_per_seq is not None
+                and total_needed > self.max_blocks_per_seq):
+            seq.done = True
+            seq.truncated = True
+            return False
+        if need > self.kv_cache.free_blocks:
+            return False
+        new_blocks = self.kv_cache.allocator.allocate(need)
+        seq.kv_blocks = np.concatenate([seq.kv_blocks, new_blocks])
+        return True
+
+    def release(self, uid: int) -> None:
+        seq = self.seqs.pop(uid, None)
+        if seq is not None and len(seq.kv_blocks):
+            self.kv_cache.free(seq.kv_blocks)
+
+    def live_uids(self) -> List[int]:
+        return list(self.seqs)

@@ -1,0 +1,67 @@
+"""Dynamic SplitFuse token scheduler.
+
+Reference: the FastGen scheduler lives in MII above
+``InferenceEngineV2.query/can_schedule`` (inference/v2/engine_v2.py:184);
+Dynamic SplitFuse composes each forward from (a) one decode token per
+running sequence and (b) prompt *chunks* that fill the remaining token
+budget, so every step has near-constant compute — which on TPU also means
+ONE compiled program per bucket.
+"""
+
+from __future__ import annotations
+
+from typing import List, Tuple
+
+import numpy as np
+
+from deepspeed_tpu.inference.ragged.sequence import (
+    SequenceDescriptor, StateManager)
+
+
+class SplitFuseScheduler:
+    def __init__(self, state: StateManager, max_tokens_per_step: int = 256,
+                 max_seqs_per_step: int = 32):
+        self.state = state
+        self.max_tokens = max_tokens_per_step
+        self.max_seqs = max_seqs_per_step
+
+    def schedule(self) -> List[Tuple[SequenceDescriptor, np.ndarray, int]]:
+        """Pick (seq, new_tokens, start_pos) chunks for the next step.
+
+        Decode tokens first (latency), then prefill chunks fill the budget
+        (throughput) — the SplitFuse recipe.
+        """
+        budget = self.max_tokens
+        slots = self.max_seqs
+        out: List[Tuple[SequenceDescriptor, np.ndarray, int]] = []
+
+        # decode: the last generated (or last prompt) token advances the seq
+        for seq in self.state.seqs.values():
+            if budget <= 0 or slots <= 0:
+                break
+            if not seq.in_decode or seq.done:
+                continue
+            if not self.state.ensure_capacity(seq, seq.seen_tokens + 1):
+                continue  # KV OOM: leave for a later step
+            tok = (seq.generated[-1] if seq.generated
+                   else int(seq.input_tokens[-1]))
+            out.append((seq, np.asarray([tok], np.int32), seq.seen_tokens))
+            budget -= 1
+            slots -= 1
+
+        # prefill chunks (a chunk that reaches the end of the prompt makes
+        # the engine sample that step's last-token logits)
+        for seq in self.state.seqs.values():
+            if budget <= 0 or slots <= 0:
+                break
+            pending = seq.pending_prefill
+            if pending == 0 or seq.done:
+                continue
+            chunk = min(pending, budget)
+            if not self.state.ensure_capacity(seq, seq.seen_tokens + chunk):
+                continue
+            toks = seq.input_tokens[seq.seen_tokens:seq.seen_tokens + chunk]
+            out.append((seq, toks.astype(np.int32), seq.seen_tokens))
+            budget -= chunk
+            slots -= 1
+        return out

@@ -156,16 +156,28 @@ def apply(cfg: MoETransformerConfig, params, tokens, positions=None,
     x = constrain_activation(x, ("batch", "seq", "embed"))
 
     layer_fn = partial(_moe_layer, cfg)
-    if cfg.remat:
-        layer_fn = jax.checkpoint(layer_fn)
 
-    def body(carry, layer_params):
-        x, aux = carry
-        x, l_aux = layer_fn(x, layer_params, positions, train)
-        return (x, aux + l_aux), None
+    from deepspeed_tpu.parallel import topology as _topo
+    from deepspeed_tpu.parallel.pipeline import (
+        pipeline_enabled, pipelined_layers)
 
-    (x, aux_total), _ = lax.scan(
-        body, (x, jnp.asarray(0.0, jnp.float32)), params["layers"])
+    if pipeline_enabled(_topo._GLOBAL_MESH):
+        # pp > 1: microbatched stage pipeline threading the aux-loss
+        # accumulator through the ring (remat applied per stage inside)
+        x, aux_total = pipelined_layers(
+            lambda c, lp: layer_fn(c, lp, positions, train),
+            params["layers"], x, with_aux=True)
+    else:
+        if cfg.remat:
+            layer_fn = jax.checkpoint(layer_fn)
+
+        def body(carry, layer_params):
+            x, aux = carry
+            x, l_aux = layer_fn(x, layer_params, positions, train)
+            return (x, aux + l_aux), None
+
+        (x, aux_total), _ = lax.scan(
+            body, (x, jnp.asarray(0.0, jnp.float32)), params["layers"])
 
     x = tfm._norm(x, params["final_norm"], cfg.norm, cfg.norm_eps)
     if cfg.tie_embeddings:

@@ -22,8 +22,9 @@ fraction is (P-1)/(M+P-1) per direction — choose M >= 2P.
 
 from __future__ import annotations
 
+from contextlib import nullcontext
 from functools import partial
-from typing import Any, Callable, Optional
+from typing import Any, Callable, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -38,13 +39,19 @@ def pipeline_enabled(mesh: Optional[Mesh]) -> bool:
 
 
 def pipelined_layers(layer_fn: Callable, stacked_params: Any, x: jax.Array,
-                     num_microbatches: Optional[int] = None) -> jax.Array:
+                     num_microbatches: Optional[int] = None,
+                     with_aux: bool = False):
     """Run ``scan(layer_fn)`` over [L, ...]-stacked params as a pp-stage
     pipeline.
 
-    layer_fn(carry, layer_params) -> carry, with carry [mb, S, H].
+    layer_fn(carry, layer_params) -> carry, with carry [mb, S, H]; when
+    ``with_aux`` it returns (carry, aux_scalar) and the pipeline threads a
+    per-microbatch float32 accumulator alongside the activations (MoE
+    aux/z losses — the reference accumulates these across the pipe via the
+    engine's loss reduction, pipe/engine.py:592).
     x: [B, S, H]; B must divide into num_microbatches (default 2*pp).
-    Returns [B, S, H] replicated over pp.
+    Returns [B, S, H] replicated over pp (and the summed aux when
+    ``with_aux``).
     """
     mesh = topo.get_global_mesh()
     PP = mesh.shape["pp"]
@@ -57,8 +64,6 @@ def pipelined_layers(layer_fn: Callable, stacked_params: Any, x: jax.Array,
     L = jax.tree.leaves(stacked_params)[0].shape[0]
     assert L % PP == 0, f"num_layers {L} must divide pp {PP}"
 
-    xs = x.reshape(M, B // M, *x.shape[1:])  # [M, mb, S, H]
-
     def per_stage(params_stage, xs_local):
         # params_stage leaves: [L/PP, ...]; xs_local: [M, mb, S, H]
         stage = lax.axis_index("pp")
@@ -66,28 +71,41 @@ def pipelined_layers(layer_fn: Callable, stacked_params: Any, x: jax.Array,
         fwd_perm = [(i, (i + 1) % PP) for i in range(PP)]
 
         def stage_fn(inp, params_stage):
-            out, _ = lax.scan(lambda c, p: (layer_fn(c, p), None),
-                              inp, params_stage)
-            return out
+            act, aux = inp
+
+            def one_layer(c, p):
+                if with_aux:
+                    a, l_aux = layer_fn(c[0], p)
+                    return (a, c[1] + l_aux), None
+                return (layer_fn(c[0], p), c[1]), None
+
+            (act, aux), _ = lax.scan(one_layer, (act, aux), params_stage)
+            return act, aux
 
         stage_fn = jax.checkpoint(stage_fn)
 
         def body(carry, t):
-            buf = carry  # activations arriving from the previous stage
+            buf, aux_buf = carry  # arriving from the previous stage
             mb_idx = jnp.clip(t, 0, M - 1)
             inp = jnp.where(stage == 0, xs_local[mb_idx], buf)
-            out = stage_fn(inp, params_stage)
+            aux_in = jnp.where(stage == 0, 0.0, aux_buf)
+            out, aux_out = stage_fn((inp, aux_in), params_stage)
             nxt = lax.ppermute(out, "pp", fwd_perm)
+            aux_nxt = lax.ppermute(aux_out, "pp", fwd_perm)
             is_valid = jnp.logical_and(stage == PP - 1, t >= PP - 1)
             y = jnp.where(is_valid, out, jnp.zeros_like(out))
-            return nxt, y
+            y_aux = jnp.where(is_valid, aux_out, 0.0)
+            return (nxt, aux_nxt), (y, y_aux)
 
-        _, ys = lax.scan(body, jnp.zeros_like(xs_local[0]),
-                         jnp.arange(steps))
+        init = (jnp.zeros_like(xs_local[0]), jnp.asarray(0.0, jnp.float32))
+        _, (ys, aux_ys) = lax.scan(body, init, jnp.arange(steps))
         ys = ys[PP - 1:]  # [M, mb, S, H] — real only on the last stage
+        aux_total = aux_ys[PP - 1:].sum()
         # replicate the last stage's result to every stage (out_specs P())
-        return lax.psum(jnp.where(stage == PP - 1, ys,
-                                  jnp.zeros_like(ys)), "pp")
+        ys = lax.psum(jnp.where(stage == PP - 1, ys,
+                                jnp.zeros_like(ys)), "pp")
+        aux_total = lax.psum(jnp.where(stage == PP - 1, aux_total, 0.0), "pp")
+        return ys, aux_total
 
     from deepspeed_tpu.runtime.sharding import disable_constraints, force_f32
 
@@ -103,26 +121,22 @@ def pipelined_layers(layer_fn: Callable, stacked_params: Any, x: jax.Array,
                           if t.dtype == jnp.bfloat16 else t)
         stacked_params = jax.tree.map(to32, stacked_params)
         x = to32(x)
-        xs = x.reshape(M, B // M, *x.shape[1:])
+    xs = x.reshape(M, B // M, *x.shape[1:])  # [M, mb, S, H]
 
     param_specs = jax.tree.map(lambda _: P("pp"), stacked_params)
-    ctx2 = force_f32() if cast_f32 else _null()
+    ctx2 = force_f32() if cast_f32 else nullcontext()
     with disable_constraints(), ctx2:
-        out = jax.shard_map(
+        out, aux = jax.shard_map(
             per_stage,
             mesh=mesh,
             in_specs=(param_specs, P()),
-            out_specs=P(),
+            out_specs=(P(), P()),
             axis_names=frozenset({"pp"}),
             check_vma=False,
         )(stacked_params, xs)
     out = out.reshape(B, *x.shape[1:])
-    return out.astype(orig_dtype) if cast_f32 else out
-
-
-class _null:
-    def __enter__(self):
-        return None
-
-    def __exit__(self, *a):
-        return False
+    if cast_f32:
+        out = out.astype(orig_dtype)
+    if with_aux:
+        return out, aux
+    return out
