@@ -1,1 +1,5 @@
+"""Launcher: head-node fan-out + per-host rendezvous + env report.
 
+Reference: deepspeed/launcher/ (runner.py:436, launch.py:145,
+multinode_runner.py) and bin/ds_report.
+"""

@@ -1,0 +1,87 @@
+"""Per-host launcher: join the JAX distributed rendezvous and exec the
+user script.
+
+Reference: launcher/launch.py:145 spawns one process per CUDA device with
+RANK/LOCAL_RANK/WORLD_SIZE env and a torch rendezvous. On TPU one process
+per *host* owns all local chips, and the rendezvous is
+``jax.distributed.initialize(coordinator_address, num_processes,
+process_id)`` — so this script resolves its process id (from the CLI,
+SLURM, or MPI env), initializes the JAX distributed runtime, then runs
+the user script in-process (signal handling kills the child process tree
+on SIGTERM like launch.py:131).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import runpy
+import signal
+import sys
+
+from deepspeed_tpu.utils.logging import logger
+
+
+def _resolve_process_id(args) -> int:
+    if args.process_id is not None:
+        return args.process_id
+    if args.slurm_managed:
+        return int(os.environ["SLURM_PROCID"])
+    if args.mpi_managed:
+        for var in ("OMPI_COMM_WORLD_RANK", "PMI_RANK"):
+            if var in os.environ:
+                return int(os.environ[var])
+        raise RuntimeError("MPI-managed launch but no MPI rank env found")
+    raise RuntimeError("need --process_id (or --slurm_managed/--mpi_managed)")
+
+
+def parse_args(argv=None):
+    p = argparse.ArgumentParser(prog="dstpu-launch")
+    p.add_argument("--coordinator_address", required=True)
+    p.add_argument("--process_id", type=int, default=None)
+    p.add_argument("--num_processes", type=int, required=True)
+    p.add_argument("--world_info", default="")
+    p.add_argument("--slurm_managed", action="store_true")
+    p.add_argument("--mpi_managed", action="store_true")
+    p.add_argument("--module", action="store_true",
+                   help="run user_script as a module (python -m)")
+    p.add_argument("user_script")
+    p.add_argument("user_args", nargs=argparse.REMAINDER)
+    return p.parse_args(argv)
+
+
+def main(argv=None) -> int:
+    args = parse_args(argv)
+    process_id = _resolve_process_id(args)
+
+    # expose reference-compatible env to the user script
+    os.environ["RANK"] = str(process_id)
+    os.environ["WORLD_SIZE"] = str(args.num_processes)
+    os.environ["LOCAL_RANK"] = "0"  # one process per host on TPU
+    if args.world_info:
+        os.environ["DSTPU_WORLD_INFO"] = args.world_info
+
+    import jax
+
+    if args.num_processes > 1:
+        logger.info(
+            f"joining rendezvous at {args.coordinator_address} as "
+            f"{process_id}/{args.num_processes}")
+        jax.distributed.initialize(
+            coordinator_address=args.coordinator_address,
+            num_processes=args.num_processes,
+            process_id=process_id)
+
+    # forward SIGTERM to a clean interpreter exit so atexit/finalizers run
+    signal.signal(signal.SIGTERM, lambda *_: sys.exit(143))
+
+    sys.argv = [args.user_script] + list(args.user_args or [])
+    if args.module:
+        runpy.run_module(args.user_script, run_name="__main__")
+    else:
+        runpy.run_path(args.user_script, run_name="__main__")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,79 @@
+"""Environment/compat report CLI (reference: bin/ds_report →
+deepspeed/env_report.py): prints versions, device inventory, op-registry
+compatibility, and mesh defaults."""
+
+from __future__ import annotations
+
+import os
+import platform
+import sys
+
+
+def _row(name, value, width=34):
+    return f"{name:.<{width}} {value}"
+
+
+def main(argv=None) -> int:
+    lines = ["-" * 60, "deepspeed_tpu environment report", "-" * 60]
+
+    from deepspeed_tpu.version import __version__
+
+    lines.append(_row("deepspeed_tpu version", __version__))
+    lines.append(_row("python", platform.python_version()))
+    lines.append(_row("platform", platform.platform()))
+
+    try:
+        import jax
+        import jaxlib
+
+        lines.append(_row("jax version", jax.__version__))
+        lines.append(_row("jaxlib version", jaxlib.__version__))
+        try:
+            devs = jax.devices()
+            lines.append(_row("default backend", jax.default_backend()))
+            lines.append(_row("device count", str(len(devs))))
+            kinds = sorted({d.device_kind for d in devs})
+            lines.append(_row("device kinds", ", ".join(kinds)))
+            lines.append(_row("process count", str(jax.process_count())))
+        except Exception as e:  # no accelerator: still report
+            lines.append(_row("devices", f"unavailable ({e})"))
+    except ImportError as e:
+        lines.append(_row("jax", f"NOT INSTALLED ({e})"))
+
+    for mod in ("flax", "optax", "orbax.checkpoint", "numpy"):
+        try:
+            m = __import__(mod)
+            for part in mod.split(".")[1:]:
+                m = getattr(m, part)
+            lines.append(_row(mod, getattr(m, "__version__", "?")))
+        except ImportError:
+            lines.append(_row(mod, "not installed"))
+
+    # op registry compat (reference: ds_report op compatibility table)
+    lines.append("-" * 60)
+    lines.append("op compatibility")
+    lines.append("-" * 60)
+    try:
+        from deepspeed_tpu.ops.registry import all_ops
+
+        for name, op in sorted(all_ops().items()):
+            ok, why = op.is_compatible()
+            lines.append(_row(name, "OK" if ok else f"NO ({why})"))
+    except ImportError:
+        lines.append("op registry not available")
+
+    env_flags = {k: v for k, v in os.environ.items()
+                 if k.startswith(("JAX_", "XLA_", "LIBTPU", "DSTPU_"))}
+    if env_flags:
+        lines.append("-" * 60)
+        lines.append("relevant environment")
+        lines.append("-" * 60)
+        for k in sorted(env_flags):
+            lines.append(_row(k, env_flags[k]))
+
+    print("\n".join(lines))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

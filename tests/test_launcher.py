@@ -1,0 +1,146 @@
+"""Launcher tests (parity model: reference tests/unit/launcher/)."""
+
+import shlex
+import subprocess
+import sys
+
+import pytest
+
+from deepspeed_tpu.launcher.runner import (
+    GCERunner, MPIRunner, SSHRunner, SlurmRunner, decode_world_info,
+    encode_world_info, main as runner_main, parse_args,
+    parse_hostfile, parse_inclusion_exclusion)
+
+
+class TestHostfile:
+    def test_parse_basic(self):
+        pool = parse_hostfile(["hostA slots=4\n", "# comment\n",
+                               "hostB slots=8\n", "\n", "hostC\n"])
+        assert pool == {"hostA": 4, "hostB": 8, "hostC": 1}
+
+    def test_duplicate_host_rejected(self):
+        with pytest.raises(ValueError, match="duplicate"):
+            parse_hostfile(["a slots=1\n", "a slots=2\n"])
+
+    def test_bad_token_rejected(self):
+        with pytest.raises(ValueError, match="bad hostfile token"):
+            parse_hostfile(["a gpus=4\n"])
+
+    def test_empty_rejected(self):
+        with pytest.raises(ValueError, match="empty"):
+            parse_hostfile(["# nothing\n"])
+
+
+class TestFilters:
+    POOL = {"a": 4, "b": 4, "c": 4}
+
+    def test_include_hosts(self):
+        out = parse_inclusion_exclusion(self.POOL, include="a@c")
+        assert out == {"a": 4, "c": 4}
+
+    def test_include_slots(self):
+        out = parse_inclusion_exclusion(self.POOL, include="a:0,1")
+        assert out == {"a": 2}
+
+    def test_exclude_host(self):
+        out = parse_inclusion_exclusion(self.POOL, exclude="b")
+        assert out == {"a": 4, "c": 4}
+
+    def test_exclude_slots(self):
+        out = parse_inclusion_exclusion(self.POOL, exclude="b:0")
+        assert out == {"a": 4, "b": 3, "c": 4}
+
+    def test_mutual_exclusion(self):
+        with pytest.raises(ValueError):
+            parse_inclusion_exclusion(self.POOL, include="a", exclude="b")
+
+    def test_unknown_host(self):
+        with pytest.raises(ValueError, match="not in hostfile"):
+            parse_inclusion_exclusion(self.POOL, include="zzz")
+
+    def test_world_info_roundtrip(self):
+        enc = encode_world_info(self.POOL)
+        assert decode_world_info(enc) == self.POOL
+
+
+class TestRunnersBuildCommands:
+    def _args(self, extra=()):
+        return parse_args(list(extra) + ["train.py", "--lr", "0.1"])
+
+    def test_ssh_cmds(self):
+        args = self._args()
+        r = SSHRunner(args, "WI")
+        cmds = r.get_cmd({"DSTPU_WORLD_INFO": "WI"}, {"h1": 1, "h2": 1})
+        assert len(cmds) == 2
+        assert cmds[0][0] == "ssh" and cmds[0][-2] == "h1"
+        inner = cmds[1][-1]
+        assert "--process_id=1" in inner
+        assert "--num_processes=2" in inner
+        assert "--coordinator_address=h1:8476" in inner
+        assert "train.py" in inner and "--lr 0.1" in inner
+
+    def test_slurm_cmd(self):
+        r = SlurmRunner(self._args(), "WI")
+        cmd = r.get_cmd({}, {"h1": 1, "h2": 1, "h3": 1})
+        assert cmd[0] == "srun" and "--nodes=3" in cmd
+        assert "--slurm_managed" in cmd
+
+    def test_mpi_cmd(self):
+        r = MPIRunner(self._args(), "WI")
+        cmd = r.get_cmd({}, {"h1": 1, "h2": 1})
+        assert cmd[:3] == ["mpirun", "-np", "2"]
+        assert "--mpi_managed" in cmd
+
+    def test_gce_cmd(self):
+        args = self._args(["--tpu_name", "pod1", "--tpu_zone", "us-x1"])
+        r = GCERunner(args, "WI")
+        cmd = r.get_cmd({}, {"w0": 1})
+        assert "gcloud" == cmd[0] and "pod1" in cmd
+        assert any("--worker=all" in c for c in cmd)
+
+    def test_dry_run_multinode(self, tmp_path, capsys):
+        hf = tmp_path / "hostfile"
+        hf.write_text("h1 slots=4\nh2 slots=4\n")
+        rc = runner_main(["-H", str(hf), "--dry_run", "train.py"])
+        assert rc == 0
+        out = capsys.readouterr().out
+        assert out.count("ssh") == 2
+
+    def test_dry_run_localhost(self, capsys):
+        rc = runner_main(["--dry_run", "train.py", "--x", "1"])
+        assert rc == 0
+        out = capsys.readouterr().out.strip()
+        assert out.endswith("train.py --x 1")
+
+
+class TestReport:
+    def test_report_runs(self):
+        out = subprocess.run(
+            [sys.executable, "-m", "deepspeed_tpu.launcher.report"],
+            capture_output=True, text=True, timeout=240,
+            env={"PATH": "/usr/bin:/bin", "PYTHONPATH": ".",
+                 "JAX_PLATFORMS": "cpu"})
+        assert out.returncode == 0, out.stderr
+        assert "deepspeed_tpu version" in out.stdout
+        assert "flash_attention" in out.stdout
+
+
+class TestOpRegistry:
+    def test_all_ops_probe(self):
+        from deepspeed_tpu.ops.registry import all_ops, get_op
+
+        ops = all_ops()
+        assert {"flash_attention", "quantize_blockwise",
+                "xla_attention", "ragged_forward"} <= set(ops)
+        for spec in ops.values():
+            ok, why = spec.is_compatible()
+            assert isinstance(ok, bool)
+        fn = get_op("xla_attention")
+        assert callable(fn)
+
+    def test_unknown_op(self):
+        from deepspeed_tpu.ops.registry import all_ops, get_op
+
+        all_ops()
+        with pytest.raises(KeyError, match="unknown op"):
+            get_op("nope")
