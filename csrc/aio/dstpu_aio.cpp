@@ -1,0 +1,254 @@
+// dstpu_aio: threaded async file I/O for tensor swap (DeepNVMe analog).
+//
+// TPU-native equivalent of the reference's libaio/io_uring AIO layer
+// (reference: csrc/aio/py_lib/deepspeed_py_aio_handle.cpp,
+// csrc/aio/py_lib/deepspeed_aio_thread.cpp). The reference drives NVMe
+// reads/writes of pinned CUDA tensors through libaio from a worker-thread
+// pool; on TPU the device side is handled by JAX host transfers, so this
+// library's job is the host<->NVMe leg: a C worker pool that splits large
+// requests into block-sized chunks, issues pread/pwrite in parallel, and
+// exposes async handles to Python over a plain C ABI (loaded via ctypes —
+// no pybind11 in this image).
+//
+// Design notes vs the reference:
+//  * queue_depth/block_size/num_threads mirror aio_config knobs
+//    (reference: deepspeed/runtime/swap_tensor/constants.py).
+//  * O_DIRECT is attempted for reads/writes on aligned requests and
+//    silently downgraded to buffered I/O when the filesystem refuses it
+//    (container overlayfs commonly does) — same graceful degradation the
+//    reference's is_compatible() probing provides at build time.
+//  * pinned buffers: page-aligned + best-effort mlock. On TPU "pinned"
+//    buys alignment for O_DIRECT and stable addresses for async use, not
+//    DMA registration.
+
+#include <atomic>
+#include <condition_variable>
+#include <cstdint>
+#include <cstdlib>
+#include <cstring>
+#include <deque>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <vector>
+
+#include <fcntl.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+namespace {
+
+struct Chunk {
+  int fd;
+  void* buf;
+  int64_t nbytes;
+  int64_t offset;
+  bool is_write;
+  struct Request* req;
+};
+
+struct Request {
+  std::atomic<int> remaining{0};
+  std::atomic<int> errors{0};
+  int fd = -1;
+  int id = 0;
+};
+
+struct Handle {
+  int block_size;
+  int queue_depth;  // max in-flight chunks before submit blocks
+  std::vector<std::thread> workers;
+  std::deque<Chunk> queue;
+  std::mutex mu;
+  std::condition_variable cv_work;    // workers wait for work
+  std::condition_variable cv_space;   // submitters wait for queue space
+  std::condition_variable cv_done;    // waiters wait for request completion
+  std::vector<Request*> inflight;
+  std::atomic<int64_t> bytes_read{0};
+  std::atomic<int64_t> bytes_written{0};
+  std::atomic<bool> stop{false};
+  int next_id = 1;
+
+  void worker_loop() {
+    for (;;) {
+      Chunk c;
+      {
+        std::unique_lock<std::mutex> lk(mu);
+        cv_work.wait(lk, [&] { return stop.load() || !queue.empty(); });
+        if (stop.load() && queue.empty()) return;
+        c = queue.front();
+        queue.pop_front();
+        cv_space.notify_all();
+      }
+      int64_t done = 0;
+      bool err = false;
+      char* p = static_cast<char*>(c.buf);
+      while (done < c.nbytes) {
+        ssize_t n = c.is_write
+                        ? pwrite(c.fd, p + done, c.nbytes - done, c.offset + done)
+                        : pread(c.fd, p + done, c.nbytes - done, c.offset + done);
+        if (n <= 0) {
+          err = true;
+          break;
+        }
+        done += n;
+      }
+      if (err) c.req->errors.fetch_add(1);
+      if (c.is_write)
+        bytes_written.fetch_add(done);
+      else
+        bytes_read.fetch_add(done);
+      if (c.req->remaining.fetch_sub(1) == 1) {
+        std::lock_guard<std::mutex> lk(mu);
+        cv_done.notify_all();
+      }
+    }
+  }
+};
+
+int open_for(const char* path, bool is_write, int64_t nbytes, void* buf) {
+  int flags = is_write ? (O_WRONLY | O_CREAT) : O_RDONLY;
+  // O_DIRECT only when buffer & size meet 512B alignment.
+  bool aligned = ((reinterpret_cast<uintptr_t>(buf) % 512) == 0) &&
+                 (nbytes % 512 == 0);
+  if (aligned) {
+    int fd = open(path, flags | O_DIRECT, 0644);
+    if (fd >= 0) return fd;
+  }
+  return open(path, flags, 0644);
+}
+
+int submit(Handle* h, void* buf, int64_t nbytes, const char* path,
+           int64_t file_offset, bool is_write) {
+  int fd = open_for(path, is_write, nbytes, buf);
+  if (fd < 0) return -1;
+  Request* req = new Request();
+  req->fd = fd;
+  int nchunks = 0;
+  {
+    std::unique_lock<std::mutex> lk(h->mu);
+    req->id = h->next_id++;
+    h->inflight.push_back(req);
+    for (int64_t off = 0; off < nbytes; off += h->block_size) nchunks++;
+    if (nchunks == 0) nchunks = 1;
+    req->remaining.store(nchunks);
+    int64_t off = 0;
+    int queued = 0;
+    do {
+      int64_t len = std::min<int64_t>(h->block_size, nbytes - off);
+      if (len < 0) len = 0;
+      h->cv_space.wait(lk, [&] {
+        return static_cast<int>(h->queue.size()) < h->queue_depth;
+      });
+      h->queue.push_back(Chunk{fd, static_cast<char*>(buf) + off, len, file_offset + off,
+                               is_write, req});
+      h->cv_work.notify_one();
+      off += h->block_size;
+      queued++;
+    } while (off < nbytes);
+    // zero-length request: single empty chunk already queued above.
+    (void)queued;
+  }
+  return req->id;
+}
+
+}  // namespace
+
+extern "C" {
+
+void* dstpu_aio_create(int block_size, int queue_depth, int num_threads) {
+  Handle* h = new Handle();
+  h->block_size = block_size > 0 ? block_size : (1 << 20);
+  h->queue_depth = queue_depth > 0 ? queue_depth : 32;
+  if (num_threads <= 0) num_threads = 4;
+  for (int i = 0; i < num_threads; i++)
+    h->workers.emplace_back([h] { h->worker_loop(); });
+  return h;
+}
+
+void dstpu_aio_destroy(void* hp) {
+  Handle* h = static_cast<Handle*>(hp);
+  {
+    std::lock_guard<std::mutex> lk(h->mu);
+    h->stop.store(true);
+  }
+  h->cv_work.notify_all();
+  for (auto& t : h->workers) t.join();
+  for (Request* r : h->inflight) {
+    if (r->fd >= 0) close(r->fd);
+    delete r;
+  }
+  delete h;
+}
+
+// Async submit; returns request id (>0) or -1 on open failure.
+int dstpu_aio_pread(void* hp, void* buf, int64_t nbytes, const char* path,
+                    int64_t file_offset) {
+  return submit(static_cast<Handle*>(hp), buf, nbytes, path, file_offset, false);
+}
+
+int dstpu_aio_pwrite(void* hp, const void* buf, int64_t nbytes,
+                     const char* path, int64_t file_offset) {
+  return submit(static_cast<Handle*>(hp), const_cast<void*>(buf), nbytes, path,
+                file_offset, true);
+}
+
+// Wait for ALL in-flight requests; returns number of failed requests.
+int dstpu_aio_wait(void* hp) {
+  Handle* h = static_cast<Handle*>(hp);
+  std::unique_lock<std::mutex> lk(h->mu);
+  h->cv_done.wait(lk, [&] {
+    for (Request* r : h->inflight)
+      if (r->remaining.load() > 0) return false;
+    return true;
+  });
+  int errors = 0;
+  for (Request* r : h->inflight) {
+    errors += r->errors.load() > 0 ? 1 : 0;
+    if (r->fd >= 0) close(r->fd);
+    delete r;
+  }
+  h->inflight.clear();
+  return errors;
+}
+
+// Blocking single-shot helpers (reference: deepspeed_py_aio.cpp sync path).
+int dstpu_aio_sync_pread(void* hp, void* buf, int64_t nbytes, const char* path,
+                         int64_t file_offset) {
+  int id = dstpu_aio_pread(hp, buf, nbytes, path, file_offset);
+  if (id < 0) return -1;
+  return dstpu_aio_wait(hp);
+}
+
+int dstpu_aio_sync_pwrite(void* hp, const void* buf, int64_t nbytes,
+                          const char* path, int64_t file_offset) {
+  int id = dstpu_aio_pwrite(hp, buf, nbytes, path, file_offset);
+  if (id < 0) return -1;
+  return dstpu_aio_wait(hp);
+}
+
+int64_t dstpu_aio_bytes_read(void* hp) {
+  return static_cast<Handle*>(hp)->bytes_read.load();
+}
+int64_t dstpu_aio_bytes_written(void* hp) {
+  return static_cast<Handle*>(hp)->bytes_written.load();
+}
+
+// Page-aligned, best-effort-locked host buffer (reference:
+// csrc/aio/py_lib/deepspeed_pin_tensor.cpp).
+void* dstpu_alloc_pinned(int64_t nbytes) {
+  void* p = nullptr;
+  if (posix_memalign(&p, 4096, static_cast<size_t>(nbytes)) != 0) return nullptr;
+  memset(p, 0, static_cast<size_t>(nbytes));
+  (void)mlock(p, static_cast<size_t>(nbytes));  // best effort
+  return p;
+}
+
+void dstpu_free_pinned(void* p, int64_t nbytes) {
+  if (!p) return;
+  munlock(p, static_cast<size_t>(nbytes));
+  free(p);
+}
+
+}  // extern "C"

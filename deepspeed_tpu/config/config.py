@@ -104,6 +104,9 @@ class OffloadOptimizerConfig(ConfigModel):
     pin_memory: bool = False
     pipeline_read: bool = False
     pipeline_write: bool = False
+    # device->host gradient transfer dtype: "fp32" (exact) or "bf16"
+    # (halves transfer volume; native bf16-grad optimizer kernels)
+    grad_transfer_dtype: str = "fp32"
     ratio: float = 1.0
 
 

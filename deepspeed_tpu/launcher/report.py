@@ -58,7 +58,11 @@ def main(argv=None) -> int:
 
         for name, op in sorted(all_ops().items()):
             ok, why = op.is_compatible()
-            lines.append(_row(name, "OK" if ok else f"NO ({why})"))
+            if ok:
+                status = f"OK ({why})" if why else "OK"
+            else:
+                status = f"NO ({why})"
+            lines.append(_row(name, status))
     except ImportError:
         lines.append("op registry not available")
 

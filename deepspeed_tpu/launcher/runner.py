@@ -291,7 +291,8 @@ def parse_args(argv=None):
 def main(argv=None) -> int:
     args = parse_args(argv)
     if args.user_script is None:
-        parse_args(["-h"])  # prints help and exits
+        print("dstpu: error: user_script is required (see dstpu --help)",
+              file=sys.stderr)
         return 2
 
     if args.hostfile:

@@ -1,0 +1,9 @@
+"""Native (C++) host-side components: AIO, pinned buffers, host optimizers.
+
+Reference: csrc/ tree built by op_builder JIT infrastructure
+(op_builder/builder.py:526 ``load()``). Here the native pieces are
+host-side only (the device compute path is XLA/Pallas), built on demand
+with g++ and loaded over ctypes.
+"""
+
+from deepspeed_tpu.ops.native.builder import build_native_lib, native_available

@@ -1,0 +1,188 @@
+"""Python-facing async I/O handle over the native library.
+
+Reference: the ``aio_handle`` Python object built by AsyncIOBuilder
+(csrc/aio/py_lib/deepspeed_py_aio_handle.cpp — async_pread/async_pwrite/
+wait, get_block_size/get_queue_depth...). numpy arrays stand in for
+pinned torch tensors; ``PinnedBuffer`` wraps a page-aligned, mlocked
+allocation so O_DIRECT can engage and addresses stay stable across async
+submits.
+
+Falls back to a pure-Python threadpool implementation when the native
+build is unavailable (no compiler) so the swap stack stays functional.
+"""
+
+from __future__ import annotations
+
+import concurrent.futures as _fut
+import ctypes
+import os
+from typing import List, Optional
+
+import numpy as np
+
+from deepspeed_tpu.ops.native.builder import build_native_lib
+
+DEFAULT_BLOCK_SIZE = 1 << 20
+DEFAULT_QUEUE_DEPTH = 32
+DEFAULT_THREADS = 8
+
+
+def _as_bytes_view(arr: np.ndarray) -> np.ndarray:
+    assert arr.flags["C_CONTIGUOUS"], "aio buffers must be contiguous"
+    return arr
+
+
+class PinnedBuffer:
+    """Page-aligned host buffer exposed as a numpy array.
+
+    Reference: deepspeed_pin_tensor.cpp (new_cpu_locked_tensor).
+    """
+
+    def __init__(self, nbytes: int, dtype=np.float32):
+        self._lib = build_native_lib()
+        self.nbytes = int(nbytes)
+        if self._lib is not None:
+            self._ptr = self._lib.dstpu_alloc_pinned(self.nbytes)
+            if not self._ptr:
+                raise MemoryError(f"pinned alloc of {nbytes} bytes failed")
+            buf = (ctypes.c_char * self.nbytes).from_address(self._ptr)
+            self.array = np.frombuffer(buf, dtype=dtype)
+        else:
+            self._ptr = None
+            self.array = np.zeros(self.nbytes // np.dtype(dtype).itemsize,
+                                  dtype=dtype)
+
+    def free(self):
+        if self._ptr is not None and self._lib is not None:
+            self._lib.dstpu_free_pinned(self._ptr, self.nbytes)
+            self._ptr = None
+            self.array = None
+
+    def __del__(self):  # pragma: no cover - gc timing
+        try:
+            self.free()
+        except Exception:
+            pass
+
+
+class AsyncIOHandle:
+    """Async file reader/writer of numpy arrays.
+
+    API parity with the reference aio_handle: async_pread/async_pwrite
+    queue work, wait() blocks for all in-flight requests and returns the
+    number of failed requests (0 == success).
+    """
+
+    def __init__(self, block_size: int = DEFAULT_BLOCK_SIZE,
+                 queue_depth: int = DEFAULT_QUEUE_DEPTH,
+                 num_threads: int = DEFAULT_THREADS):
+        self.block_size = block_size
+        self.queue_depth = queue_depth
+        self.num_threads = num_threads
+        self._lib = build_native_lib()
+        if self._lib is not None:
+            self._h = self._lib.dstpu_aio_create(block_size, queue_depth,
+                                                 num_threads)
+            self._pool = None
+        else:
+            self._h = None
+            self._pool = _fut.ThreadPoolExecutor(max_workers=num_threads)
+        self._futures: List[_fut.Future] = []
+        # buffers of in-flight requests: the worker threads read/write the
+        # raw pointers, so the arrays must outlive the request (a GC'd
+        # source array would be use-after-free in the native pool)
+        self._refs: List[np.ndarray] = []
+
+    # -- async API ---------------------------------------------------------
+    def async_pread(self, arr: np.ndarray, path: str, offset: int = 0) -> int:
+        arr = _as_bytes_view(arr)
+        self._refs.append(arr)
+        if self._h is not None:
+            rid = self._lib.dstpu_aio_pread(
+                self._h, arr.ctypes.data_as(ctypes.c_void_p), arr.nbytes,
+                path.encode(), offset)
+            if rid < 0:
+                raise IOError(f"aio pread submit failed for {path}")
+            return rid
+        self._futures.append(self._pool.submit(self._py_read, arr, path, offset))
+        return len(self._futures)
+
+    def async_pwrite(self, arr: np.ndarray, path: str, offset: int = 0) -> int:
+        arr = _as_bytes_view(arr)
+        self._refs.append(arr)
+        if self._h is not None:
+            rid = self._lib.dstpu_aio_pwrite(
+                self._h, arr.ctypes.data_as(ctypes.c_void_p), arr.nbytes,
+                path.encode(), offset)
+            if rid < 0:
+                raise IOError(f"aio pwrite submit failed for {path}")
+            return rid
+        self._futures.append(self._pool.submit(self._py_write, arr, path, offset))
+        return len(self._futures)
+
+    def wait(self) -> int:
+        if self._h is not None:
+            errors = self._lib.dstpu_aio_wait(self._h)
+            self._refs.clear()
+            return errors
+        errors = 0
+        for f in self._futures:
+            try:
+                f.result()
+            except Exception:
+                errors += 1
+        self._futures.clear()
+        self._refs.clear()
+        return errors
+
+    # -- sync convenience --------------------------------------------------
+    def pread(self, arr: np.ndarray, path: str, offset: int = 0) -> None:
+        self.async_pread(arr, path, offset)
+        errs = self.wait()
+        if errs:
+            raise IOError(f"aio read of {path} failed ({errs} errors)")
+
+    def pwrite(self, arr: np.ndarray, path: str, offset: int = 0) -> None:
+        self.async_pwrite(arr, path, offset)
+        errs = self.wait()
+        if errs:
+            raise IOError(f"aio write of {path} failed ({errs} errors)")
+
+    # -- stats -------------------------------------------------------------
+    def bytes_read(self) -> int:
+        return self._lib.dstpu_aio_bytes_read(self._h) if self._h else -1
+
+    def bytes_written(self) -> int:
+        return self._lib.dstpu_aio_bytes_written(self._h) if self._h else -1
+
+    # -- python fallback ---------------------------------------------------
+    @staticmethod
+    def _py_read(arr: np.ndarray, path: str, offset: int):
+        with open(path, "rb") as f:
+            f.seek(offset)
+            data = f.read(arr.nbytes)
+        if len(data) != arr.nbytes:
+            raise IOError(f"short read from {path}: got {len(data)} of "
+                          f"{arr.nbytes} bytes")
+        arr.view(np.uint8).reshape(-1)[:] = np.frombuffer(data, np.uint8)
+
+    @staticmethod
+    def _py_write(arr: np.ndarray, path: str, offset: int):
+        mode = "r+b" if os.path.exists(path) else "wb"
+        with open(path, mode) as f:
+            f.seek(offset)
+            f.write(arr.tobytes())
+
+    def close(self):
+        if self._h is not None:
+            self._lib.dstpu_aio_destroy(self._h)
+            self._h = None
+        if self._pool is not None:
+            self._pool.shutdown(wait=True)
+            self._pool = None
+
+    def __del__(self):  # pragma: no cover
+        try:
+            self.close()
+        except Exception:
+            pass

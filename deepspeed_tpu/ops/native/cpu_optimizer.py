@@ -1,0 +1,225 @@
+"""Host-side optimizer steps over numpy arrays (offloaded ZeRO states).
+
+Reference: deepspeed/ops/adam/cpu_adam.py ``DeepSpeedCPUAdam`` wrapping
+csrc/adam/cpu_adam.cpp; also cpu_lion/cpu_adagrad. Numpy arrays play the
+role of CPU torch tensors; the native OpenMP kernels do the math, with a
+pure-numpy fallback when no compiler exists.
+
+Each optimizer owns fp32 master params + moments for ONE flat shard (the
+caller — runtime/offload.py — handles flattening, sharding and device
+transfer). ``step`` optionally emits a bf16 shadow copy for upload.
+"""
+
+from __future__ import annotations
+
+import ctypes
+from typing import Optional
+
+import numpy as np
+
+from deepspeed_tpu.ops.native.builder import build_native_lib
+
+
+def _f32p(a: np.ndarray):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_float))
+
+
+def _u16p(a: Optional[np.ndarray]):
+    if a is None:
+        return None
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_uint16))
+
+
+class CPUAdam:
+    """Adam/AdamW on a flat fp32 shard (reference: DeepSpeedCPUAdam)."""
+
+    def __init__(self, n: int, lr=1e-3, betas=(0.9, 0.999), eps=1e-8,
+                 weight_decay=0.0, adamw_mode=True, bias_correction=True):
+        self.n = int(n)
+        self.lr, self.betas, self.eps = lr, betas, eps
+        self.weight_decay = weight_decay
+        self.adamw_mode = adamw_mode
+        self.bias_correction = bias_correction
+        self.step_count = 0
+        self.exp_avg = np.zeros(self.n, np.float32)
+        self.exp_avg_sq = np.zeros(self.n, np.float32)
+        self._lib = build_native_lib()
+
+    def step(self, param_fp32: np.ndarray, grad: np.ndarray,
+             param_bf16_out: Optional[np.ndarray] = None,
+             lr: Optional[float] = None) -> None:
+        assert param_fp32.dtype == np.float32 and param_fp32.size == self.n
+        self.ensure_state()
+        self.step_count += 1
+        lr = self.lr if lr is None else float(lr)
+        b1, b2 = self.betas
+        if self._lib is not None:
+            if grad.dtype == np.float32:
+                self._lib.dstpu_adam_step(
+                    _f32p(param_fp32), _f32p(grad), _f32p(self.exp_avg),
+                    _f32p(self.exp_avg_sq), self.n, lr, b1, b2, self.eps,
+                    self.weight_decay, self.step_count,
+                    int(self.adamw_mode), int(self.bias_correction),
+                    _u16p(param_bf16_out))
+                return
+            if grad.dtype == np.uint16:  # bf16 bit pattern
+                self._lib.dstpu_adam_step_bf16grad(
+                    _f32p(param_fp32), _u16p(grad), _f32p(self.exp_avg),
+                    _f32p(self.exp_avg_sq), self.n, lr, b1, b2, self.eps,
+                    self.weight_decay, self.step_count,
+                    int(self.adamw_mode), int(self.bias_correction),
+                    _u16p(param_bf16_out))
+                return
+        self._numpy_step(param_fp32, grad, lr, param_bf16_out)
+
+    def _numpy_step(self, p, grad, lr, out_bf16):
+        if grad.dtype == np.uint16:
+            grad = bf16_to_f32(grad)
+        g = grad.astype(np.float32, copy=False)
+        if not self.adamw_mode and self.weight_decay > 0:
+            g = g + self.weight_decay * p
+        self.exp_avg *= self.betas[0]
+        self.exp_avg += (1 - self.betas[0]) * g
+        self.exp_avg_sq *= self.betas[1]
+        self.exp_avg_sq += (1 - self.betas[1]) * g * g
+        bc1 = 1 - self.betas[0] ** self.step_count if self.bias_correction else 1.0
+        bc2 = 1 - self.betas[1] ** self.step_count if self.bias_correction else 1.0
+        denom = np.sqrt(self.exp_avg_sq) / np.sqrt(bc2) + self.eps
+        # decoupled wd uses plain lr (torch AdamW / optax), not lr/bc1
+        if self.adamw_mode and self.weight_decay > 0:
+            p -= lr * self.weight_decay * p
+        p -= (lr / bc1) * (self.exp_avg / denom)
+        if out_bf16 is not None:
+            out_bf16[:] = f32_to_bf16(p)
+
+    def state_dict(self):
+        self.ensure_state()
+        return {"exp_avg": self.exp_avg, "exp_avg_sq": self.exp_avg_sq,
+                "step": self.step_count}
+
+    def load_state_dict(self, sd):
+        self.ensure_state()
+        self.exp_avg[:] = sd["exp_avg"]
+        self.exp_avg_sq[:] = sd["exp_avg_sq"]
+        self.step_count = int(sd["step"])
+
+    def ensure_state(self):
+        """(Re)allocate moment buffers after detach_state."""
+        if self.exp_avg is None:
+            self.exp_avg = np.zeros(self.n, np.float32)
+        if self.exp_avg_sq is None:
+            self.exp_avg_sq = np.zeros(self.n, np.float32)
+
+    def detach_state(self):
+        """Drop moment buffers from host RAM (NVMe tier: the swap store
+        holds the truth between steps)."""
+        self.exp_avg = None
+        self.exp_avg_sq = None
+
+
+class CPULion:
+    """Lion on a flat fp32 shard (reference: deepspeed/ops/lion)."""
+
+    def __init__(self, n: int, lr=1e-4, betas=(0.9, 0.99), weight_decay=0.0):
+        self.n = int(n)
+        self.lr, self.betas, self.weight_decay = lr, betas, weight_decay
+        self.exp_avg = np.zeros(self.n, np.float32)
+        self._lib = build_native_lib()
+
+    def step(self, param_fp32, grad, param_bf16_out=None, lr=None):
+        self.ensure_state()
+        lr = self.lr if lr is None else float(lr)
+        b1, b2 = self.betas
+        if self._lib is not None and grad.dtype == np.float32:
+            self._lib.dstpu_lion_step(
+                _f32p(param_fp32), _f32p(grad), _f32p(self.exp_avg), self.n,
+                lr, b1, b2, self.weight_decay, _u16p(param_bf16_out))
+            return
+        if grad.dtype == np.uint16:
+            grad = bf16_to_f32(grad)
+        c = b1 * self.exp_avg + (1 - b1) * grad
+        param_fp32 *= (1 - lr * self.weight_decay)
+        param_fp32 -= lr * np.sign(c)
+        self.exp_avg *= b2
+        self.exp_avg += (1 - b2) * grad
+        if param_bf16_out is not None:
+            param_bf16_out[:] = f32_to_bf16(param_fp32)
+
+    def state_dict(self):
+        self.ensure_state()
+        return {"exp_avg": self.exp_avg}
+
+    def load_state_dict(self, sd):
+        self.ensure_state()
+        self.exp_avg[:] = sd["exp_avg"]
+
+    def ensure_state(self):
+        if self.exp_avg is None:
+            self.exp_avg = np.zeros(self.n, np.float32)
+
+    def detach_state(self):
+        self.exp_avg = None
+
+
+class CPUAdagrad:
+    """Adagrad on a flat fp32 shard (reference: csrc/adagrad)."""
+
+    def __init__(self, n: int, lr=1e-2, eps=1e-10, weight_decay=0.0):
+        self.n = int(n)
+        self.lr, self.eps, self.weight_decay = lr, eps, weight_decay
+        self.exp_avg_sq = np.zeros(self.n, np.float32)
+        self._lib = build_native_lib()
+
+    def step(self, param_fp32, grad, param_bf16_out=None, lr=None):
+        self.ensure_state()
+        lr = self.lr if lr is None else float(lr)
+        if self._lib is not None and grad.dtype == np.float32:
+            self._lib.dstpu_adagrad_step(
+                _f32p(param_fp32), _f32p(grad), _f32p(self.exp_avg_sq),
+                self.n, lr, self.eps, self.weight_decay, _u16p(param_bf16_out))
+            return
+        if grad.dtype == np.uint16:
+            grad = bf16_to_f32(grad)
+        g = grad + self.weight_decay * param_fp32 if self.weight_decay > 0 else grad
+        self.exp_avg_sq += g * g
+        param_fp32 -= lr * g / (np.sqrt(self.exp_avg_sq) + self.eps)
+        if param_bf16_out is not None:
+            param_bf16_out[:] = f32_to_bf16(param_fp32)
+
+    def state_dict(self):
+        self.ensure_state()
+        return {"exp_avg_sq": self.exp_avg_sq}
+
+    def load_state_dict(self, sd):
+        self.ensure_state()
+        self.exp_avg_sq[:] = sd["exp_avg_sq"]
+
+    def ensure_state(self):
+        if self.exp_avg_sq is None:
+            self.exp_avg_sq = np.zeros(self.n, np.float32)
+
+    def detach_state(self):
+        self.exp_avg_sq = None
+
+
+def f32_to_bf16(x: np.ndarray) -> np.ndarray:
+    """Round-to-nearest-even fp32 -> bf16 bit patterns (uint16)."""
+    lib = build_native_lib()
+    out = np.empty(x.size, np.uint16)
+    if lib is not None:
+        lib.dstpu_f32_to_bf16(_f32p(np.ascontiguousarray(x, np.float32)),
+                              _u16p(out), x.size)
+        return out.reshape(x.shape)
+    bits = np.ascontiguousarray(x, np.float32).view(np.uint32)
+    lsb = (bits >> 16) & 1
+    rounded = bits + 0x7FFF + lsb
+    return (rounded >> 16).astype(np.uint16).reshape(x.shape)
+
+
+def bf16_to_f32(x: np.ndarray) -> np.ndarray:
+    """bf16 bit patterns (uint16) -> fp32."""
+    return (x.astype(np.uint32) << 16).view(np.float32).reshape(x.shape)
+
+
+CPU_OPTIMIZERS = {"adam": CPUAdam, "adamw": CPUAdam, "lion": CPULion,
+                  "adagrad": CPUAdagrad}

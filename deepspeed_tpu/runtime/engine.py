@@ -156,6 +156,12 @@ class Engine:
             self.lr_schedule = None
         self.lr_scheduler = lr_scheduler or self.lr_schedule
 
+        # -- offload (ZeRO-Offload/Infinity analog) -----------------------
+        off_cfg = config.zero_optimization.offload_optimizer
+        self._offload_device = (off_cfg.device if off_cfg is not None
+                                else "none") or "none"
+        self._offload = None  # built in _build_state when enabled
+
         # -- state init (sharded; zero.Init analog is in abstract init) ---
         self._rng = jax.random.PRNGKey(seed if seed is not None else config.seed)
         self._axes = model.logical_axes()
@@ -231,16 +237,46 @@ class Engine:
         opt_sh = plan.opt_shardings(self._axes)
         cdt = self.compute_dtype
 
-        def init_fn(rng):
-            p32 = self.model.init(rng)
-            p32 = _constrain_tree(p32, opt_sh)
-            mp = init_mixed_precision(p32, self.tx)
-            params = jax.tree.map(lambda m: m.astype(cdt), mp.master)
-            params = _constrain_tree(params, param_sh)
-            return params, mp
+        if self._offload_device in ("cpu", "nvme"):
+            # fp32 init sharded like optimizer state, pulled host-side into
+            # the native offload optimizer; device keeps compute dtype only
+            # (reference: stage_1_and_2.py cpu_offload / stage3.py
+            # offload_optimizer paths).
+            def init32(rng):
+                p32 = self.model.init(rng)
+                return _constrain_tree(p32, opt_sh)
 
-        with jax.set_mesh(mesh) if hasattr(jax, "set_mesh") else _nullctx():
-            self.params, self.opt_state = jax.jit(init_fn)(self._rng)
+            with jax.set_mesh(mesh) if hasattr(jax, "set_mesh") else _nullctx():
+                p32 = jax.jit(init32)(self._rng)
+            from deepspeed_tpu.runtime.offload import HostOffloadOptimizer
+
+            ocfg = self.config.optimizer
+            off = self.config.zero_optimization.offload_optimizer
+            self._offload = HostOffloadOptimizer(
+                p32,
+                optimizer_name=(ocfg.type if ocfg else "adamw") or "adamw",
+                optimizer_params=dict((ocfg.params or {}) if ocfg else {}),
+                compute_dtype=cdt,
+                grad_clip=self.config.gradient_clipping,
+                nvme_path=(off.nvme_path
+                           if self._offload_device == "nvme" else None))
+            cast = jax.jit(
+                lambda t: _constrain_tree(
+                    jax.tree.map(lambda m: m.astype(cdt), t), param_sh),
+                donate_argnums=(0,))
+            self.params = cast(p32)
+            self.opt_state = None
+        else:
+            def init_fn(rng):
+                p32 = self.model.init(rng)
+                p32 = _constrain_tree(p32, opt_sh)
+                mp = init_mixed_precision(p32, self.tx)
+                params = jax.tree.map(lambda m: m.astype(cdt), mp.master)
+                params = _constrain_tree(params, param_sh)
+                return params, mp
+
+            with jax.set_mesh(mesh) if hasattr(jax, "set_mesh") else _nullctx():
+                self.params, self.opt_state = jax.jit(init_fn)(self._rng)
         self._param_shardings = param_sh
         self._opt_shardings = opt_sh
         # scalars live replicated on the mesh so every jitted fn (and every
@@ -314,8 +350,43 @@ class Engine:
             metrics["loss"] = jnp.mean(losses)
             return params, opt_state, new_ls, new_step, metrics
 
+        opt_sh = self._opt_shardings
+        off_cfg = cfg.zero_optimization.offload_optimizer
+        grad_xfer_bf16 = (off_cfg is not None
+                          and off_cfg.grad_transfer_dtype == "bf16")
+
+        def grad_step(params, batches, scale):
+            """Offload path: (loss-scaled) grads only — the update happens
+            host-side in the native CPU optimizer (runtime/offload.py),
+            which unscales by grad_scale. grad_transfer_dtype=bf16 halves
+            device->host volume and feeds the native bf16-grad kernel."""
+
+            def total_loss(params):
+                def body(carry, mb):
+                    loss, aux = self.model.loss(params, mb)
+                    return carry + loss * scale / gas, loss
+
+                total, losses = lax.scan(body, jnp.asarray(0.0, jnp.float32),
+                                         batches)
+                return total, losses
+
+            (_, losses), grads = jax.value_and_grad(
+                total_loss, has_aux=True)(params)
+            xfer = jnp.bfloat16 if grad_xfer_bf16 else jnp.float32
+            grads = jax.tree.map(lambda g: g.astype(xfer), grads)
+            grads = _constrain_tree(grads, opt_sh)
+            return grads, jnp.mean(losses)
+
         donate = (0, 1, 2, 3)
         self._jit_train_step = jax.jit(train_step, donate_argnums=donate)
+        self._jit_grad_step = jax.jit(grad_step)
+        # offload resharding hops: host-updated (optimizer-sharded) tree →
+        # param sharding = the "allgather updated partitions" collective,
+        # compiled by XLA over ICI; and grad-acc → optimizer sharding.
+        self._jit_reshard_to_params = jax.jit(lambda t: t,
+                                              out_shardings=param_sh)
+        self._jit_to_opt_sharding = jax.jit(
+            lambda t: t, out_shardings=opt_sh)
         self._jit_fwd_bwd = jax.jit(fwd_bwd)
         self._jit_apply = jax.jit(apply_update, donate_argnums=(0, 1, 2, 3, 4))
         self._jit_eval = jax.jit(lambda params, batch: self.model.loss(params, batch))
@@ -365,10 +436,16 @@ class Engine:
         self.tput_timer.start()
         batches = self._next_microbatches(data_iter,
                                           self.gradient_accumulation_steps)
-        (self.params, self.opt_state, self.loss_scale_state, self.step_count,
-         metrics) = self._jit_train_step(
-            self.params, self.opt_state, self.loss_scale_state,
-            self.step_count, batches)
+        if self._offload is not None:
+            scale = (self.loss_scale_state.scale if self.config.fp16.enabled
+                     else jnp.asarray(1.0, jnp.float32))
+            grads, loss = self._jit_grad_step(self.params, batches, scale)
+            metrics = self._offload_apply(grads, loss)
+        else:
+            (self.params, self.opt_state, self.loss_scale_state,
+             self.step_count, metrics) = self._jit_train_step(
+                self.params, self.opt_state, self.loss_scale_state,
+                self.step_count, batches)
         self._after_step(metrics)
         self.timers(TRAIN_BATCH_TIMER).stop(block=metrics["loss"])
         return metrics["loss"]
@@ -414,13 +491,43 @@ class Engine:
         if self._grad_acc is None:
             raise RuntimeError("step() called without accumulated gradients")
         self.timers(STEP_GLOBAL_TIMER).start()
-        (self.params, self.opt_state, self.loss_scale_state, self.step_count,
-         metrics) = self._jit_apply(
-            self.params, self.opt_state, self.loss_scale_state,
-            self.step_count, self._grad_acc, jnp.asarray(0.0))
+        if self._offload is not None:
+            grads = self._jit_to_opt_sharding(self._grad_acc)
+            metrics = self._offload_apply(grads, None)
+        else:
+            (self.params, self.opt_state, self.loss_scale_state,
+             self.step_count, metrics) = self._jit_apply(
+                self.params, self.opt_state, self.loss_scale_state,
+                self.step_count, self._grad_acc, jnp.asarray(0.0))
         self._grad_acc = None
         self._after_step(metrics)
         self.timers(STEP_GLOBAL_TIMER).stop()
+
+    def _offload_apply(self, grads, loss):
+        """Host-side optimizer step (ZeRO-Offload boundary): device grads
+        → native CPU optimizer → resharded device params."""
+        lr = (float(self.lr_schedule(self.step_count)) if self.lr_schedule
+              else float(self._base_lr or 0.0))
+        fp16 = self.config.fp16.enabled
+        scale = float(self.loss_scale_state.scale) if fp16 else None
+        new_tree, gnorm, overflow = self._offload.step(
+            grads, self.params, lr=lr, grad_scale=scale,
+            skip_on_nonfinite=fp16)
+        if not overflow:
+            self.params = self._jit_reshard_to_params(new_tree)
+            self.step_count = self.step_count + 1
+        if fp16:
+            self.loss_scale_state = jax.device_put(
+                update_loss_scale(self.loss_scale_state,
+                                  jnp.asarray(overflow), self.config.fp16),
+                NamedSharding(self.mesh, P()))
+        self._last_grad_norm = gnorm
+        metrics = {"grad_norm": jnp.asarray(gnorm), "lr": jnp.asarray(lr),
+                   "loss_scale": self.loss_scale_state.scale,
+                   "overflow": jnp.asarray(overflow)}
+        if loss is not None:
+            metrics["loss"] = loss
+        return metrics
 
     def eval_batch(self, batch):
         batch = self.shard_batch(batch)

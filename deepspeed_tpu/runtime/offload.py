@@ -1,0 +1,397 @@
+"""ZeRO-Offload / ZeRO-Infinity equivalent: host (+NVMe) optimizer states.
+
+Reference semantics (runtime/zero/offload_config.py, stage_1_and_2.py
+cpu_offload path, stage3.py offload_optimizer + swap_tensor/*): fp32
+master weights and optimizer moments live on the host (or NVMe); the
+device computes grads in compute dtype; each boundary the grads' local
+partition is copied host-side, the vectorized native CPU optimizer
+(ops/native/cpu_optimizer.py, reference csrc/adam/cpu_adam.cpp) steps the
+flat shard, and the updated compute-dtype shard is uploaded back.
+
+Partitioning falls out of the grad/param sharding plan: each process
+updates exactly the UNIQUE addressable shards of every leaf (dedup by
+shard.index — replicas along tp/sp axes are uploaded to every holder but
+stepped once), which is precisely the ZeRO partition of the local host.
+
+NVMe tier: with ``offload_optimizer.device == "nvme"`` the fp32 master +
+moments of each shard live in a TensorSwapStore (native AIO) and are
+swapped in/out around that shard's step (moments are detached from RAM
+after swap-out), so resident optimizer state is bounded at one shard;
+the fetched gradient shards are still all host-resident within a step
+(reference: PartitionedOptimizerSwapper partitioned_optimizer_swapper.py:27).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, List, Optional, Tuple
+
+import jax
+import numpy as np
+
+from deepspeed_tpu.ops.native.builder import build_native_lib
+from deepspeed_tpu.ops.native.cpu_optimizer import (
+    CPU_OPTIMIZERS, CPUAdam, bf16_to_f32, f32_to_bf16)
+from deepspeed_tpu.runtime.swap_tensor.swapper import TensorSwapStore
+from deepspeed_tpu.utils.logging import log_dist, logger
+
+try:
+    import ml_dtypes
+
+    _BF16 = np.dtype(ml_dtypes.bfloat16)
+except ImportError:  # pragma: no cover
+    _BF16 = None
+
+
+def _leaf_paths(tree):
+    flat, treedef = jax.tree_util.tree_flatten_with_path(tree)
+    paths = [jax.tree_util.keystr(kp) for kp, _ in flat]
+    leaves = [v for _, v in flat]
+    return paths, leaves, treedef
+
+
+def _index_key(index) -> str:
+    return repr(index)
+
+
+def _to_f32(host: np.ndarray) -> np.ndarray:
+    if _BF16 is not None and host.dtype == _BF16:
+        return bf16_to_f32(host.view(np.uint16)).reshape(host.shape)
+    return np.ascontiguousarray(host, np.float32)
+
+
+class HostOffloadOptimizer:
+    """Owns host-resident fp32 master params + moments for every unique
+    local shard; steps them with the native CPU optimizer."""
+
+    def __init__(self, params, optimizer_name: str = "adamw",
+                 optimizer_params: Optional[dict] = None,
+                 compute_dtype=None, grad_clip: float = 0.0,
+                 nvme_path: Optional[str] = None):
+        optimizer_params = dict(optimizer_params or {})
+        self.lr = float(optimizer_params.pop("lr", 1e-3))
+        name = optimizer_name.lower()
+        if name in ("adam", "adamw"):
+            optimizer_params.setdefault("adamw_mode", name == "adamw")
+        self._opt_cls = CPU_OPTIMIZERS.get(name)
+        if self._opt_cls is None:
+            raise ValueError(
+                f"host offload supports {sorted(CPU_OPTIMIZERS)}, got {name!r}")
+        self._opt_kwargs = optimizer_params
+        self.grad_clip = grad_clip
+        self.compute_dtype = compute_dtype
+
+        self._swap: Optional[TensorSwapStore] = None
+        if nvme_path:
+            folder = os.path.join(nvme_path, f"dstpu_opt_swap_{os.getpid()}",
+                                  f"rank{jax.process_index()}")
+            self._swap = TensorSwapStore(folder)
+
+        # masters[(leaf_path, index_key)] = fp32 flat buffer (or None when
+        # swapped out); optimizers keyed the same.
+        self.masters: Dict[Tuple[str, str], Optional[np.ndarray]] = {}
+        self.optimizers: Dict[Tuple[str, str], object] = {}
+        self._shard_shapes: Dict[Tuple[str, str], tuple] = {}
+        self._owned_cache: Optional[set] = None
+        self._init_from_params(params)
+        n = sum(o.n for o in self.optimizers.values())
+        where = "nvme" if self._swap else "cpu"
+        log_dist(f"host offload optimizer: {len(self.optimizers)} shards, "
+                 f"{n/1e6:.1f}M local elements on {where}", ranks=[0])
+
+    # ------------------------------------------------------------------
+    def _init_from_params(self, params) -> None:
+        paths, leaves, _ = _leaf_paths(params)
+        # global layout of the optimizer partition, for rebuilds after load
+        self._leaf_layout: Dict[str, Tuple[tuple, object]] = {}
+        for path, leaf in zip(paths, leaves):
+            self._leaf_layout[path] = (leaf.shape, leaf.sharding)
+            for shard in leaf.addressable_shards:
+                key = (path, _index_key(shard.index))
+                if key in self.masters:
+                    continue
+                host = np.asarray(shard.data)
+                master = _to_f32(host).reshape(-1).copy()
+                self._shard_shapes[key] = host.shape
+                opt = self._opt_cls(master.size, lr=self.lr, **self._opt_kwargs)
+                self.optimizers[key] = opt
+                if self._swap is not None:
+                    self._swap.register(f"{path}.{_index_key(shard.index)}.master",
+                                        master)
+                    self.masters[key] = None
+                else:
+                    self.masters[key] = master
+        if self._swap is not None:
+            # moments start as zeros; register lazily at first swap-out
+            self._swap.wait()
+
+    # ------------------------------------------------------------------
+    def _swap_in(self, key) -> np.ndarray:
+        path, idx = key
+        master = self._swap.swap_in(f"{path}.{idx}.master")
+        opt = self.optimizers[key]
+        sd = opt.state_dict()  # (re)allocates moment buffers via ensure_state
+        for name in sd:
+            if name == "step":
+                continue
+            sname = f"{path}.{idx}.{name}"
+            if self._swap.contains(sname):
+                self._swap.swap_in(sname, out=sd[name])
+        return master
+
+    def _swap_out(self, key, master: np.ndarray) -> None:
+        path, idx = key
+        self._swap.swap_out(f"{path}.{idx}.master", master)
+        sd = self.optimizers[key].state_dict()
+        for name, arr in sd.items():
+            if name == "step":
+                continue
+            self._swap.swap_out(f"{path}.{idx}.{name}", arr)
+        self._swap.wait()
+        # bound host RAM: moments live on NVMe between steps
+        self.optimizers[key].detach_state()
+
+    # ------------------------------------------------------------------
+    def _owned_keys(self, g_paths, g_leaves) -> set:
+        """Keys of shards this process owns for grad-norm accounting (the
+        lowest (process_index, device_id) replica). Static for a fixed
+        sharding — computed once and cached."""
+        if self._owned_cache is not None:
+            return self._owned_cache
+        my_proc = jax.process_index()
+        owned = set()
+        for path, gleaf in zip(g_paths, g_leaves):
+            idx_map = gleaf.sharding.devices_indices_map(gleaf.shape)
+            owner: Dict[str, Tuple[int, int]] = {}
+            for device, index in idx_map.items():
+                k = _index_key(index)
+                cand = (device.process_index, device.id)
+                if k not in owner or cand < owner[k]:
+                    owner[k] = cand
+            for k, (proc, _dev) in owner.items():
+                if proc == my_proc:
+                    owned.add((path, k))
+        self._owned_cache = owned
+        return owned
+
+    def step(self, grads, params, lr: Optional[float] = None,
+             grad_scale: Optional[float] = None,
+             skip_on_nonfinite: bool = False):
+        """Apply one update; returns (new_cdt_tree, grad_norm, overflow).
+
+        ``grads`` must carry the optimizer (fully-sharded) sharding — its
+        shard layout IS the ZeRO partition this host owns. The returned
+        tree carries the same sharding in compute dtype; the engine
+        reshards it to the param sharding under jit, which is exactly the
+        reference's "allgather updated partitions" collective
+        (stage_1_and_2.py step :2204), but emitted by XLA over ICI.
+        """
+        lr = self.lr if lr is None else float(lr)
+        g_paths, g_leaves, g_treedef = _leaf_paths(grads)
+        p_paths, p_leaves, _ = _leaf_paths(params)
+        assert g_paths == p_paths, "grad/param tree mismatch"
+
+        # 1) fetch unique grad shards to host (device->host copy). bf16
+        # grads stay bf16 (uint16 bit view) — the native optimizer kernels
+        # consume them directly (dstpu_adam_step_bf16grad).
+        host_grads: Dict[Tuple[str, str], np.ndarray] = {}
+        for path, gleaf in zip(g_paths, g_leaves):
+            for shard in gleaf.addressable_shards:
+                key = (path, _index_key(shard.index))
+                if key in host_grads or key not in self.optimizers:
+                    continue
+                host = np.asarray(shard.data)
+                if _BF16 is not None and host.dtype == _BF16:
+                    host_grads[key] = np.ascontiguousarray(
+                        host.view(np.uint16)).reshape(-1)
+                else:
+                    host_grads[key] = np.ascontiguousarray(
+                        host, np.float32).reshape(-1)
+
+        # 2) global grad norm. Each shard is counted by exactly ONE process
+        # globally: the owner is the lowest (process_index, device_id)
+        # holding it — in-process replicas are deduped by the host_grads
+        # keying, cross-process replicas by the (cached) ownership set.
+        owned = self._owned_keys(g_paths, g_leaves)
+        lib = build_native_lib()
+        sq = 0.0
+        for key, arr in host_grads.items():
+            if key not in owned:
+                continue
+            if arr.dtype == np.uint16:
+                f = bf16_to_f32(arr)
+                sq += float(np.dot(f, f))
+            elif lib is not None:
+                import ctypes
+
+                sq += lib.dstpu_sq_norm(
+                    arr.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+                    arr.size)
+            else:
+                sq += float(np.dot(arr, arr))
+        if jax.process_count() > 1:
+            from jax.experimental import multihost_utils
+
+            sq = float(np.sum(multihost_utils.process_allgather(
+                np.asarray([sq]))))
+        if grad_scale and grad_scale != 1.0:
+            sq /= grad_scale ** 2
+        gnorm = float(np.sqrt(sq))
+
+        coef = 1.0
+        if grad_scale and grad_scale != 1.0:
+            coef /= grad_scale
+        if self.grad_clip and gnorm > self.grad_clip:
+            coef *= self.grad_clip / (gnorm + 1e-6)
+        # only the fp16 loss-scaling protocol skips steps on overflow
+        # (matching the device path's apply_update); bf16 runs apply the
+        # step so a NaN source is visible, not silently spun on.
+        overflow = skip_on_nonfinite and not np.isfinite(gnorm)
+
+        if overflow:
+            return None, gnorm, True
+
+        # 3) step each unique local shard (ZeRO partition of this host)
+        updated: Dict[Tuple[str, str], np.ndarray] = {}
+        for (path, gleaf), pleaf in zip(zip(g_paths, g_leaves), p_leaves):
+            cdt = pleaf.dtype
+            use_bf16_out = (_BF16 is not None and cdt == _BF16)
+            for shard in gleaf.addressable_shards:
+                key = (path, _index_key(shard.index))
+                if key in updated or key not in self.optimizers:
+                    continue
+                g = host_grads[key]
+                if coef != 1.0:
+                    # scaling needs fp32; otherwise bf16 grads flow to the
+                    # native bf16-grad kernel unwidened
+                    if g.dtype == np.uint16:
+                        g = bf16_to_f32(g)
+                    g = g * np.float32(coef)
+                master = (self._swap_in(key) if self._swap is not None
+                          else self.masters[key])
+                out_bf16 = (np.empty(master.size, np.uint16)
+                            if use_bf16_out else None)
+                self.optimizers[key].step(master, g, param_bf16_out=out_bf16,
+                                          lr=lr)
+                shape = self._shard_shapes[key]
+                if use_bf16_out:
+                    updated[key] = out_bf16.view(_BF16).reshape(shape)
+                else:
+                    updated[key] = master.reshape(shape).astype(cdt)
+                if self._swap is not None:
+                    self._swap_out(key, master)
+
+        # 4) upload: rebuild each leaf WITH THE GRAD (optimizer) SHARDING;
+        # the engine reshards to the param sharding under jit.
+        new_leaves = []
+        for (path, gleaf), pleaf in zip(zip(g_paths, g_leaves), p_leaves):
+            cdt = pleaf.dtype
+            bufs = []
+            for shard in gleaf.addressable_shards:
+                key = (path, _index_key(shard.index))
+                bufs.append(jax.device_put(updated[key].astype(cdt, copy=False),
+                                           shard.device))
+            new_leaves.append(jax.make_array_from_single_device_arrays(
+                gleaf.shape, gleaf.sharding, bufs))
+        new_tree = jax.tree_util.tree_unflatten(g_treedef, new_leaves)
+        return new_tree, gnorm, overflow
+
+    # ------------------------------------------------------------------
+    def reinit_masters(self, p32_tree) -> None:
+        """Re-seed fp32 masters from a device tree carrying the optimizer
+        sharding (moments reset to zero). Used when a checkpoint is loaded
+        without optimizer state."""
+        paths, leaves, _ = _leaf_paths(p32_tree)
+        for path, leaf in zip(paths, leaves):
+            for shard in leaf.addressable_shards:
+                key = (path, _index_key(shard.index))
+                if key not in self.optimizers:
+                    continue
+                master = _to_f32(np.asarray(shard.data)).reshape(-1).copy()
+                self.optimizers[key] = self._opt_cls(master.size, lr=self.lr,
+                                                     **self._opt_kwargs)
+                if self._swap is not None:
+                    self._swap_out(key, master)
+                    self.masters[key] = None
+                else:
+                    self.masters[key] = master
+
+    # ------------------------------------------------------------------
+    # checkpoint surface (engine CheckpointIO hooks)
+    # ------------------------------------------------------------------
+    def state_dict(self) -> dict:
+        """NVMe caveat: the returned dict holds ALL local shards' masters
+        and moments at once (np.savez needs them together) — peak host RAM
+        during checkpointing is the full local optimizer state."""
+        out = {}
+        for key, opt in self.optimizers.items():
+            master = (self._swap_in(key) if self._swap is not None
+                      else self.masters[key])
+            entry = {"master": np.asarray(master),
+                     "shape": self._shard_shapes[key]}
+            entry.update({k: np.asarray(v) if isinstance(v, np.ndarray) else v
+                          for k, v in opt.state_dict().items()})
+            out[f"{key[0]}|{key[1]}"] = entry
+            if self._swap is not None:
+                # the dict keeps the refs; drop the optimizer's own copies
+                opt.detach_state()
+        return out
+
+    def load_state_dict(self, sd: dict) -> None:
+        matched = set()
+        for flat_key, entry in sd.items():
+            path, idx = flat_key.split("|", 1)
+            key = (path, idx)
+            if key not in self.optimizers:
+                logger.warning(f"offload load: unknown shard {key}; skipped")
+                continue
+            master = np.ascontiguousarray(entry["master"], np.float32)
+            opt_sd = {k: v for k, v in entry.items()
+                      if k not in ("master", "shape")}
+            self.optimizers[key].load_state_dict(opt_sd)
+            if self._swap is not None:
+                self._swap_out(key, master)
+            else:
+                self.masters[key] = master
+            matched.add(key)
+        missing = set(self.optimizers) - matched
+        if missing:
+            # an unmatched shard would keep its INIT master, and the next
+            # sync/step would overwrite the restored params with it — fail
+            # loudly instead (topology changed: resave from the original
+            # layout or load with load_optimizer_states=False).
+            raise ValueError(
+                f"offload optimizer state covers {len(matched)} of "
+                f"{len(self.optimizers)} local shards; {len(missing)} "
+                "missing (e.g. "
+                f"{sorted(missing)[:2]}). The checkpoint was saved on a "
+                "different process/mesh layout — load with "
+                "load_optimizer_states=False to rebuild masters from the "
+                "checkpoint params.")
+
+    def sync_params_from_masters(self, params):
+        """Rebuild a compute-dtype tree (optimizer sharding) from host
+        masters; the engine reshards it to the param sharding. Used after
+        checkpoint load."""
+        p_paths, p_leaves, p_treedef = _leaf_paths(params)
+        new_leaves = []
+        for path, pleaf in zip(p_paths, p_leaves):
+            cdt = pleaf.dtype
+            gshape, sharding = self._leaf_layout[path]
+            bufs = []
+            idx_map = sharding.addressable_devices_indices_map(gshape)
+            for device, index in idx_map.items():
+                key = (path, _index_key(index))
+                # only the master is needed here — don't drag moments in
+                master = (self._swap.swap_in(f"{path}.{index!r}.master")
+                          if self._swap is not None
+                          else self.masters.get(key))
+                shape = self._shard_shapes[key]
+                if _BF16 is not None and cdt == _BF16:
+                    piece = f32_to_bf16(master).view(_BF16).reshape(shape)
+                else:
+                    piece = master.reshape(shape).astype(cdt)
+                bufs.append(jax.device_put(piece, device))
+            new_leaves.append(jax.make_array_from_single_device_arrays(
+                gshape, sharding, bufs))
+        return jax.tree_util.tree_unflatten(p_treedef, new_leaves)

@@ -1,0 +1,12 @@
+"""NVMe tensor swapping for ZeRO-Infinity-style memory extension.
+
+Reference: deepspeed/runtime/swap_tensor/ (AsyncTensorSwapper
+async_swapper.py:19, AsyncPartitionedParameterSwapper
+partitioned_param_swapper.py:37, PartitionedOptimizerSwapper
+optimizer_utils.py/partitioned_optimizer_swapper.py:27). The device leg
+is JAX host transfer; these managers own the host<->NVMe leg on the
+native AIO library.
+"""
+
+from deepspeed_tpu.runtime.swap_tensor.swapper import (
+    AsyncTensorSwapper, SwapBufferPool, TensorSwapStore)

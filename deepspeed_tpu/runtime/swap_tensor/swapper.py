@@ -1,0 +1,147 @@
+"""Async host<->NVMe swap of flat numpy buffers.
+
+Reference mapping:
+  * ``SwapBufferPool``   — pinned staging buffers
+    (swap_tensor/utils.py SwapBufferPool/SwapBufferManager).
+  * ``AsyncTensorSwapper`` — fire-and-forget swap-out of buffers with
+    deferred completion (swap_tensor/async_swapper.py:19
+    AsyncTensorSwapper: add_buffers/swap_out_tensors/
+    wait_for_swapout... semantics).
+  * ``TensorSwapStore`` — keyed store of named flat tensors on disk with
+    swap_in/swap_out, used by the optimizer/param swappers
+    (partitioned_optimizer_swapper.py:27, partitioned_param_swapper.py:37).
+
+All byte counts are element counts × 4 (fp32) or × 2 (bf16); files are
+one-tensor-per-file under a swap folder, like the reference's
+``zero_stage_3`` swap layout.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from deepspeed_tpu.ops.native.aio import (
+    AsyncIOHandle, DEFAULT_BLOCK_SIZE, DEFAULT_QUEUE_DEPTH, DEFAULT_THREADS,
+    PinnedBuffer)
+from deepspeed_tpu.utils.logging import logger
+
+
+class SwapBufferPool:
+    """Fixed pool of pinned staging buffers (reference SwapBufferPool)."""
+
+    def __init__(self, count: int, elems: int, dtype=np.float32):
+        self.elems = elems
+        self.dtype = np.dtype(dtype)
+        self._buffers = [PinnedBuffer(elems * self.dtype.itemsize, dtype)
+                         for _ in range(count)]
+        self._free = list(range(count))
+
+    def get(self) -> Tuple[int, np.ndarray]:
+        if not self._free:
+            raise RuntimeError("swap buffer pool exhausted")
+        i = self._free.pop()
+        return i, self._buffers[i].array
+
+    def put(self, i: int) -> None:
+        self._free.append(i)
+
+    def available(self) -> int:
+        return len(self._free)
+
+    def free(self):
+        for b in self._buffers:
+            b.free()
+        self._buffers = []
+        self._free = []
+
+
+class AsyncTensorSwapper:
+    """Queue buffers for async swap-out; completion deferred to
+    ``wait_for_swapout`` (reference async_swapper.py:19)."""
+
+    def __init__(self, aio: Optional[AsyncIOHandle] = None):
+        self.aio = aio or AsyncIOHandle()
+        self._inflight: List[str] = []
+
+    def swap_out(self, arr: np.ndarray, path: str) -> None:
+        self.aio.async_pwrite(arr, path)
+        self._inflight.append(path)
+
+    def swap_in(self, arr: np.ndarray, path: str) -> None:
+        self.aio.async_pread(arr, path)
+        self._inflight.append(path)
+
+    def wait(self) -> None:
+        errs = self.aio.wait()
+        if errs:
+            raise IOError(f"tensor swap failed: {errs} errors "
+                          f"(paths: {self._inflight[-errs:]})")
+        self._inflight.clear()
+
+
+class TensorSwapStore:
+    """Named flat tensors swapped to one file each under ``folder``.
+
+    The optimizer swapper (runtime/offload.py) registers each state
+    buffer once, then brackets the host step with swap_in/swap_out.
+    Reads/writes within one request are parallelized across the AIO
+    worker pool; ``sync=False`` swap-outs let the caller overlap the next
+    shard's compute with the write-back.
+    """
+
+    def __init__(self, folder: str, aio: Optional[AsyncIOHandle] = None):
+        self.folder = folder
+        os.makedirs(folder, exist_ok=True)
+        self.aio = aio or AsyncIOHandle()
+        self._meta: Dict[str, Tuple[int, np.dtype]] = {}
+
+    def _path(self, name: str) -> str:
+        safe = name.replace("/", "_").replace(".", "_")
+        return os.path.join(self.folder, f"{safe}.swp")
+
+    def register(self, name: str, arr: np.ndarray) -> None:
+        """Initial swap-out; afterwards the host copy may be dropped."""
+        self._meta[name] = (arr.size, arr.dtype)
+        self.aio.async_pwrite(arr, self._path(name))
+
+    def contains(self, name: str) -> bool:
+        return name in self._meta
+
+    def swap_in(self, name: str, out: Optional[np.ndarray] = None,
+                sync: bool = True) -> np.ndarray:
+        size, dtype = self._meta[name]
+        if out is None:
+            out = np.empty(size, dtype)
+        assert out.size == size and out.dtype == dtype
+        self.aio.async_pread(out, self._path(name))
+        if sync:
+            self._wait()
+        return out
+
+    def swap_out(self, name: str, arr: np.ndarray, sync: bool = False) -> None:
+        self._meta[name] = (arr.size, arr.dtype)
+        self.aio.async_pwrite(arr, self._path(name))
+        if sync:
+            self._wait()
+
+    def wait(self) -> None:
+        self._wait()
+
+    def _wait(self):
+        errs = self.aio.wait()
+        if errs:
+            raise IOError(f"swap store I/O failed ({errs} errors)")
+
+    def nbytes(self) -> int:
+        return sum(s * np.dtype(d).itemsize for s, d in self._meta.values())
+
+    def purge(self) -> None:
+        for name in self._meta:
+            try:
+                os.unlink(self._path(name))
+            except OSError:
+                pass
+        self._meta.clear()
