@@ -109,6 +109,20 @@ class OffloadOptimizerConfig(ConfigModel):
     grad_transfer_dtype: str = "fp32"
     ratio: float = 1.0
 
+    def validate(self) -> None:
+        if self.device not in ("none", "cpu", "nvme"):
+            raise ValueError(
+                f"offload_optimizer.device must be none|cpu|nvme, "
+                f"got {self.device!r}")
+        if self.device == "nvme" and not self.nvme_path:
+            raise ValueError(
+                "offload_optimizer.device='nvme' requires nvme_path "
+                "(otherwise state would silently stay in host RAM)")
+        if self.grad_transfer_dtype not in ("fp32", "bf16"):
+            raise ValueError(
+                f"offload_optimizer.grad_transfer_dtype must be fp32|bf16, "
+                f"got {self.grad_transfer_dtype!r}")
+
 
 @register_config_model
 @dataclass

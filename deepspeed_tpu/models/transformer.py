@@ -60,7 +60,20 @@ class TransformerConfig:
     remat: bool = True
     remat_policy: str = "nothing_saveable"
     attn_impl: str = "auto"  # auto | xla | flash
-    sequence_parallel: bool = False  # Ulysses all-to-all inside attention
+    sequence_parallel: bool = False  # SP attention over the sp mesh axis
+    sp_mode: str = "ulysses"  # ulysses (all-to-all) | ring (ppermute CP)
+    # ALST-style tiled compute (reference ulysses_sp.py TiledMLP /
+    # TiledFusedLogitsLoss): number of sequence tiles, 0/1 = off
+    tiled_logits: int = 0
+    tiled_mlp: int = 0
+    # FPDT-style chunked attention (reference fpdt_layer.py): number of
+    # query chunks scanned sequentially, 0/1 = off
+    attn_chunks: int = 0
+
+    def __post_init__(self):
+        if self.sp_mode not in ("ulysses", "ring"):
+            raise ValueError(
+                f"sp_mode must be ulysses|ring, got {self.sp_mode!r}")
 
     @property
     def kv_heads(self) -> int:
@@ -238,9 +251,24 @@ def _attention(q, k, v, cfg: TransformerConfig, causal: bool = True):
     from deepspeed_tpu.ops.attention import multi_head_attention
 
     if cfg.sequence_parallel:
+        if cfg.sp_mode == "ring":
+            # ring is already blockwise: per-chip attention memory is one
+            # [S/p × S/p] block, so attn_chunks adds nothing there
+            from deepspeed_tpu.parallel.ring_attention import ring_attention
+
+            return ring_attention(q, k, v, causal=causal)
+        if cfg.sp_mode != "ulysses":
+            raise ValueError(f"sp_mode must be ulysses|ring, got "
+                             f"{cfg.sp_mode!r}")
         from deepspeed_tpu.parallel.ulysses import ulysses_attention
 
-        return ulysses_attention(q, k, v, causal=causal, impl=cfg.attn_impl)
+        return ulysses_attention(q, k, v, causal=causal, impl=cfg.attn_impl,
+                                 attn_chunks=cfg.attn_chunks)
+    if cfg.attn_chunks > 1:
+        from deepspeed_tpu.parallel.fpdt import chunked_attention
+
+        return chunked_attention(q, k, v, causal=causal,
+                                 q_chunks=cfg.attn_chunks)
     return multi_head_attention(q, k, v, causal=causal, impl=cfg.attn_impl)
 
 
@@ -273,14 +301,25 @@ def _layer(cfg: TransformerConfig, x, layer_params, positions):
 
     # mlp
     y = _norm(x, layer_params["ln2"], cfg.norm, cfg.norm_eps)
-    if cfg.activation == "swiglu":
-        g = jnp.einsum("bsh,hf->bsf", y, mp["wg"].astype(dt))
-        u = jnp.einsum("bsh,hf->bsf", y, mp["wi"].astype(dt))
-        z = jax.nn.silu(g) * u
+
+    def mlp_fn(y):
+        if cfg.activation == "swiglu":
+            g = jnp.einsum("bsh,hf->bsf", y, mp["wg"].astype(dt))
+            u = jnp.einsum("bsh,hf->bsf", y, mp["wi"].astype(dt))
+            z = jax.nn.silu(g) * u
+        else:
+            z = jax.nn.gelu(jnp.einsum("bsh,hf->bsf", y, mp["wi"].astype(dt)))
+        z = constrain_activation(z, ("batch", "seq", "mlp"))
+        return jnp.einsum("bsf,fh->bsh", z, mp["wo"].astype(dt))
+
+    if cfg.tiled_mlp > 1:
+        # position-wise → chunk the sequence (ALST TiledMLP analog):
+        # peak MLP activation drops to one tile's worth
+        from deepspeed_tpu.parallel.tiled_compute import tiled_mlp
+
+        z = tiled_mlp(mlp_fn, y, cfg.tiled_mlp)
     else:
-        z = jax.nn.gelu(jnp.einsum("bsh,hf->bsf", y, mp["wi"].astype(dt)))
-    z = constrain_activation(z, ("batch", "seq", "mlp"))
-    z = jnp.einsum("bsf,fh->bsh", z, mp["wo"].astype(dt))
+        z = mlp_fn(y)
     return x + constrain_activation(z, ("batch", "seq", "embed"))
 
 
@@ -288,13 +327,17 @@ _REMAT_POLICIES = {
     "nothing_saveable": None,  # default jax.checkpoint = save nothing
     "dots_saveable": "dots_saveable",
     "dots_with_no_batch_dims_saveable": "dots_with_no_batch_dims_saveable",
+    # FPDT-style host activation offload: checkpointed dot outputs spill
+    # to pinned host memory and stream back in backward (TPU only)
+    "offload_dots_host": "offload_dots_host",
     "none": "everything",
 }
 
 
-def apply(cfg: TransformerConfig, params: Dict[str, Any], tokens: jax.Array,
-          positions: Optional[jax.Array] = None) -> jax.Array:
-    """Forward pass: tokens [B, S] int32 → logits [B, S, V] float32."""
+def apply_hidden(cfg: TransformerConfig, params: Dict[str, Any],
+                 tokens: jax.Array,
+                 positions: Optional[jax.Array] = None) -> jax.Array:
+    """Forward pass up to the final norm: tokens [B,S] → hidden [B,S,H]."""
     B, S = tokens.shape
     dt = cfg.dtype
     if positions is None:
@@ -322,6 +365,11 @@ def apply(cfg: TransformerConfig, params: Dict[str, Any], tokens: jax.Array,
                 pass  # no remat
             elif policy_name is None:
                 layer_fn = jax.checkpoint(layer_fn)
+            elif policy_name == "offload_dots_host":
+                layer_fn = jax.checkpoint(
+                    layer_fn,
+                    policy=jax.checkpoint_policies.
+                    offload_dot_with_no_batch_dims("device", "pinned_host"))
             else:
                 layer_fn = jax.checkpoint(
                     layer_fn, policy=getattr(jax.checkpoint_policies, policy_name)
@@ -332,7 +380,14 @@ def apply(cfg: TransformerConfig, params: Dict[str, Any], tokens: jax.Array,
 
         x, _ = lax.scan(scan_body, x, params["layers"])
 
-    x = _norm(x, params["final_norm"], cfg.norm, cfg.norm_eps)
+    return _norm(x, params["final_norm"], cfg.norm, cfg.norm_eps)
+
+
+def apply(cfg: TransformerConfig, params: Dict[str, Any], tokens: jax.Array,
+          positions: Optional[jax.Array] = None) -> jax.Array:
+    """Forward pass: tokens [B, S] int32 → logits [B, S, V] float32."""
+    dt = cfg.dtype
+    x = apply_hidden(cfg, params, tokens, positions)
     if cfg.tie_embeddings:
         logits = jnp.einsum("bsh,vh->bsv", x, params["embed"]["tokens"].astype(dt))
     else:
@@ -349,17 +404,36 @@ def loss_fn(cfg: TransformerConfig, params, batch) -> Tuple[jax.Array, Dict]:
         inputs, labels = tokens, batch["labels"]
     else:
         inputs, labels = tokens[:, :-1], tokens[:, 1:]
+    mask = batch.get("loss_mask")
+    if mask is not None:
+        mask = mask.astype(jnp.float32)
+        if mask.shape[1] == tokens.shape[1] and "labels" not in batch:
+            mask = mask[:, 1:]
+
+    if cfg.tiled_logits > 1:
+        # fused unembed+loss per sequence tile: [B,S,V] never materializes
+        from deepspeed_tpu.parallel.tiled_compute import tiled_logits_loss
+
+        hidden = apply_hidden(cfg, params, inputs)
+        if cfg.tie_embeddings:
+            unembed = params["embed"]["tokens"].astype(cfg.dtype)
+            transpose = True
+        else:
+            unembed = params["unembed"]["kernel"].astype(cfg.dtype)
+            transpose = False
+        nll_sum, total = tiled_logits_loss(
+            hidden, unembed, labels, mask, cfg.tiled_logits,
+            transpose_unembed=transpose)
+        total = jnp.maximum(total, 1.0)
+        loss = nll_sum / total
+        return loss, {"loss": loss, "ntokens": total}
+
     logits = apply(cfg, params, inputs)
     logz = jax.nn.logsumexp(logits, axis=-1)
     gold = jnp.take_along_axis(logits, labels[..., None], axis=-1)[..., 0]
     nll = logz - gold
-    mask = batch.get("loss_mask")
     if mask is None:
         mask = jnp.ones_like(nll)
-    else:
-        mask = mask.astype(nll.dtype)
-        if mask.shape[1] == tokens.shape[1] and "labels" not in batch:
-            mask = mask[:, 1:]
     total = jnp.maximum(mask.sum(), 1.0)
     loss = (nll * mask).sum() / total
     return loss, {"loss": loss, "ntokens": total}

@@ -1,0 +1,75 @@
+"""Shared blockwise-attention numerics for ring (ring_attention.py) and
+FPDT chunked attention (fpdt.py).
+
+One implementation of the flash-attention online softmax: a partial
+block compute producing unnormalized (o, m, l) statistics, and the
+rescale-and-merge of partials into a running accumulator. Both consumers
+iterate blocks differently (KV rotating around a ppermute ring vs a
+lax.scan over resident KV tiles) but share this math exactly, so a
+numerics fix lands in both.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+class BlockStats(NamedTuple):
+    o: jax.Array      # [B,N,Sq,D] fp32 unnormalized weighted values
+    m: jax.Array      # [B,N,Sq] fp32 row max (0 where row fully masked)
+    l: jax.Array      # [B,N,Sq] fp32 row sum (0 where row fully masked)
+    valid: jax.Array  # [B,N,Sq] bool: any unmasked key in this block
+
+
+def block_attn_partial(q, k, v, q_pos, k_pos, causal: bool,
+                       s_valid: int) -> BlockStats:
+    """One Q-block × KV-block partial attention in fp32.
+
+    q: [B,Sq,N,D]; k,v: [B,Sk,N,D]; q_pos/k_pos: global positions of the
+    rows/keys; keys at positions >= s_valid (padding) are always masked.
+    """
+    d = q.shape[-1]
+    scores = jnp.einsum("bqnd,bknd->bnqk", q, k).astype(jnp.float32)
+    scores = scores / jnp.sqrt(jnp.asarray(d, jnp.float32))
+    mask = k_pos[None, :] < s_valid
+    if causal:
+        mask = mask & (k_pos[None, :] <= q_pos[:, None])
+    else:
+        mask = jnp.broadcast_to(mask, (q_pos.shape[0], k_pos.shape[0]))
+    scores = jnp.where(mask[None, None, :, :], scores, -jnp.inf)
+    m = jnp.max(scores, axis=-1)
+    valid = jnp.isfinite(m)
+    m_safe = jnp.where(valid, m, 0.0)
+    p = jnp.exp(scores - m_safe[..., None])
+    l = jnp.where(valid, jnp.sum(p, axis=-1), 0.0)
+    o = jnp.einsum("bnqk,bknd->bnqd", p, v.astype(jnp.float32))
+    return BlockStats(o, m_safe, l, valid)
+
+
+def online_merge(o_acc, m_acc, l_acc, blk: BlockStats
+                 ) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Merge a block's partial stats into the running accumulator
+    (o_acc fp32 [B,N,Sq,D]; m_acc fp32 [B,N,Sq] init -inf; l_acc init 0).
+    """
+    m_new = jnp.maximum(m_acc, jnp.where(blk.valid, blk.m, -jnp.inf))
+    m_safe = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
+    alpha = jnp.where(jnp.isfinite(m_acc), jnp.exp(m_acc - m_safe), 0.0)
+    beta = jnp.where(blk.valid, jnp.exp(blk.m - m_safe), 0.0)
+    o_acc = o_acc * alpha[..., None] + blk.o * beta[..., None]
+    l_acc = l_acc * alpha + blk.l * beta
+    return o_acc, m_new, l_acc
+
+
+def init_accumulators(B: int, N: int, Sq: int, D: int):
+    return (jnp.zeros((B, N, Sq, D), jnp.float32),
+            jnp.full((B, N, Sq), -jnp.inf, jnp.float32),
+            jnp.zeros((B, N, Sq), jnp.float32))
+
+
+def finalize(o_acc, l_acc, dtype) -> jax.Array:
+    """Normalize and restore [B,Sq,N,D] layout in the caller's dtype."""
+    out = o_acc / jnp.maximum(l_acc[..., None], 1e-30)
+    return jnp.transpose(out, (0, 2, 1, 3)).astype(dtype)

@@ -1,0 +1,118 @@
+"""Ring attention: blockwise context parallelism over the sp mesh axis.
+
+The reference has NO ring attention (SURVEY.md §5: long context is
+Ulysses/ALST/FPDT only) — but Ulysses caps the sequence-parallel degree at
+the head count (sequence/layer.py head-scatter). Ring attention removes
+that cap: KV blocks rotate around the sp axis via ``ppermute`` on ICI
+while each chip keeps its resident Q block, accumulating the exact
+softmax online (flash-attention style), so sp can exceed num_heads and
+sequence length scales with the ring size. This is the TPU-native
+long-context path that complements parallel/ulysses.py:
+
+  * Ulysses: 2 all-to-alls, full-sequence local attention — best when
+    sp <= heads and the sequence fits one chip's HBM.
+  * Ring: p-1 ppermute hops overlapped with per-block attention compute —
+    best when sp > heads or S/p is all that fits.
+
+Causality is handled by global position masking, so the math matches
+dense causal attention bit-for-bit in fp32 accumulation. Gradients flow
+through ``lax.scan`` + ``ppermute`` (transpose of a permute is the
+inverse permute), giving the exact backward without a hand-written
+kernel.
+
+The sp axis must already shard the sequence dim of q/k/v (the engine's
+sharding plan does this when sequence_parallel.size > 1).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from deepspeed_tpu.parallel import topology
+from deepspeed_tpu.utils.comms_logging import get_comms_logger
+
+BATCH = ("dp", "fsdp", "ep")
+
+
+def _ring_attn_local(q, k, v, *, axis: str, causal: bool, s_global: int):
+    """Runs INSIDE shard_map: q,k,v are the local [B, S/p, N_loc, D]
+    blocks; rotates kv around ``axis`` accumulating exact softmax (shared
+    numerics in parallel/_blockwise.py)."""
+    from deepspeed_tpu.parallel._blockwise import (
+        block_attn_partial, finalize, init_accumulators, online_merge)
+
+    p_size = lax.axis_size(axis)
+    my_idx = lax.axis_index(axis)
+    s_loc = q.shape[1]
+    q_pos = my_idx * s_loc + jnp.arange(s_loc)
+
+    dt = q.dtype
+    B, _, N, D = q.shape
+    o_acc, m_acc, l_acc = init_accumulators(B, N, s_loc, D)
+
+    def body(carry, step):
+        k_blk, v_blk, o_acc, m_acc, l_acc = carry
+        kv_idx = (my_idx - step) % p_size
+        k_pos = kv_idx * s_loc + jnp.arange(s_loc)
+        blk = block_attn_partial(q, k_blk, v_blk, q_pos, k_pos, causal,
+                                 s_global)
+        o_acc, m_acc, l_acc = online_merge(o_acc, m_acc, l_acc, blk)
+        # rotate kv forward around the ring (device i -> i+1)
+        perm = [(i, (i + 1) % p_size) for i in range(p_size)]
+        k_blk = lax.ppermute(k_blk, axis, perm)
+        v_blk = lax.ppermute(v_blk, axis, perm)
+        return (k_blk, v_blk, o_acc, m_acc, l_acc), None
+
+    (k, v, o_acc, m_acc, l_acc), _ = lax.scan(
+        body, (k, v, o_acc, m_acc, l_acc), jnp.arange(p_size))
+
+    return finalize(o_acc, l_acc, dt)  # [B,S/p,N,D]
+
+
+def ring_attention(q, k, v, causal: bool = True, axis: str = "sp",
+                   segment_ids: Optional[jax.Array] = None):
+    """Context-parallel attention; drop-in for multi_head_attention when
+    the sequence dim is sharded over ``axis``.
+
+    q,k,v: [B, S, N, D] global (kv heads already repeated for GQA, same
+    contract as ops/attention.py multi_head_attention). segment_ids are
+    not yet supported under the ring (packing + ring is follow-up work).
+    """
+    from deepspeed_tpu.ops.attention import multi_head_attention
+
+    mesh = topology._GLOBAL_MESH
+    if mesh is None or axis not in mesh.shape or mesh.shape[axis] == 1:
+        return multi_head_attention(q, k, v, causal=causal,
+                                    segment_ids=segment_ids)
+    if segment_ids is not None:
+        raise NotImplementedError("ring attention with segment_ids")
+
+    logger = get_comms_logger()
+    p_size = mesh.shape[axis]
+    for t in (k, v):
+        # each kv block traverses p-1 hops
+        logger.record("ppermute", t.size * t.dtype.itemsize * (p_size - 1)
+                      // p_size, axis, "ring_attention_kv")
+
+    # pad S to a multiple of the ring size; padded KV positions are masked
+    # inside the blockwise compute, padded Q rows are sliced off
+    S = q.shape[1]
+    pad = (-S) % p_size
+    if pad:
+        widths = [(0, 0), (0, pad), (0, 0), (0, 0)]
+        q, k, v = (jnp.pad(t, widths) for t in (q, k, v))
+
+    batch_axes = tuple(a for a in BATCH if a in mesh.shape)
+    spec = P(batch_axes, axis, "tp" if "tp" in mesh.shape else None, None)
+    fn = jax.shard_map(
+        partial(_ring_attn_local, axis=axis, causal=causal, s_global=S),
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        check_vma=False)
+    out = fn(q, k, v)
+    return out[:, :S] if pad else out

@@ -1,0 +1,116 @@
+"""ALST/arctic-style tiled compute: trade FLOPs scheduling for activation
+memory on long sequences.
+
+Reference: runtime/sequence_parallel/ulysses_sp.py —
+``sequence_tiled_compute`` (:720) applies a module over sequence shards;
+``TiledMLP`` (:564) chunks the MLP over the sequence dim; and
+``TiledFusedLogitsLoss`` (:943) computes the unembed-projection + loss
+per tile so the [B, S, V] logits tensor never materializes (the dominant
+activation at long S and 100k+ vocab).
+
+TPU-native form: a ``lax.scan`` over sequence tiles with
+``jax.checkpoint`` on the tile body — the scan carries only the running
+reduction, remat recomputes tile activations in backward, and XLA
+pipelines the tiles. Zero Python-level loops; fully jit-traceable.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def _split_tiles(x: jax.Array, n_tiles: int, axis: int = 1):
+    """[..., S, ...] -> (n_tiles, tile) leading structure for scan; pads S
+    up to a multiple of n_tiles. Returns (tiles, orig_len)."""
+    S = x.shape[axis]
+    pad = (-S) % n_tiles
+    if pad:
+        widths = [(0, 0)] * x.ndim
+        widths[axis] = (0, pad)
+        x = jnp.pad(x, widths)
+    tile = (S + pad) // n_tiles
+    new_shape = (x.shape[:axis] + (n_tiles, tile) + x.shape[axis + 1:])
+    x = x.reshape(new_shape)
+    # move the n_tiles dim to the front for scan
+    x = jnp.moveaxis(x, axis, 0)
+    return x, S
+
+
+def sequence_tiled_compute(fn: Callable, x: jax.Array, n_tiles: int,
+                           axis: int = 1, checkpoint: bool = True):
+    """Apply ``fn`` (shape-preserving along ``axis``) tile-by-tile.
+
+    fn: tile -> tile, where tile has the same rank as x with the sequence
+    dim shortened. Backward recomputes each tile (remat) so peak
+    activation memory is one tile's worth.
+    """
+    if n_tiles <= 1:
+        return fn(x)
+    tiles, S = _split_tiles(x, n_tiles, axis)
+    body_fn = jax.checkpoint(fn) if checkpoint else fn
+
+    def body(_, tile):
+        return None, body_fn(tile)
+
+    _, out = lax.scan(body, None, tiles)
+    out = jnp.moveaxis(out, 0, axis)
+    out = out.reshape(out.shape[:axis] + (-1,) + out.shape[axis + 2:])
+    return lax.slice_in_dim(out, 0, S, axis=axis)
+
+
+def tiled_mlp(mlp_fn: Callable, x: jax.Array, n_tiles: int,
+              checkpoint: bool = True):
+    """MLPs are position-wise — chunk the sequence dim (reference TiledMLP
+    ulysses_sp.py:564)."""
+    return sequence_tiled_compute(mlp_fn, x, n_tiles, axis=1,
+                                  checkpoint=checkpoint)
+
+
+def tiled_logits_loss(hidden: jax.Array, unembed: jax.Array,
+                      labels: jax.Array, mask: Optional[jax.Array],
+                      n_tiles: int, transpose_unembed: bool = False
+                      ) -> Tuple[jax.Array, jax.Array]:
+    """Fused unembed + causal-LM cross-entropy without materializing
+    [B, S, V] logits (reference TiledFusedLogitsLoss ulysses_sp.py:943).
+
+    hidden: [B, S, H]; unembed: [V, H] (tied embedding) or [H, V] with
+    ``transpose_unembed=False``; labels: [B, S] int; mask: [B, S] or None.
+    Returns (masked_nll_sum, mask_total) — caller divides.
+    """
+    B, S, H = hidden.shape
+    if mask is None:
+        mask = jnp.ones((B, S), jnp.float32)
+    mask = mask.astype(jnp.float32)
+    if n_tiles <= 1:
+        n_tiles = 1
+    h_tiles, _ = _split_tiles(hidden, n_tiles, axis=1)
+    l_tiles, _ = _split_tiles(labels, n_tiles, axis=1)
+    m_tiles, _ = _split_tiles(mask, n_tiles, axis=1)
+
+    def tile_nll(h, lbl, m):
+        if transpose_unembed:
+            logits = jnp.einsum("bsh,vh->bsv", h, unembed)
+        else:
+            logits = jnp.einsum("bsh,hv->bsv", h, unembed)
+        logits = logits.astype(jnp.float32)
+        logz = jax.nn.logsumexp(logits, axis=-1)
+        gold = jnp.take_along_axis(logits, lbl[..., None], axis=-1)[..., 0]
+        nll = (logz - gold) * m
+        return jnp.sum(nll), jnp.sum(m)
+
+    tile_nll = jax.checkpoint(tile_nll)
+
+    def body(carry, xs):
+        acc_nll, acc_m = carry
+        h, lbl, m = xs
+        s_nll, s_m = tile_nll(h, lbl, m)
+        return (acc_nll + s_nll, acc_m + s_m), None
+
+    (total_nll, total_m), _ = lax.scan(
+        body, (jnp.asarray(0.0, jnp.float32), jnp.asarray(0.0, jnp.float32)),
+        (h_tiles, l_tiles, m_tiles))
+    return total_nll, total_m

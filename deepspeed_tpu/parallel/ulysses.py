@@ -38,19 +38,30 @@ def _constrain(x, spec: P):
 
 
 def ulysses_attention(q, k, v, causal: bool = True, impl: str = "auto",
-                      segment_ids: Optional[jax.Array] = None):
+                      segment_ids: Optional[jax.Array] = None,
+                      attn_chunks: int = 0):
     """Attention over a sequence-sharded input.
 
     q,k,v: [B, S, N, D] logically; physically S is sharded over sp on entry
     and exit. Inside, heads are sharded over (tp, sp) and S is full — the
     head-scatter layout of the reference's DistributedAttention.forward.
+    ``attn_chunks > 1`` runs the full-sequence local attention through the
+    FPDT-style chunked path (parallel/fpdt.py) to bound score memory.
     """
     from deepspeed_tpu.ops.attention import multi_head_attention
 
-    mesh = topology._GLOBAL_MESH
-    if mesh is None or mesh.shape["sp"] == 1:
+    def local_attn(q, k, v):
+        if attn_chunks > 1:
+            from deepspeed_tpu.parallel.fpdt import chunked_attention
+
+            return chunked_attention(q, k, v, causal=causal,
+                                     q_chunks=attn_chunks)
         return multi_head_attention(q, k, v, causal=causal, impl=impl,
                                     segment_ids=segment_ids)
+
+    mesh = topology._GLOBAL_MESH
+    if mesh is None or mesh.shape["sp"] == 1:
+        return local_attn(q, k, v)
 
     logger = get_comms_logger()
     for t in (q, k, v):
@@ -63,8 +74,7 @@ def ulysses_attention(q, k, v, causal: bool = True, impl: str = "auto",
     k = _constrain(k, inner)
     v = _constrain(v, inner)
 
-    out = multi_head_attention(q, k, v, causal=causal, impl=impl,
-                               segment_ids=segment_ids)
+    out = local_attn(q, k, v)
 
     logger.record("all_to_all", out.size * out.dtype.itemsize, "sp",
                   "ulysses_out")

@@ -104,12 +104,14 @@ class HostOffloadOptimizer:
         paths, leaves, _ = _leaf_paths(params)
         # global layout of the optimizer partition, for rebuilds after load
         self._leaf_layout: Dict[str, Tuple[tuple, object]] = {}
+        self._shard_index: Dict[Tuple[str, str], tuple] = {}
         for path, leaf in zip(paths, leaves):
             self._leaf_layout[path] = (leaf.shape, leaf.sharding)
             for shard in leaf.addressable_shards:
                 key = (path, _index_key(shard.index))
                 if key in self.masters:
                     continue
+                self._shard_index[key] = shard.index
                 host = np.asarray(shard.data)
                 master = _to_f32(host).reshape(-1).copy()
                 self._shard_shapes[key] = host.shape
@@ -315,6 +317,74 @@ class HostOffloadOptimizer:
                     self.masters[key] = None
                 else:
                     self.masters[key] = master
+
+    # ------------------------------------------------------------------
+    # fragment APIs (utils/tensor_fragment.py backing when offloaded)
+    # ------------------------------------------------------------------
+    def _master_of(self, key) -> np.ndarray:
+        if self._swap is not None:
+            return self._swap.swap_in(f"{key[0]}.{key[1]}.master")
+        return self.masters[key]
+
+    def _leaf_keys(self, keystr: str):
+        keys = [k for k in self.optimizers if k[0] == keystr]
+        if not keys:
+            known = sorted({k[0] for k in self.optimizers})
+            raise KeyError(f"no offloaded shards for param {keystr!r}; "
+                           f"known leaves: {known[:10]}...")
+        return keys
+
+    def full_fp32_param(self, keystr: str) -> np.ndarray:
+        """Assemble the global fp32 master from local shards. Multi-host:
+        only valid when this process holds every shard (single-host or
+        replicated layouts); raises otherwise."""
+        gshape, _ = self._leaf_layout[keystr]
+        out = np.zeros(gshape, np.float32)
+        covered = 0
+        for key in self._leaf_keys(keystr):
+            idx = self._shard_index[key]
+            piece = self._master_of(key).reshape(self._shard_shapes[key])
+            out[idx] = piece
+            covered += piece.size
+        if covered < int(np.prod(gshape)):
+            raise ValueError(
+                f"param {keystr!r}: local shards cover {covered} of "
+                f"{int(np.prod(gshape))} elements — full assembly needs "
+                "all shards on this host (use local_fp32_param instead)")
+        return out
+
+    def local_fp32_param(self, keystr: str) -> np.ndarray:
+        key = self._leaf_keys(keystr)[0]
+        return self._master_of(key).reshape(self._shard_shapes[key])
+
+    def set_full_fp32_param(self, keystr: str, value: np.ndarray) -> None:
+        value = np.asarray(value, np.float32)
+        gshape, _ = self._leaf_layout[keystr]
+        assert value.shape == tuple(gshape), (value.shape, gshape)
+        for key in self._leaf_keys(keystr):
+            idx = self._shard_index[key]
+            master = np.ascontiguousarray(value[idx]).reshape(-1)
+            if self._swap is not None:
+                self._swap.swap_out(f"{key[0]}.{key[1]}.master", master,
+                                    sync=True)
+            else:
+                self.masters[key] = master
+
+    def full_optimizer_state(self, keystr: str, state_key: str
+                             ) -> Optional[np.ndarray]:
+        gshape, _ = self._leaf_layout[keystr]
+        out = np.zeros(gshape, np.float32)
+        for key in self._leaf_keys(keystr):
+            if self._swap is not None:
+                self._swap_in(key)
+            sd = self.optimizers[key].state_dict()
+            if state_key not in sd:
+                return None
+            out[self._shard_index[key]] = np.asarray(
+                sd[state_key]).reshape(self._shard_shapes[key])
+            if self._swap is not None:
+                self.optimizers[key].detach_state()
+        return out
 
     # ------------------------------------------------------------------
     # checkpoint surface (engine CheckpointIO hooks)

@@ -76,10 +76,13 @@ class AsyncTensorSwapper:
 
     def wait(self) -> None:
         errs = self.aio.wait()
+        inflight = self._inflight
+        self._inflight = []
         if errs:
-            raise IOError(f"tensor swap failed: {errs} errors "
-                          f"(paths: {self._inflight[-errs:]})")
-        self._inflight.clear()
+            # the native layer reports a count, not which request failed —
+            # list the whole in-flight set for diagnosis
+            raise IOError(f"tensor swap failed: {errs} of {len(inflight)} "
+                          f"requests errored (in-flight: {inflight})")
 
 
 class TensorSwapStore:

@@ -50,14 +50,26 @@ def _to_host(x: jax.Array) -> np.ndarray:
     return np.asarray(jax.device_get(x))
 
 
+def _offload_keystr(engine, path: str) -> str:
+    """'layers/attn/wq' → the jax.tree_util.keystr form used as the
+    offload optimizer's leaf key: \"['layers']['attn']['wq']\"."""
+    return "".join(f"[{k!r}]" for k in path.strip("/").split("/"))
+
+
 def safe_get_full_fp32_param(engine, path: str) -> np.ndarray:
-    """Full fp32 master weight (reference tensor_fragment.py:134)."""
+    """Full fp32 master weight (reference tensor_fragment.py:134).
+    With optimizer offload the masters live host-side
+    (runtime/offload.py) and are assembled from local shards."""
+    if getattr(engine, "_offload", None) is not None:
+        return engine._offload.full_fp32_param(_offload_keystr(engine, path))
     return _to_host(_walk(engine.opt_state.master, path))
 
 
 def safe_get_local_fp32_param(engine, path: str) -> np.ndarray:
     """This process's shard of the fp32 master weight (reference
     safe_get_local_fp32_param)."""
+    if getattr(engine, "_offload", None) is not None:
+        return engine._offload.local_fp32_param(_offload_keystr(engine, path))
     leaf = _walk(engine.opt_state.master, path)
     return np.asarray(leaf.addressable_shards[0].data)
 
@@ -65,10 +77,18 @@ def safe_get_local_fp32_param(engine, path: str) -> np.ndarray:
 def safe_set_full_fp32_param(engine, path: str, value) -> None:
     """Overwrite a master weight (resharded automatically) and refresh the
     compute-dtype copy (reference safe_set_full_fp32_param)."""
+    params_leaf = _walk(engine.params, path)
+    if getattr(engine, "_offload", None) is not None:
+        engine._offload.set_full_fp32_param(_offload_keystr(engine, path),
+                                            value)
+        new = np.asarray(value, dtype=np.float32)
+        _set_leaf(engine.params, path,
+                  jax.device_put(new.astype(params_leaf.dtype),
+                                 params_leaf.sharding))
+        return
     master = _walk(engine.opt_state.master, path)
     new = jax.device_put(np.asarray(value, dtype=np.float32), master.sharding)
     _set_leaf(engine.opt_state.master, path, new)
-    params_leaf = _walk(engine.params, path)
     _set_leaf(engine.params, path,
               jax.device_put(new.astype(params_leaf.dtype),
                              params_leaf.sharding))
@@ -79,6 +99,10 @@ def safe_get_full_optimizer_state(engine, path: str, state_key: str
     """Optimizer state for one param, e.g. state_key='exp_avg' / 'exp_avg_sq'
     (reference safe_get_full_optimizer_state). Torch names map to optax:
     exp_avg → mu, exp_avg_sq → nu, momentum → trace/mu."""
+    if getattr(engine, "_offload", None) is not None:
+        # host optimizers use the torch names directly (exp_avg/exp_avg_sq)
+        return engine._offload.full_optimizer_state(
+            _offload_keystr(engine, path), state_key)
     alias = {"exp_avg": ("mu", "trace", "momentum"),
              "exp_avg_sq": ("nu",),
              "momentum": ("trace", "mu")}

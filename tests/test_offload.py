@@ -111,6 +111,68 @@ def test_swap_store(tmp_path):
     store.purge()
 
 
+def test_swap_buffer_pool_and_async_swapper(tmp_path):
+    from deepspeed_tpu.runtime.swap_tensor.swapper import (
+        AsyncTensorSwapper, SwapBufferPool)
+
+    pool = SwapBufferPool(count=2, elems=1024)
+    i, buf = pool.get()
+    buf[:] = 7.0
+    assert pool.available() == 1
+    sw = AsyncTensorSwapper()
+    path = str(tmp_path / "b.swp")
+    sw.swap_out(buf, path)
+    sw.wait()
+    j, buf2 = pool.get()
+    sw.swap_in(buf2, path)
+    sw.wait()
+    np.testing.assert_array_equal(buf2, buf)
+    pool.put(i)
+    pool.put(j)
+    pool.free()
+
+
+def test_nvme_requires_path():
+    from deepspeed_tpu.config.config import load_config
+
+    with pytest.raises(ValueError, match="nvme_path"):
+        load_config({"zero_optimization": {
+            "offload_optimizer": {"device": "nvme"}}})
+    with pytest.raises(ValueError, match="grad_transfer_dtype"):
+        load_config({"zero_optimization": {
+            "offload_optimizer": {"device": "cpu",
+                                  "grad_transfer_dtype": "bfloat16"}}})
+
+
+def test_fragment_apis_with_offload(devices):
+    from deepspeed_tpu.utils.tensor_fragment import (
+        safe_get_full_fp32_param, safe_get_full_optimizer_state,
+        safe_get_local_fp32_param, safe_set_full_fp32_param)
+
+    engine = make_engine(zero_stage=2, offload_device="cpu")
+    it = data_iter(engine.micro_batch_size * engine.dp_world_size)
+    engine.train_batch(it)
+
+    full = safe_get_full_fp32_param(engine, "layers/attn/wq")
+    dev = np.asarray(jax.device_get(
+        engine.params["layers"]["attn"]["wq"])).astype(np.float32)
+    assert full.shape == dev.shape
+    # master ≈ bf16 device copy
+    np.testing.assert_allclose(full, dev, rtol=1e-2, atol=1e-2)
+
+    local = safe_get_local_fp32_param(engine, "layers/attn/wq")
+    assert local.size > 0
+
+    m = safe_get_full_optimizer_state(engine, "layers/attn/wq", "exp_avg")
+    assert m is not None and m.shape == full.shape
+    assert float(np.abs(m).sum()) > 0  # one step taken: nonzero momentum
+
+    new = np.zeros_like(full)
+    safe_set_full_fp32_param(engine, "layers/attn/wq", new)
+    got = safe_get_full_fp32_param(engine, "layers/attn/wq")
+    np.testing.assert_array_equal(got, new)
+
+
 def test_bf16_conversion_matches_jax():
     x = np.random.randn(1000).astype(np.float32) * 100
     ours = bf16_to_f32(f32_to_bf16(x))
