@@ -54,6 +54,8 @@ class CheckpointIO:
         if e.opt_state is not None:  # offload keeps optimizer state on host
             state["opt_master"] = e.opt_state.master
             state["opt_inner"] = e.opt_state.inner
+        if getattr(e, "_onebit_state", None) is not None:
+            state["onebit"] = e._onebit_state
         return state
 
     def _abstract_state(self) -> Dict[str, Any]:
@@ -158,6 +160,8 @@ class CheckpointIO:
                                      abstract)
 
         e.params = restored["params"]
+        if getattr(e, "_onebit_state", None) is not None and "onebit" in restored:
+            e._onebit_state = restored["onebit"]
         if getattr(e, "_offload", None) is not None:
             import numpy as np
 

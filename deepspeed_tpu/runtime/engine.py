@@ -143,8 +143,28 @@ class Engine:
         self.train_batch_size = config.train_batch_size
         self.compute_dtype = config.compute_dtype
 
+        # -- 1-bit compressed-comm optimizers (runtime/onebit.py) ---------
+        opt_name = ((config.optimizer.type if config.optimizer else "")
+                    or "").lower().replace("_", "").replace("-", "")
+        from deepspeed_tpu.runtime.onebit import (
+            ONEBIT_OPTIMIZERS, validate_onebit_config)
+
+        self._onebit = opt_name in ONEBIT_OPTIMIZERS
+        if self._onebit:
+            validate_onebit_config(config)
+
         # -- optimizer (engine.py:1901 _configure_optimizer analog) -------
-        if client_optimizer is not None:
+        if self._onebit:
+            self.tx = None
+            from deepspeed_tpu.runtime.onebit import parse_onebit_params
+
+            self._onebit_params = parse_onebit_params(
+                opt_name, (config.optimizer.params or {})
+                if config.optimizer else {})
+            self._base_lr = self._onebit_params["lr"]
+            self.lr_schedule = get_lr_schedule(config.scheduler,
+                                               base_lr=self._base_lr)
+        elif client_optimizer is not None:
             self.tx = client_optimizer  # user-supplied optax transform
             self._base_lr = None
         else:
@@ -237,7 +257,28 @@ class Engine:
         opt_sh = plan.opt_shardings(self._axes)
         cdt = self.compute_dtype
 
-        if self._offload_device in ("cpu", "nvme"):
+        if self._onebit:
+            # masters/moments replicated over dp (stage<=1 layout); error
+            # feedback is per-rank: leading dp axis, sharded over dp
+            from deepspeed_tpu.runtime.onebit import (OneBitState,
+                                                      build_onebit_step)
+
+            init_fn, step_fn = build_onebit_step(
+                self.model, mesh, self.config, self._onebit_params,
+                param_sh, self.lr_schedule)
+            self._onebit_step_fn = step_fn
+            rep = NamedSharding(mesh, P())
+            err_sh = jax.tree.map(
+                lambda s: NamedSharding(mesh, P("dp")), param_sh)
+            master_sh = param_sh
+            out_sh = (param_sh, OneBitState(master=master_sh, m=master_sh,
+                                            v=master_sh, error=err_sh,
+                                            step=rep))
+            with jax.set_mesh(mesh) if hasattr(jax, "set_mesh") else _nullctx():
+                self.params, self._onebit_state = jax.jit(
+                    init_fn, out_shardings=out_sh)(self._rng)
+            self.opt_state = None
+        elif self._offload_device in ("cpu", "nvme"):
             # fp32 init sharded like optimizer state, pulled host-side into
             # the native offload optimizer; device keeps compute dtype only
             # (reference: stage_1_and_2.py cpu_offload / stage3.py
@@ -380,6 +421,9 @@ class Engine:
         donate = (0, 1, 2, 3)
         self._jit_train_step = jax.jit(train_step, donate_argnums=donate)
         self._jit_grad_step = jax.jit(grad_step)
+        if self._onebit:
+            self._jit_onebit = jax.jit(self._onebit_step_fn,
+                                       donate_argnums=(0, 1))
         # offload resharding hops: host-updated (optimizer-sharded) tree →
         # param sharding = the "allgather updated partitions" collective,
         # compiled by XLA over ICI; and grad-acc → optimizer sharding.
@@ -436,7 +480,11 @@ class Engine:
         self.tput_timer.start()
         batches = self._next_microbatches(data_iter,
                                           self.gradient_accumulation_steps)
-        if self._offload is not None:
+        if self._onebit:
+            self.params, self._onebit_state, metrics = self._jit_onebit(
+                self.params, self._onebit_state, batches)
+            self.step_count = self._onebit_state.step
+        elif self._offload is not None:
             scale = (self.loss_scale_state.scale if self.config.fp16.enabled
                      else jnp.asarray(1.0, jnp.float32))
             grads, loss = self._jit_grad_step(self.params, batches, scale)
@@ -452,6 +500,11 @@ class Engine:
 
     def forward(self, batch, *args, **kwargs):
         """Micro-step path: compute loss (grads cached for backward)."""
+        if self._onebit:
+            raise RuntimeError(
+                "1-bit optimizers support the fused train_batch() path "
+                "only (the compressed allreduce lives inside the compiled "
+                "step); use engine.train_batch(data_iter)")
         self.timers(FORWARD_GLOBAL_TIMER).start()
         batch = self.shard_batch(batch)
         scale = (self.loss_scale_state.scale if self.config.fp16.enabled
