@@ -1,1 +1,4 @@
-
+from deepspeed_tpu.checkpoint.state import CheckpointIO  # noqa: F401
+from deepspeed_tpu.checkpoint.universal import (  # noqa: F401
+    convert_to_fp32, convert_to_universal,
+    get_fp32_state_dict_from_checkpoint, inspect_checkpoint, load_universal)

@@ -42,6 +42,11 @@ class CheckpointIO:
 
     def __init__(self, engine):
         self.engine = engine
+        from deepspeed_tpu.runtime.checkpoint_engine import \
+            make_checkpoint_engine
+
+        self.ckpt_engine = make_checkpoint_engine(engine.config.checkpoint)
+        self._pending_commit = None  # (tag, save_dir, ckpt_dir, meta, latest)
 
     # -- state tree ----------------------------------------------------
     def _state(self) -> Dict[str, Any]:
@@ -69,16 +74,14 @@ class CheckpointIO:
     # -- save ----------------------------------------------------------
     def save(self, save_dir: str, tag: Optional[str] = None,
              client_state: Optional[Dict] = None, save_latest: bool = True):
-        import orbax.checkpoint as ocp
-
         e = self.engine
+        self.commit_pending()  # at most one async save in flight
         tag = tag or f"global_step{e.global_steps}"
         ckpt_dir = os.path.join(os.path.abspath(save_dir), str(tag))
         os.makedirs(ckpt_dir, exist_ok=True)
 
-        with ocp.StandardCheckpointer() as ckptr:
-            ckptr.save(os.path.join(ckpt_dir, STATE_DIR), self._state(),
-                       force=True)
+        self.ckpt_engine.create(str(tag))
+        self.ckpt_engine.save(os.path.join(ckpt_dir, STATE_DIR), self._state())
 
         if getattr(e, "_offload", None) is not None:
             # host-resident optimizer shards: one npz per process
@@ -92,48 +95,89 @@ class CheckpointIO:
                     flat[f"{key}##{field}"] = np.asarray(val)
             dst = os.path.join(
                 ckpt_dir, f"offload_optim_rank{jax.process_index()}.npz")
-            # np.savez appends '.npz' unless the path already ends in it
-            tmp = f"{dst}.{os.getpid()}.tmp.npz"
-            np.savez(tmp, **flat)
-            os.replace(tmp, dst)  # atomic: no half-written rank files
+            if hasattr(self.ckpt_engine, "save_host_blob"):
+                # fast engine: pipelined AIO write of the serialized blob
+                import io as _io
 
+                buf = _io.BytesIO()
+                np.savez(buf, **flat)
+                self.ckpt_engine.save_host_blob(buf.getvalue(), dst)
+            else:
+                # np.savez appends '.npz' unless the path already ends in it
+                tmp = f"{dst}.{os.getpid()}.tmp.npz"
+                np.savez(tmp, **flat)
+                os.replace(tmp, dst)  # atomic: no half-written rank files
+
+        meta = {
+            "tag": str(tag),
+            "framework_version": __version__,
+            "saved_at": time.time(),
+            "global_steps": e.global_steps,
+            "global_samples": e.global_samples,
+            "skipped_steps": e.skipped_steps,
+            "mesh_shape": {k: int(v) for k, v in e.mesh.shape.items()},
+            "zero_stage": e.config.zero_optimization.stage,
+            "config": e.config.to_dict(),
+            "client_state": client_state or {},
+        }
+        from deepspeed_tpu.runtime.checkpoint_engine import \
+            DecoupledCheckpointEngine
+
+        if isinstance(self.ckpt_engine, DecoupledCheckpointEngine):
+            # decoupled: 'latest' is published at commit (next GAS boundary
+            # or the next save/load), reference engine.py:3273
+            self._pending_commit = (str(tag), save_dir, ckpt_dir, meta,
+                                    save_latest)
+            log_dist(f"checkpoint save in flight: {ckpt_dir}", ranks=[0])
+            return ckpt_dir
+        self._publish(str(tag), save_dir, ckpt_dir, meta, save_latest)
+        log_dist(f"saved checkpoint: {ckpt_dir}", ranks=[0])
+        return ckpt_dir
+
+    def _publish(self, tag, save_dir, ckpt_dir, meta, save_latest):
+        """Barrier + metadata + 'latest' pointer — only after every rank's
+        payload is durable, or a preemption could leave 'latest' pointing
+        at a checkpoint that cannot restore on some ranks."""
         if jax.process_count() > 1:
-            # every rank must finish its npz before 'latest' is published,
-            # or a preemption could leave 'latest' pointing at a
-            # checkpoint that cannot restore on some ranks
             from jax.experimental import multihost_utils
 
             multihost_utils.sync_global_devices(f"ckpt_save_{tag}")
-
         if _is_primary():
-            meta = {
-                "tag": str(tag),
-                "framework_version": __version__,
-                "saved_at": time.time(),
-                "global_steps": e.global_steps,
-                "global_samples": e.global_samples,
-                "skipped_steps": e.skipped_steps,
-                "mesh_shape": {k: int(v) for k, v in e.mesh.shape.items()},
-                "zero_stage": e.config.zero_optimization.stage,
-                "config": e.config.to_dict(),
-                "client_state": client_state or {},
-            }
             with open(os.path.join(ckpt_dir, METADATA_FILE), "w") as f:
                 json.dump(meta, f, indent=2, default=str)
             if save_latest:
                 with open(os.path.join(os.path.abspath(save_dir),
                                        LATEST_FILE), "w") as f:
                     f.write(str(tag))
+
+    def commit_pending(self):
+        """Block until an in-flight async save is durable, then publish."""
+        if self._pending_commit is None:
+            return
+        tag, save_dir, ckpt_dir, meta, save_latest = self._pending_commit
+        self._pending_commit = None
+        self.ckpt_engine.commit(tag)
+        self._publish(tag, save_dir, ckpt_dir, meta, save_latest)
         log_dist(f"saved checkpoint: {ckpt_dir}", ranks=[0])
-        return ckpt_dir
+
+    def maybe_commit(self):
+        """Polled at GAS boundaries (reference engine.py:3273)."""
+        if self._pending_commit is not None and \
+                self.ckpt_engine.maybe_finalize():
+            self.commit_pending()
 
     # -- load ----------------------------------------------------------
     def load(self, load_dir: str, tag: Optional[str] = None,
              load_optimizer_states: bool = True
              ) -> Tuple[Optional[str], Optional[Dict]]:
-        import orbax.checkpoint as ocp
-
         e = self.engine
+        self.commit_pending()
+        if e.config.checkpoint.load_universal:
+            from deepspeed_tpu.checkpoint.universal import load_universal
+
+            load_universal(e, load_dir,
+                           load_optimizer_states=load_optimizer_states)
+            return os.path.abspath(load_dir), {}
         load_dir = os.path.abspath(load_dir)
         if tag is None:
             latest = os.path.join(load_dir, LATEST_FILE)
@@ -155,9 +199,8 @@ class CheckpointIO:
         self._validate_tag(meta, tag)
 
         abstract = self._abstract_state()
-        with ocp.StandardCheckpointer() as ckptr:
-            restored = ckptr.restore(os.path.join(ckpt_dir, STATE_DIR),
-                                     abstract)
+        restored = self.ckpt_engine.load(os.path.join(ckpt_dir, STATE_DIR),
+                                         abstract)
 
         e.params = restored["params"]
         if getattr(e, "_onebit_state", None) is not None and "onebit" in restored:

@@ -1,0 +1,389 @@
+"""Universal / offline checkpoint tools.
+
+Covers the reference's offline checkpoint machinery:
+
+  * ``get_fp32_state_dict_from_checkpoint`` / ``convert_to_fp32`` —
+    ``zero_to_fp32.py`` analog (deepspeed/utils/zero_to_fp32.py):
+    consolidate a (possibly topology-sharded) engine checkpoint into a
+    single host fp32 state dict, without needing a device mesh or a
+    running cluster. The reference stitches flat dp-rank partitions with
+    offset arithmetic; orbax stores *global* arrays, so consolidation is
+    just a host restore of the master tree.
+  * ``convert_to_universal`` — ``ds_to_universal.py`` analog
+    (deepspeed/checkpoint/ds_to_universal.py:121-249): explode the
+    checkpoint into one file per parameter (fp32 master + optimizer
+    moments) so any future topology/zero-stage/framework can consume it.
+  * ``load_universal`` — ``load_universal_checkpoint`` analog
+    (runtime/zero/stage*.py + universal_checkpoint.py:99): map a
+    universal dir back onto a live engine with the *current* sharding
+    plan (resharding on load).
+  * ``inspect_checkpoint`` + the ``dstpu-ckpt`` CLI.
+
+On-disk universal layout (one dir per tree path, '.'-joined):
+
+    <out>/universal/<param-path>/fp32.npy
+    <out>/universal/<param-path>/<moment-name>.npy   (exp_avg, exp_avg_sq, ...)
+    <out>/universal/metadata.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Any, Dict, Optional, Tuple
+
+import numpy as np
+
+LATEST_FILE = "latest"
+METADATA_FILE = "metadata.json"
+STATE_DIR = "state"
+UNIVERSAL_DIR = "universal"
+SEP = "."
+
+
+# ----------------------------------------------------------------------
+# host-side restore
+# ----------------------------------------------------------------------
+def _resolve_tag(ckpt_root: str, tag: Optional[str]) -> str:
+    if tag is None:
+        latest = os.path.join(ckpt_root, LATEST_FILE)
+        if not os.path.exists(latest):
+            raise FileNotFoundError(
+                f"no '{LATEST_FILE}' file in {ckpt_root}; pass an explicit tag")
+        with open(latest) as f:
+            tag = f.read().strip()
+    return str(tag)
+
+
+def _restore_host(ckpt_root: str, tag: Optional[str]
+                  ) -> Tuple[Dict[str, Any], Dict[str, Any], str]:
+    """Restore the saved tree as host numpy arrays + metadata."""
+    import orbax.checkpoint as ocp
+
+    tag = _resolve_tag(ckpt_root, tag)
+    ckpt_dir = os.path.join(os.path.abspath(ckpt_root), tag)
+    meta = {}
+    meta_path = os.path.join(ckpt_dir, METADATA_FILE)
+    if os.path.exists(meta_path):
+        with open(meta_path) as f:
+            meta = json.load(f)
+    with ocp.StandardCheckpointer() as ckptr:
+        state = ckptr.restore(os.path.join(ckpt_dir, STATE_DIR))
+    return state, meta, ckpt_dir
+
+
+def _is_namedtuple(x) -> bool:
+    return isinstance(x, tuple) and hasattr(x, "_fields")
+
+
+def _flatten(tree, prefix="") -> Dict[str, np.ndarray]:
+    """Flatten with '.'-joined keys. Namedtuples (optax states) flatten by
+    FIELD NAME so live-engine trees and orbax-restored trees (which come
+    back as field-name dicts) produce identical keys — the moment-name
+    mapping below depends on this."""
+    out: Dict[str, np.ndarray] = {}
+    if isinstance(tree, dict):
+        for k, v in tree.items():
+            out.update(_flatten(v, f"{prefix}{SEP}{k}" if prefix else str(k)))
+    elif _is_namedtuple(tree):
+        for name, v in zip(tree._fields, tree):
+            out.update(_flatten(v, f"{prefix}{SEP}{name}" if prefix else name))
+    elif isinstance(tree, (list, tuple)):
+        for i, v in enumerate(tree):
+            out.update(_flatten(v, f"{prefix}{SEP}{i}" if prefix else str(i)))
+    elif tree is None:
+        pass
+    else:
+        out[prefix] = np.asarray(tree)
+    return out
+
+
+def _unflatten_into(flat: Dict[str, np.ndarray], tree, prefix=""):
+    """Return a copy of ``tree`` with leaves replaced from ``flat``."""
+    if isinstance(tree, dict):
+        return {k: _unflatten_into(flat, v,
+                                   f"{prefix}{SEP}{k}" if prefix else str(k))
+                for k, v in tree.items()}
+    if _is_namedtuple(tree):
+        vals = [_unflatten_into(flat, v,
+                                f"{prefix}{SEP}{n}" if prefix else str(n))
+                for n, v in zip(tree._fields, tree)]
+        return type(tree)(*vals)
+    if isinstance(tree, (list, tuple)):
+        vals = [_unflatten_into(flat, v,
+                                f"{prefix}{SEP}{i}" if prefix else str(i))
+                for i, v in enumerate(tree)]
+        return tuple(vals) if isinstance(tree, tuple) else vals
+    return flat.get(prefix, tree)
+
+
+# ----------------------------------------------------------------------
+# zero_to_fp32 analog
+# ----------------------------------------------------------------------
+def get_fp32_state_dict_from_checkpoint(ckpt_root: str,
+                                        tag: Optional[str] = None
+                                        ) -> Dict[str, np.ndarray]:
+    """Single consolidated fp32 param dict (reference
+    zero_to_fp32.py get_fp32_state_dict_from_zero_checkpoint)."""
+    state, _meta, _dir = _restore_host(ckpt_root, tag)
+    # prefer fp32 masters (exact); else cast the compute-dtype params
+    src = state.get("opt_master") or state["params"]
+    return {k: np.asarray(v, dtype=np.float32)
+            for k, v in _flatten(src).items()}
+
+
+def convert_to_fp32(ckpt_root: str, out_path: str,
+                    tag: Optional[str] = None) -> str:
+    """Write the consolidated fp32 dict as one .npz (zero_to_fp32 CLI)."""
+    sd = get_fp32_state_dict_from_checkpoint(ckpt_root, tag)
+    out_path = os.path.abspath(out_path)
+    os.makedirs(os.path.dirname(out_path) or ".", exist_ok=True)
+    tmp = out_path + ".tmp.npz"
+    np.savez(tmp, **sd)
+    os.replace(tmp, out_path)
+    total = sum(v.size for v in sd.values())
+    print(f"wrote {len(sd)} tensors / {total/1e6:.1f}M fp32 params -> {out_path}")
+    return out_path
+
+
+# ----------------------------------------------------------------------
+# ds_to_universal analog
+# ----------------------------------------------------------------------
+def convert_to_universal(ckpt_root: str, out_dir: str,
+                         tag: Optional[str] = None) -> str:
+    """Explode an engine checkpoint into per-parameter files
+    (reference ds_to_universal.py main: extract → merge → save)."""
+    state, meta, _dir = _restore_host(ckpt_root, tag)
+    out = os.path.join(os.path.abspath(out_dir), UNIVERSAL_DIR)
+    os.makedirs(out, exist_ok=True)
+
+    masters = _flatten(state.get("opt_master") or state["params"])
+    moments: Dict[str, Dict[str, np.ndarray]] = {}
+    # optax inner state: a tuple of stage states, each possibly holding
+    # mu/nu/trace trees shaped like the params
+    inner = state.get("opt_inner")
+    if inner is not None:
+        flat_inner = _flatten(inner)
+        for key, arr in flat_inner.items():
+            # key like "0.mu.<param-path>" — map moment-name per param
+            parts = key.split(SEP)
+            for i, p in enumerate(parts):
+                if p in ("mu", "nu", "trace", "m", "v"):
+                    param_path = SEP.join(parts[i + 1:])
+                    name = {"mu": "exp_avg", "m": "exp_avg",
+                            "nu": "exp_avg_sq", "v": "exp_avg_sq",
+                            "trace": "momentum"}[p]
+                    if param_path in masters and \
+                            arr.shape == masters[param_path].shape:
+                        moments.setdefault(param_path, {})[name] = arr
+                    break
+
+    manifest = {}
+    for path, arr in masters.items():
+        pdir = os.path.join(out, path)
+        os.makedirs(pdir, exist_ok=True)
+        np.save(os.path.join(pdir, "fp32.npy"),
+                np.asarray(arr, dtype=np.float32))
+        entry = {"shape": list(arr.shape), "dtype": "float32",
+                 "moments": sorted(moments.get(path, {}))}
+        for name, m in moments.get(path, {}).items():
+            np.save(os.path.join(pdir, f"{name}.npy"),
+                    np.asarray(m, dtype=np.float32))
+        manifest[path] = entry
+
+    uni_meta = {
+        "source_tag": meta.get("tag"),
+        "global_steps": meta.get("global_steps"),
+        "step_count": int(np.asarray(state.get("step_count", 0))),
+        "source_mesh_shape": meta.get("mesh_shape"),
+        "zero_stage": meta.get("zero_stage"),
+        "params": manifest,
+    }
+    with open(os.path.join(out, METADATA_FILE), "w") as f:
+        json.dump(uni_meta, f, indent=2)
+    print(f"wrote universal checkpoint ({len(manifest)} params) -> {out}")
+    return out
+
+
+def load_universal(engine, universal_dir: str,
+                   load_optimizer_states: bool = True):
+    """Map a universal dir onto a live engine with its current sharding
+    plan (reference load_universal_checkpoint; universal_checkpoint.py:99).
+
+    Every param found in the dir is loaded (resharded by device_put with
+    the engine's target sharding); missing params keep their values.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    root = os.path.abspath(universal_dir)
+    if os.path.basename(root) != UNIVERSAL_DIR and \
+            os.path.isdir(os.path.join(root, UNIVERSAL_DIR)):
+        root = os.path.join(root, UNIVERSAL_DIR)
+    with open(os.path.join(root, METADATA_FILE)) as f:
+        meta = json.load(f)
+
+    flat: Dict[str, np.ndarray] = {}
+    for path in meta["params"]:
+        flat[path] = np.load(os.path.join(root, path, "fp32.npy"))
+
+    if engine.opt_state is not None and load_optimizer_states:
+        # fp32 masters: exact restore, then recompute compute-dtype params
+        new_master = _unflatten_into(flat, jax.tree.map(np.asarray,
+                                                        engine.opt_state.master))
+        master_sh = jax.tree.map(lambda a: a.sharding, engine.opt_state.master)
+        new_master = jax.tree.map(
+            lambda arr, sh: jax.device_put(np.asarray(arr, np.float32), sh),
+            new_master, master_sh)
+        # moments
+        step_count = meta.get("step_count")
+
+        def load_inner(old_inner):
+            flat_old = _flatten(jax.tree.map(np.asarray, old_inner))
+            updates: Dict[str, np.ndarray] = {}
+            for key in flat_old:
+                parts = key.split(SEP)
+                # optimizer step counters resume at the source run's step,
+                # or Adam bias correction restarts from scratch
+                if parts[-1] == "count" and flat_old[key].ndim == 0 \
+                        and step_count is not None:
+                    updates[key] = np.asarray(step_count,
+                                              flat_old[key].dtype)
+                    continue
+                for i, p in enumerate(parts):
+                    if p in ("mu", "nu", "trace", "m", "v"):
+                        param_path = SEP.join(parts[i + 1:])
+                        name = {"mu": "exp_avg", "m": "exp_avg",
+                                "nu": "exp_avg_sq", "v": "exp_avg_sq",
+                                "trace": "momentum"}[p]
+                        f = os.path.join(root, param_path, f"{name}.npy")
+                        if os.path.exists(f):
+                            arr = np.load(f)
+                            if arr.shape == flat_old[key].shape:
+                                updates[key] = arr
+                        break
+            return _unflatten_into({**flat_old, **updates}, old_inner) \
+                if updates else None
+
+        host_inner = jax.tree.map(np.asarray, engine.opt_state.inner)
+        maybe_inner = load_inner(host_inner)
+        if maybe_inner is not None:
+            inner_sh = jax.tree.map(lambda a: a.sharding,
+                                    engine.opt_state.inner)
+            new_inner = jax.tree.map(
+                lambda arr, old, sh: jax.device_put(
+                    np.asarray(arr, np.asarray(old).dtype), sh),
+                maybe_inner, host_inner, inner_sh)
+        else:
+            new_inner = engine.opt_state.inner
+        from deepspeed_tpu.runtime.optimizer import MixedPrecisionState
+
+        engine.opt_state = MixedPrecisionState(master=new_master,
+                                               inner=new_inner)
+        cdt = engine.compute_dtype
+        engine.params = jax.jit(
+            lambda m: jax.tree.map(lambda x: x.astype(cdt), m),
+            out_shardings=engine._param_shardings)(new_master)
+    else:
+        host_params = jax.tree.map(np.asarray, engine.params)
+        new_params = _unflatten_into(flat, host_params)
+        engine.params = jax.tree.map(
+            lambda arr, old: jax.device_put(
+                np.asarray(arr, dtype=np.asarray(old).dtype), old.sharding),
+            new_params, engine.params)
+
+    step = meta.get("step_count")
+    if step is not None:
+        engine.step_count = jax.device_put(
+            jnp.asarray(int(step), jnp.int32), engine.step_count.sharding)
+        engine.global_steps = int(meta.get("global_steps") or step)
+    return engine
+
+
+# ----------------------------------------------------------------------
+# inspection + CLI
+# ----------------------------------------------------------------------
+def inspect_checkpoint(ckpt_root: str, tag: Optional[str] = None) -> Dict:
+    """Metadata-only: reads orbax tree metadata (shapes/dtypes), never the
+    tensor payload — inspecting a multi-B-param checkpoint stays cheap."""
+    import orbax.checkpoint as ocp
+
+    tag = _resolve_tag(ckpt_root, tag)
+    ckpt_dir = os.path.join(os.path.abspath(ckpt_root), tag)
+    meta = {}
+    meta_path = os.path.join(ckpt_dir, METADATA_FILE)
+    if os.path.exists(meta_path):
+        with open(meta_path) as f:
+            meta = json.load(f)
+    with ocp.PyTreeCheckpointer() as ckptr:
+        md = ckptr.metadata(os.path.join(ckpt_dir, STATE_DIR))
+    item = getattr(md, "item_metadata", None)
+    tree = getattr(item, "tree", None) or item or md
+    shapes = {k: v for k, v in _flatten_meta(tree).items()}
+    param_shapes = {k: v for k, v in shapes.items()
+                    if k.split(SEP)[0] == "params"}
+    n_params = sum(int(np.prod(s)) for s in param_shapes.values())
+    return {
+        "dir": ckpt_dir,
+        "tag": meta.get("tag"),
+        "global_steps": meta.get("global_steps"),
+        "mesh_shape": meta.get("mesh_shape"),
+        "zero_stage": meta.get("zero_stage"),
+        "n_tensors": len(param_shapes),
+        "n_params": n_params,
+        "has_optimizer_state": any(k.split(SEP)[0] == "opt_master"
+                                   for k in shapes),
+    }
+
+
+def _flatten_meta(tree, prefix="") -> Dict[str, Tuple[int, ...]]:
+    """Flatten an orbax metadata tree to {path: shape}."""
+    out: Dict[str, Tuple[int, ...]] = {}
+    if isinstance(tree, dict):
+        for k, v in tree.items():
+            out.update(_flatten_meta(v, f"{prefix}{SEP}{k}" if prefix else str(k)))
+    elif isinstance(tree, (list, tuple)) and not hasattr(tree, "shape"):
+        for i, v in enumerate(tree):
+            out.update(_flatten_meta(v, f"{prefix}{SEP}{i}" if prefix else str(i)))
+    elif tree is None:
+        pass
+    else:
+        out[prefix] = tuple(getattr(tree, "shape", ()) or ())
+    return out
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        prog="dstpu-ckpt",
+        description="checkpoint tools: inspect / to-fp32 (zero_to_fp32) / "
+                    "to-universal (ds_to_universal)")
+    sub = ap.add_subparsers(dest="cmd", required=True)
+
+    p = sub.add_parser("inspect")
+    p.add_argument("ckpt_dir")
+    p.add_argument("--tag", default=None)
+
+    p = sub.add_parser("to-fp32")
+    p.add_argument("ckpt_dir")
+    p.add_argument("output", help="output .npz path")
+    p.add_argument("--tag", default=None)
+
+    p = sub.add_parser("to-universal")
+    p.add_argument("ckpt_dir")
+    p.add_argument("output", help="output directory")
+    p.add_argument("--tag", default=None)
+
+    args = ap.parse_args(argv)
+    if args.cmd == "inspect":
+        print(json.dumps(inspect_checkpoint(args.ckpt_dir, args.tag), indent=2))
+    elif args.cmd == "to-fp32":
+        convert_to_fp32(args.ckpt_dir, args.output, args.tag)
+    elif args.cmd == "to-universal":
+        convert_to_universal(args.ckpt_dir, args.output, args.tag)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,3 @@
+from deepspeed_tpu.io.fast_file_writer import FastFileWriter
+
+__all__ = ["FastFileWriter"]

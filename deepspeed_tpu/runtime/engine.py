@@ -590,6 +590,9 @@ class Engine:
     def _after_step(self, metrics):
         self.global_steps += 1
         self.global_samples += self.train_batch_size
+        # decoupled checkpoint engine: publish a finished async save at the
+        # GAS boundary (reference engine.py:3273)
+        self._ckpt_io.maybe_commit()
         if bool(metrics.get("overflow", False)):
             self.skipped_steps += 1
         self.tput_timer.stop(global_step=True)

@@ -103,7 +103,14 @@ def build_onebit_step(model, mesh, cfg, opt: Dict, param_shardings,
     def local_grads(params, batches, m, error, step):
         """MANUAL over dp: local grads -> compressed/full momentum sync.
         batches leaves: [gas, B/dp, ...]; error leaves [1, *shape]."""
+        from deepspeed_tpu.runtime import sharding as shard_lib
 
+        # trace-time: the model's sharding constraints reference mesh axes
+        # that are manual inside this shard_map region
+        with shard_lib.disable_constraints():
+            return _local_grads_inner(params, batches, m, error, step)
+
+    def _local_grads_inner(params, batches, m, error, step):
         def total_loss(p):
             def body(carry, mb):
                 loss, _aux = model.loss(p, mb)
