@@ -47,6 +47,19 @@ class CheckpointIO:
 
         self.ckpt_engine = make_checkpoint_engine(engine.config.checkpoint)
         self._pending_commit = None  # (tag, save_dir, ckpt_dir, meta, latest)
+        # a final async save with no later step/save/load would otherwise
+        # never publish metadata + 'latest' — commit at interpreter exit
+        import atexit
+        import weakref
+
+        ref = weakref.ref(self)
+
+        def _commit_at_exit():
+            obj = ref()  # bind once: the object can be collected mid-expr
+            if obj is not None:
+                obj.commit_pending()
+
+        atexit.register(_commit_at_exit)
 
     # -- state tree ----------------------------------------------------
     def _state(self) -> Dict[str, Any]:
@@ -96,12 +109,11 @@ class CheckpointIO:
             dst = os.path.join(
                 ckpt_dir, f"offload_optim_rank{jax.process_index()}.npz")
             if hasattr(self.ckpt_engine, "save_host_blob"):
-                # fast engine: pipelined AIO write of the serialized blob
-                import io as _io
-
-                buf = _io.BytesIO()
-                np.savez(buf, **flat)
-                self.ckpt_engine.save_host_blob(buf.getvalue(), dst)
+                # fast engine: np.savez streams zip members straight into
+                # the double-buffered AIO writer — serialization overlaps
+                # disk I/O and peak extra memory is one staging buffer
+                self.ckpt_engine.save_host_blob(
+                    lambda f: np.savez(f, **flat), dst)
             else:
                 # np.savez appends '.npz' unless the path already ends in it
                 tmp = f"{dst}.{os.getpid()}.tmp.npz"
@@ -150,20 +162,70 @@ class CheckpointIO:
                                        LATEST_FILE), "w") as f:
                     f.write(str(tag))
 
+    @staticmethod
+    def _agree(done: bool, failed: bool) -> Tuple[bool, bool]:
+        """All-process agreement on (all done, any failed). Every rank with
+        a pending commit calls this in lockstep (same save ⇒ same polling
+        sequence), so the collective never mismatches."""
+        if jax.process_count() == 1:
+            return done, failed
+        import numpy as np
+        from jax.experimental import multihost_utils
+
+        flags = multihost_utils.process_allgather(
+            np.asarray([bool(done), bool(failed)]))
+        return bool(np.all(flags[:, 0])), bool(np.any(flags[:, 1]))
+
     def commit_pending(self):
-        """Block until an in-flight async save is durable, then publish."""
+        """Block until an in-flight async save is durable, then publish.
+
+        A rank whose write failed must not leave the others stuck in the
+        publish barrier: ranks first agree on success, and on any failure
+        everyone abandons the pending save (the failing rank re-raises)."""
         if self._pending_commit is None:
             return
         tag, save_dir, ckpt_dir, meta, save_latest = self._pending_commit
         self._pending_commit = None
-        self.ckpt_engine.commit(tag)
+        err = None
+        try:
+            self.ckpt_engine.commit(tag)
+        except Exception as e:  # noqa: BLE001 — agreed on below
+            err = e
+        _, any_failed = self._agree(True, err is not None)
+        if any_failed:
+            if err is not None:
+                raise err
+            raise RuntimeError(
+                f"async checkpoint '{tag}' failed on another rank; "
+                "not publishing")
         self._publish(tag, save_dir, ckpt_dir, meta, save_latest)
         log_dist(f"saved checkpoint: {ckpt_dir}", ranks=[0])
 
     def maybe_commit(self):
-        """Polled at GAS boundaries (reference engine.py:3273)."""
-        if self._pending_commit is not None and \
-                self.ckpt_engine.maybe_finalize():
+        """Polled at GAS boundaries (reference engine.py:3273).
+
+        Multi-host: ranks finish their async writes at different times, and
+        ``_publish`` runs a global barrier — so all processes must agree the
+        save is done *before* anyone enters it, or one rank blocks in the
+        barrier while another issues the next step's collectives (deadlock).
+        A rank-local write error is folded into the agreement the same way
+        (a raise before the all-gather would strand the other ranks)."""
+        if self._pending_commit is None:
+            return
+        err = None
+        try:
+            done = self.ckpt_engine.maybe_finalize()
+        except Exception as e:  # noqa: BLE001 — agreed on below
+            err, done = e, True
+        done, any_failed = self._agree(done, err is not None)
+        if any_failed:
+            self._pending_commit = None
+            if err is not None:
+                raise err
+            raise RuntimeError(
+                "async checkpoint save failed on another rank; pending "
+                "save abandoned")
+        if done:
             self.commit_pending()
 
     # -- load ----------------------------------------------------------

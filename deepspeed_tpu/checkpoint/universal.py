@@ -36,11 +36,19 @@ from typing import Any, Dict, Optional, Tuple
 
 import numpy as np
 
-LATEST_FILE = "latest"
-METADATA_FILE = "metadata.json"
-STATE_DIR = "state"
+from deepspeed_tpu.checkpoint.state import (LATEST_FILE, METADATA_FILE,
+                                            STATE_DIR)
+
 UNIVERSAL_DIR = "universal"
 SEP = "."
+
+# optax moment field → the reference's torch optimizer-state name
+# (universal checkpoints use the torch names so both frameworks can consume
+# them; the inverse mapping lives in utils/tensor_fragment.py)
+MOMENT_NAME_MAP = {"mu": "exp_avg", "m": "exp_avg",
+                   "nu": "exp_avg_sq", "v": "exp_avg_sq",
+                   "trace": "momentum"}
+MOMENT_KEYS = tuple(MOMENT_NAME_MAP)
 
 
 # ----------------------------------------------------------------------
@@ -100,25 +108,6 @@ def _flatten(tree, prefix="") -> Dict[str, np.ndarray]:
     return out
 
 
-def _unflatten_into(flat: Dict[str, np.ndarray], tree, prefix=""):
-    """Return a copy of ``tree`` with leaves replaced from ``flat``."""
-    if isinstance(tree, dict):
-        return {k: _unflatten_into(flat, v,
-                                   f"{prefix}{SEP}{k}" if prefix else str(k))
-                for k, v in tree.items()}
-    if _is_namedtuple(tree):
-        vals = [_unflatten_into(flat, v,
-                                f"{prefix}{SEP}{n}" if prefix else str(n))
-                for n, v in zip(tree._fields, tree)]
-        return type(tree)(*vals)
-    if isinstance(tree, (list, tuple)):
-        vals = [_unflatten_into(flat, v,
-                                f"{prefix}{SEP}{i}" if prefix else str(i))
-                for i, v in enumerate(tree)]
-        return tuple(vals) if isinstance(tree, tuple) else vals
-    return flat.get(prefix, tree)
-
-
 # ----------------------------------------------------------------------
 # zero_to_fp32 analog
 # ----------------------------------------------------------------------
@@ -170,11 +159,9 @@ def convert_to_universal(ckpt_root: str, out_dir: str,
             # key like "0.mu.<param-path>" — map moment-name per param
             parts = key.split(SEP)
             for i, p in enumerate(parts):
-                if p in ("mu", "nu", "trace", "m", "v"):
+                if p in MOMENT_KEYS:
                     param_path = SEP.join(parts[i + 1:])
-                    name = {"mu": "exp_avg", "m": "exp_avg",
-                            "nu": "exp_avg_sq", "v": "exp_avg_sq",
-                            "trace": "momentum"}[p]
+                    name = MOMENT_NAME_MAP[p]
                     if param_path in masters and \
                             arr.shape == masters[param_path].shape:
                         moments.setdefault(param_path, {})[name] = arr
@@ -207,16 +194,56 @@ def convert_to_universal(ckpt_root: str, out_dir: str,
     return out
 
 
+def _map_with_paths(tree, fn, prefix=""):
+    """Structure-preserving map of ``fn(path, leaf)`` (dicts, namedtuples,
+    lists/tuples — same path scheme as _flatten)."""
+    if isinstance(tree, dict):
+        return {k: _map_with_paths(v, fn,
+                                   f"{prefix}{SEP}{k}" if prefix else str(k))
+                for k, v in tree.items()}
+    if _is_namedtuple(tree):
+        vals = [_map_with_paths(v, fn,
+                                f"{prefix}{SEP}{n}" if prefix else str(n))
+                for n, v in zip(tree._fields, tree)]
+        return type(tree)(*vals)
+    if isinstance(tree, (list, tuple)):
+        vals = [_map_with_paths(v, fn,
+                                f"{prefix}{SEP}{i}" if prefix else str(i))
+                for i, v in enumerate(tree)]
+        return tuple(vals) if isinstance(tree, tuple) else vals
+    if tree is None:
+        return None
+    return fn(prefix, tree)
+
+
+def _put_like(host_arr: np.ndarray, like) -> Any:
+    """Place a host array with ``like``'s sharding + dtype. Multi-process
+    safe: every process holds the full array (read from shared storage),
+    and make_array_from_callback assembles only this process's addressable
+    shards — device_put of a cross-process global array is invalid in
+    multi-controller JAX, and np.asarray of one would be too.
+    """
+    import jax
+
+    arr = np.asarray(host_arr, dtype=np.dtype(like.dtype))
+    sharding = like.sharding
+    if jax.process_count() == 1:
+        return jax.device_put(arr, sharding)
+    return jax.make_array_from_callback(arr.shape, sharding,
+                                        lambda idx: arr[idx])
+
+
 def load_universal(engine, universal_dir: str,
                    load_optimizer_states: bool = True):
     """Map a universal dir onto a live engine with its current sharding
     plan (reference load_universal_checkpoint; universal_checkpoint.py:99).
 
-    Every param found in the dir is loaded (resharded by device_put with
-    the engine's target sharding); missing params keep their values.
+    Every param found in the dir is loaded (resharded onto the engine's
+    target sharding); missing params keep their values. Works on
+    multi-host meshes: file contents are read by every process and placed
+    shard-by-shard, existing device arrays are never pulled to host.
     """
     import jax
-    import jax.numpy as jnp
 
     root = os.path.abspath(universal_dir)
     if os.path.basename(root) != UNIVERSAL_DIR and \
@@ -231,53 +258,34 @@ def load_universal(engine, universal_dir: str,
 
     if engine.opt_state is not None and load_optimizer_states:
         # fp32 masters: exact restore, then recompute compute-dtype params
-        new_master = _unflatten_into(flat, jax.tree.map(np.asarray,
-                                                        engine.opt_state.master))
-        master_sh = jax.tree.map(lambda a: a.sharding, engine.opt_state.master)
-        new_master = jax.tree.map(
-            lambda arr, sh: jax.device_put(np.asarray(arr, np.float32), sh),
-            new_master, master_sh)
-        # moments
+        def restore_master(path, leaf):
+            if path in flat and flat[path].shape == leaf.shape:
+                return _put_like(flat[path], leaf)
+            return leaf
+
+        new_master = _map_with_paths(engine.opt_state.master, restore_master)
         step_count = meta.get("step_count")
 
-        def load_inner(old_inner):
-            flat_old = _flatten(jax.tree.map(np.asarray, old_inner))
-            updates: Dict[str, np.ndarray] = {}
-            for key in flat_old:
-                parts = key.split(SEP)
-                # optimizer step counters resume at the source run's step,
-                # or Adam bias correction restarts from scratch
-                if parts[-1] == "count" and flat_old[key].ndim == 0 \
-                        and step_count is not None:
-                    updates[key] = np.asarray(step_count,
-                                              flat_old[key].dtype)
-                    continue
-                for i, p in enumerate(parts):
-                    if p in ("mu", "nu", "trace", "m", "v"):
-                        param_path = SEP.join(parts[i + 1:])
-                        name = {"mu": "exp_avg", "m": "exp_avg",
-                                "nu": "exp_avg_sq", "v": "exp_avg_sq",
-                                "trace": "momentum"}[p]
-                        f = os.path.join(root, param_path, f"{name}.npy")
-                        if os.path.exists(f):
-                            arr = np.load(f)
-                            if arr.shape == flat_old[key].shape:
-                                updates[key] = arr
-                        break
-            return _unflatten_into({**flat_old, **updates}, old_inner) \
-                if updates else None
+        def restore_inner(path, leaf):
+            parts = path.split(SEP)
+            # optimizer step counters resume at the source run's step, or
+            # Adam bias correction restarts from scratch
+            if parts[-1] == "count" and getattr(leaf, "ndim", None) == 0 \
+                    and step_count is not None:
+                return _put_like(np.asarray(step_count), leaf)
+            for i, p in enumerate(parts):
+                if p in MOMENT_KEYS:
+                    param_path = SEP.join(parts[i + 1:])
+                    name = MOMENT_NAME_MAP[p]
+                    f = os.path.join(root, param_path, f"{name}.npy")
+                    if os.path.exists(f):
+                        arr = np.load(f)
+                        if arr.shape == tuple(leaf.shape):
+                            return _put_like(arr, leaf)
+                    break
+            return leaf
 
-        host_inner = jax.tree.map(np.asarray, engine.opt_state.inner)
-        maybe_inner = load_inner(host_inner)
-        if maybe_inner is not None:
-            inner_sh = jax.tree.map(lambda a: a.sharding,
-                                    engine.opt_state.inner)
-            new_inner = jax.tree.map(
-                lambda arr, old, sh: jax.device_put(
-                    np.asarray(arr, np.asarray(old).dtype), sh),
-                maybe_inner, host_inner, inner_sh)
-        else:
-            new_inner = engine.opt_state.inner
+        new_inner = _map_with_paths(engine.opt_state.inner, restore_inner)
         from deepspeed_tpu.runtime.optimizer import MixedPrecisionState
 
         engine.opt_state = MixedPrecisionState(master=new_master,
@@ -287,17 +295,32 @@ def load_universal(engine, universal_dir: str,
             lambda m: jax.tree.map(lambda x: x.astype(cdt), m),
             out_shardings=engine._param_shardings)(new_master)
     else:
-        host_params = jax.tree.map(np.asarray, engine.params)
-        new_params = _unflatten_into(flat, host_params)
-        engine.params = jax.tree.map(
-            lambda arr, old: jax.device_put(
-                np.asarray(arr, dtype=np.asarray(old).dtype), old.sharding),
-            new_params, engine.params)
+        def restore_param(path, leaf):
+            if path in flat and flat[path].shape == tuple(leaf.shape):
+                return _put_like(flat[path], leaf)
+            return leaf
+
+        engine.params = _map_with_paths(engine.params, restore_param)
+        if getattr(engine, "_offload", None) is not None:
+            # offload engines keep the fp32 masters on host — re-seed them
+            # from the restored params or the next step's master→param sync
+            # would silently roll the model back (same hazard the regular
+            # load path guards in state.py)
+            engine._offload.reinit_masters(
+                engine._jit_to_opt_sharding(jax.tree.map(
+                    lambda x: x.astype("float32"), engine.params)))
+            if load_optimizer_states:
+                from deepspeed_tpu.utils.logging import logger
+
+                logger.warning(
+                    "load_universal: offload-engine optimizer moments are "
+                    "not mapped from the universal dir (masters re-seeded "
+                    "from params, moments reset)")
 
     step = meta.get("step_count")
     if step is not None:
-        engine.step_count = jax.device_put(
-            jnp.asarray(int(step), jnp.int32), engine.step_count.sharding)
+        engine.step_count = _put_like(np.asarray(int(step), np.int32),
+                                      engine.step_count)
         engine.global_steps = int(meta.get("global_steps") or step)
     return engine
 

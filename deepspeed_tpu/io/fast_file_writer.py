@@ -109,8 +109,19 @@ class FastFileWriter:
             self.flush()
         finally:
             self._closed = True
-            for b in self._bufs:
-                b.free()
+            # a failed flush can leave writes in flight — the native
+            # threads still read from the pinned buffers, so they must be
+            # drained (best-effort) before the memory is freed
+            try:
+                if any(self._pending):
+                    try:
+                        self._aio.wait()
+                    except Exception:
+                        pass
+                    self._pending = [False, False]
+            finally:
+                for b in self._bufs:
+                    b.free()
 
     def __enter__(self):
         return self

@@ -29,8 +29,6 @@ from typing import Any, Dict, Optional
 
 from deepspeed_tpu.utils.logging import log_dist, logger
 
-STATE_DIR = "state"
-
 
 class CheckpointEngine(abc.ABC):
     """Reference ABC: checkpoint_engine.py:21."""
@@ -152,11 +150,49 @@ class FastCheckpointEngine(SyncCheckpointEngine):
     — reference deepspeed/io/fast_file_writer.py:44).
     """
 
-    def save_host_blob(self, data: bytes, path: str):
+    def save_host_blob(self, data, path: str):
+        """Write host bytes through the pipelined AIO writer.
+
+        ``data`` is either ``bytes`` or a callable taking a write-only
+        file-like object (e.g. ``lambda f: np.savez(f, **arrays)``) — the
+        callable form streams through the double buffer instead of
+        materializing the whole blob in RAM first. The write lands at a
+        tmp path and is os.replace'd on success so a crash mid-write
+        never corrupts a previously-published file.
+        """
+        tmp = f"{path}.{os.getpid()}.tmp"
         from deepspeed_tpu.io.fast_file_writer import FastFileWriter
 
-        with FastFileWriter(path) as w:
-            w.write(data)
+        with FastFileWriter(tmp) as w:
+            if callable(data):
+                data(_WriteStream(w))
+            else:
+                w.write(data)
+        os.replace(tmp, path)
+
+
+class _WriteStream:
+    """Minimal write-only file object over FastFileWriter (zipfile/np.savez
+    compatible: unseekable streams get zipfile's _Tellable wrapper; the
+    ``read`` stub makes numpy's zipfile_factory treat it as a file object
+    rather than a path)."""
+
+    def __init__(self, writer):
+        self._w = writer
+
+    def write(self, b) -> int:
+        return self._w.write(bytes(b))
+
+    def flush(self):
+        pass
+
+    def seekable(self) -> bool:
+        return False
+
+    def read(self, *args):
+        import io
+
+        raise io.UnsupportedOperation("write-only stream")
 
 
 _ENGINES = {
