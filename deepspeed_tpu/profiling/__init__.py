@@ -1,1 +1,7 @@
+"""Profiling subsystem (reference: deepspeed/profiling/)."""
 
+from deepspeed_tpu.profiling.flops_profiler import (  # noqa: F401
+    FlopsProfiler,
+    get_model_profile,
+    profile_compiled,
+)

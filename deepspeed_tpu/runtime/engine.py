@@ -142,6 +142,7 @@ class Engine:
         self.gradient_accumulation_steps = config.gradient_accumulation_steps
         self.train_batch_size = config.train_batch_size
         self.compute_dtype = config.compute_dtype
+        self._post_step_hooks = []
 
         # -- 1-bit compressed-comm optimizers (runtime/onebit.py) ---------
         opt_name = ((config.optimizer.type if config.optimizer else "")
@@ -587,9 +588,17 @@ class Engine:
         loss, _aux = self._jit_eval(self.params, batch)
         return loss
 
+    def register_post_step_hook(self, fn):
+        """``fn(engine)`` runs after every optimizer step (compression
+        re-masking, progressive layer drop, custom callbacks)."""
+        self._post_step_hooks.append(fn)
+        return fn
+
     def _after_step(self, metrics):
         self.global_steps += 1
         self.global_samples += self.train_batch_size
+        for hook in self._post_step_hooks:
+            hook(self)
         # decoupled checkpoint engine: publish a finished async save at the
         # GAS boundary (reference engine.py:3273)
         self._ckpt_io.maybe_commit()
@@ -613,6 +622,22 @@ class Engine:
                 self.global_steps % self.config.steps_per_print == 0:
             self.timers.log([FORWARD_GLOBAL_TIMER, BACKWARD_GLOBAL_TIMER,
                              STEP_GLOBAL_TIMER, TRAIN_BATCH_TIMER])
+        fp = self.config.flops_profiler
+        if fp.enabled and self.global_steps == fp.profile_step \
+                and jax.process_index() == 0:
+            # rank 0 only: the profile recompiles the step (lowering is
+            # process-local, no collectives run) and writes output_file
+            from deepspeed_tpu.profiling.flops_profiler import FlopsProfiler
+
+            prof = FlopsProfiler(engine=self)
+            prof.start_profile()
+            prof.stop_profile()
+            prof.print_model_profile(profile_step=fp.profile_step,
+                                     module_depth=fp.module_depth,
+                                     top_modules=fp.top_modules,
+                                     detailed=fp.detailed,
+                                     output_file=fp.output_file)
+            prof.end_profile()
 
     def _build_monitor(self):
         try:
