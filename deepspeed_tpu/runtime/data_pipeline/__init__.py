@@ -1,0 +1,31 @@
+"""Data-efficiency pipeline (reference: deepspeed/runtime/data_pipeline/).
+
+Covers the reference's data-efficiency library: curriculum learning
+(CurriculumScheduler + curriculum-aware sampler), the memmap indexed
+dataset, per-sample difficulty analysis (DataAnalyzer), variable batch
+size with LR scaling, and random layerwise token dropping (random-LTD).
+"""
+
+from deepspeed_tpu.runtime.data_pipeline.curriculum_scheduler import (  # noqa: F401
+    CurriculumScheduler,
+)
+from deepspeed_tpu.runtime.data_pipeline.indexed_dataset import (  # noqa: F401
+    MMapIndexedDataset,
+    MMapIndexedDatasetBuilder,
+)
+from deepspeed_tpu.runtime.data_pipeline.data_sampler import (  # noqa: F401
+    DeepSpeedDataSampler,
+)
+from deepspeed_tpu.runtime.data_pipeline.data_analyzer import (  # noqa: F401
+    DataAnalyzer,
+    DistributedDataAnalyzer,
+)
+from deepspeed_tpu.runtime.data_pipeline.variable_batch_size_and_lr import (  # noqa: F401
+    batch_by_tokens,
+    VariableBatchSizeLoader,
+)
+from deepspeed_tpu.runtime.data_pipeline.random_ltd import (  # noqa: F401
+    RandomLTDScheduler,
+    random_ltd_gather,
+    random_ltd_scatter,
+)

@@ -1,0 +1,108 @@
+"""Curriculum difficulty schedules.
+
+Reference: ``runtime/data_pipeline/curriculum_scheduler.py:11``
+(``CurriculumScheduler``) — maps a global step to a difficulty value
+(typically max sequence length) under fixed_linear / fixed_root /
+fixed_discrete / custom schedules.
+
+TPU note: difficulty usually controls sequence length, and every distinct
+length is a distinct compiled program. ``rounding`` therefore defaults to
+a power-of-2-friendly multiple (the reference uses ``difficulty_step`` the
+same way) — keep it coarse (e.g. 64) to bound recompiles.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Callable, Dict, List, Optional
+
+FIXED_LINEAR = "fixed_linear"
+FIXED_ROOT = "fixed_root"
+FIXED_DISCRETE = "fixed_discrete"
+CUSTOM = "custom"
+
+
+class CurriculumScheduler:
+    """Step → difficulty.
+
+    config keys (reference schema):
+      curriculum_type: fixed_linear | fixed_root | fixed_discrete | custom
+      min_difficulty, max_difficulty
+      schedule_config:
+        fixed_linear/fixed_root: {total_curriculum_step, difficulty_step,
+                                  root_degree (root only)}
+        fixed_discrete: {difficulty: [..], max_step: [..]}  (len-1 steps)
+    """
+
+    def __init__(self, config: Dict[str, Any]):
+        self.config = dict(config)
+        self.curriculum_type = config.get("curriculum_type", FIXED_LINEAR)
+        self.min_difficulty = int(config.get("min_difficulty", 1))
+        self.max_difficulty = int(config.get("max_difficulty", 1))
+        self.schedule_config = dict(config.get("schedule_config", {}))
+        self._custom_fn: Optional[Callable[[int], int]] = None
+        self.current_difficulty = self.min_difficulty
+
+        if self.curriculum_type in (FIXED_LINEAR, FIXED_ROOT):
+            sc = self.schedule_config
+            if "total_curriculum_step" not in sc:
+                raise ValueError(
+                    f"{self.curriculum_type} schedule needs "
+                    "schedule_config.total_curriculum_step")
+            self.total_step = int(sc["total_curriculum_step"])
+            self.difficulty_step = int(sc.get("difficulty_step", 1))
+            self.root_degree = int(sc.get("root_degree", 2)) \
+                if self.curriculum_type == FIXED_ROOT else 1
+        elif self.curriculum_type == FIXED_DISCRETE:
+            sc = self.schedule_config
+            self.difficulties: List[int] = list(sc["difficulty"])
+            self.max_steps: List[int] = list(sc.get("max_step", []))
+            if len(self.max_steps) != len(self.difficulties) - 1:
+                raise ValueError(
+                    "fixed_discrete: len(max_step) must be "
+                    "len(difficulty) - 1")
+        elif self.curriculum_type == CUSTOM:
+            pass  # set_custom_get_difficulty must be called
+        else:
+            raise ValueError(
+                f"unknown curriculum_type '{self.curriculum_type}'")
+
+    def set_custom_get_difficulty(self, fn: Callable[[int], int]):
+        """Reference engine.set_custom_curriculum_learning_schedule."""
+        self._custom_fn = fn
+
+    def get_difficulty(self, global_steps: int) -> int:
+        if self.curriculum_type == CUSTOM:
+            if self._custom_fn is None:
+                raise RuntimeError(
+                    "custom curriculum: call set_custom_get_difficulty first")
+            d = int(self._custom_fn(global_steps))
+        elif self.curriculum_type == FIXED_DISCRETE:
+            d = self.difficulties[-1]
+            for diff, until in zip(self.difficulties, self.max_steps):
+                if global_steps <= until:
+                    d = diff
+                    break
+        else:
+            frac = min(1.0, max(0.0, global_steps / max(self.total_step, 1)))
+            if self.curriculum_type == FIXED_ROOT:
+                frac = frac ** (1.0 / self.root_degree)
+            span = self.max_difficulty - self.min_difficulty
+            d = self.min_difficulty + frac * span
+            # quantize to difficulty_step multiples (bounds recompiles)
+            d = int(math.floor(d / self.difficulty_step)) * self.difficulty_step
+            d = max(self.min_difficulty, d)
+        self.current_difficulty = int(min(d, self.max_difficulty))
+        return self.current_difficulty
+
+    def update_difficulty(self, global_steps: int) -> int:
+        return self.get_difficulty(global_steps)
+
+    def is_fully_ramped(self, global_steps: int) -> bool:
+        return self.get_difficulty(global_steps) >= self.max_difficulty
+
+    def state_dict(self) -> Dict[str, Any]:
+        return {"current_difficulty": self.current_difficulty}
+
+    def load_state_dict(self, sd: Dict[str, Any]):
+        self.current_difficulty = int(sd["current_difficulty"])

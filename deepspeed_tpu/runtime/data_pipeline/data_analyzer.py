@@ -1,0 +1,142 @@
+"""Offline per-sample difficulty analysis.
+
+Reference: ``runtime/data_pipeline/data_sampling/data_analyzer.py:23``
+(``DataAnalyzer``) and ``:457`` (``DistributedDataAnalyzer``) — scan a
+dataset once, compute one or more per-sample metric values (sequence
+length, vocab rarity, ...), and write index files that map a difficulty
+value to the sample ids at that difficulty. The curriculum sampler
+consumes these indexes at training time.
+
+On-disk layout per metric:
+
+    <out>/<metric>/sample_values.npy        value per sample id
+    <out>/<metric>/index_to_sample.json     {difficulty: [sample ids]}
+    <out>/<metric>/metadata.json            {num_samples, min, max}
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Callable, Dict, List, Optional, Sequence
+
+import numpy as np
+
+
+# built-in metric functions (reference data_analyzer metric_function)
+def metric_seqlen(sample) -> int:
+    return int(np.asarray(sample).size)
+
+
+def metric_vocab_rarity(vocab_freq: np.ndarray) -> Callable:
+    """Reference vocab_rarity: sum of -log p(token) over the sample."""
+    logp = -np.log(np.clip(vocab_freq / max(vocab_freq.sum(), 1), 1e-12, 1))
+
+    def fn(sample) -> int:
+        toks = np.asarray(sample).astype(np.int64).ravel()
+        return int(logp[toks].sum())
+
+    return fn
+
+
+BUILTIN_METRICS: Dict[str, Callable] = {"seqlen": metric_seqlen}
+
+
+class DataAnalyzer:
+    """Single-process scan (reference DataAnalyzer.run_map/run_reduce)."""
+
+    def __init__(self, dataset, output_dir: str,
+                 metric_names: Sequence[str] = ("seqlen",),
+                 metric_functions: Optional[Dict[str, Callable]] = None,
+                 num_quantiles: int = 0):
+        self.dataset = dataset
+        self.output_dir = os.path.abspath(output_dir)
+        self.metric_names = list(metric_names)
+        fns = dict(BUILTIN_METRICS)
+        fns.update(metric_functions or {})
+        missing = [m for m in self.metric_names if m not in fns]
+        if missing:
+            raise ValueError(f"no metric function for {missing}")
+        self.metric_functions = {m: fns[m] for m in self.metric_names}
+        self.num_quantiles = num_quantiles
+
+    def run(self, start: int = 0, end: Optional[int] = None) -> Dict[str, str]:
+        n = len(self.dataset)
+        end = n if end is None else min(end, n)
+        out_paths = {}
+        values = {m: np.zeros(end - start, dtype=np.int64)
+                  for m in self.metric_names}
+        for i in range(start, end):
+            sample = self.dataset[i]
+            for m, fn in self.metric_functions.items():
+                values[m][i - start] = fn(sample)
+        for m, vals in values.items():
+            out_paths[m] = self._write_metric(m, vals, start)
+        return out_paths
+
+    def _write_metric(self, metric: str, vals: np.ndarray,
+                      id_base: int) -> str:
+        mdir = os.path.join(self.output_dir, metric)
+        os.makedirs(mdir, exist_ok=True)
+        if self.num_quantiles > 1:
+            # bucket raw values into quantile bins → difficulty ∈ [0, Q)
+            edges = np.quantile(vals, np.linspace(0, 1, self.num_quantiles + 1))
+            diff = np.clip(np.searchsorted(edges, vals, side="right") - 1,
+                           0, self.num_quantiles - 1)
+        else:
+            diff = vals
+        np.save(os.path.join(mdir, "sample_values.npy"), vals)
+        index: Dict[int, List[int]] = {}
+        for sid, d in enumerate(diff):
+            index.setdefault(int(d), []).append(sid + id_base)
+        with open(os.path.join(mdir, "index_to_sample.json"), "w") as f:
+            json.dump({str(k): v for k, v in sorted(index.items())}, f)
+        with open(os.path.join(mdir, "metadata.json"), "w") as f:
+            json.dump({"num_samples": int(vals.size),
+                       "min": int(vals.min()) if vals.size else 0,
+                       "max": int(vals.max()) if vals.size else 0,
+                       "quantiles": self.num_quantiles}, f)
+        return mdir
+
+
+class DistributedDataAnalyzer(DataAnalyzer):
+    """Each process scans its contiguous shard; rank 0 merges
+    (reference DistributedDataAnalyzer.run_map_reduce — there over
+    torch.distributed; here the merge is a host-filesystem reduce since
+    every process writes shard files to shared storage)."""
+
+    def run_map_reduce(self) -> Dict[str, str]:
+        import jax
+
+        n = len(self.dataset)
+        nproc = jax.process_count()
+        pid = jax.process_index()
+        per = (n + nproc - 1) // nproc
+        start, end = pid * per, min((pid + 1) * per, n)
+
+        shard_vals = {m: np.zeros(max(end - start, 0), dtype=np.int64)
+                      for m in self.metric_names}
+        for i in range(start, end):
+            sample = self.dataset[i]
+            for m, fn in self.metric_functions.items():
+                shard_vals[m][i - start] = fn(sample)
+        sdir = os.path.join(self.output_dir, "shards")
+        os.makedirs(sdir, exist_ok=True)
+        for m, vals in shard_vals.items():
+            np.save(os.path.join(sdir, f"{m}.rank{pid}.npy"), vals)
+
+        if nproc > 1:
+            from jax.experimental import multihost_utils
+
+            multihost_utils.sync_global_devices("data_analyzer_map")
+        out_paths = {}
+        if pid == 0:
+            for m in self.metric_names:
+                parts = [np.load(os.path.join(sdir, f"{m}.rank{r}.npy"))
+                         for r in range(nproc)]
+                out_paths[m] = self._write_metric(m, np.concatenate(parts), 0)
+        if nproc > 1:
+            from jax.experimental import multihost_utils
+
+            multihost_utils.sync_global_devices("data_analyzer_reduce")
+        return out_paths

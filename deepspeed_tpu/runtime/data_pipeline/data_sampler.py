@@ -1,0 +1,150 @@
+"""Curriculum-aware distributed data sampler.
+
+Reference: ``runtime/data_pipeline/data_sampling/data_sampler.py:36``
+(``DeepSpeedDataSampler``) — at each step, draw the global batch from the
+pool of samples whose difficulty (per the analyzer's index files) is
+within the curriculum scheduler's current threshold; shard the batch
+across dp ranks; deterministic under a seed and resumable from a step.
+
+TPU note: the sampler is pure host-side numpy. It yields *global-batch*
+index arrays; the engine's ``shard_batch`` handles device placement, so
+no per-rank torch Sampler machinery is needed — each process slices its
+rows of the global batch when multi-host.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, Iterator, List, Optional
+
+import numpy as np
+
+from deepspeed_tpu.runtime.data_pipeline.curriculum_scheduler import \
+    CurriculumScheduler
+
+
+class DeepSpeedDataSampler:
+    """Iterator of global-batch sample-id arrays under a curriculum.
+
+    Args:
+      total_samples:   dataset length
+      batch_size:      global train batch size (micro × GAS × dp)
+      curriculum:      CurriculumScheduler or its config dict (difficulty
+                       threshold per step), or None for plain shuffling
+      difficulty_values: per-sample difficulty (analyzer sample_values.npy
+                       or an array); required when curriculum is set
+      curriculum_metric_dir: load difficulty_values from an analyzer dir
+    """
+
+    def __init__(self, total_samples: int, batch_size: int,
+                 curriculum: Optional[Any] = None,
+                 difficulty_values: Optional[np.ndarray] = None,
+                 curriculum_metric_dir: Optional[str] = None,
+                 shuffle: bool = True, seed: int = 1234,
+                 drop_last: bool = True):
+        self.total_samples = int(total_samples)
+        self.batch_size = int(batch_size)
+        if isinstance(curriculum, dict):
+            curriculum = CurriculumScheduler(curriculum)
+        self.curriculum: Optional[CurriculumScheduler] = curriculum
+        if curriculum_metric_dir is not None:
+            difficulty_values = np.load(
+                os.path.join(curriculum_metric_dir, "sample_values.npy"))
+        if self.curriculum is not None and difficulty_values is None:
+            raise ValueError(
+                "curriculum sampling needs difficulty_values (or "
+                "curriculum_metric_dir)")
+        self.difficulty_values = (None if difficulty_values is None
+                                  else np.asarray(difficulty_values))
+        if self.difficulty_values is not None and \
+                self.difficulty_values.size != self.total_samples:
+            raise ValueError(
+                f"difficulty_values has {self.difficulty_values.size} "
+                f"entries for {self.total_samples} samples")
+        self.shuffle = shuffle
+        self.seed = seed
+        self.drop_last = drop_last
+        self.consumed_batches = 0  # resumable position
+
+    # -- state (reference sampler state_dict for resume) ----------------
+    def state_dict(self) -> Dict[str, Any]:
+        sd = {"consumed_batches": self.consumed_batches, "seed": self.seed}
+        if self.curriculum is not None:
+            sd["curriculum"] = self.curriculum.state_dict()
+        return sd
+
+    def load_state_dict(self, sd: Dict[str, Any]):
+        self.consumed_batches = int(sd["consumed_batches"])
+        self.seed = int(sd.get("seed", self.seed))
+        if self.curriculum is not None and "curriculum" in sd:
+            self.curriculum.load_state_dict(sd["curriculum"])
+
+    # -- sampling -------------------------------------------------------
+    def _eligible(self, step: int) -> np.ndarray:
+        if self.curriculum is None:
+            return np.arange(self.total_samples)
+        threshold = self.curriculum.get_difficulty(step)
+        ids = np.nonzero(self.difficulty_values <= threshold)[0]
+        if ids.size == 0:
+            # nothing at or below the threshold yet: take the easiest bin
+            # rather than deadlocking (reference warns similarly)
+            easiest = self.difficulty_values.min()
+            ids = np.nonzero(self.difficulty_values <= easiest)[0]
+        return ids
+
+    def batch_for_step(self, step: int) -> np.ndarray:
+        """Global batch of sample ids at ``step`` (deterministic)."""
+        ids = self._eligible(step)
+        rng = np.random.default_rng(self.seed + step)
+        if self.shuffle:
+            pick = rng.choice(ids.size, size=self.batch_size,
+                              replace=ids.size < self.batch_size)
+        else:
+            base = (step * self.batch_size) % ids.size
+            pick = (base + np.arange(self.batch_size)) % ids.size
+        return ids[pick]
+
+    def __iter__(self) -> Iterator[np.ndarray]:
+        while True:
+            batch = self.batch_for_step(self.consumed_batches)
+            self.consumed_batches += 1
+            yield batch
+
+    @property
+    def current_difficulty(self) -> Optional[int]:
+        return (self.curriculum.current_difficulty
+                if self.curriculum is not None else None)
+
+
+class CurriculumDataLoader:
+    """Wrap (dataset, sampler) into an engine-ready batch iterator.
+
+    Applies curriculum *sequence truncation* when the metric is seqlen:
+    samples are cut to the scheduler's current difficulty, and lengths
+    are padded up to the difficulty so the compiled step sees at most
+    one shape per difficulty value (recompiles bounded by the
+    scheduler's difficulty_step quantization).
+    """
+
+    def __init__(self, dataset, sampler: DeepSpeedDataSampler,
+                 key: str = "input_ids", truncate_to_difficulty: bool = True,
+                 pad_id: int = 0):
+        self.dataset = dataset
+        self.sampler = sampler
+        self.key = key
+        self.truncate = truncate_to_difficulty
+        self.pad_id = pad_id
+
+    def __iter__(self):
+        for batch_ids in self.sampler:
+            rows = [np.asarray(self.dataset[int(i)]) for i in batch_ids]
+            if self.truncate and self.sampler.current_difficulty:
+                seq = int(self.sampler.current_difficulty)
+            else:
+                seq = max(r.size for r in rows)
+            out = np.full((len(rows), seq), self.pad_id, dtype=np.int32)
+            for r_i, row in enumerate(rows):
+                n = min(row.size, seq)
+                out[r_i, :n] = row[:n]
+            yield {self.key: out}

@@ -1,0 +1,141 @@
+"""Memory-mapped indexed dataset.
+
+Reference: ``runtime/data_pipeline/data_sampling/indexed_dataset.py``
+(the Megatron-style .bin/.idx pair). Same capability — O(1) random access
+to variable-length token sequences far larger than RAM, zero-copy reads —
+with a clean little-endian format of our own:
+
+  <path>.idx : magic 'DSTPUIDX' | version u32 | dtype_code u32 | count u64
+               | offsets u64[count+1]          (element offsets into .bin)
+  <path>.bin : raw sample data, concatenated
+
+Reads return numpy views straight off the memmap (no copies); the builder
+streams appends and finalizes the index on close.
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+_MAGIC = b"DSTPUIDX"
+_VERSION = 1
+
+_DTYPES = {1: np.uint8, 2: np.int8, 3: np.int16, 4: np.int32,
+           5: np.int64, 6: np.float32, 7: np.float64, 8: np.uint16,
+           9: np.uint32}
+_DTYPE_CODES = {np.dtype(v): k for k, v in _DTYPES.items()}
+
+
+def data_file_path(prefix: str) -> str:
+    return prefix + ".bin"
+
+
+def index_file_path(prefix: str) -> str:
+    return prefix + ".idx"
+
+
+class MMapIndexedDatasetBuilder:
+    """Append samples, then ``finalize()`` writes the index."""
+
+    def __init__(self, path_prefix: str, dtype=np.int32):
+        self.prefix = path_prefix
+        self.dtype = np.dtype(dtype)
+        if self.dtype not in _DTYPE_CODES:
+            raise ValueError(f"unsupported dtype {dtype}")
+        os.makedirs(os.path.dirname(os.path.abspath(path_prefix)),
+                    exist_ok=True)
+        self._data_f = open(data_file_path(path_prefix), "wb")
+        self._lengths: List[int] = []
+
+    def add_item(self, sample: Sequence) -> None:
+        arr = np.ascontiguousarray(sample, dtype=self.dtype)
+        self._data_f.write(arr.tobytes())
+        self._lengths.append(arr.size)
+
+    def add_items(self, samples) -> None:
+        for s in samples:
+            self.add_item(s)
+
+    def merge_file(self, other_prefix: str) -> None:
+        """Append another builder's output (reference merge_file_ — used by
+        the distributed analyzer to stitch per-rank shards)."""
+        other = MMapIndexedDataset(other_prefix)
+        for i in range(len(other)):
+            self.add_item(other[i])
+
+    def finalize(self) -> None:
+        self._data_f.close()
+        offsets = np.zeros(len(self._lengths) + 1, dtype=np.uint64)
+        np.cumsum(self._lengths, out=offsets[1:])
+        tmp = index_file_path(self.prefix) + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(_MAGIC)
+            f.write(struct.pack("<II", _VERSION, _DTYPE_CODES[self.dtype]))
+            f.write(struct.pack("<Q", len(self._lengths)))
+            f.write(offsets.tobytes())
+        os.replace(tmp, index_file_path(self.prefix))
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *a):
+        self.finalize()
+
+
+class MMapIndexedDataset:
+    """Zero-copy random access over the .bin/.idx pair."""
+
+    def __init__(self, path_prefix: str):
+        self.prefix = path_prefix
+        with open(index_file_path(path_prefix), "rb") as f:
+            magic = f.read(len(_MAGIC))
+            if magic != _MAGIC:
+                raise ValueError(
+                    f"{index_file_path(path_prefix)}: bad magic {magic!r}")
+            version, dtype_code = struct.unpack("<II", f.read(8))
+            if version != _VERSION:
+                raise ValueError(f"unsupported index version {version}")
+            (count,) = struct.unpack("<Q", f.read(8))
+            header = f.tell()
+        self.dtype = np.dtype(_DTYPES[dtype_code])
+        self._offsets = np.memmap(index_file_path(path_prefix),
+                                  dtype=np.uint64, mode="r",
+                                  offset=header, shape=(count + 1,))
+        self._data = np.memmap(data_file_path(path_prefix),
+                               dtype=self.dtype, mode="r")
+        self._count = int(count)
+
+    def __len__(self) -> int:
+        return self._count
+
+    def __getitem__(self, idx):
+        if isinstance(idx, slice):
+            return [self[i] for i in range(*idx.indices(self._count))]
+        if idx < 0:
+            idx += self._count
+        if not 0 <= idx < self._count:
+            raise IndexError(idx)
+        lo, hi = int(self._offsets[idx]), int(self._offsets[idx + 1])
+        return self._data[lo:hi]
+
+    def get(self, idx: int, offset: int = 0,
+            length: Optional[int] = None) -> np.ndarray:
+        """Partial read of one sample (reference .get with offset/length —
+        curriculum seqlen truncation reads only the prefix)."""
+        lo = int(self._offsets[idx]) + offset
+        hi = int(self._offsets[idx + 1])
+        if length is not None:
+            hi = min(hi, lo + length)
+        return self._data[lo:hi]
+
+    @property
+    def sizes(self) -> np.ndarray:
+        return np.diff(self._offsets).astype(np.int64)
+
+    def close(self):
+        del self._offsets
+        del self._data

@@ -1,0 +1,96 @@
+"""Random layerwise token dropping (random-LTD).
+
+Reference: ``runtime/data_pipeline/data_routing/`` (+ ``csrc/random_ltd``
+gather/scatter kernels) — during training, selected transformer layers
+process only a random subset of the sequence; the skipped tokens bypass
+the layer and are scattered back in place afterwards. A scheduler ramps
+the kept-token count from ``start_seq`` to the full length.
+
+TPU-native: the reference's CUDA gather/scatter kernels are one
+``take_along_axis`` / one-hot scatter here — XLA fuses them into the
+surrounding layer. Static shapes are preserved by making the kept count
+a *schedule of python ints* (one compiled program per distinct count;
+quantized by ``seq_step`` exactly like curriculum difficulty).
+
+Usage inside a layer stack::
+
+    keep = scheduler.kept_tokens(step)            # python int
+    idx = random_ltd_sample(rng, batch, seqlen, keep)
+    sub = random_ltd_gather(x, idx)               # [B, keep, H]
+    sub = layer(sub)
+    x = random_ltd_scatter(x, sub, idx)           # tokens restored
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import numpy as np
+
+
+class RandomLTDScheduler:
+    """Kept-token schedule (reference data_routing/scheduler.py).
+
+    config keys: total_layer_num, random_ltd_layer_num,
+    random_ltd_layer_id (optional explicit list), and a seq schedule
+    {min_value (start kept), max_value (full seq), seq_step,
+    require_steps (steps per increment)}.
+    """
+
+    def __init__(self, config: Dict[str, Any]):
+        self.total_layer_num = int(config.get("total_layer_num", 0))
+        self.random_ltd_layer_num = int(config.get("random_ltd_layer_num", 0))
+        self.layer_ids = list(config.get(
+            "random_ltd_layer_id",
+            # default: the middle layers (first/last stay dense, matching
+            # the reference's recommended usage)
+            range(1, 1 + self.random_ltd_layer_num)))
+        sched = config.get("schedule", config)
+        self.min_value = int(sched.get("min_value", 128))
+        self.max_value = int(sched.get("max_value", 512))
+        self.seq_step = int(sched.get("seq_step", 16))
+        self.require_steps = int(sched.get("require_steps", 100))
+        self.current_seq = self.min_value
+
+    def kept_tokens(self, global_steps: int) -> int:
+        inc = (global_steps // max(self.require_steps, 1)) * self.seq_step
+        self.current_seq = int(min(self.min_value + inc, self.max_value))
+        return self.current_seq
+
+    def is_dense(self, global_steps: int) -> bool:
+        return self.kept_tokens(global_steps) >= self.max_value
+
+    def state_dict(self):
+        return {"current_seq": self.current_seq}
+
+    def load_state_dict(self, sd):
+        self.current_seq = int(sd["current_seq"])
+
+
+def random_ltd_sample(rng, batch: int, seqlen: int, keep: int):
+    """Per-row sorted random token indices [batch, keep] (sorted keeps
+    relative order, as the reference's sampler does)."""
+    import jax
+
+    idx = jax.vmap(
+        lambda k: jax.random.choice(k, seqlen, shape=(keep,), replace=False)
+    )(jax.random.split(rng, batch))
+    return jax.numpy.sort(idx, axis=-1)
+
+
+def random_ltd_gather(x, idx):
+    """[B, S, H] × [B, K] → [B, K, H] (reference gather kernel)."""
+    import jax.numpy as jnp
+
+    return jnp.take_along_axis(x, idx[:, :, None], axis=1)
+
+
+def random_ltd_scatter(x, sub, idx):
+    """Scatter [B, K, H] back into [B, S, H] at idx (reference scatter
+    kernel). Unselected positions keep their input values."""
+    import jax
+
+    def per_row(row_x, row_sub, row_idx):
+        return row_x.at[row_idx].set(row_sub)
+
+    return jax.vmap(per_row)(x, sub, idx)

@@ -1,0 +1,118 @@
+"""Variable batch size (token-budget packing) with LR scaling.
+
+Reference: ``runtime/data_pipeline/data_sampling/
+variable_batch_size_and_lr.py:226`` (``VariableBatchSizeLR``) — group
+variable-length samples into batches bounded by a *token* budget instead
+of a sample count, and scale the learning rate per batch so the update
+magnitude matches the reference batch size (linear or sqrt scaling rule).
+
+TPU note: batches are padded to the bucket's max length; bucketing by
+``length_multiple`` (default 64) bounds the number of distinct compiled
+shapes the same way the curriculum scheduler quantizes difficulty.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterator, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+
+def batch_by_tokens(seqlens: Sequence[int], max_tokens: int,
+                    length_multiple: int = 64,
+                    shuffle_seed: Optional[int] = None,
+                    ) -> List[List[int]]:
+    """Pack sample ids into batches with padded-token budget ≤ max_tokens.
+
+    Sorting by length first minimizes padding waste (the reference sorts
+    inside its dataloader_for_variable_batch_size too); a seeded shuffle
+    of the *batches* keeps step-to-step diversity without unsorting the
+    packing.
+    """
+    seqlens = np.asarray(seqlens)
+    order = np.argsort(seqlens, kind="stable")
+    batches: List[List[int]] = []
+    cur: List[int] = []
+    cur_maxlen = 0
+    for sid in order:
+        L = int(np.ceil(max(int(seqlens[sid]), 1) / length_multiple)
+                ) * length_multiple
+        new_max = max(cur_maxlen, L)
+        if cur and new_max * (len(cur) + 1) > max_tokens:
+            batches.append(cur)
+            cur, cur_maxlen = [int(sid)], L
+        else:
+            cur.append(int(sid))
+            cur_maxlen = new_max
+        if cur_maxlen > max_tokens:
+            raise ValueError(
+                f"sample {sid} alone ({cur_maxlen} padded tokens) exceeds "
+                f"max_tokens={max_tokens}")
+    if cur:
+        batches.append(cur)
+    if shuffle_seed is not None:
+        rng = np.random.default_rng(shuffle_seed)
+        rng.shuffle(batches)
+    return batches
+
+
+def lr_scale_for_batch(batch_size: int, base_batch_size: int,
+                       method: str = "linear") -> float:
+    """Reference scale_lr: linear (Goyal et al.) or sqrt scaling."""
+    if method == "linear":
+        return batch_size / base_batch_size
+    if method == "sqrt":
+        return float(np.sqrt(batch_size / base_batch_size))
+    if method in ("none", ""):
+        return 1.0
+    raise ValueError(f"unknown lr scaling method '{method}'")
+
+
+class VariableBatchSizeLoader:
+    """Iterate (batch dict, lr_scale) pairs over a token-budget packing.
+
+    dataset[i] must be a 1-D token array. Each yielded batch is padded to
+    its bucket length; ``lr_scale`` multiplies the scheduler LR for that
+    step (reference VariableBatchSizeLR.step).
+    """
+
+    def __init__(self, dataset, max_tokens: int, base_batch_size: int,
+                 lr_scaling_method: str = "linear",
+                 length_multiple: int = 64, seed: int = 0,
+                 pad_id: int = 0, key: str = "input_ids",
+                 dp_world_size: int = 1):
+        self.dataset = dataset
+        sizes = getattr(dataset, "sizes", None)
+        if sizes is None:
+            sizes = np.asarray([np.asarray(dataset[i]).size
+                                for i in range(len(dataset))])
+        self.seqlens = np.asarray(sizes)
+        self.batches = batch_by_tokens(self.seqlens, max_tokens,
+                                       length_multiple, shuffle_seed=seed)
+        if dp_world_size > 1:
+            # pad each batch's sample count to a dp multiple so the global
+            # batch shards evenly (duplicates wrap around inside the batch)
+            for b in self.batches:
+                while len(b) % dp_world_size:
+                    b.append(b[len(b) % dp_world_size])
+        self.base_batch_size = base_batch_size
+        self.method = lr_scaling_method
+        self.length_multiple = length_multiple
+        self.pad_id = pad_id
+        self.key = key
+
+    def __len__(self) -> int:
+        return len(self.batches)
+
+    def __iter__(self) -> Iterator[Tuple[Dict[str, np.ndarray], float]]:
+        for batch_ids in self.batches:
+            rows = [np.asarray(self.dataset[int(i)]) for i in batch_ids]
+            maxlen = int(np.ceil(max(r.size for r in rows)
+                                 / self.length_multiple)
+                         ) * self.length_multiple
+            out = np.full((len(rows), maxlen), self.pad_id, dtype=np.int32)
+            for r_i, row in enumerate(rows):
+                out[r_i, : row.size] = row
+            yield ({self.key: out},
+                   lr_scale_for_batch(len(rows), self.base_batch_size,
+                                      self.method))

@@ -1,0 +1,229 @@
+"""Data-efficiency pipeline tests (reference analog:
+tests/unit/runtime/test_data_efficiency.py + data_sampling suites)."""
+
+import numpy as np
+import pytest
+
+import jax
+
+from deepspeed_tpu.runtime.data_pipeline import (
+    CurriculumScheduler, DataAnalyzer, DeepSpeedDataSampler,
+    MMapIndexedDataset, MMapIndexedDatasetBuilder, RandomLTDScheduler,
+    VariableBatchSizeLoader, batch_by_tokens, random_ltd_gather,
+    random_ltd_scatter,
+)
+from deepspeed_tpu.runtime.data_pipeline.data_sampler import \
+    CurriculumDataLoader
+from deepspeed_tpu.runtime.data_pipeline.random_ltd import random_ltd_sample
+
+
+# -- curriculum scheduler ---------------------------------------------------
+
+def test_curriculum_fixed_linear():
+    s = CurriculumScheduler({
+        "curriculum_type": "fixed_linear",
+        "min_difficulty": 8, "max_difficulty": 64,
+        "schedule_config": {"total_curriculum_step": 100,
+                            "difficulty_step": 8}})
+    assert s.get_difficulty(0) == 8
+    assert s.get_difficulty(100) == 64
+    assert s.get_difficulty(1000) == 64
+    mid = s.get_difficulty(50)
+    assert 8 <= mid <= 64 and mid % 8 == 0
+    # monotone non-decreasing
+    vals = [s.get_difficulty(i) for i in range(0, 101, 10)]
+    assert vals == sorted(vals)
+
+
+def test_curriculum_fixed_root_faster_early():
+    lin = CurriculumScheduler({
+        "curriculum_type": "fixed_linear", "min_difficulty": 0,
+        "max_difficulty": 100,
+        "schedule_config": {"total_curriculum_step": 100,
+                            "difficulty_step": 1}})
+    root = CurriculumScheduler({
+        "curriculum_type": "fixed_root", "min_difficulty": 0,
+        "max_difficulty": 100,
+        "schedule_config": {"total_curriculum_step": 100,
+                            "difficulty_step": 1, "root_degree": 2}})
+    assert root.get_difficulty(25) > lin.get_difficulty(25)
+
+
+def test_curriculum_fixed_discrete_and_custom():
+    s = CurriculumScheduler({
+        "curriculum_type": "fixed_discrete",
+        "min_difficulty": 1, "max_difficulty": 3,
+        "schedule_config": {"difficulty": [1, 2, 3],
+                            "max_step": [10, 20]}})
+    assert s.get_difficulty(5) == 1
+    assert s.get_difficulty(15) == 2
+    assert s.get_difficulty(25) == 3
+
+    c = CurriculumScheduler({"curriculum_type": "custom",
+                             "max_difficulty": 100})
+    c.set_custom_get_difficulty(lambda step: 7 + step)
+    assert c.get_difficulty(3) == 10
+
+
+def test_curriculum_bad_config():
+    with pytest.raises(ValueError):
+        CurriculumScheduler({"curriculum_type": "nope"})
+    with pytest.raises(ValueError):
+        CurriculumScheduler({"curriculum_type": "fixed_linear"})
+
+
+# -- indexed dataset --------------------------------------------------------
+
+def test_indexed_dataset_roundtrip(tmp_path):
+    prefix = str(tmp_path / "ds")
+    rows = [np.arange(n, dtype=np.int32) for n in (3, 7, 1, 12)]
+    with MMapIndexedDatasetBuilder(prefix, dtype=np.int32) as b:
+        for r in rows:
+            b.add_item(r)
+    ds = MMapIndexedDataset(prefix)
+    assert len(ds) == 4
+    for got, want in zip(ds[:], rows):
+        np.testing.assert_array_equal(got, want)
+    np.testing.assert_array_equal(ds.sizes, [3, 7, 1, 12])
+    # partial read (curriculum prefix truncation)
+    np.testing.assert_array_equal(ds.get(3, length=5), np.arange(5))
+    np.testing.assert_array_equal(ds[-1], rows[-1])
+
+
+def test_indexed_dataset_bad_magic(tmp_path):
+    prefix = str(tmp_path / "bad")
+    with open(prefix + ".idx", "wb") as f:
+        f.write(b"NOTMAGIC" + b"\0" * 16)
+    with open(prefix + ".bin", "wb"):
+        pass
+    with pytest.raises(ValueError, match="magic"):
+        MMapIndexedDataset(prefix)
+
+
+# -- analyzer + sampler -----------------------------------------------------
+
+def make_dataset(tmp_path, lengths):
+    prefix = str(tmp_path / "ds")
+    with MMapIndexedDatasetBuilder(prefix, dtype=np.int32) as b:
+        for n in lengths:
+            b.add_item(np.full(n, n % 64, dtype=np.int32))
+    return MMapIndexedDataset(prefix)
+
+
+def test_analyzer_seqlen(tmp_path):
+    ds = make_dataset(tmp_path, [5, 10, 3, 10, 7])
+    out = DataAnalyzer(ds, str(tmp_path / "idx")).run()
+    vals = np.load(out["seqlen"] + "/sample_values.npy")
+    np.testing.assert_array_equal(vals, [5, 10, 3, 10, 7])
+
+
+def test_sampler_curriculum_respects_threshold(tmp_path):
+    lengths = list(range(1, 41))
+    ds = make_dataset(tmp_path, lengths)
+    out = DataAnalyzer(ds, str(tmp_path / "idx")).run()
+    sampler = DeepSpeedDataSampler(
+        total_samples=len(ds), batch_size=8,
+        curriculum={"curriculum_type": "fixed_linear",
+                    "min_difficulty": 4, "max_difficulty": 40,
+                    "schedule_config": {"total_curriculum_step": 100,
+                                        "difficulty_step": 4}},
+        curriculum_metric_dir=out["seqlen"], seed=3)
+    early = sampler.batch_for_step(0)
+    assert all(ds.sizes[i] <= 4 for i in early)
+    late = sampler.batch_for_step(100)
+    assert len(late) == 8
+    # deterministic
+    np.testing.assert_array_equal(early, sampler.batch_for_step(0))
+    # resumable
+    sd = sampler.state_dict()
+    it = iter(sampler)
+    a = next(it)
+    sampler2 = DeepSpeedDataSampler(
+        total_samples=len(ds), batch_size=8,
+        curriculum={"curriculum_type": "fixed_linear",
+                    "min_difficulty": 4, "max_difficulty": 40,
+                    "schedule_config": {"total_curriculum_step": 100,
+                                        "difficulty_step": 4}},
+        curriculum_metric_dir=out["seqlen"], seed=3)
+    sampler2.load_state_dict(sd)
+    np.testing.assert_array_equal(a, next(iter(sampler2)))
+
+
+def test_curriculum_dataloader_pads_to_difficulty(tmp_path):
+    ds = make_dataset(tmp_path, [5, 30, 12, 40, 8, 3, 22, 17])
+    out = DataAnalyzer(ds, str(tmp_path / "idx")).run()
+    sampler = DeepSpeedDataSampler(
+        total_samples=len(ds), batch_size=4,
+        curriculum={"curriculum_type": "fixed_linear",
+                    "min_difficulty": 8, "max_difficulty": 40,
+                    "schedule_config": {"total_curriculum_step": 10,
+                                        "difficulty_step": 8}},
+        curriculum_metric_dir=out["seqlen"])
+    loader = CurriculumDataLoader(ds, sampler)
+    batch = next(iter(loader))
+    assert batch["input_ids"].shape == (4, 8)  # step-0 difficulty = 8
+
+
+# -- variable batch size ----------------------------------------------------
+
+def test_batch_by_tokens_budget():
+    seqlens = [10, 200, 30, 64, 120, 5, 500, 90]
+    batches = batch_by_tokens(seqlens, max_tokens=1024, length_multiple=64)
+    seen = sorted(i for b in batches for i in b)
+    assert seen == list(range(len(seqlens)))  # every sample exactly once
+    for b in batches:
+        padded = max(int(np.ceil(seqlens[i] / 64)) * 64 for i in b)
+        assert padded * len(b) <= 1024
+    with pytest.raises(ValueError, match="exceeds"):
+        batch_by_tokens([2000], max_tokens=1024)
+
+
+def test_variable_batch_loader_lr_scaling(tmp_path):
+    ds = make_dataset(tmp_path, [10, 20, 30, 40, 300, 310, 5, 8])
+    loader = VariableBatchSizeLoader(ds, max_tokens=1280, base_batch_size=4,
+                                     lr_scaling_method="linear")
+    total = 0
+    for batch, scale in loader:
+        n, L = batch["input_ids"].shape
+        assert L % 64 == 0
+        assert scale == n / 4
+        total += n
+    assert total == len(ds)
+
+
+# -- random-LTD -------------------------------------------------------------
+
+def test_random_ltd_scheduler_ramps():
+    s = RandomLTDScheduler({"total_layer_num": 12, "random_ltd_layer_num": 10,
+                            "schedule": {"min_value": 64, "max_value": 256,
+                                         "seq_step": 64,
+                                         "require_steps": 10}})
+    assert s.kept_tokens(0) == 64
+    assert s.kept_tokens(10) == 128
+    assert s.kept_tokens(100) == 256
+    assert s.is_dense(100)
+    assert s.layer_ids == list(range(1, 11))
+
+
+def test_random_ltd_gather_scatter_roundtrip(devices):
+    import jax.numpy as jnp
+
+    rng = jax.random.PRNGKey(0)
+    x = jnp.arange(2 * 8 * 4, dtype=jnp.float32).reshape(2, 8, 4)
+    idx = random_ltd_sample(rng, batch=2, seqlen=8, keep=5)
+    assert idx.shape == (2, 5)
+    # sorted, unique per row
+    for row in np.asarray(idx):
+        assert list(row) == sorted(set(row))
+    sub = random_ltd_gather(x, idx)
+    assert sub.shape == (2, 5, 4)
+    # identity layer: scatter(gather(x)) == x
+    np.testing.assert_allclose(np.asarray(random_ltd_scatter(x, sub, idx)),
+                               np.asarray(x))
+    # modified tokens land in the right rows
+    out = random_ltd_scatter(x, sub + 100.0, idx)
+    got = np.asarray(out)
+    for b in range(2):
+        for j, t in enumerate(np.asarray(idx)[b]):
+            np.testing.assert_allclose(got[b, t],
+                                       np.asarray(x)[b, t] + 100.0)
