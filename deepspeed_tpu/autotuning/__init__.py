@@ -1,0 +1,6 @@
+"""Autotuning subsystem (reference: deepspeed/autotuning/)."""
+
+from deepspeed_tpu.autotuning.autotuner import (  # noqa: F401
+    Autotuner,
+    AutotunerResult,
+)

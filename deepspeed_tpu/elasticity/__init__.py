@@ -1,0 +1,8 @@
+"""Elasticity subsystem (reference: deepspeed/elasticity/)."""
+
+from deepspeed_tpu.elasticity.elasticity import (  # noqa: F401
+    ElasticityConfig,
+    ElasticityError,
+    compute_elastic_config,
+    get_valid_batch_sizes,
+)

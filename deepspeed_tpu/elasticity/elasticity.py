@@ -1,0 +1,169 @@
+"""Elastic training: batch sizes that stay valid as the chip count changes.
+
+Reference: ``deepspeed/elasticity/elasticity.py`` —
+``compute_elastic_config`` (:233) picks a global train batch size that is
+(a) as large as allowed, (b) highly composite, so that for *every* chip
+count in ``[min_chips, max_chips]`` (times a granularity of micro-batch ×
+GAS splits) the batch divides evenly. A job can then checkpoint, lose or
+gain hosts, and resume with identical optimization semantics — the same
+global batch, re-factored into a new micro×GAS×dp triple.
+
+Two algorithm versions exist in the reference (v0.1 :83, v0.2 :126 — v0.2
+adds ``model_parallel_size``/granularity interplay). Here a single
+implementation covers both: candidate batches are built from
+highly-composite multiples of (micro-batch candidates × granularity), and
+compatible chip counts are whatever divides them after removing the
+model-parallel factor.
+
+On TPU the "chip count" axis is the data-parallel extent of the mesh
+(total chips / (tp·pp·sp) — elasticity composes with model parallelism
+exactly as the reference's v0.2 does).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+LATEST_ELASTICITY_VERSION = 0.2
+
+
+class ElasticityError(Exception):
+    """Reference raises the same-named family of errors."""
+
+
+@dataclasses.dataclass
+class ElasticityConfig:
+    """Reference elasticity config block (elasticity/config.py)."""
+
+    enabled: bool = False
+    max_train_batch_size: int = 2000
+    micro_batch_sizes: Sequence[int] = (2, 4, 6)
+    min_chips: int = 1
+    max_chips: int = 10000
+    min_time: int = 0  # minutes per step lower bound (advisory, unused here)
+    version: float = LATEST_ELASTICITY_VERSION
+    ignore_non_elastic_batch_info: bool = False
+    prefer_larger_batch: bool = True
+    model_parallel_size: int = 1  # tp·pp·sp product (v0.2)
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "ElasticityConfig":
+        known = {f.name for f in dataclasses.fields(cls)}
+        # reference key aliases
+        d = dict(d)
+        if "min_gpus" in d:
+            d["min_chips"] = d.pop("min_gpus")
+        if "max_gpus" in d:
+            d["max_chips"] = d.pop("max_gpus")
+        return cls(**{k: v for k, v in d.items() if k in known})
+
+
+def _candidate_batches(max_batch: int,
+                       micro_batches: Sequence[int]) -> List[int]:
+    """Highly-composite batch candidates ≤ max_batch built as
+    micro_batch × (products of small primes) — the reference's
+    get_candidate_batch_sizes over its HCN table."""
+    # highly composite numbers up to ~10k (reference HCN_LIST-equivalent)
+    hcn = [1, 2, 4, 6, 12, 24, 36, 48, 60, 120, 180, 240, 360, 720, 840,
+           1260, 1680, 2520, 5040, 7560]
+    out = set()
+    for mb in micro_batches:
+        best = None
+        for h in hcn:
+            if mb * h <= max_batch:
+                best = mb * h
+        if best is not None:
+            out.add(best)
+    return sorted(out)
+
+
+def _compatible_chip_counts(batch: int, micro_batches: Sequence[int],
+                            min_chips: int, max_chips: int) -> List[int]:
+    """All dp extents w ∈ [min,max] s.t. batch = micro × GAS × w for some
+    listed micro and integer GAS (reference get_compatible_gpus)."""
+    ok = []
+    for w in range(min_chips, max_chips + 1):
+        for mb in micro_batches:
+            if batch % (mb * w) == 0:
+                ok.append(w)
+                break
+    return ok
+
+
+def get_valid_batch_sizes(max_batch: int, micro_batches: Sequence[int],
+                          min_chips: int, max_chips: int
+                          ) -> Dict[int, List[int]]:
+    """batch → compatible dp chip counts, for every candidate batch."""
+    return {b: _compatible_chip_counts(b, micro_batches, min_chips,
+                                       max_chips)
+            for b in _candidate_batches(max_batch, micro_batches)}
+
+
+def compute_elastic_config(ds_config: Dict[str, Any],
+                           target_deployment_size: Optional[int] = None,
+                           return_microbatch: bool = False
+                           ) -> Tuple[int, List[int], Any]:
+    """Pick (final_batch_size, valid_chip_counts[, micro_batch]) —
+    reference compute_elastic_config (elasticity.py:233).
+
+    ``target_deployment_size``: the dp extent the job is actually starting
+    with (world // model_parallel_size); when given, also returns the
+    micro-batch for that extent.
+    """
+    if "elasticity" not in ds_config:
+        raise ElasticityError("config has no 'elasticity' block")
+    ecfg = ElasticityConfig.from_dict(ds_config["elasticity"])
+    if not ecfg.enabled:
+        raise ElasticityError("elasticity.enabled is false")
+    if float(ecfg.version) > LATEST_ELASTICITY_VERSION:
+        raise ElasticityError(
+            f"unsupported elasticity version {ecfg.version} "
+            f"(latest {LATEST_ELASTICITY_VERSION})")
+    if not ecfg.ignore_non_elastic_batch_info:
+        fixed = [k for k in ("train_batch_size",
+                             "train_micro_batch_size_per_chip",
+                             "train_micro_batch_size_per_gpu",
+                             "gradient_accumulation_steps")
+                 if ds_config.get(k) not in (None, "auto")]
+        if fixed:
+            raise ElasticityError(
+                f"elastic mode: remove fixed batch keys {fixed} or set "
+                "elasticity.ignore_non_elastic_batch_info=true")
+
+    mp = max(int(ecfg.model_parallel_size), 1)
+    min_dp = max(1, ecfg.min_chips // mp)
+    max_dp = max(min_dp, ecfg.max_chips // mp)
+    table = get_valid_batch_sizes(ecfg.max_train_batch_size,
+                                  ecfg.micro_batch_sizes, min_dp, max_dp)
+    # score: widest compatibility first; tie-break larger batch (the
+    # reference's "prefer_larger" flag)
+    best_batch, best_counts = None, []
+    for batch, counts in table.items():
+        better = len(counts) > len(best_counts) or (
+            len(counts) == len(best_counts)
+            and ecfg.prefer_larger_batch and (best_batch or 0) < batch)
+        if counts and better:
+            best_batch, best_counts = batch, counts
+    if best_batch is None:
+        raise ElasticityError(
+            f"no batch ≤ {ecfg.max_train_batch_size} works for chips "
+            f"[{ecfg.min_chips}, {ecfg.max_chips}] with micro batches "
+            f"{list(ecfg.micro_batch_sizes)}")
+
+    if target_deployment_size is not None:
+        dp = target_deployment_size // mp
+        if dp not in best_counts:
+            raise ElasticityError(
+                f"current deployment dp={dp} (chips/"
+                f"{mp} mp) not compatible with elastic batch {best_batch}; "
+                f"valid dp extents: {best_counts}")
+        if return_microbatch:
+            micro = next(mb for mb in sorted(ecfg.micro_batch_sizes,
+                                             reverse=True)
+                         if best_batch % (mb * dp) == 0)
+            return best_batch, best_counts, micro
+    if return_microbatch:
+        return best_batch, best_counts, None
+    return best_batch, best_counts, ElasticityConfig.from_dict(
+        ds_config["elasticity"])

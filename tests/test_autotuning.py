@@ -1,0 +1,72 @@
+"""Autotuner tests (reference analog: tests/unit/autotuning/)."""
+
+import numpy as np
+import pytest
+
+from deepspeed_tpu.autotuning import Autotuner
+from deepspeed_tpu.models.transformer import TransformerConfig, TransformerLM
+
+TINY = TransformerConfig(
+    vocab_size=64, hidden_size=32, num_layers=2, num_heads=4,
+    max_seq_len=32, pos_emb="learned", norm="layernorm",
+    activation="gelu", tie_embeddings=True, remat=False)
+
+BASE = {
+    "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+    "steps_per_print": 1000,
+}
+
+
+def batch_fn(global_batch):
+    rng = np.random.default_rng(0)
+    return {"input_ids": rng.integers(0, 64, (global_batch, 16)
+                                      ).astype(np.int32)}
+
+
+def make_tuner(tmp_path, space):
+    return Autotuner(model_factory=lambda: TransformerLM(TINY),
+                     base_config=dict(BASE), batch_fn=batch_fn,
+                     tuning_space=space, results_dir=str(tmp_path))
+
+
+def test_candidates_enumeration(tmp_path):
+    t = make_tuner(tmp_path, {"micro_batch_sizes": [1, 2],
+                              "zero_stages": [1, 3]})
+    cands = t.candidates()
+    assert len(cands) == 4
+    combos = {(c["train_micro_batch_size_per_chip"],
+               c["zero_optimization"]["stage"]) for c in cands}
+    assert combos == {(1, 1), (1, 3), (2, 1), (2, 3)}
+
+
+def test_fast_tune_picks_viable_config(tmp_path, devices):
+    t = make_tuner(tmp_path, {"micro_batch_sizes": [2],
+                              "zero_stages": [1, 2]})
+    best = t.tune(fast=True)
+    assert best is not None
+    assert best["train_micro_batch_size_per_chip"] == 2
+    assert best["zero_optimization"]["stage"] in (1, 2)
+    # compile-probe results recorded for every candidate
+    assert len(t.results) == 2
+    assert all(r.compiled_ok for r in t.results)
+    assert (tmp_path / "autotuner_results.json").exists()
+
+
+def test_hbm_budget_prunes_everything(tmp_path, devices):
+    t = Autotuner(model_factory=lambda: TransformerLM(TINY),
+                  base_config=dict(BASE), batch_fn=batch_fn,
+                  tuning_space={"micro_batch_sizes": [2],
+                                "zero_stages": [1]},
+                  hbm_budget_bytes=1)  # nothing fits in 1 byte
+    assert t.tune(fast=True) is None
+    assert all(not r.compiled_ok for r in t.results)
+
+
+@pytest.mark.slow
+def test_measured_tune(tmp_path, devices):
+    t = make_tuner(tmp_path, {"micro_batch_sizes": [2],
+                              "zero_stages": [1]})
+    best = t.tune(top_k=1, measure_steps=2)
+    assert best is not None
+    timed = [r for r in t.results if r.ran]
+    assert timed and timed[0].metric_value > 0

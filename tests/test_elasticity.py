@@ -1,0 +1,90 @@
+"""Elasticity tests (reference analog: tests/unit/elasticity/)."""
+
+import pytest
+
+from deepspeed_tpu.elasticity import (ElasticityConfig, ElasticityError,
+                                      compute_elastic_config,
+                                      get_valid_batch_sizes)
+
+BASE = {
+    "elasticity": {
+        "enabled": True,
+        "max_train_batch_size": 2000,
+        "micro_batch_sizes": [2, 4, 6],
+        "min_gpus": 1,
+        "max_gpus": 100,
+        "version": 0.2,
+    }
+}
+
+
+def test_compute_elastic_config_basic():
+    batch, counts, _ = compute_elastic_config(dict(BASE))
+    assert batch <= 2000
+    # every advertised chip count must actually divide the batch with some
+    # listed micro batch
+    for w in counts:
+        assert any(batch % (mb * w) == 0 for mb in (2, 4, 6)), (batch, w)
+    # highly-composite batch: many compatible dp extents (divisor counts)
+    assert len(counts) >= 20
+
+
+def test_target_deployment_micro_batch():
+    batch, counts, micro = compute_elastic_config(
+        dict(BASE), target_deployment_size=8, return_microbatch=True)
+    assert 8 in counts
+    assert micro in (2, 4, 6)
+    assert batch % (micro * 8) == 0
+
+
+def test_incompatible_deployment_raises():
+    cfg = {"elasticity": dict(BASE["elasticity"], micro_batch_sizes=[2],
+                              max_train_batch_size=16, min_gpus=1,
+                              max_gpus=8)}
+    with pytest.raises(ElasticityError, match="not compatible"):
+        compute_elastic_config(cfg, target_deployment_size=7)
+
+
+def test_fixed_batch_keys_rejected():
+    cfg = dict(BASE)
+    cfg["train_batch_size"] = 64
+    with pytest.raises(ElasticityError, match="fixed batch keys"):
+        compute_elastic_config(cfg)
+    cfg["elasticity"] = dict(BASE["elasticity"],
+                             ignore_non_elastic_batch_info=True)
+    batch, _, _ = compute_elastic_config(cfg)  # now allowed
+    assert batch > 0
+
+
+def test_version_and_enabled_guards():
+    with pytest.raises(ElasticityError, match="no 'elasticity'"):
+        compute_elastic_config({})
+    cfg = {"elasticity": dict(BASE["elasticity"], enabled=False)}
+    with pytest.raises(ElasticityError, match="enabled"):
+        compute_elastic_config(cfg)
+    cfg = {"elasticity": dict(BASE["elasticity"], version=9.9)}
+    with pytest.raises(ElasticityError, match="version"):
+        compute_elastic_config(cfg)
+
+
+def test_model_parallel_composition():
+    cfg = {"elasticity": dict(BASE["elasticity"], model_parallel_size=4,
+                              min_gpus=4, max_gpus=64)}
+    batch, counts, micro = compute_elastic_config(
+        cfg, target_deployment_size=32, return_microbatch=True)
+    # dp extent = 32 chips / mp 4 = 8
+    assert 8 in counts
+    assert batch % (micro * 8) == 0
+
+
+def test_valid_batch_table():
+    table = get_valid_batch_sizes(100, [2, 4], 1, 10)
+    for batch, counts in table.items():
+        for w in counts:
+            assert any(batch % (mb * w) == 0 for mb in (2, 4))
+
+
+def test_config_aliases():
+    e = ElasticityConfig.from_dict({"enabled": True, "min_gpus": 3,
+                                    "max_gpus": 9})
+    assert e.min_chips == 3 and e.max_chips == 9
