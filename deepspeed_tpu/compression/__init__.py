@@ -1,0 +1,17 @@
+"""Compression subsystem (reference: deepspeed/compression/)."""
+
+from deepspeed_tpu.compression.compress import (  # noqa: F401
+    CompressionScheduler,
+    init_compression,
+    redundancy_clean,
+)
+from deepspeed_tpu.compression.quantization import (  # noqa: F401
+    fake_quantize,
+    quantize_activation,
+)
+from deepspeed_tpu.compression.pruning import (  # noqa: F401
+    channel_pruning_mask,
+    head_pruning_mask,
+    row_pruning_mask,
+    sparse_pruning_mask,
+)

@@ -1,0 +1,73 @@
+"""Pruning mask construction.
+
+Reference: ``deepspeed/compression/basic_layer.py`` pruning paths +
+``helper.py`` — unstructured (sparse), row, channel, and attention-head
+pruning, each by L1 magnitude or top-k ratio. Masks are boolean arrays
+shaped like (or broadcastable onto) the weight; training applies them
+every step (projected SGD), ``redundancy_clean`` bakes them in.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import numpy as np
+
+
+def sparse_pruning_mask(w: np.ndarray, dense_ratio: float,
+                        method: str = "l1") -> np.ndarray:
+    """Unstructured mask keeping ``dense_ratio`` of entries (reference
+    sparse_pruning; method l1 == topk by |w|)."""
+    w = np.asarray(w)
+    k = int(np.ceil(dense_ratio * w.size))
+    if k >= w.size:
+        return np.ones_like(w, dtype=bool)
+    if method not in ("l1", "topk"):
+        raise ValueError(f"unknown sparse pruning method '{method}'")
+    thresh = np.partition(np.abs(w).ravel(), w.size - k)[w.size - k]
+    return np.abs(w) >= thresh
+
+
+def row_pruning_mask(w: np.ndarray, dense_ratio: float) -> np.ndarray:
+    """Keep the highest-L1 rows (output neurons; reference row_pruning).
+    w: [in, out] — rows scored along the input dim, mask broadcasts
+    [1, out]."""
+    w = np.asarray(w)
+    scores = np.abs(w).sum(axis=0)
+    k = max(1, int(np.ceil(dense_ratio * scores.size)))
+    keep = np.argsort(scores)[-k:]
+    mask = np.zeros((1, scores.size), dtype=bool)
+    mask[0, keep] = True
+    return mask
+
+
+def channel_pruning_mask(w: np.ndarray, dense_ratio: float) -> np.ndarray:
+    """Keep the highest-L1 input channels (reference channel_pruning).
+    w: [in, out] — mask broadcasts [in, 1]."""
+    w = np.asarray(w)
+    scores = np.abs(w).sum(axis=1)
+    k = max(1, int(np.ceil(dense_ratio * scores.size)))
+    keep = np.argsort(scores)[-k:]
+    mask = np.zeros((scores.size, 1), dtype=bool)
+    mask[keep, 0] = True
+    return mask
+
+
+def head_pruning_mask(w_o: np.ndarray, num_heads: int,
+                      dense_ratio: float) -> Tuple[np.ndarray, np.ndarray]:
+    """Attention-head mask from the output projection's magnitude
+    (reference head_pruning scores the attention output matrix).
+
+    w_o: [num_heads * head_dim, hidden] (our attention 'wo' layout,
+    flattened heads leading). Returns (head_keep [num_heads] bool,
+    mask broadcastable onto w_o).
+    """
+    w_o = np.asarray(w_o)
+    hd = w_o.shape[0] // num_heads
+    scores = np.abs(w_o.reshape(num_heads, hd, -1)).sum(axis=(1, 2))
+    k = max(1, int(np.ceil(dense_ratio * num_heads)))
+    keep_ids = np.argsort(scores)[-k:]
+    head_keep = np.zeros(num_heads, dtype=bool)
+    head_keep[keep_ids] = True
+    mask = np.repeat(head_keep, hd)[:, None]
+    return head_keep, np.broadcast_to(mask, w_o.shape).copy()

@@ -1,0 +1,58 @@
+"""Quantization-aware-training primitives.
+
+Reference: ``deepspeed/compression/basic_layer.py`` (``Embedding_Compress``,
+``LinearLayer_Compress`` quantization paths) + ``utils.py`` — symmetric /
+asymmetric fake quantization with a straight-through estimator, applied to
+weights (QAT) and activations during the forward pass.
+
+TPU-native: fake-quant is a pure function fused by XLA into the
+surrounding matmul; the STE is ``x + stop_gradient(q(x) - x)`` — identical
+gradients to the reference's autograd-function STE, no custom kernels
+needed until real int8 execution (ops/pallas/quantization.py covers that).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def _quantize_symmetric(x, bits: int, axis: Optional[int]):
+    qmax = 2.0 ** (bits - 1) - 1.0
+    amax = jnp.max(jnp.abs(x), axis=axis, keepdims=axis is not None)
+    scale = jnp.where(amax > 0, amax / qmax, 1.0)
+    q = jnp.clip(jnp.round(x / scale), -qmax - 1, qmax)
+    return q * scale
+
+
+def _quantize_asymmetric(x, bits: int, axis: Optional[int]):
+    qmax = 2.0 ** bits - 1.0
+    lo = jnp.min(x, axis=axis, keepdims=axis is not None)
+    hi = jnp.max(x, axis=axis, keepdims=axis is not None)
+    scale = jnp.where(hi > lo, (hi - lo) / qmax, 1.0)
+    q = jnp.clip(jnp.round((x - lo) / scale), 0, qmax)
+    return q * scale + lo
+
+
+def fake_quantize(x, bits: int = 8, symmetric: bool = True,
+                  axis: Optional[int] = None, enabled=True):
+    """Quantize-dequantize with straight-through gradient.
+
+    ``axis``: per-channel scales along that axis (None = per-tensor).
+    ``enabled`` may be a traced boolean (schedule offset inside jit).
+    """
+    q = (_quantize_symmetric(x, bits, axis) if symmetric
+         else _quantize_asymmetric(x, bits, axis))
+    out = x + jax.lax.stop_gradient(q - x)  # STE
+    return jnp.where(enabled, out, x) if not isinstance(enabled, bool) \
+        else (out if enabled else x)
+
+
+def quantize_activation(x, bits: int = 8, symmetric: bool = False,
+                        range_calibration: str = "dynamic"):
+    """Activation fake-quant (reference activation_quantization block;
+    dynamic = per-batch min/max, the reference's default)."""
+    del range_calibration  # static calibration would carry running stats
+    return fake_quantize(x, bits=bits, symmetric=symmetric, axis=None)

@@ -1,0 +1,8 @@
+"""Optimized linear / LoRA subsystem (reference: deepspeed/linear/)."""
+
+from deepspeed_tpu.linear.config import LoRAConfig, QuantizationConfig  # noqa: F401
+from deepspeed_tpu.linear.optimized_linear import (  # noqa: F401
+    LoRAOptimizedLinear,
+    lora_merge,
+    lora_trainable_mask,
+)

@@ -1,0 +1,35 @@
+"""Config dataclasses for the optimized-linear subsystem.
+
+Reference: ``deepspeed/linear/config.py`` (``LoRAConfig`` with lora_r /
+lora_alpha / base_weight_sharding, ``QuantizationConfig`` with q_bits /
+group_size).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+
+@dataclasses.dataclass
+class LoRAConfig:
+    lora_r: int = 64
+    lora_alpha: float = 16.0
+    # reference base_weight_sharding shards the frozen base across dp
+    # ranks; here the equivalent is a NamedSharding on the base weight —
+    # the axis name to shard the contraction dim over ('' = replicated)
+    base_weight_sharding_axis: str = ""
+    offload: bool = False  # keep frozen base in host memory
+
+    def __post_init__(self):
+        if self.lora_r <= 0:
+            raise ValueError("lora_r must be positive")
+
+
+@dataclasses.dataclass
+class QuantizationConfig:
+    q_bits: int = 8
+    group_size: int = 128  # blockwise-quant block (reference group_size)
+
+    def __post_init__(self):
+        if self.q_bits not in (4, 8):
+            raise ValueError("q_bits must be 4 or 8")

@@ -1,0 +1,129 @@
+"""LoRA linear with (optionally quantized) frozen base weights.
+
+Reference: ``deepspeed/linear/optimized_linear.py:76``
+(``LoRAOptimizedLinear``) + ``linear/quantization.py`` (``QuantizedParameter``)
+— a Linear whose full-rank base weight is frozen (and int8/int4-quantized
+to save memory), trained only through low-rank A·B adapters:
+
+    y = x @ W_base + (alpha / r) · (x @ A) @ B
+
+TPU-native: a functional layer over a param dict. The quantized base is
+stored as (int8 values, fp32 block scales) from ops/pallas/quantization
+and dequantized on the fly inside the forward — XLA fuses the dequant
+into the matmul's operand read, so HBM traffic for the base weight drops
+by ~2x (bf16→int8), the reference's motivation. ``lora_trainable_mask``
+feeds ``optax.masked`` so the optimizer steps only the adapters.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.linear.config import LoRAConfig, QuantizationConfig
+from deepspeed_tpu.ops.pallas.quantization import (dequantize_blockwise,
+                                                   quantize_blockwise)
+
+
+class LoRAOptimizedLinear:
+    """Functional LoRA linear.
+
+    params layout (dict):
+      base     : [in, out] bf16   (absent when quantized)
+      base_q   : [in, out] int8 + base_scale [in, out/group]  (quantized)
+      lora_a   : [in, r]
+      lora_b   : [r, out]
+    """
+
+    def __init__(self, input_dim: int, output_dim: int,
+                 lora_config: Optional[LoRAConfig] = None,
+                 quantization_config: Optional[QuantizationConfig] = None,
+                 dtype=jnp.bfloat16):
+        self.input_dim = int(input_dim)
+        self.output_dim = int(output_dim)
+        self.lora = lora_config or LoRAConfig()
+        self.quant = quantization_config
+        self.dtype = dtype
+        if self.lora.lora_r > min(input_dim, output_dim):
+            raise ValueError(
+                f"lora_r={self.lora.lora_r} exceeds "
+                f"min(in={input_dim}, out={output_dim})")
+
+    # -- params --------------------------------------------------------
+    def init(self, rng, base_weight: Optional[jax.Array] = None
+             ) -> Dict[str, Any]:
+        k_base, k_a = jax.random.split(rng)
+        if base_weight is None:
+            base_weight = jax.random.normal(
+                k_base, (self.input_dim, self.output_dim),
+                jnp.float32) * (self.input_dim ** -0.5)
+        base_weight = jnp.asarray(base_weight)
+        r = self.lora.lora_r
+        params: Dict[str, Any] = {
+            # Kaiming init for A, zeros for B (standard LoRA init: the
+            # adapter starts as a no-op)
+            "lora_a": (jax.random.normal(k_a, (self.input_dim, r),
+                                         jnp.float32)
+                       * (self.input_dim ** -0.5)).astype(self.dtype),
+            "lora_b": jnp.zeros((r, self.output_dim), self.dtype),
+        }
+        if self.quant is not None:
+            q, s = quantize_blockwise(base_weight.astype(jnp.float32),
+                                      bits=self.quant.q_bits,
+                                      block=self.quant.group_size)
+            params["base_q"] = q
+            params["base_scale"] = s
+        else:
+            params["base"] = base_weight.astype(self.dtype)
+        if self.lora.offload and "base" in params:
+            params["base"] = jax.device_put(
+                params["base"], jax.local_devices(backend="cpu")[0]) \
+                if jax.local_devices(backend="cpu") else params["base"]
+        return params
+
+    # -- forward -------------------------------------------------------
+    def apply(self, params: Dict[str, Any], x: jax.Array) -> jax.Array:
+        x = x.astype(self.dtype)
+        w = self._base_weight(params)
+        y = x @ w
+        scaling = self.lora.lora_alpha / self.lora.lora_r
+        y = y + (x @ params["lora_a"].astype(self.dtype)
+                 ) @ params["lora_b"].astype(self.dtype) * scaling
+        return y
+
+    __call__ = apply
+
+    def _base_weight(self, params) -> jax.Array:
+        if "base_q" in params:
+            return dequantize_blockwise(
+                params["base_q"], params["base_scale"],
+                bits=self.quant.q_bits, block=self.quant.group_size,
+                dtype=self.dtype)
+        return jax.lax.stop_gradient(params["base"]).astype(self.dtype)
+
+    # -- utilities -------------------------------------------------------
+    def merge(self, params: Dict[str, Any]) -> jax.Array:
+        """Fold the adapters into a dense weight (reference hybrid-engine
+        LoRA fuse; used when exporting or switching to inference)."""
+        w = self._base_weight(params).astype(jnp.float32)
+        scaling = self.lora.lora_alpha / self.lora.lora_r
+        return (w + params["lora_a"].astype(jnp.float32)
+                @ params["lora_b"].astype(jnp.float32) * scaling
+                ).astype(self.dtype)
+
+
+def lora_merge(layer: LoRAOptimizedLinear, params: Dict[str, Any]):
+    return layer.merge(params)
+
+
+def lora_trainable_mask(params) -> Any:
+    """Pytree of bools marking only LoRA adapters trainable — feed to
+    ``optax.masked(tx, mask)`` (reference freezes the base weight the
+    same way via requires_grad)."""
+    def mark(path, leaf):
+        name = path[-1].key if hasattr(path[-1], "key") else str(path[-1])
+        return name.startswith("lora_")
+
+    return jax.tree_util.tree_map_with_path(mark, params)

@@ -1,0 +1,167 @@
+"""Compression tests (reference analog: tests/unit/compression/)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.compression import (
+    CompressionScheduler, channel_pruning_mask, fake_quantize,
+    head_pruning_mask, init_compression, quantize_activation,
+    redundancy_clean, row_pruning_mask, sparse_pruning_mask,
+)
+from deepspeed_tpu.compression.compress import apply_masks
+
+
+# -- quantization -----------------------------------------------------------
+
+def test_fake_quantize_levels(devices):
+    x = jnp.linspace(-1.0, 1.0, 101)
+    q = fake_quantize(x, bits=4, symmetric=True)
+    # 4-bit symmetric: at most 16 distinct levels
+    assert len(np.unique(np.asarray(q).round(6))) <= 16
+    # 8-bit is a much finer grid
+    q8 = fake_quantize(x, bits=8, symmetric=True)
+    assert np.abs(np.asarray(q8) - np.asarray(x)).max() < \
+        np.abs(np.asarray(q) - np.asarray(x)).max()
+
+
+def test_fake_quantize_ste_gradient(devices):
+    # gradient passes through unchanged (straight-through estimator)
+    g = jax.grad(lambda x: fake_quantize(x, bits=4).sum())(jnp.ones(8))
+    np.testing.assert_allclose(np.asarray(g), 1.0)
+
+
+def test_fake_quantize_asymmetric_preserves_range(devices):
+    x = jnp.asarray([0.1, 0.5, 0.9])
+    q = quantize_activation(x, bits=8, symmetric=False)
+    np.testing.assert_allclose(np.asarray(q), np.asarray(x), atol=0.01)
+
+
+# -- pruning masks ------------------------------------------------------------
+
+def test_sparse_pruning_mask_ratio():
+    w = np.random.default_rng(0).normal(size=(32, 16))
+    m = sparse_pruning_mask(w, dense_ratio=0.25)
+    assert m.shape == w.shape
+    frac = m.mean()
+    assert 0.2 <= frac <= 0.3
+    # keeps the largest magnitudes
+    assert np.abs(w[m]).min() >= np.abs(w[~m]).max() - 1e-12
+
+
+def test_row_channel_masks():
+    w = np.random.default_rng(1).normal(size=(8, 12))
+    rm = row_pruning_mask(w, 0.5)
+    assert rm.shape == (1, 12) and rm.sum() == 6
+    cm = channel_pruning_mask(w, 0.25)
+    assert cm.shape == (8, 1) and cm.sum() == 2
+
+
+def test_head_pruning_mask():
+    nh, hd, h = 4, 8, 16
+    w = np.random.default_rng(2).normal(size=(nh * hd, h))
+    w[0:hd] *= 10  # head 0 is clearly most important
+    keep, mask = head_pruning_mask(w, num_heads=nh, dense_ratio=0.5)
+    assert keep.sum() == 2 and keep[0]
+    assert mask.shape == w.shape
+    # whole heads masked together
+    per_head = mask.reshape(nh, hd, h)
+    for i in range(nh):
+        assert per_head[i].all() == keep[i]
+
+
+# -- orchestration ------------------------------------------------------------
+
+PARAMS = {
+    "layers": {"attn": {"wq": np.random.default_rng(3).normal(
+        size=(2, 16, 16)).astype(np.float32)}},
+    "embed": {"tok": np.random.default_rng(4).normal(
+        size=(64, 16)).astype(np.float32)},
+}
+
+CFG = {
+    "compression_training": {
+        "sparse_pruning": {
+            "shared_parameters": {"enabled": True, "schedule_offset": 5,
+                                  "method": "l1"},
+            "different_groups": {
+                "g": {"params": {"dense_ratio": 0.5},
+                      "modules": ["attn"]}}},
+        "weight_quantization": {
+            "shared_parameters": {"enabled": True, "schedule_offset": 0},
+            "different_groups": {
+                "g": {"params": {"target_bits": 8},
+                      "modules": ["embed"]}}},
+    }
+}
+
+
+def test_init_compression_builds_state():
+    state = init_compression(PARAMS, CFG)
+    assert "layers.attn.wq" in state.masks
+    assert state.masks["layers.attn.wq"].mask.shape == (2, 16, 16)
+    assert "embed.tok" in state.quant
+
+
+def test_apply_masks_respects_schedule():
+    state = init_compression(PARAMS, CFG)
+    before = apply_masks(PARAMS, state, step=0)  # offset 5 not reached
+    np.testing.assert_array_equal(before["layers"]["attn"]["wq"],
+                                  PARAMS["layers"]["attn"]["wq"])
+    after = apply_masks(PARAMS, state, step=10)
+    w = np.asarray(after["layers"]["attn"]["wq"])
+    assert (w == 0).mean() == pytest.approx(0.5, abs=0.05)
+
+
+def test_redundancy_clean_quantizes_and_prunes():
+    state = init_compression(PARAMS, CFG)
+    out = redundancy_clean(PARAMS, state)
+    w = np.asarray(out["layers"]["attn"]["wq"])
+    assert (w == 0).mean() >= 0.45
+    emb = np.asarray(out["embed"]["tok"])
+    assert not np.array_equal(emb, PARAMS["embed"]["tok"])  # quantized
+    np.testing.assert_allclose(emb, PARAMS["embed"]["tok"], atol=0.05)
+
+
+def test_layer_reduction():
+    cfg = {"compression_training": {
+        "layer_reduction": {"enabled": True, "keep_number_layer": 1,
+                            "total_layers": 2}}}
+    state = init_compression(PARAMS, cfg)
+    out = redundancy_clean(PARAMS, state)
+    assert out["layers"]["attn"]["wq"].shape[0] == 1
+
+
+def test_scheduler_on_engine(devices):
+    import deepspeed_tpu as dstpu
+    from deepspeed_tpu.models.transformer import (TransformerConfig,
+                                                  TransformerLM)
+
+    tiny = TransformerConfig(
+        vocab_size=64, hidden_size=32, num_layers=2, num_heads=4,
+        max_seq_len=32, pos_emb="learned", norm="layernorm",
+        activation="gelu", tie_embeddings=True, remat=False)
+    cfg = {"train_micro_batch_size_per_chip": 2,
+           "optimizer": {"type": "adamw", "params": {"lr": 1e-2}},
+           "zero_optimization": {"stage": 1}, "steps_per_print": 1000}
+    engine, *_ = dstpu.initialize(model=TransformerLM(tiny), config=cfg)
+    comp_cfg = {"compression_training": {"sparse_pruning": {
+        "shared_parameters": {"enabled": True, "schedule_offset": 0},
+        "different_groups": {"g": {"params": {"dense_ratio": 0.25},
+                                   "modules": ["attn.wq"]}}}}}
+    state = init_compression(engine.params, comp_cfg)
+    CompressionScheduler(state).attach(engine)
+
+    gb = engine.micro_batch_size * engine.dp_world_size
+    rng = np.random.default_rng(0)
+
+    def it():
+        while True:
+            yield {"input_ids": rng.integers(0, 64, (gb, 16)
+                                             ).astype(np.int32)}
+
+    engine.train_batch(it())
+    w = np.asarray(engine.params["layers"]["attn"]["wq"])
+    assert (w == 0).mean() >= 0.7  # 25% dense after projection

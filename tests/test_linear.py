@@ -1,0 +1,89 @@
+"""LoRA / OptimizedLinear tests (reference analog:
+tests/unit/linear/test_linear.py + test_quant_param.py)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from deepspeed_tpu.linear import (LoRAConfig, LoRAOptimizedLinear,
+                                  QuantizationConfig, lora_merge,
+                                  lora_trainable_mask)
+
+
+def test_lora_starts_as_base(devices):
+    layer = LoRAOptimizedLinear(32, 16, LoRAConfig(lora_r=4))
+    params = layer.init(jax.random.PRNGKey(0))
+    x = jax.random.normal(jax.random.PRNGKey(1), (2, 32))
+    y = layer.apply(params, x)
+    base = x.astype(jnp.bfloat16) @ params["base"]
+    np.testing.assert_allclose(np.asarray(y, np.float32),
+                               np.asarray(base, np.float32), rtol=1e-2)
+
+
+def test_quantized_base_close(devices):
+    layer = LoRAOptimizedLinear(
+        64, 32, LoRAConfig(lora_r=4),
+        QuantizationConfig(q_bits=8, group_size=64))
+    w = jax.random.normal(jax.random.PRNGKey(0), (64, 32)) * 0.1
+    params = layer.init(jax.random.PRNGKey(1), base_weight=w)
+    assert params["base_q"].dtype == jnp.int8
+    assert "base" not in params
+    x = jax.random.normal(jax.random.PRNGKey(2), (4, 64))
+    y = layer.apply(params, x)
+    ref = x.astype(jnp.bfloat16) @ w.astype(jnp.bfloat16)
+    err = np.abs(np.asarray(y, np.float32) - np.asarray(ref, np.float32))
+    assert err.max() < 0.15  # int8 quantization error bound
+
+
+def test_base_frozen_adapters_train(devices):
+    layer = LoRAOptimizedLinear(16, 8, LoRAConfig(lora_r=2, lora_alpha=4))
+    params = layer.init(jax.random.PRNGKey(0))
+    x = jax.random.normal(jax.random.PRNGKey(1), (4, 16))
+
+    grads = jax.grad(lambda p: (layer.apply(p, x) ** 2).sum().astype(
+        jnp.float32))(params)
+    # base gets no gradient (stop_gradient), adapters do
+    np.testing.assert_allclose(np.asarray(grads["base"], np.float32), 0.0)
+    # lora_b starts at zero, so lora_a's grad is zero at init (standard
+    # LoRA property) — lora_b's is not
+    assert np.abs(np.asarray(grads["lora_b"], np.float32)).max() > 0
+
+    mask = lora_trainable_mask(params)
+    assert mask["lora_a"] and mask["lora_b"] and not mask["base"]
+    # optax.masked integration: one step leaves base untouched
+    tx = optax.masked(optax.sgd(0.1), mask)
+    state = tx.init(params)
+    updates, _ = tx.update(grads, state, params)
+    new = optax.apply_updates(params, updates)
+    np.testing.assert_array_equal(np.asarray(new["base"], np.float32),
+                                  np.asarray(params["base"], np.float32))
+    assert not np.array_equal(np.asarray(new["lora_b"], np.float32),
+                              np.asarray(params["lora_b"], np.float32))
+
+
+def test_merge_matches_forward(devices):
+    layer = LoRAOptimizedLinear(16, 8, LoRAConfig(lora_r=2, lora_alpha=8))
+    params = layer.init(jax.random.PRNGKey(0))
+    # non-trivial adapters
+    params["lora_b"] = jax.random.normal(jax.random.PRNGKey(3),
+                                         params["lora_b"].shape,
+                                         jnp.float32).astype(jnp.bfloat16)
+    x = jax.random.normal(jax.random.PRNGKey(1), (4, 16))
+    y = layer.apply(params, x)
+    merged = lora_merge(layer, params)
+    y2 = x.astype(jnp.bfloat16) @ merged
+    np.testing.assert_allclose(np.asarray(y, np.float32),
+                               np.asarray(y2, np.float32),
+                               rtol=0.05, atol=0.05)
+
+
+def test_config_validation():
+    with pytest.raises(ValueError, match="lora_r"):
+        LoRAConfig(lora_r=0)
+    with pytest.raises(ValueError, match="q_bits"):
+        QuantizationConfig(q_bits=3)
+    with pytest.raises(ValueError, match="exceeds"):
+        LoRAOptimizedLinear(4, 4, LoRAConfig(lora_r=8))
