@@ -1,0 +1,118 @@
+"""Hybrid engine: one parameter copy serving training AND generation.
+
+Reference: ``deepspeed/runtime/hybrid_engine.py:30``
+(``DeepSpeedHybridEngine``) — RLHF actors alternate train steps with
+rollout generation on the same weights; the reference switches a ZeRO-3
+model into inference mode (gather partitioned params, fuse LoRA, borrow
+inference kernels/KV-cache) and back.
+
+TPU-native: both modes are jit programs over the *same* global arrays —
+"mode switching" is a cached resharding jit from the training plan's
+shardings (fsdp/tp) to the inference TP shardings, re-run only when the
+train step count has advanced (XLA compiles the reshard into a single
+all-gather over ICI, the `_zero3_forward` gather analog, hybrid_engine.py
+:362). Generation then runs the dense-KV inference path
+(inference/engine.py) under the same mesh.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional
+
+import jax
+
+from deepspeed_tpu.utils.logging import log_dist
+from deepspeed_tpu.utils.timer import SynchronizedWallClockTimer
+
+GENERATE_TIMER = "generate"
+
+
+class HybridEngine:
+    """Wrap a training Engine with a parameter-sharing generate path.
+
+    Args:
+      engine: deepspeed_tpu Engine (any ZeRO stage)
+      max_batch: generation batch bound (KV cache allocation)
+      param_transform: optional fn(params) -> params applied at sync
+        (e.g. LoRA merge — reference fuse_lora before generate)
+    """
+
+    def __init__(self, engine, max_batch: int = 8,
+                 max_seq_len: Optional[int] = None,
+                 param_transform: Optional[Callable] = None):
+        from deepspeed_tpu.inference.engine import InferenceEngine
+
+        self.engine = engine
+        self.param_transform = param_transform
+        self._synced_at = -1
+        self.timers = SynchronizedWallClockTimer()
+        self._infer = InferenceEngine(
+            engine.model, mesh=engine.mesh, params=engine.params,
+            max_batch=max_batch, max_seq_len=max_seq_len)
+        self._reshard = jax.jit(
+            lambda p: p,
+            out_shardings=jax.tree.map(lambda a: a.sharding,
+                                       self._infer.params))
+        self._sync()
+
+    # -- mode switch (reference eval()/train() transitions) -------------
+    def _sync(self):
+        """Refresh inference params iff training stepped since last sync."""
+        if self._synced_at == self.engine.global_steps:
+            return
+        params = self.engine.params
+        if self.param_transform is not None:
+            params = self.param_transform(params)
+        self._infer.params = self._reshard(params)
+        self._synced_at = self.engine.global_steps
+        log_dist(f"hybrid engine: params synced at step {self._synced_at}",
+                 ranks=[0])
+
+    # -- generation (reference generate :168) ----------------------------
+    def generate(self, tokens, max_new_tokens: int = 32,
+                 temperature: float = 0.0, top_k: int = 0, seed: int = 0,
+                 eos_token_id: Optional[int] = None):
+        self._sync()
+        self.timers(GENERATE_TIMER).start()
+        out = self._infer.generate(
+            tokens, max_new_tokens=max_new_tokens, temperature=temperature,
+            top_k=top_k, seed=seed, eos_token_id=eos_token_id)
+        self.timers(GENERATE_TIMER).stop()
+        return out
+
+    # -- training passthrough -------------------------------------------
+    def train_batch(self, data_iter=None):
+        loss = self.engine.train_batch(data_iter)
+        return loss
+
+    def forward(self, *a, **kw):
+        return self.engine.forward(*a, **kw)
+
+    def backward(self, *a, **kw):
+        return self.engine.backward(*a, **kw)
+
+    def step(self):
+        return self.engine.step()
+
+    def eval(self):
+        self._sync()
+        return self
+
+    def train(self, mode: bool = True):
+        return self
+
+    @property
+    def params(self):
+        return self.engine.params
+
+    @property
+    def global_steps(self):
+        return self.engine.global_steps
+
+    def save_checkpoint(self, *a, **kw):
+        return self.engine.save_checkpoint(*a, **kw)
+
+    def load_checkpoint(self, *a, **kw):
+        out = self.engine.load_checkpoint(*a, **kw)
+        self._synced_at = -1  # force re-sync after restore
+        return out

@@ -1,0 +1,10 @@
+"""Rollout engines for RL training (reference: deepspeed/runtime/rollout/)."""
+
+from deepspeed_tpu.runtime.rollout.base import (  # noqa: F401
+    RolloutEngine,
+    RolloutRequest,
+    RolloutResponse,
+)
+from deepspeed_tpu.runtime.rollout.hybrid_engine_rollout import (  # noqa: F401
+    HybridEngineRollout,
+)

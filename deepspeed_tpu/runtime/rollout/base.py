@@ -1,0 +1,58 @@
+"""Rollout abstraction: pluggable generation-for-RL.
+
+Reference: ``deepspeed/runtime/rollout/base.py:88`` (``BaseRollout``) — a
+stable interface RL trainers call for trajectory generation, decoupled
+from *how* generation runs (hybrid engine, external server, ...).
+"""
+
+from __future__ import annotations
+
+import abc
+import dataclasses
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+
+
+@dataclasses.dataclass
+class RolloutRequest:
+    """One generation request (reference request dataclass)."""
+
+    prompts: Any  # [B, S] token array (np/list)
+    max_new_tokens: int = 128
+    temperature: float = 1.0
+    top_k: int = 0
+    seed: int = 0
+    eos_token_id: Optional[int] = None
+    metadata: Dict[str, Any] = dataclasses.field(default_factory=dict)
+
+
+@dataclasses.dataclass
+class RolloutResponse:
+    """Sequences [B, S+N] + per-row prompt lengths (so the trainer can
+    split prompt/completion) + optional per-token logprobs."""
+
+    sequences: np.ndarray
+    prompt_lengths: np.ndarray
+    logprobs: Optional[np.ndarray] = None
+    metadata: Dict[str, Any] = dataclasses.field(default_factory=dict)
+
+    @property
+    def completions(self) -> List[np.ndarray]:
+        return [seq[plen:] for seq, plen in
+                zip(self.sequences, self.prompt_lengths)]
+
+
+class RolloutEngine(abc.ABC):
+    """Reference BaseRollout contract: generate + weight-sync lifecycle."""
+
+    @abc.abstractmethod
+    def generate(self, request: RolloutRequest) -> RolloutResponse:
+        ...
+
+    def sync_weights(self) -> None:
+        """Refresh generation weights from the trainer (no-op when the
+        implementation shares parameters)."""
+
+    def shutdown(self) -> None:
+        """Release generation resources."""

@@ -1,0 +1,35 @@
+"""Rollout over the hybrid engine.
+
+Reference: ``deepspeed/runtime/rollout/hybrid_engine_rollout.py:29``
+(``HybridEngineRollout``) — the in-process rollout implementation: the
+trainer's own weights generate, no weight transfer needed.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from deepspeed_tpu.runtime.rollout.base import (RolloutEngine,
+                                                RolloutRequest,
+                                                RolloutResponse)
+
+
+class HybridEngineRollout(RolloutEngine):
+    def __init__(self, hybrid_engine):
+        self.hybrid_engine = hybrid_engine
+
+    def generate(self, request: RolloutRequest) -> RolloutResponse:
+        prompts = np.asarray(request.prompts, np.int32)
+        if prompts.ndim == 1:
+            prompts = prompts[None]
+        seqs = self.hybrid_engine.generate(
+            prompts, max_new_tokens=request.max_new_tokens,
+            temperature=request.temperature, top_k=request.top_k,
+            seed=request.seed, eos_token_id=request.eos_token_id)
+        plens = np.full(prompts.shape[0], prompts.shape[1], np.int64)
+        return RolloutResponse(sequences=np.asarray(seqs),
+                               prompt_lengths=plens,
+                               metadata=dict(request.metadata))
+
+    def sync_weights(self) -> None:
+        self.hybrid_engine._sync()
