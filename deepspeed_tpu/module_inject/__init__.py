@@ -1,0 +1,7 @@
+"""Module injection: automatic tensor-parallel sharding for external
+models (reference: deepspeed/module_inject/)."""
+
+from deepspeed_tpu.module_inject.auto_tp import (  # noqa: F401
+    AutoTP,
+    tp_model_init,
+)
