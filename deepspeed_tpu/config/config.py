@@ -239,7 +239,9 @@ class ActivationCheckpointingConfig(ConfigModel):
     synchronize_checkpoint_boundary: bool = False
     profile: bool = False
     # TPU-native knob: which remat policy to use for the layer scan.
-    policy: str = "nothing_saveable"  # nothing_saveable | dots_saveable | save_dot_except_mlp | none
+    # nothing_saveable | dots_saveable | dots_with_no_batch_dims_saveable
+    # | offload_dots_host | none
+    policy: str = "nothing_saveable"
 
 
 @register_config_model

@@ -58,7 +58,9 @@ class TransformerConfig:
     dtype: Any = jnp.bfloat16
     param_dtype: Any = jnp.float32
     remat: bool = True
-    remat_policy: str = "nothing_saveable"
+    # None defers to the engine's activation_checkpointing.policy config;
+    # an explicit name here wins over the config
+    remat_policy: Optional[str] = None
     attn_impl: str = "auto"  # auto | xla | flash
     sequence_parallel: bool = False  # SP attention over the sp mesh axis
     sp_mode: str = "ulysses"  # ulysses (all-to-all) | ring (ppermute CP)
@@ -323,15 +325,9 @@ def _layer(cfg: TransformerConfig, x, layer_params, positions):
     return x + constrain_activation(z, ("batch", "seq", "embed"))
 
 
-_REMAT_POLICIES = {
-    "nothing_saveable": None,  # default jax.checkpoint = save nothing
-    "dots_saveable": "dots_saveable",
-    "dots_with_no_batch_dims_saveable": "dots_with_no_batch_dims_saveable",
-    # FPDT-style host activation offload: checkpointed dot outputs spill
-    # to pinned host memory and stream back in backward (TPU only)
-    "offload_dots_host": "offload_dots_host",
-    "none": "everything",
-}
+# remat policy names resolve through the activation-checkpointing
+# subsystem (runtime/activation_checkpointing.py), which also applies
+# partition_activations / cpu_checkpointing when configured
 
 
 def apply_hidden(cfg: TransformerConfig, params: Dict[str, Any],
@@ -360,20 +356,10 @@ def apply_hidden(cfg: TransformerConfig, params: Dict[str, Any],
             lambda c, lp: layer_fn(c, lp, positions), params["layers"], x)
     else:
         if cfg.remat:
-            policy_name = _REMAT_POLICIES.get(cfg.remat_policy)
-            if policy_name == "everything":
-                pass  # no remat
-            elif policy_name is None:
-                layer_fn = jax.checkpoint(layer_fn)
-            elif policy_name == "offload_dots_host":
-                layer_fn = jax.checkpoint(
-                    layer_fn,
-                    policy=jax.checkpoint_policies.
-                    offload_dot_with_no_batch_dims("device", "pinned_host"))
-            else:
-                layer_fn = jax.checkpoint(
-                    layer_fn, policy=getattr(jax.checkpoint_policies, policy_name)
-                )
+            from deepspeed_tpu.runtime.activation_checkpointing import \
+                checkpoint_wrapper
+
+            layer_fn = checkpoint_wrapper(layer_fn, policy=cfg.remat_policy)
 
         def scan_body(carry, layer_params):
             return layer_fn(carry, layer_params, positions), None

@@ -137,6 +137,9 @@ class Engine:
         config.resolve_batch_size(self.dp_world_size)
         self.plan = shard_lib.make_sharding_plan(config, mesh)
         comm.configure(config)
+        from deepspeed_tpu.runtime import activation_checkpointing as act_ckpt
+
+        act_ckpt.configure(config.activation_checkpointing)
 
         self.micro_batch_size = config.train_micro_batch_size_per_chip
         self.gradient_accumulation_steps = config.gradient_accumulation_steps

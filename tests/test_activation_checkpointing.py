@@ -1,0 +1,104 @@
+"""Activation checkpointing tests (reference analog:
+tests/unit/runtime/activation_checkpointing/test_activation_checkpointing.py)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.runtime import activation_checkpointing as ac
+
+
+@pytest.fixture(autouse=True)
+def _reset_config():
+    ac._GLOBAL_CONFIG.clear()
+    yield
+    ac._GLOBAL_CONFIG.clear()
+
+
+def f(x, w):
+    return jnp.tanh(x @ w) @ w.T
+
+
+def test_checkpoint_matches_plain(devices):
+    x = jax.random.normal(jax.random.PRNGKey(0), (4, 8))
+    w = jax.random.normal(jax.random.PRNGKey(1), (8, 8))
+    for policy in ("nothing_saveable", "dots_saveable", "none"):
+        wrapped = ac.checkpoint_wrapper(f, policy=policy)
+        out = wrapped(x, w)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(f(x, w)),
+                                   rtol=1e-6)
+        # gradients identical too (remat is semantics-preserving)
+        g1 = jax.grad(lambda x: wrapped(x, w).sum())(x)
+        g2 = jax.grad(lambda x: f(x, w).sum())(x)
+        np.testing.assert_allclose(np.asarray(g1), np.asarray(g2),
+                                   rtol=1e-5)
+
+
+def test_direct_call_form(devices):
+    x = jax.random.normal(jax.random.PRNGKey(0), (4, 8))
+    w = jax.random.normal(jax.random.PRNGKey(1), (8, 8))
+    out = ac.checkpoint(f, x, w)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(f(x, w)),
+                               rtol=1e-6)
+
+
+def test_configure_from_config_model(devices):
+    from deepspeed_tpu.config.config import ActivationCheckpointingConfig
+
+    cfg = ActivationCheckpointingConfig(partition_activations=True,
+                                        policy="dots_saveable")
+    state = ac.configure(cfg)
+    assert state["partition_activations"] is True
+    assert state["policy"] == "dots_saveable"
+    assert ac.is_configured()
+
+
+def test_unknown_policy_raises():
+    with pytest.raises(ValueError, match="unknown activation"):
+        ac.resolve_policy("bogus")
+
+
+def test_cpu_checkpointing_selects_offload():
+    ac.configure(cpu_checkpointing=True)
+    p = ac.resolve_policy()
+    assert p is not None and p != "everything"
+
+
+def test_partition_activations_preserves_math(devices):
+    from deepspeed_tpu.parallel import topology as topo
+
+    mesh = topo.build_mesh(topo.TopologyConfig(tp=4, dp=-1))
+    topo.set_global_mesh(mesh)
+    ac.configure(partition_activations=True)
+    x = jax.random.normal(jax.random.PRNGKey(0), (4, 8))
+    w = jax.random.normal(jax.random.PRNGKey(1), (8, 8))
+    wrapped = ac.checkpoint_wrapper(f)
+    with mesh:
+        out = jax.jit(wrapped)(x, w)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(f(x, w)),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_remat_reduces_saved_memory(devices):
+    """Compiled peak memory with remat <= without (the point of the
+    subsystem)."""
+    from deepspeed_tpu.profiling import profile_compiled
+
+    x = jax.random.normal(jax.random.PRNGKey(0), (64, 256))
+    w = jax.random.normal(jax.random.PRNGKey(1), (256, 256))
+
+    def stack(fn):
+        def loss(x, w):
+            for _ in range(8):
+                x = fn(x, w)
+            return (x ** 2).sum()
+        return loss
+
+    plain = profile_compiled(jax.grad(stack(f)), x, w)
+    remat = profile_compiled(
+        jax.grad(stack(ac.checkpoint_wrapper(f, policy="nothing_saveable"))),
+        x, w)
+    if plain["peak_bytes"] and remat["peak_bytes"]:
+        assert remat["peak_bytes"] <= plain["peak_bytes"] * 1.05
