@@ -123,14 +123,18 @@ class AutoTP:
         return P(*[None] * len(shape))
 
     def infer_specs(self, params) -> Any:
-        """PartitionSpec pytree mirroring ``params``."""
+        """PartitionSpec pytree mirroring ``params`` (dicts, lists, and
+        tuples all recurse — HF-Flax trees mix them)."""
         def walk(tree, prefix=""):
             if isinstance(tree, dict):
                 return {k: walk(v, f"{prefix}{SEP}{k}" if prefix else str(k))
                         for k, v in tree.items()}
+            if isinstance(tree, (list, tuple)):
+                vals = [walk(v, f"{prefix}{SEP}{i}" if prefix else str(i))
+                        for i, v in enumerate(tree)]
+                return vals if isinstance(tree, list) else tuple(vals)
             shape = tuple(getattr(tree, "shape", ()) or ())
-            spec = self.spec_for(prefix, shape)
-            return spec
+            return self.spec_for(prefix, shape)
 
         return walk(params)
 
@@ -141,6 +145,9 @@ class AutoTP:
             if isinstance(tree, dict):
                 for k, v in tree.items():
                     walk(v, f"{prefix}{SEP}{k}" if prefix else str(k))
+            elif isinstance(tree, (list, tuple)):
+                for i, v in enumerate(tree):
+                    walk(v, f"{prefix}{SEP}{i}" if prefix else str(i))
             else:
                 counts[self.classify(
                     prefix, tuple(getattr(tree, "shape", ()) or ()))] += 1
@@ -189,7 +196,8 @@ def tp_model_init(params, mesh: Optional[Mesh] = None, tp_size: int = 0,
         return jax.device_put(arr, NamedSharding(mesh, spec))
 
     sharded = jax.tree.map(place, params, specs,
-                           is_leaf=lambda x: not isinstance(x, dict))
+                           is_leaf=lambda x: not isinstance(
+                               x, (dict, list, tuple)))
     counts = atp.summary(params)
     log_dist(f"AutoTP over tp={mesh.shape.get('tp', 1)}: {counts}",
              ranks=[0])

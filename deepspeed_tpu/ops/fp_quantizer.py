@@ -1,0 +1,118 @@
+"""Floating-point (FP8) quantization.
+
+Reference: ``csrc/fp_quantizer/{fp_quantize.cpp,fp_quantize_impl.cu}``
+(852 LoC) — group-wise FP6/FP8/FP12 quantization with scale-per-group
+and *selective dequantization* (dequantize only the rows a kernel needs,
+``selective_dequantize`` in the pybind surface).
+
+TPU-native: fp8 is a hardware dtype here (``float8_e4m3fn`` /
+``float8_e5m2`` feed the MXU directly on v5p+), so quantization is a
+cast with a per-group scale rather than custom bit-packing kernels; XLA
+fuses the scale multiply into neighbors. FP6/FP12 have no TPU storage
+dtype — requests for 6/12 bits round to fp8 with a warning (the
+reference's own fallback ladder quantizes to the nearest supported
+format).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.utils.logging import logger
+
+FORMATS = {
+    "e4m3": jnp.float8_e4m3fn,  # max normal 448
+    "e5m2": jnp.float8_e5m2,  # max normal 57344
+}
+_FMT_MAX = {"e4m3": 448.0, "e5m2": 57344.0}
+DEFAULT_GROUP = 128
+
+
+def _resolve_format(q_bits: int = 8, fmt: Optional[str] = None) -> str:
+    if fmt is not None:
+        if fmt not in FORMATS:
+            raise ValueError(f"unknown fp format '{fmt}' "
+                             f"(choose from {sorted(FORMATS)})")
+        return fmt
+    if q_bits != 8:
+        logger.warning(f"fp_quantizer: {q_bits}-bit formats have no TPU "
+                       "storage dtype; rounding to fp8 e4m3")
+    return "e4m3"
+
+
+def fp_quantize(x: jax.Array, q_bits: int = 8, fmt: Optional[str] = None,
+                group_size: int = DEFAULT_GROUP
+                ) -> Tuple[jax.Array, jax.Array]:
+    """x [..., N] → (fp8 values [..., N], fp32 scales [..., N/group]).
+
+    Scales are chosen so each group's absmax maps to the format's max
+    normal (full dynamic range per group — the reference's group-wise
+    scaling).
+    """
+    fmt = _resolve_format(q_bits, fmt)
+    n = x.shape[-1]
+    g = group_size if n % group_size == 0 else n
+    xf = x.astype(jnp.float32)
+    grouped = xf.reshape(*x.shape[:-1], n // g, g)
+    amax = jnp.max(jnp.abs(grouped), axis=-1, keepdims=True)
+    scale = jnp.where(amax > 0, amax / _FMT_MAX[fmt], 1.0)
+    q = (grouped / scale).astype(FORMATS[fmt])
+    return (q.reshape(x.shape), scale[..., 0].reshape(*x.shape[:-1], n // g))
+
+
+def fp_dequantize(q: jax.Array, scale: jax.Array,
+                  group_size: int = DEFAULT_GROUP,
+                  dtype=jnp.bfloat16) -> jax.Array:
+    n = q.shape[-1]
+    g = group_size if n % group_size == 0 else n
+    grouped = q.astype(jnp.float32).reshape(*q.shape[:-1], n // g, g)
+    out = grouped * scale[..., None]
+    return out.reshape(q.shape).astype(dtype)
+
+
+def selective_dequantize(q: jax.Array, scale: jax.Array,
+                         rows: jax.Array, group_size: int = DEFAULT_GROUP,
+                         dtype=jnp.bfloat16) -> jax.Array:
+    """Dequantize only ``rows`` (leading-dim indices) — the reference's
+    selective_dequantize: optimizer/attention kernels touch a slice of a
+    quantized tensor without materializing the whole thing."""
+    return fp_dequantize(q[rows], scale[rows], group_size, dtype)
+
+
+def fp8_matmul(a: jax.Array, b: jax.Array,
+               fmt: str = "e4m3", out_dtype=jnp.bfloat16) -> jax.Array:
+    """Per-tensor-scaled fp8×fp8 matmul (the fp8 GEMM path the reference
+    gets from its 6-bit cuda_linear kernels; on TPU the fp8 operands hit
+    the MXU natively and XLA fuses the rescale)."""
+    amax_a = jnp.max(jnp.abs(a)).astype(jnp.float32)
+    amax_b = jnp.max(jnp.abs(b)).astype(jnp.float32)
+    sa = jnp.where(amax_a > 0, amax_a / _FMT_MAX[fmt], 1.0)
+    sb = jnp.where(amax_b > 0, amax_b / _FMT_MAX[fmt], 1.0)
+    qa = (a.astype(jnp.float32) / sa).astype(FORMATS[fmt])
+    qb = (b.astype(jnp.float32) / sb).astype(FORMATS[fmt])
+    acc = jnp.matmul(qa, qb, preferred_element_type=jnp.float32)
+    return (acc * (sa * sb)).astype(out_dtype)
+
+
+class FPQuantizer:
+    """Object API parity with the reference's ``FP_Quantize`` wrapper
+    (deepspeed/ops/fp_quantizer/quantize.py): quantize / dequantize /
+    selective_dequantize with stored group size + format."""
+
+    def __init__(self, q_bits: int = 8, fmt: Optional[str] = None,
+                 group_size: int = DEFAULT_GROUP):
+        self.fmt = _resolve_format(q_bits, fmt)
+        self.q_bits = 8
+        self.group_size = group_size
+
+    def quantize(self, x):
+        return fp_quantize(x, fmt=self.fmt, group_size=self.group_size)
+
+    def dequantize(self, q, scale, dtype=jnp.bfloat16):
+        return fp_dequantize(q, scale, self.group_size, dtype)
+
+    def selective_dequantize(self, q, scale, rows, dtype=jnp.bfloat16):
+        return selective_dequantize(q, scale, rows, self.group_size, dtype)

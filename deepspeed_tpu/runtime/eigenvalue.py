@@ -1,0 +1,99 @@
+"""Block-wise Hessian eigenvalue estimation (MoQ scheduling signal).
+
+Reference: ``deepspeed/runtime/eigenvalue.py:153`` (``Eigenvalue``) —
+power iteration on the loss curvature per layer block; the
+mixture-of-quantization scheduler uses the eigenvalue ratio to decide
+which layers can drop precision earlier.
+
+TPU-native: Hessian-vector products come from ``jax.jvp`` over
+``jax.grad`` (forward-over-reverse), compiled by XLA; power iteration is
+a ``lax.fori``-style Python loop over compiled HVPs (iteration counts
+are small and static).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+
+def _tree_dot(a, b) -> jax.Array:
+    return sum(jnp.vdot(x, y) for x, y in
+               zip(jax.tree.leaves(a), jax.tree.leaves(b)))
+
+
+def _tree_norm(a) -> jax.Array:
+    return jnp.sqrt(_tree_dot(a, a).real)
+
+
+def _normalize(a):
+    n = _tree_norm(a) + 1e-12
+    return jax.tree.map(lambda x: x / n, a)
+
+
+class Eigenvalue:
+    """Power-iteration top Hessian eigenvalue per parameter block.
+
+    Reference constructor knobs (verbose/max_iter/tol/stability/
+    gas_boundary_resolution/layer_name/layer_num) map onto max_iter/tol
+    here; blocks are top-level pytree keys instead of module-name
+    prefixes.
+    """
+
+    def __init__(self, verbose: bool = False, max_iter: int = 100,
+                 tol: float = 1e-2, stability: float = 1e-6,
+                 seed: int = 0):
+        self.verbose = verbose
+        self.max_iter = int(max_iter)
+        self.tol = float(tol)
+        self.stability = float(stability)
+        self.seed = seed
+
+    def compute_eigenvalue(self, loss_fn: Callable, params,
+                           block: Optional[str] = None) -> float:
+        """Top eigenvalue of the Hessian of ``loss_fn(params)`` restricted
+        to ``block`` (a top-level key) or the full tree."""
+        if block is not None:
+            sub = params[block]
+
+            def f(sub_p):
+                return loss_fn({**params, block: sub_p})
+        else:
+            sub, f = params, loss_fn
+
+        grad_fn = jax.grad(f)
+
+        @jax.jit
+        def hvp(v):
+            return jax.jvp(grad_fn, (sub,), (v,))[1]
+
+        key = jax.random.PRNGKey(self.seed)
+        leaves, treedef = jax.tree.flatten(sub)
+        keys = jax.random.split(key, len(leaves))
+        v = jax.tree.unflatten(treedef, [
+            jax.random.normal(k, x.shape, jnp.float32)
+            for k, x in zip(keys, leaves)])
+        v = _normalize(v)
+
+        eig_prev = 0.0
+        for i in range(self.max_iter):
+            hv = hvp(v)
+            eig = float(_tree_dot(v, hv).real)
+            v = _normalize(hv)
+            if abs(eig - eig_prev) < self.tol * max(abs(eig), self.stability):
+                break
+            eig_prev = eig
+        if self.verbose:
+            print(f"eigenvalue[{block or 'all'}]: {eig:.4e} ({i + 1} iters)")
+        return eig
+
+    def compute_eigenvalues(self, loss_fn: Callable, params
+                            ) -> Dict[str, float]:
+        """Per-top-level-block eigenvalues (reference returns per-layer
+        list used by the MoQ schedule)."""
+        return {k: self.compute_eigenvalue(loss_fn, params, block=k)
+                for k in params}

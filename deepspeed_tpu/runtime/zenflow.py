@@ -1,0 +1,275 @@
+"""ZenFlow: stall-free offloaded optimization by importance splitting.
+
+Reference: ``deepspeed/runtime/zenflow/`` (``ZenFlowZeroOptimizer``
+zenflow_stage_1_and_2.py:47, ``ZenFlowSelectiveAdamW``
+ops/adam/zenflow_torch_adam.py:43) and ``runtime/superoffload/``
+(``SuperOffloadOptimizer_Stage3`` :27 with its CPU-side optimizer worker
+process superoffload_utils.py:165). ZeRO-Offload stalls the accelerator
+>60% of each step waiting for the host optimizer; ZenFlow removes the
+stall by splitting coordinates by gradient importance:
+
+  * the top-k fraction of coordinates (per parameter) update **on
+    device every step** with a compact Adam whose state covers only
+    those coordinates;
+  * the rest accumulate on device and flow through the **host optimizer
+    asynchronously every ``update_interval`` steps** — the device never
+    waits (SuperOffload's worker-process overlap, done with a thread +
+    the native CPU optimizer here).
+
+TPU mapping: the selective update is a gather → Adam → scatter jit
+(static k, MXU-free VPU work fused by XLA); accumulators live on device
+so the per-step host traffic of plain offload disappears; the async host
+pass uses the same vectorized native CPU Adam as the offload tier.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.ops.native.cpu_optimizer import CPUAdam
+from deepspeed_tpu.utils.logging import log_dist, logger
+
+
+@dataclasses.dataclass
+class ZenFlowConfig:
+    """Reference zenflow config block (zenflow_config.py): topk_ratio,
+    update_interval, select_strategy/interval, overlap_step."""
+
+    topk_ratio: float = 0.01
+    update_interval: int = 4
+    select_interval: int = 16  # re-pick important coords every N steps
+    overlap_step: bool = True  # async host pass (False = blocking)
+    betas: Tuple[float, float] = (0.9, 0.999)
+    eps: float = 1e-8
+    weight_decay: float = 0.0
+
+
+class _AsyncWorker:
+    """One in-flight host-optimizer pass (SuperOffload worker analog)."""
+
+    def __init__(self):
+        self._thread: Optional[threading.Thread] = None
+        self._result = None
+        self._error: Optional[BaseException] = None
+
+    def submit(self, fn, *args):
+        assert not self.busy, "previous host pass still in flight"
+        self._result, self._error = None, None
+
+        def run():
+            try:
+                self._result = fn(*args)
+            except BaseException as e:  # surfaced at collect()
+                self._error = e
+
+        self._thread = threading.Thread(target=run, name="zenflow-host-opt",
+                                        daemon=True)
+        self._thread.start()
+
+    @property
+    def busy(self) -> bool:
+        return self._thread is not None and self._thread.is_alive()
+
+    def collect(self, block: bool = True):
+        if self._thread is None:
+            return None
+        if not block and self._thread.is_alive():
+            return None
+        self._thread.join()
+        self._thread = None
+        if self._error is not None:
+            raise self._error
+        return self._result
+
+
+class ZenFlowOptimizer:
+    """Importance-split optimizer over a parameter pytree.
+
+    step(grads, params, lr) → new params (same structure/dtype). The
+    host fp32 masters are the source of truth for the non-selected
+    coordinates; selected coordinates run ahead on device and are folded
+    back into the masters at each async-pass boundary.
+    """
+
+    def __init__(self, params, config: Optional[ZenFlowConfig] = None,
+                 lr: float = 1e-3):
+        self.cfg = config or ZenFlowConfig()
+        self.lr = float(lr)
+        self.steps = 0
+        leaves, self._treedef = jax.tree.flatten(params)
+        self._shapes = [x.shape for x in leaves]
+        self._dtypes = [x.dtype for x in leaves]
+        self._sizes = [int(np.prod(s)) for s in self._shapes]
+        self._ks = [max(1, int(np.ceil(self.cfg.topk_ratio * n)))
+                    for n in self._sizes]
+        # host fp32 masters + native CPU Adam per leaf
+        self._masters = [np.asarray(x, np.float32).reshape(-1)
+                         for x in leaves]
+        self._host_opts = [CPUAdam(n, lr=self.lr, betas=self.cfg.betas,
+                                   eps=self.cfg.eps,
+                                   weight_decay=self.cfg.weight_decay)
+                           for n in self._sizes]
+        # device state: accumulators [n], selected idx [k], m/v [k]
+        self._acc = [jnp.zeros(n, jnp.float32) for n in self._sizes]
+        self._idx = [jnp.arange(k, dtype=jnp.int32) for k in self._ks]
+        self._m = [jnp.zeros(k, jnp.float32) for k in self._ks]
+        self._v = [jnp.zeros(k, jnp.float32) for k in self._ks]
+        self._sel_step = [0] * len(self._ks)
+        self._worker = _AsyncWorker()
+        self._pending_upload: Optional[List[np.ndarray]] = None
+        # selection in effect when the in-flight host grads were shipped:
+        # those coords were zeroed in the shipped grads, so the masters
+        # are stale for them and the device values must survive fold-in
+        # even after a reselection changes self._idx
+        self._shipped_idx: Optional[List[jnp.ndarray]] = None
+        log_dist(
+            f"ZenFlow: {len(leaves)} tensors, topk={self.cfg.topk_ratio:.2%}"
+            f", update_interval={self.cfg.update_interval}", ranks=[0])
+
+    # -- jitted pieces ---------------------------------------------------
+    @staticmethod
+    @jax.jit
+    def _accumulate(acc, g):
+        return acc + g
+
+    @staticmethod
+    @jax.jit
+    def _selective_adam(flat_param, g, idx, m, v, step, lr, b1, b2, eps):
+        """Adam on the selected coordinates only (ZenFlowSelectiveAdamW)."""
+        sel_g = g[idx]
+        m = b1 * m + (1 - b1) * sel_g
+        v = b2 * v + (1 - b2) * sel_g * sel_g
+        mhat = m / (1 - b1 ** step)
+        vhat = v / (1 - b2 ** step)
+        upd = lr * mhat / (jnp.sqrt(vhat) + eps)
+        new = flat_param.astype(jnp.float32).at[idx].add(-upd)
+        return new.astype(flat_param.dtype), m, v
+
+    # -- selection -------------------------------------------------------
+    def _reselect(self, i: int):
+        """Re-pick the top-k coordinates of leaf i by |accumulated grad|
+        (reference select_strategy='auto': gradient magnitude)."""
+        acc = self._acc[i]
+        k = self._ks[i]
+        _, idx = jax.lax.top_k(jnp.abs(acc), k)
+        self._idx[i] = idx.astype(jnp.int32)
+        self._m[i] = jnp.zeros(k, jnp.float32)
+        self._v[i] = jnp.zeros(k, jnp.float32)
+        self._sel_step[i] = 0
+
+    # -- host pass -------------------------------------------------------
+    def _host_pass(self, host_grads: List[np.ndarray], lr: float,
+                   denom: float) -> List[np.ndarray]:
+        out = []
+        for i, hg in enumerate(host_grads):
+            self._host_opts[i].step(self._masters[i], hg / denom, lr=lr)
+            out.append(self._masters[i].copy())
+        return out
+
+    # -- main ------------------------------------------------------------
+    def step(self, grads, params, lr: Optional[float] = None):
+        lr = self.lr if lr is None else float(lr)
+        self.steps += 1
+        g_leaves = jax.tree.leaves(grads)
+        p_leaves, treedef = jax.tree.flatten(params)
+        cfg = self.cfg
+
+        # fold a finished async host pass into the device params: masters
+        # own the non-selected coords; device-selected coords stay ahead
+        done = self._worker.collect(block=not cfg.overlap_step)
+        if done is None and not self._worker.busy and \
+                self._pending_upload is not None:
+            done = self._pending_upload
+        if done is not None:
+            self._pending_upload = None
+            new_leaves = []
+            for i, (pl_, master) in enumerate(zip(p_leaves, done)):
+                flat = jnp.asarray(master)
+                # device values survive for the current selection AND the
+                # selection the shipped grads were zeroed under (the
+                # masters are stale for both)
+                keep = self._idx[i]
+                if self._shipped_idx is not None:
+                    keep = jnp.concatenate([keep, self._shipped_idx[i]])
+                dev_flat = pl_.reshape(-1).astype(jnp.float32)
+                flat = flat.at[keep].set(dev_flat[keep])
+                self._masters[i] = np.asarray(flat)
+                new_leaves.append(
+                    flat.reshape(self._shapes[i]).astype(self._dtypes[i]))
+            p_leaves = new_leaves
+            self._shipped_idx = None
+
+        new_p = []
+        for i, (pl_, gl) in enumerate(zip(p_leaves, g_leaves)):
+            g_flat = gl.reshape(-1).astype(jnp.float32)
+            self._acc[i] = self._accumulate(self._acc[i], g_flat)
+            if (self.steps - 1) % cfg.select_interval == 0:
+                self._reselect(i)
+            self._sel_step[i] += 1
+            flat, self._m[i], self._v[i] = self._selective_adam(
+                pl_.reshape(-1), g_flat, self._idx[i], self._m[i],
+                self._v[i], jnp.asarray(self._sel_step[i], jnp.float32),
+                jnp.asarray(lr, jnp.float32), cfg.betas[0], cfg.betas[1],
+                cfg.eps)
+            new_p.append(flat.reshape(self._shapes[i]))
+
+        if self.steps % cfg.update_interval == 0:
+            # ship accumulated (averaged) grads to the host optimizer,
+            # zeroing the selected coords (already applied on device)
+            host_grads = []
+            for i in range(len(new_p)):
+                acc = self._acc[i].at[self._idx[i]].set(0.0)
+                host_grads.append(np.asarray(acc))
+                self._acc[i] = jnp.zeros_like(self._acc[i])
+            if self._worker.busy:  # previous pass still running: wait
+                self._pending_upload = self._worker.collect(block=True)
+            self._shipped_idx = [jnp.asarray(i) for i in self._idx]
+            if cfg.overlap_step:
+                self._worker.submit(self._host_pass, host_grads, lr,
+                                    float(cfg.update_interval))
+            else:
+                self._pending_upload = self._host_pass(
+                    host_grads, lr, float(cfg.update_interval))
+
+        return jax.tree.unflatten(treedef, new_p)
+
+    def finalize(self):
+        """Block on any in-flight host pass and fold it in (end of
+        training / before checkpoint)."""
+        done = self._worker.collect(block=True)
+        if done is not None:
+            self._pending_upload = done
+        return self._pending_upload is not None
+
+    def state_dict(self) -> Dict[str, Any]:
+        # never snapshot mid-host-pass: the worker mutates masters and
+        # CPUAdam moments in place (a torn copy would restore garbage)
+        self.finalize()
+        return {
+            "steps": self.steps,
+            "masters": [m.copy() for m in self._masters],
+            "host_opt": [o.state_dict() for o in self._host_opts],
+            "idx": [np.asarray(i) for i in self._idx],
+            "m": [np.asarray(m) for m in self._m],
+            "v": [np.asarray(v) for v in self._v],
+            "acc": [np.asarray(a) for a in self._acc],
+            "sel_step": list(self._sel_step),
+        }
+
+    def load_state_dict(self, sd: Dict[str, Any]):
+        self.steps = int(sd["steps"])
+        self._masters = [np.asarray(m, np.float32) for m in sd["masters"]]
+        for o, os_ in zip(self._host_opts, sd["host_opt"]):
+            o.load_state_dict(os_)
+        self._idx = [jnp.asarray(i) for i in sd["idx"]]
+        self._m = [jnp.asarray(m) for m in sd["m"]]
+        self._v = [jnp.asarray(v) for v in sd["v"]]
+        self._acc = [jnp.asarray(a) for a in sd["acc"]]
+        self._sel_step = [int(s) for s in sd["sel_step"]]

@@ -1,0 +1,68 @@
+"""FP8 quantizer tests (reference analog: tests/unit/ops/fp_quantizer/)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.ops.fp_quantizer import (FPQuantizer, fp8_matmul,
+                                            fp_dequantize, fp_quantize,
+                                            selective_dequantize)
+
+
+def test_quantize_roundtrip_error(devices):
+    x = jax.random.normal(jax.random.PRNGKey(0), (16, 256)) * 3.0
+    q, s = fp_quantize(x, fmt="e4m3", group_size=128)
+    assert q.dtype == jnp.float8_e4m3fn
+    assert s.shape == (16, 2)
+    back = fp_dequantize(q, s, group_size=128, dtype=jnp.float32)
+    rel = np.abs(np.asarray(back) - np.asarray(x)) / (np.abs(np.asarray(x))
+                                                      + 1e-3)
+    # e4m3 has ~2 mantissa-bit precision → ~6% relative error bound
+    assert np.median(rel) < 0.05
+    assert rel.max() < 0.2
+
+
+def test_e5m2_wider_range(devices):
+    x = jnp.asarray([[1e-4, 50000.0] * 64], jnp.float32)
+    q5, s5 = fp_quantize(x, fmt="e5m2", group_size=128)
+    back = fp_dequantize(q5, s5, group_size=128, dtype=jnp.float32)
+    assert np.isfinite(np.asarray(back)).all()
+
+
+def test_group_scaling_isolates_outliers(devices):
+    # one huge group must not destroy the precision of the other
+    x = jnp.concatenate([jnp.ones((1, 128)) * 1e-2,
+                         jnp.ones((1, 128)) * 1e4], axis=-1)
+    q, s = fp_quantize(x, group_size=128)
+    back = fp_dequantize(q, s, group_size=128, dtype=jnp.float32)
+    np.testing.assert_allclose(np.asarray(back[0, :128]), 1e-2, rtol=0.05)
+    np.testing.assert_allclose(np.asarray(back[0, 128:]), 1e4, rtol=0.05)
+
+
+def test_selective_dequantize(devices):
+    x = jax.random.normal(jax.random.PRNGKey(1), (8, 128))
+    qz = FPQuantizer(group_size=64)
+    q, s = qz.quantize(x)
+    rows = jnp.asarray([1, 5])
+    sel = qz.selective_dequantize(q, s, rows, dtype=jnp.float32)
+    full = qz.dequantize(q, s, dtype=jnp.float32)
+    np.testing.assert_allclose(np.asarray(sel), np.asarray(full)[[1, 5]],
+                               rtol=1e-6)
+
+
+def test_fp8_matmul_close(devices):
+    a = jax.random.normal(jax.random.PRNGKey(0), (64, 64)) * 0.2
+    b = jax.random.normal(jax.random.PRNGKey(1), (64, 64)) * 0.2
+    ref = np.asarray(a @ b)
+    out = np.asarray(fp8_matmul(a, b, out_dtype=jnp.float32))
+    err = np.abs(out - ref) / (np.abs(ref) + 1e-2)
+    assert np.median(err) < 0.1
+
+
+def test_unknown_format_and_bits_fallback(devices):
+    with pytest.raises(ValueError, match="unknown fp format"):
+        fp_quantize(jnp.ones((4, 4)), fmt="e3m4")
+    q, _ = fp_quantize(jnp.ones((4, 128)), q_bits=6)  # FP6 → fp8 fallback
+    assert q.dtype == jnp.float8_e4m3fn

@@ -1,0 +1,106 @@
+"""PLD / eigenvalue / sparse-gradient tests (reference analogs:
+tests/unit/runtime/test_pld.py, sparse-grad unit tests)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.runtime.eigenvalue import Eigenvalue
+from deepspeed_tpu.runtime.progressive_layer_drop import ProgressiveLayerDrop
+from deepspeed_tpu.runtime.sparse_tensor import (SparseTensor,
+                                                 sparse_allreduce)
+
+
+# -- PLD --------------------------------------------------------------------
+
+def test_pld_theta_schedule():
+    pld = ProgressiveLayerDrop(theta=0.5, gamma=0.01)
+    assert pld.get_theta() == 1.0
+    t0 = pld.update_state(0)
+    assert t0 == pytest.approx(1.0)
+    t100 = pld.update_state(100)
+    t1000 = pld.update_state(10000)
+    assert 0.5 <= t1000 < t100 < t0
+    assert t1000 == pytest.approx(0.5, abs=1e-3)
+    assert pld.get_state()["pld_theta"] == t1000
+
+
+def test_pld_layer_gates(devices):
+    pld = ProgressiveLayerDrop(theta=0.6, gamma=0.01)
+    pld.update_state(10**6)  # fully annealed: theta ≈ 0.6
+    probs = pld.layer_keep_probs(12)
+    assert probs[0] > probs[-1]  # deeper layers drop more
+    assert probs[-1] == pytest.approx(0.6, abs=1e-3)
+    gates = pld.layer_gates(jax.random.PRNGKey(0), 12)
+    assert gates.shape == (12,)
+    g = np.asarray(gates)
+    # gates are 0 or 1/p (unbiased scaling)
+    nz = g[g > 0]
+    np.testing.assert_allclose(nz, 1.0 / probs[g > 0], rtol=1e-5)
+
+
+# -- eigenvalue --------------------------------------------------------------
+
+def test_eigenvalue_quadratic(devices):
+    """For loss = 0.5 x^T A x the top Hessian eigenvalue is known."""
+    A = np.diag([5.0, 2.0, 1.0]).astype(np.float32)
+
+    def loss(params):
+        x = params["x"]
+        return 0.5 * x @ jnp.asarray(A) @ x
+
+    eig = Eigenvalue(max_iter=200, tol=1e-5).compute_eigenvalue(
+        loss, {"x": jnp.ones(3, jnp.float32)})
+    assert eig == pytest.approx(5.0, rel=1e-2)
+
+
+def test_eigenvalue_per_block(devices):
+    def loss(params):
+        return (10.0 * (params["a"] ** 2).sum()
+                + 1.0 * (params["b"] ** 2).sum())
+
+    eigs = Eigenvalue(max_iter=50).compute_eigenvalues(
+        loss, {"a": jnp.ones(4), "b": jnp.ones(4)})
+    assert eigs["a"] == pytest.approx(20.0, rel=1e-2)
+    assert eigs["b"] == pytest.approx(2.0, rel=1e-2)
+
+
+# -- sparse gradients --------------------------------------------------------
+
+def test_sparse_tensor_roundtrip(devices):
+    vocab, h = 16, 4
+    grad = jnp.zeros((vocab, h)).at[jnp.asarray([2, 5, 2])].add(1.0)
+    tokens = jnp.asarray([2, 5, 2])
+    st = SparseTensor.from_dense_rows(grad, tokens)
+    dense = st.to_dense()
+    np.testing.assert_allclose(np.asarray(dense), np.asarray(grad),
+                               rtol=1e-6)
+
+
+def test_sparse_allreduce_matches_dense(devices):
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    devs = np.array(jax.devices()[:4])
+    mesh = Mesh(devs, ("dp",))
+    vocab, h, bt = 32, 8, 6
+    rng = np.random.default_rng(0)
+    tokens = jnp.asarray(rng.integers(0, vocab, (4, bt)), jnp.int32)
+    grads = jnp.asarray(rng.normal(size=(4, vocab, h)), jnp.float32)
+
+    def body(grad, toks):
+        return sparse_allreduce(grad[0], toks[0], axis="dp")
+
+    fn = jax.shard_map(body, mesh=mesh,
+                       in_specs=(P("dp"), P("dp")),
+                       out_specs=P(), check_vma=False)
+    out = fn(grads, tokens)
+    # dense reference: zero all rows not touched per rank, then sum
+    expect = np.zeros((vocab, h), np.float32)
+    for r in range(4):
+        mask = np.zeros(vocab, bool)
+        mask[np.asarray(tokens[r])] = True
+        expect += np.asarray(grads[r]) * mask[:, None]
+    np.testing.assert_allclose(np.asarray(out), expect, rtol=1e-5,
+                               atol=1e-5)
