@@ -51,7 +51,7 @@ class TransformerConfig:
     max_seq_len: int = 1024
     pos_emb: str = "learned"  # learned | rope | none
     norm: str = "layernorm"  # layernorm | rmsnorm
-    activation: str = "gelu"  # gelu | swiglu
+    activation: str = "gelu"  # gelu | swiglu | relu
     tie_embeddings: bool = True
     rope_theta: float = 10000.0
     norm_eps: float = 1e-5
@@ -310,7 +310,8 @@ def _layer(cfg: TransformerConfig, x, layer_params, positions):
             u = jnp.einsum("bsh,hf->bsf", y, mp["wi"].astype(dt))
             z = jax.nn.silu(g) * u
         else:
-            z = jax.nn.gelu(jnp.einsum("bsh,hf->bsf", y, mp["wi"].astype(dt)))
+            act = jax.nn.relu if cfg.activation == "relu" else jax.nn.gelu
+            z = act(jnp.einsum("bsh,hf->bsf", y, mp["wi"].astype(dt)))
         z = constrain_activation(z, ("batch", "seq", "mlp"))
         return jnp.einsum("bsf,fh->bsh", z, mp["wo"].astype(dt))
 

@@ -51,6 +51,24 @@ CONFIGS = {
     # Phi-3-mini (reference: inference/v2/model_implementations/phi)
     "phi3-mini": _llama(3072, 32, 32, 32, 8192, vocab=32064, ctx=4096,
                         theta=10000.0),
+    # OPT family (reference: inference/v2/model_implementations/opt,
+    # module_inject/containers/opt.py): learned positions, ReLU MLP
+    "opt-1.3b": TransformerConfig(
+        vocab_size=50272, hidden_size=2048, num_layers=24, num_heads=32,
+        ffn_size=8192, max_seq_len=2048, pos_emb="learned",
+        norm="layernorm", activation="relu", tie_embeddings=True),
+    "opt-6.7b": TransformerConfig(
+        vocab_size=50272, hidden_size=4096, num_layers=32, num_heads=32,
+        ffn_size=16384, max_seq_len=2048, pos_emb="learned",
+        norm="layernorm", activation="relu", tie_embeddings=True),
+    # Falcon-7B (reference: .../falcon): rope + LayerNorm + GELU MLP +
+    # multi-query attention (1 KV head). Deviation: residual blocks are
+    # sequential here, not Falcon's fused parallel attn/mlp.
+    "falcon-7b": TransformerConfig(
+        vocab_size=65024, hidden_size=4544, num_layers=32, num_heads=71,
+        num_kv_heads=1, ffn_size=18176, max_seq_len=2048, pos_emb="rope",
+        norm="layernorm", activation="gelu", tie_embeddings=True,
+        rope_theta=10000.0),
     # tiny debug config (reference tests/unit/simple_model.py role)
     "tiny": TransformerConfig(vocab_size=256, hidden_size=64, num_layers=2,
                               num_heads=4, max_seq_len=128, remat=False),

@@ -5,3 +5,8 @@ from deepspeed_tpu.module_inject.auto_tp import (  # noqa: F401
     AutoTP,
     tp_model_init,
 )
+from deepspeed_tpu.module_inject.auto_ep import (  # noqa: F401
+    AutoEP,
+    ep_model_init,
+    stack_expert_modulelist,
+)

@@ -1,0 +1,169 @@
+"""AutoEP: automatic expert parallelism for HF-style MoE parameter trees.
+
+Reference: ``deepspeed/module_inject/auto_ep.py:273`` (``AutoEP``) —
+detects a stock HF MoE model's router + experts (fused-3D tensors or a
+ModuleList of per-expert modules), converts the experts to grouped
+(stacked) layout for grouped-GEMM execution, and partitions them over
+the expert-parallel group; presets per architecture live in
+``module_inject/auto_ep_presets/``.
+
+TPU-native: expert parallelism is a sharding of the stacked expert
+tensors' leading E axis over the mesh's ``ep`` axis — GSPMD inserts the
+dispatch/combine collectives the reference performs with explicit
+all-to-alls. AutoEP here does the two mechanical parts the reference
+does: (1) **restack** ``experts.<i>.<leaf>`` ModuleList entries into
+fused ``[E, ...]`` arrays (the grouped-GEMM layout
+``moe/ep_experts.py:136`` builds), and (2) **classify** paths → specs:
+expert-stacked tensors shard E over ep (and their matrix dims over tp
+by the AutoTP policy), router/gate weights replicate.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from deepspeed_tpu.module_inject.auto_tp import AutoTP, SEP, _divisible
+from deepspeed_tpu.utils.logging import log_dist, logger
+
+# path fragments marking the expert container (reference presets:
+# mixtral 'block_sparse_moe.experts', qwen2_moe 'mlp.experts', ...)
+_EXPERT_PATTERNS = [r"experts"]
+_ROUTER_PATTERNS = [r"\bgate\b", r"router", r"gate_proj\b.*router"]
+
+
+class AutoEPPreset:
+    """Architecture preset (reference auto_ep_presets/): where experts
+    and the router live."""
+
+    def __init__(self, expert_patterns=None, router_patterns=None):
+        self.expert_patterns = list(expert_patterns or _EXPERT_PATTERNS)
+        self.router_patterns = list(router_patterns or _ROUTER_PATTERNS)
+
+
+PRESETS: Dict[str, AutoEPPreset] = {
+    "default": AutoEPPreset(),
+    "mixtral": AutoEPPreset([r"block_sparse_moe\.experts", r"experts"],
+                            [r"block_sparse_moe\.gate\b"]),
+    "qwen2_moe": AutoEPPreset([r"mlp\.experts", r"experts"],
+                              [r"mlp\.gate\b", r"shared_expert_gate"]),
+}
+
+
+def _is_int_keyed(d: dict) -> bool:
+    return len(d) > 0 and all(
+        isinstance(k, str) and k.isdigit() for k in d)
+
+
+def stack_expert_modulelist(params, preset: Optional[AutoEPPreset] = None):
+    """Restack ``experts.{0..E-1}.<leaf>`` dicts into fused ``[E, ...]``
+    arrays (reference GroupedExperts conversion, moe/ep_experts.py:136).
+    Fused-3D checkpoints pass through unchanged. Returns a new tree.
+    """
+    preset = preset or PRESETS["default"]
+
+    def walk(tree, prefix=""):
+        if not isinstance(tree, dict):
+            return tree
+        is_expert_list = (
+            _is_int_keyed(tree)
+            and any(re.search(p, prefix) for p in preset.expert_patterns)
+            and all(isinstance(v, dict) for v in tree.values()))
+        if is_expert_list:
+            order = sorted(tree, key=int)
+            per_expert = [walk(tree[k], f"{prefix}{SEP}{k}") for k in order]
+            # stack leaf-wise: {'w1': [E,...], 'w2': [E,...]}
+            return jax.tree.map(
+                lambda *xs: jax.numpy.stack(
+                    [jax.numpy.asarray(x) for x in xs]), *per_expert)
+        return {k: walk(v, f"{prefix}{SEP}{k}" if prefix else str(k))
+                for k, v in tree.items()}
+
+    return walk(params)
+
+
+class AutoEP:
+    """Classify paths of a (restacked) MoE tree → EP×TP PartitionSpecs."""
+
+    def __init__(self, ep_axis: str = "ep", tp_axis: str = "tp",
+                 preset: str = "default", tp_policy: Optional[str] = None):
+        self.ep_axis = ep_axis
+        self.preset = PRESETS.get(preset.lower())
+        if self.preset is None:
+            logger.warning(f"AutoEP: no preset '{preset}', using default")
+            self.preset = PRESETS["default"]
+        self.autotp = AutoTP(tp_axis=tp_axis, policy=tp_policy)
+
+    def _is_expert(self, path: str) -> bool:
+        return any(re.search(p, path) for p in self.preset.expert_patterns)
+
+    def _is_router(self, path: str) -> bool:
+        return any(re.search(p, path) for p in self.preset.router_patterns)
+
+    def spec_for(self, path: str, shape: Tuple[int, ...]) -> P:
+        if self._is_router(path):
+            return P(*[None] * len(shape))  # router replicates (tiny)
+        if self._is_expert(path) and len(shape) >= 2:
+            # leading axis = E over ep; trailing matrix dims follow the
+            # AutoTP column/row policy
+            inner = self.autotp.spec_for(path, shape[1:])
+            return P(self.ep_axis, *tuple(inner))
+        return self.autotp.spec_for(path, shape)
+
+    def infer_specs(self, params) -> Any:
+        def walk(tree, prefix=""):
+            if isinstance(tree, dict):
+                return {k: walk(v, f"{prefix}{SEP}{k}" if prefix else str(k))
+                        for k, v in tree.items()}
+            if isinstance(tree, (list, tuple)):
+                vals = [walk(v, f"{prefix}{SEP}{i}" if prefix else str(i))
+                        for i, v in enumerate(tree)]
+                return vals if isinstance(tree, list) else tuple(vals)
+            return self.spec_for(prefix,
+                                 tuple(getattr(tree, "shape", ()) or ()))
+
+        return walk(params)
+
+
+def ep_model_init(params, mesh: Optional[Mesh] = None, ep_size: int = 0,
+                  preset: str = "default", dtype=None):
+    """Restack + shard an HF MoE tree for expert parallelism (reference
+    ``AutoEP`` runtime conversion entry). Returns (sharded_params, specs).
+
+    Experts whose E doesn't divide the ep axis fall back to replicated
+    with a warning (partial conversion, like the reference).
+    """
+    from deepspeed_tpu.parallel import topology as topo
+
+    if mesh is None:
+        if ep_size <= 0:
+            raise ValueError("ep_model_init needs mesh or ep_size")
+        mesh = topo.build_mesh(topo.TopologyConfig(ep=ep_size, dp=-1))
+    stacked = stack_expert_modulelist(params,
+                                      PRESETS.get(preset, PRESETS["default"]))
+    aep = AutoEP(preset=preset)
+    specs = aep.infer_specs(stacked)
+
+    def place(x, spec):
+        shape = tuple(getattr(x, "shape", ()) or ())
+        if not _divisible(shape, spec, mesh):
+            logger.warning(
+                f"AutoEP: shape {shape} not divisible for spec {spec}; "
+                "replicating")
+            spec = P(*[None] * len(shape))
+        arr = jax.numpy.asarray(x)
+        if dtype is not None:
+            arr = arr.astype(dtype)
+        return jax.device_put(arr, NamedSharding(mesh, spec))
+
+    sharded = jax.tree.map(place, stacked, specs,
+                           is_leaf=lambda x: not isinstance(
+                               x, (dict, list, tuple)))
+    log_dist(f"AutoEP over ep={mesh.shape.get('ep', 1)} "
+             f"(preset={preset})", ranks=[0])
+    return sharded, specs

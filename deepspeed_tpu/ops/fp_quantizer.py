@@ -54,6 +54,10 @@ def fp_quantize(x: jax.Array, q_bits: int = 8, fmt: Optional[str] = None,
     """
     fmt = _resolve_format(q_bits, fmt)
     n = x.shape[-1]
+    if n % group_size:
+        logger.warning(
+            f"fp_quantize: last dim {n} not divisible by group_size "
+            f"{group_size}; using one scale per row (coarser precision)")
     g = group_size if n % group_size == 0 else n
     xf = x.astype(jnp.float32)
     grouped = xf.reshape(*x.shape[:-1], n // g, g)

@@ -124,11 +124,12 @@ class ZenFlowOptimizer:
         self._sel_step = [0] * len(self._ks)
         self._worker = _AsyncWorker()
         self._pending_upload: Optional[List[np.ndarray]] = None
-        # selection in effect when the in-flight host grads were shipped:
-        # those coords were zeroed in the shipped grads, so the masters
-        # are stale for them and the device values must survive fold-in
-        # even after a reselection changes self._idx
-        self._shipped_idx: Optional[List[jnp.ndarray]] = None
+        # every coordinate selected since the last fold-in: their grads
+        # never reach the host (zeroed at shipment for the current
+        # selection, dropped from the accumulator at reselection for past
+        # ones), so the device value is authoritative and must survive
+        # fold-in even after reselections change self._idx
+        self._protected: List[Optional[jnp.ndarray]] = [None] * len(self._ks)
         log_dist(
             f"ZenFlow: {len(leaves)} tensors, topk={self.cfg.topk_ratio:.2%}"
             f", update_interval={self.cfg.update_interval}", ranks=[0])
@@ -153,12 +154,20 @@ class ZenFlowOptimizer:
         return new.astype(flat_param.dtype), m, v
 
     # -- selection -------------------------------------------------------
-    def _reselect(self, i: int):
+    def _reselect(self, i: int, initial: bool = False):
         """Re-pick the top-k coordinates of leaf i by |accumulated grad|
-        (reference select_strategy='auto': gradient magnitude)."""
-        acc = self._acc[i]
+        (reference select_strategy='auto': gradient magnitude). The old
+        selection's accumulated grads are dropped — the device already
+        applied those updates — and the old coords join the protected set
+        until the next fold-in syncs them into the masters."""
         k = self._ks[i]
-        _, idx = jax.lax.top_k(jnp.abs(acc), k)
+        if not initial:
+            old = self._idx[i]
+            self._acc[i] = self._acc[i].at[old].set(0.0)
+            self._protected[i] = (old if self._protected[i] is None
+                                  else jnp.concatenate(
+                                      [self._protected[i], old]))
+        _, idx = jax.lax.top_k(jnp.abs(self._acc[i]), k)
         self._idx[i] = idx.astype(jnp.int32)
         self._m[i] = jnp.zeros(k, jnp.float32)
         self._v[i] = jnp.zeros(k, jnp.float32)
@@ -182,36 +191,39 @@ class ZenFlowOptimizer:
         cfg = self.cfg
 
         # fold a finished async host pass into the device params: masters
-        # own the non-selected coords; device-selected coords stay ahead
+        # own the non-selected coords; device-selected coords stay ahead.
+        # Fold-in only runs with the worker idle (a running pass reads the
+        # master arrays), and a newer snapshot supersedes a deferred one —
+        # masters mutate cumulatively, so the latest copy is complete.
         done = self._worker.collect(block=not cfg.overlap_step)
-        if done is None and not self._worker.busy and \
-                self._pending_upload is not None:
+        if done is not None:
+            self._pending_upload = None
+        elif not self._worker.busy and self._pending_upload is not None:
             done = self._pending_upload
         if done is not None:
             self._pending_upload = None
             new_leaves = []
             for i, (pl_, master) in enumerate(zip(p_leaves, done)):
                 flat = jnp.asarray(master)
-                # device values survive for the current selection AND the
-                # selection the shipped grads were zeroed under (the
-                # masters are stale for both)
+                # device values survive for every coordinate selected
+                # since the last fold-in (masters never saw their grads)
                 keep = self._idx[i]
-                if self._shipped_idx is not None:
-                    keep = jnp.concatenate([keep, self._shipped_idx[i]])
+                if self._protected[i] is not None:
+                    keep = jnp.concatenate([keep, self._protected[i]])
                 dev_flat = pl_.reshape(-1).astype(jnp.float32)
                 flat = flat.at[keep].set(dev_flat[keep])
                 self._masters[i] = np.asarray(flat)
+                self._protected[i] = None
                 new_leaves.append(
                     flat.reshape(self._shapes[i]).astype(self._dtypes[i]))
             p_leaves = new_leaves
-            self._shipped_idx = None
 
         new_p = []
         for i, (pl_, gl) in enumerate(zip(p_leaves, g_leaves)):
             g_flat = gl.reshape(-1).astype(jnp.float32)
             self._acc[i] = self._accumulate(self._acc[i], g_flat)
             if (self.steps - 1) % cfg.select_interval == 0:
-                self._reselect(i)
+                self._reselect(i, initial=self.steps == 1)
             self._sel_step[i] += 1
             flat, self._m[i], self._v[i] = self._selective_adam(
                 pl_.reshape(-1), g_flat, self._idx[i], self._m[i],
@@ -230,7 +242,6 @@ class ZenFlowOptimizer:
                 self._acc[i] = jnp.zeros_like(self._acc[i])
             if self._worker.busy:  # previous pass still running: wait
                 self._pending_upload = self._worker.collect(block=True)
-            self._shipped_idx = [jnp.asarray(i) for i in self._idx]
             if cfg.overlap_step:
                 self._worker.submit(self._host_pass, host_grads, lr,
                                     float(cfg.update_interval))
@@ -252,15 +263,24 @@ class ZenFlowOptimizer:
         # never snapshot mid-host-pass: the worker mutates masters and
         # CPUAdam moments in place (a torn copy would restore garbage)
         self.finalize()
+        def copy_opt(sd):
+            return {k: (v.copy() if isinstance(v, np.ndarray) else v)
+                    for k, v in sd.items()}
+
         return {
             "steps": self.steps,
             "masters": [m.copy() for m in self._masters],
-            "host_opt": [o.state_dict() for o in self._host_opts],
+            # deep-copy moments: CPUAdam.state_dict returns live buffers
+            # the next step mutates in place (a torn async serialization
+            # would pair step-N masters with step-N+k moments)
+            "host_opt": [copy_opt(o.state_dict()) for o in self._host_opts],
             "idx": [np.asarray(i) for i in self._idx],
             "m": [np.asarray(m) for m in self._m],
             "v": [np.asarray(v) for v in self._v],
             "acc": [np.asarray(a) for a in self._acc],
             "sel_step": list(self._sel_step),
+            "protected": [None if p is None else np.asarray(p)
+                          for p in self._protected],
         }
 
     def load_state_dict(self, sd: Dict[str, Any]):
@@ -273,3 +293,6 @@ class ZenFlowOptimizer:
         self._v = [jnp.asarray(v) for v in sd["v"]]
         self._acc = [jnp.asarray(a) for a in sd["acc"]]
         self._sel_step = [int(s) for s in sd["sel_step"]]
+        self._protected = [None if p is None else jnp.asarray(p)
+                           for p in sd.get("protected",
+                                           [None] * len(self._acc))]

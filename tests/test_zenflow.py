@@ -82,6 +82,20 @@ def test_host_pass_updates_unselected(devices):
     assert moved > 16  # far more than the k=4 selected coords
 
 
+def test_misaligned_select_and_update_intervals(devices):
+    """Reselection between shipments must neither double-apply selected
+    grads nor revert device-side updates (protected-set invariant)."""
+    params, target = make_problem(seed=3, n=128)
+    opt = ZenFlowOptimizer(params, ZenFlowConfig(
+        topk_ratio=0.1, update_interval=4, select_interval=6,
+        overlap_step=True))
+    l0 = float(quad_loss(params, target))
+    p = run_steps(opt, params, target, 48)
+    l1 = float(quad_loss(p, target))
+    assert l1 < l0 * 0.2, (l0, l1)
+    assert np.isfinite(np.asarray(p["w"])).all()
+
+
 def test_state_dict_roundtrip(devices):
     params, target = make_problem(seed=2, n=64)
     opt = ZenFlowOptimizer(params, ZenFlowConfig(overlap_step=False))
