@@ -144,8 +144,13 @@ def ep_model_init(params, mesh: Optional[Mesh] = None, ep_size: int = 0,
         if ep_size <= 0:
             raise ValueError("ep_model_init needs mesh or ep_size")
         mesh = topo.build_mesh(topo.TopologyConfig(ep=ep_size, dp=-1))
-    stacked = stack_expert_modulelist(params,
-                                      PRESETS.get(preset, PRESETS["default"]))
+    # resolve once (case-insensitive, warned) so stacking and spec
+    # inference cannot disagree on the preset
+    preset = preset.lower()
+    if preset not in PRESETS:
+        logger.warning(f"AutoEP: no preset '{preset}', using default")
+        preset = "default"
+    stacked = stack_expert_modulelist(params, PRESETS[preset])
     aep = AutoEP(preset=preset)
     specs = aep.infer_specs(stacked)
 

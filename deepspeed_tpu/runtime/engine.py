@@ -205,6 +205,23 @@ class Engine:
         self._pending = None  # (loss, grads) between forward() and backward()
         self._grad_acc = None  # accumulation buffer for the micro-step path
 
+        # -- curriculum learning (reference engine curriculum_learning
+        # config + set_custom_curriculum_learning_schedule) ---------------
+        self.curriculum_scheduler = None
+        de = config.data_efficiency
+        if de.enabled and de.curriculum_metrics:
+            from deepspeed_tpu.runtime.data_pipeline import \
+                CurriculumScheduler
+
+            if len(de.curriculum_metrics) > 1:
+                logger.warning(
+                    "data_efficiency: multiple curriculum metrics "
+                    f"configured ({sorted(de.curriculum_metrics)}); the "
+                    "engine schedules only the first — drive the others "
+                    "via DeepSpeedDataSampler directly")
+            first = next(iter(de.curriculum_metrics.values()))
+            self.curriculum_scheduler = CurriculumScheduler(first)
+
         # -- dataloader (engine.py:364 deepspeed_io analog) ---------------
         self.training_dataloader = None
         if training_data is not None:
@@ -590,6 +607,21 @@ class Engine:
         batch = self.shard_batch(batch)
         loss, _aux = self._jit_eval(self.params, batch)
         return loss
+
+    def set_custom_curriculum_learning_schedule(self, fn):
+        """Reference engine API: plug a step→difficulty callable into the
+        curriculum scheduler (requires a 'custom' curriculum config)."""
+        if self.curriculum_scheduler is None:
+            raise RuntimeError(
+                "no curriculum scheduler: enable data_efficiency with a "
+                "curriculum_metrics block first")
+        self.curriculum_scheduler.set_custom_get_difficulty(fn)
+
+    def get_data_difficulty(self) -> Optional[int]:
+        """Current curriculum difficulty (None when curriculum is off)."""
+        if self.curriculum_scheduler is None:
+            return None
+        return self.curriculum_scheduler.get_difficulty(self.global_steps)
 
     def register_post_step_hook(self, fn):
         """``fn(engine)`` runs after every optimizer step (compression

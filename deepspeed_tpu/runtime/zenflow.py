@@ -130,6 +130,9 @@ class ZenFlowOptimizer:
         # ones), so the device value is authoritative and must survive
         # fold-in even after reselections change self._idx
         self._protected: List[Optional[jnp.ndarray]] = [None] * len(self._ks)
+        # device applied selective updates since the last fold-in: only
+        # then can the masters be stale for a reselected-away coordinate
+        self._updated_since_foldin = [False] * len(self._ks)
         log_dist(
             f"ZenFlow: {len(leaves)} tensors, topk={self.cfg.topk_ratio:.2%}"
             f", update_interval={self.cfg.update_interval}", ranks=[0])
@@ -164,9 +167,15 @@ class ZenFlowOptimizer:
         if not initial:
             old = self._idx[i]
             self._acc[i] = self._acc[i].at[old].set(0.0)
-            self._protected[i] = (old if self._protected[i] is None
-                                  else jnp.concatenate(
-                                      [self._protected[i], old]))
+            if self._updated_since_foldin[i]:
+                # masters lack the device updates applied to ``old`` since
+                # the last fold-in — protect them until the next fold-in
+                # re-syncs. (If a fold-in just ran this step, masters
+                # already equal the device values and protection would
+                # wrongly revert the host's later updates.)
+                self._protected[i] = (old if self._protected[i] is None
+                                      else jnp.concatenate(
+                                          [self._protected[i], old]))
         _, idx = jax.lax.top_k(jnp.abs(self._acc[i]), k)
         self._idx[i] = idx.astype(jnp.int32)
         self._m[i] = jnp.zeros(k, jnp.float32)
@@ -196,12 +205,11 @@ class ZenFlowOptimizer:
         # master arrays), and a newer snapshot supersedes a deferred one —
         # masters mutate cumulatively, so the latest copy is complete.
         done = self._worker.collect(block=not cfg.overlap_step)
-        if done is not None:
-            self._pending_upload = None
-        elif not self._worker.busy and self._pending_upload is not None:
+        if done is None and not self._worker.busy and \
+                self._pending_upload is not None:
             done = self._pending_upload
         if done is not None:
-            self._pending_upload = None
+            self._pending_upload = None  # fresh result supersedes deferred
             new_leaves = []
             for i, (pl_, master) in enumerate(zip(p_leaves, done)):
                 flat = jnp.asarray(master)
@@ -214,6 +222,7 @@ class ZenFlowOptimizer:
                 flat = flat.at[keep].set(dev_flat[keep])
                 self._masters[i] = np.asarray(flat)
                 self._protected[i] = None
+                self._updated_since_foldin[i] = False
                 new_leaves.append(
                     flat.reshape(self._shapes[i]).astype(self._dtypes[i]))
             p_leaves = new_leaves
@@ -230,6 +239,7 @@ class ZenFlowOptimizer:
                 self._v[i], jnp.asarray(self._sel_step[i], jnp.float32),
                 jnp.asarray(lr, jnp.float32), cfg.betas[0], cfg.betas[1],
                 cfg.eps)
+            self._updated_since_foldin[i] = True
             new_p.append(flat.reshape(self._shapes[i]))
 
         if self.steps % cfg.update_interval == 0:

@@ -164,6 +164,43 @@ def test_curriculum_dataloader_pads_to_difficulty(tmp_path):
     assert batch["input_ids"].shape == (4, 8)  # step-0 difficulty = 8
 
 
+def test_engine_curriculum_wiring(devices):
+    """Reference engine curriculum API: scheduler built from config,
+    custom schedule pluggable (engine.set_custom_curriculum_learning_
+    schedule)."""
+    import deepspeed_tpu as dstpu
+    from deepspeed_tpu.models.transformer import (TransformerConfig,
+                                                  TransformerLM)
+
+    tiny = TransformerConfig(vocab_size=64, hidden_size=32, num_layers=2,
+                             num_heads=4, max_seq_len=32, remat=False,
+                             pos_emb="learned", norm="layernorm",
+                             activation="gelu")
+    cfg = {"train_micro_batch_size_per_chip": 2,
+           "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+           "data_efficiency": {
+               "enabled": True,
+               "curriculum_metrics": {"seqlen": {
+                   "curriculum_type": "fixed_linear",
+                   "min_difficulty": 8, "max_difficulty": 32,
+                   "schedule_config": {"total_curriculum_step": 10,
+                                       "difficulty_step": 8}}}},
+           "steps_per_print": 1000}
+    engine, *_ = dstpu.initialize(model=TransformerLM(tiny), config=cfg)
+    assert engine.curriculum_scheduler is not None
+    assert engine.get_data_difficulty() == 8  # step 0
+
+    custom_cfg = dict(cfg)
+    custom_cfg["data_efficiency"] = {
+        "enabled": True,
+        "curriculum_metrics": {"seqlen": {"curriculum_type": "custom",
+                                          "max_difficulty": 100}}}
+    engine2, *_ = dstpu.initialize(model=TransformerLM(tiny),
+                                   config=custom_cfg)
+    engine2.set_custom_curriculum_learning_schedule(lambda s: 42)
+    assert engine2.get_data_difficulty() == 42
+
+
 # -- variable batch size ----------------------------------------------------
 
 def test_batch_by_tokens_budget():
