@@ -275,6 +275,7 @@ class MonitorConfig(ConfigModel):
     tensorboard: MonitorBackendConfig = field(default_factory=MonitorBackendConfig)
     csv_monitor: MonitorBackendConfig = field(default_factory=MonitorBackendConfig)
     wandb: MonitorBackendConfig = field(default_factory=MonitorBackendConfig)
+    comet: MonitorBackendConfig = field(default_factory=MonitorBackendConfig)
 
 
 @register_config_model
@@ -384,6 +385,7 @@ class Config(ConfigModel):
     tensorboard: Optional[MonitorBackendConfig] = None
     csv_monitor: Optional[MonitorBackendConfig] = None
     wandb: Optional[MonitorBackendConfig] = None
+    comet: Optional[MonitorBackendConfig] = None
 
     def __post_init__(self):
         # a JSON null for a block means "defaults", not "no block"
@@ -401,7 +403,7 @@ class Config(ConfigModel):
             if getattr(self, name) is None:
                 setattr(self, name, klass())
         # hoist top-level monitor blocks into .monitor (reference accepts both)
-        for name in ("tensorboard", "csv_monitor", "wandb"):
+        for name in ("tensorboard", "csv_monitor", "wandb", "comet"):
             blk = getattr(self, name)
             if blk is not None:
                 setattr(self.monitor, name, blk)

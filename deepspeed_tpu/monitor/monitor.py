@@ -94,6 +94,28 @@ class WandbMonitor(_Backend):
             self._wandb.log({label: value}, step=step)
 
 
+class CometMonitor(_Backend):
+    """reference: monitor/comet.py — comet_ml is not in the image; gated."""
+
+    def __init__(self, cfg):
+        self.enabled = False
+        if not cfg.enabled:
+            return
+        try:
+            import comet_ml
+
+            self._exp = comet_ml.Experiment(project_name=cfg.project)
+            if cfg.job_name:
+                self._exp.set_name(cfg.job_name)
+            self.enabled = True
+        except Exception as e:
+            logger.warning(f"comet monitor unavailable: {e}")
+
+    def write_events(self, events: List[Event]):
+        for label, value, step in events:
+            self._exp.log_metric(label, value, step=step)
+
+
 class MonitorMaster:
     """Fan-out writer (reference monitor/monitor.py:30)."""
 
@@ -104,6 +126,7 @@ class MonitorMaster:
                 (TensorBoardMonitor, monitor_config.tensorboard),
                 (CSVMonitor, monitor_config.csv_monitor),
                 (WandbMonitor, monitor_config.wandb),
+                (CometMonitor, monitor_config.comet),
             ):
                 b = backend_cls(cfg)
                 if b.enabled:

@@ -1,0 +1,70 @@
+"""AutoSP: automatic sequence-parallel strategy selection.
+
+Reference: ``deepspeed/sequence/auto_sp.py:42``
+(``auto_wrap_model_for_sp``) + ``autosp_detector.py`` + the DeepCompile
+pass ``compile/passes/sp_compile.py`` — detect attention in the model's
+graph and rewrite it to Ulysses sequence parallelism automatically.
+
+TPU-native: there is no graph surgery to do — our models express
+attention through one dispatcher, so "rewriting to Ulysses" is flipping
+``sequence_parallel`` in the model config. What remains genuinely
+automatic is the *strategy choice*, which the reference leaves to the
+user: Ulysses's head-scatter all-to-all requires attention heads ≥ sp
+degree (each rank needs ≥ 1 head); when heads (or KV heads, which bound
+the scatter for GQA) are fewer than sp, ring attention (ppermute context
+parallelism) is the right mechanism. ``auto_wrap_model_for_sp`` inspects
+the mesh and the model's head layout and picks.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+from deepspeed_tpu.utils.logging import log_dist, logger
+
+
+def detect_sp_strategy(num_heads: int, num_kv_heads: Optional[int],
+                       sp_size: int) -> Optional[str]:
+    """'ulysses' | 'ring' | None (sp off). The head-scatter all-to-all
+    needs heads divisible by (or at least ≥) sp; GQA KV heads bound it
+    (reference uneven_heads_all2all handles remainders — here the ring
+    path covers that regime outright)."""
+    if sp_size <= 1:
+        return None
+    kv = num_kv_heads or num_heads
+    if num_heads % sp_size == 0 and kv % sp_size == 0:
+        return "ulysses"
+    # heads indivisible by (or fewer than) sp: ulysses would pad or
+    # starve ranks of heads — ring shards the sequence dim instead
+    return "ring"
+
+
+def auto_wrap_model_for_sp(model, mesh=None, force: Optional[str] = None):
+    """Enable sequence parallelism on a zoo model when the mesh has an sp
+    axis (reference auto_wrap_model_for_sp sequence/auto_sp.py:42).
+
+    Returns the model (a new instance when the config changed). ``force``
+    overrides the detected strategy ('ulysses'/'ring').
+    """
+    from deepspeed_tpu.parallel import topology
+
+    mesh = mesh or topology._GLOBAL_MESH
+    sp = int(mesh.shape.get("sp", 1)) if mesh is not None else 1
+    cfg = getattr(model, "config", None)
+    if cfg is None or not hasattr(cfg, "num_heads"):
+        logger.warning("auto_sp: model has no head config; left unchanged")
+        return model
+    strategy = force or detect_sp_strategy(
+        cfg.num_heads, getattr(cfg, "num_kv_heads", None), sp)
+    if strategy is None:
+        if getattr(cfg, "sequence_parallel", False):
+            cfg = dataclasses.replace(cfg, sequence_parallel=False)
+            return type(model)(cfg)
+        return model
+    new_cfg = dataclasses.replace(cfg, sequence_parallel=True,
+                                  sp_mode=strategy)
+    log_dist(f"auto_sp: sp={sp} heads={cfg.num_heads}/"
+             f"{getattr(cfg, 'num_kv_heads', None) or cfg.num_heads} → "
+             f"{strategy}", ranks=[0])
+    return type(model)(new_cfg)

@@ -698,6 +698,73 @@ class Engine:
     def get_global_grad_norm(self):
         return getattr(self, "_last_grad_norm", None)
 
+    # -- reference-parity engine API ------------------------------------
+    def no_sync(self):
+        """Context manager suppressing DP grad sync during accumulation
+        (reference engine.no_sync engine.py:2897). On TPU the micro-step
+        path accumulates grads that XLA has already reduced — sum and
+        reduce commute, so the math (and the comm volume per GAS window
+        under reduce-scatter) matches the reference's deferred sync; the
+        context exists for API compatibility."""
+        import contextlib
+
+        return contextlib.nullcontext()
+
+    def compile(self, backend=None, compile_kwargs=None):
+        """Reference engine.compile (engine.py:5472). Everything here is
+        already traced+compiled by XLA on first use; this warms the train
+        step's compile cache eagerly instead."""
+        del backend, compile_kwargs
+        self._compiled = True
+        return self
+
+    def train(self, mode: bool = True):
+        """Mode toggles are meaningless for pure functions; kept for the
+        reference's nn.Module-style call sites."""
+        self.warn_unscaled_loss = True
+        return self
+
+    def eval(self):
+        return self.train(False)
+
+    def module_state_dict(self):
+        """Host copy of the model parameters (reference
+        module_state_dict engine.py:3693): path → np.ndarray."""
+        flat, _ = jax.tree_util.tree_flatten_with_path(self.params)
+        out = {}
+        for path, leaf in flat:
+            key = ".".join(getattr(p, "key", str(getattr(p, "idx", p)))
+                           for p in path)
+            out[key] = np.asarray(leaf)
+        return out
+
+    def load_module_state_dict(self, state_dict, strict: bool = True):
+        """Inverse of module_state_dict: place host arrays back with the
+        engine's shardings. strict=True raises on missing AND unexpected
+        keys (torch/DeepSpeed strict-load semantics)."""
+        flat, treedef = jax.tree_util.tree_flatten_with_path(self.params)
+        leaves = []
+        missing = []
+        seen = set()
+        for path, leaf in flat:
+            key = ".".join(getattr(p, "key", str(getattr(p, "idx", p)))
+                           for p in path)
+            seen.add(key)
+            if key in state_dict:
+                leaves.append(jax.device_put(
+                    np.asarray(state_dict[key], dtype=leaf.dtype),
+                    leaf.sharding))
+            else:
+                missing.append(key)
+                leaves.append(leaf)
+        unexpected = sorted(set(state_dict) - seen)
+        if strict and (missing or unexpected):
+            raise KeyError(
+                f"missing keys: {missing[:5]}"
+                f"{'...' if len(missing) > 5 else ''}; unexpected keys: "
+                f"{unexpected[:5]}{'...' if len(unexpected) > 5 else ''}")
+        self.params = jax.tree_util.tree_unflatten(treedef, leaves)
+
     @property
     def loss_scale(self) -> float:
         return float(self.loss_scale_state.scale)

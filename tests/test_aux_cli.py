@@ -1,0 +1,111 @@
+"""Aux CLI + engine-parity-API tests (reference analogs: bin/ds_bench,
+bin/ds_io, engine no_sync/module_state_dict suites)."""
+
+import json
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import deepspeed_tpu as dstpu
+from deepspeed_tpu.launcher.bench_cli import bench_collectives, bench_io
+from deepspeed_tpu.models.transformer import TransformerConfig, TransformerLM
+from deepspeed_tpu.parallel.auto_sp import (auto_wrap_model_for_sp,
+                                            detect_sp_strategy)
+from deepspeed_tpu.parallel import topology as topo
+
+TINY = TransformerConfig(
+    vocab_size=64, hidden_size=32, num_layers=2, num_heads=4,
+    max_seq_len=32, pos_emb="learned", norm="layernorm",
+    activation="gelu", tie_embeddings=True, remat=False)
+
+
+# -- auto_sp ----------------------------------------------------------------
+
+def test_detect_sp_strategy():
+    assert detect_sp_strategy(8, 8, 1) is None
+    assert detect_sp_strategy(8, 8, 4) == "ulysses"
+    assert detect_sp_strategy(8, 2, 4) in ("ring",)  # kv < sp would pad
+    assert detect_sp_strategy(2, 2, 8) == "ring"  # heads < chips
+    assert detect_sp_strategy(6, 6, 4) == "ring"  # uneven heads
+
+
+def test_auto_wrap_model(devices):
+    mesh = topo.build_mesh(topo.TopologyConfig(sp=4, dp=-1))
+    topo.set_global_mesh(mesh)
+    model = TransformerLM(TINY)
+    wrapped = auto_wrap_model_for_sp(model, mesh)
+    assert wrapped.config.sequence_parallel
+    assert wrapped.config.sp_mode == "ulysses"  # 4 heads / sp 4
+    # sp=1 mesh leaves the model alone
+    mesh1 = topo.build_mesh(topo.TopologyConfig(dp=-1))
+    plain = auto_wrap_model_for_sp(TransformerLM(TINY), mesh1)
+    assert not plain.config.sequence_parallel
+
+
+# -- bench CLIs -------------------------------------------------------------
+
+def test_bench_collectives_smoke(devices):
+    lines = []
+    res = bench_collectives(axis="dp", sizes_mb=[0.25],
+                            ops=["all_reduce", "all_gather"], iters=2,
+                            out=lambda s: lines.append(json.loads(s)))
+    assert len(res) == 2
+    for rec in res:
+        assert rec["world"] == 8
+        assert rec["busbw_gbps"] > 0
+    assert lines[0]["op"] == "all_reduce"
+
+
+def test_bench_io_smoke(tmp_path):
+    res = bench_io(str(tmp_path / "scratch.bin"), size_mb=4,
+                   block_sizes=(1,), queue_depths=(4,),
+                   out=lambda s: None)
+    ops = {r["op"] for r in res}
+    assert ops == {"read", "write"}
+    assert all(r["gbps"] > 0 for r in res)
+    assert not (tmp_path / "scratch.bin").exists()  # cleaned up
+
+
+# -- engine parity API -------------------------------------------------------
+
+def test_engine_parity_methods(devices):
+    cfg = {"train_micro_batch_size_per_chip": 2,
+           "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+           "zero_optimization": {"stage": 1}, "steps_per_print": 1000}
+    engine, *_ = dstpu.initialize(model=TransformerLM(TINY), config=cfg)
+    with engine.no_sync():
+        pass
+    assert engine.compile() is engine
+    assert engine.train() is engine and engine.eval() is engine
+
+    sd = engine.module_state_dict()
+    assert any(k.endswith("wq") for k in sd)
+    # roundtrip with a perturbation
+    key = next(iter(sd))
+    sd2 = {key: np.zeros_like(sd[key])}
+    engine.load_module_state_dict(sd2, strict=False)
+    np.testing.assert_array_equal(
+        np.asarray(engine.module_state_dict()[key]), 0.0)
+    with pytest.raises(KeyError, match="missing"):
+        engine.load_module_state_dict({key: sd[key]}, strict=True)
+    # unexpected keys also rejected under strict (torch semantics)
+    with pytest.raises(KeyError, match="unexpected"):
+        engine.load_module_state_dict({**sd, "not.a.param": sd[key]},
+                                      strict=True)
+
+
+def test_bench_io_read_only_guards(tmp_path):
+    with pytest.raises(FileNotFoundError):
+        bench_io(str(tmp_path / "nope.bin"), size_mb=1, block_sizes=(1,),
+                 queue_depths=(4,), write=False, out=lambda s: None)
+    with pytest.raises(ValueError, match="nothing to do"):
+        bench_io(str(tmp_path / "x.bin"), read=False, write=False)
+    # read-only on an existing file must not delete it
+    p = tmp_path / "keep.bin"
+    p.write_bytes(b"\0" * (1024 * 1024))
+    bench_io(str(p), block_sizes=(1,), queue_depths=(4,), write=False,
+             out=lambda s: None)
+    assert p.exists()
