@@ -294,6 +294,31 @@ class FlopsProfilerConfig(ConfigModel):
 
 @register_config_model
 @dataclass
+class SparseAttentionConfig(ConfigModel):
+    """Reference: ``sparse_attention`` block (runtime/config.py:250-410):
+    dense | fixed | variable | bigbird | bslongformer modes. Maps onto the
+    Pallas block-sparse layouts (ops/pallas/blocksparse_attention.py)."""
+
+    mode: str = "fixed"
+    block: int = 128
+    num_local_blocks: int = 4
+    num_global_blocks: int = 1
+    num_random_blocks: int = 1
+    num_sliding_window_blocks: int = 3
+    local_window_blocks: Any = field(default_factory=lambda: [4])
+    global_block_indices: Any = field(default_factory=lambda: [0])
+    attention: str = "unidirectional"  # unidirectional (causal) | bidirectional
+
+    def validate(self) -> None:
+        if self.mode not in ("dense", "fixed", "variable", "bigbird",
+                             "bslongformer"):
+            raise ValueError(
+                f"sparse_attention.mode must be dense|fixed|variable|"
+                f"bigbird|bslongformer, got {self.mode!r}")
+
+
+@register_config_model
+@dataclass
 class CheckpointConfig(ConfigModel):
     """Reference: checkpoint block (runtime/config.py:439-471)."""
 
@@ -377,6 +402,7 @@ class Config(ConfigModel):
     comms_logger: CommsLoggerConfig = field(default_factory=CommsLoggerConfig)
     monitor: MonitorConfig = field(default_factory=MonitorConfig)
     flops_profiler: FlopsProfilerConfig = field(default_factory=FlopsProfilerConfig)
+    sparse_attention: Optional[SparseAttentionConfig] = None
     checkpoint: CheckpointConfig = field(default_factory=CheckpointConfig)
     compile: CompileConfig = field(default_factory=CompileConfig)
     data_efficiency: DataEfficiencyConfig = field(default_factory=DataEfficiencyConfig)
@@ -398,6 +424,7 @@ class Config(ConfigModel):
             "comms_logger": CommsLoggerConfig, "flops_profiler": FlopsProfilerConfig,
             "checkpoint": CheckpointConfig, "compile": CompileConfig,
             "data_efficiency": DataEfficiencyConfig,
+            "sparse_attention": SparseAttentionConfig,
         }
         for name, klass in defaultable.items():
             if getattr(self, name) is None:

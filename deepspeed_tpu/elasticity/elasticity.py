@@ -167,3 +167,33 @@ def compute_elastic_config(ds_config: Dict[str, Any],
         return best_batch, best_counts, None
     return best_batch, best_counts, ElasticityConfig.from_dict(
         ds_config["elasticity"])
+
+
+def main(argv=None):
+    """``dstpu-elastic`` CLI (reference bin/ds_elastic): print the elastic
+    batch plan for a config file."""
+    import argparse
+    import json
+
+    ap = argparse.ArgumentParser(
+        prog="dstpu-elastic",
+        description="show the elastic batch size and compatible chip "
+                    "counts for a deepspeed config")
+    ap.add_argument("config", help="ds_config JSON path")
+    ap.add_argument("--chips", type=int, default=None,
+                    help="planned deployment size (validates + picks micro)")
+    args = ap.parse_args(argv)
+    with open(args.config) as f:
+        cfg = json.load(f)
+    if args.chips is not None:
+        batch, counts, micro = compute_elastic_config(
+            cfg, target_deployment_size=args.chips, return_microbatch=True)
+        print(json.dumps({"train_batch_size": batch,
+                          "valid_dp_extents": counts,
+                          "micro_batch_per_chip": micro,
+                          "deployment_chips": args.chips}))
+    else:
+        batch, counts, _ = compute_elastic_config(cfg)
+        print(json.dumps({"train_batch_size": batch,
+                          "valid_dp_extents": counts}))
+    return 0

@@ -53,14 +53,19 @@ def bench_collectives(axis: str = "dp", sizes_mb: List[float] = (1, 4, 16, 64),
     from deepspeed_tpu import comm
     from deepspeed_tpu.parallel import topology as topo
 
-    mesh = topo._GLOBAL_MESH or topo.build_mesh(
-        topo.TopologyConfig(**{axis: -1}))
+    sizes = {axis: -1}
+    if axis != "dp":
+        sizes["dp"] = 1  # TopologyConfig defaults dp=-1; only one free axis
+    mesh = topo._GLOBAL_MESH or topo.build_mesh(topo.TopologyConfig(**sizes))
     world = mesh.shape[axis]
     results = []
     for op in ops:
         for mb in sizes_mb:
             n = int(mb * 1e6 / 4)
-            n = max(world, (n // (world * 128)) * world * 128)  # divisible
+            # per-shard count (n/world) must itself divide by world for the
+            # all_to_all reshape; round to a world*world multiple
+            unit = world * world
+            n = max(unit, (n // unit) * unit)
             x = jnp.ones((n,), jnp.float32)
 
             def body(x):
@@ -130,13 +135,18 @@ def bench_io(path: str, size_mb: int = 256, block_sizes=(1, 8, 16),
     if read and not write and not os.path.exists(path):
         raise FileNotFoundError(
             f"read-only sweep needs an existing file at {path}")
+    if write and os.path.exists(path):
+        raise FileExistsError(
+            f"refusing to overwrite existing file {path} — the write sweep "
+            "clobbers and deletes its scratch file; pass a fresh path")
     os.makedirs(os.path.dirname(os.path.abspath(path)) or ".", exist_ok=True)
-    data = np.random.default_rng(0).integers(
-        0, 255, size_mb * 1024 * 1024, dtype=np.uint8)
     if read and not write:
         # size from the user's file; never delete it
         data = np.empty(os.path.getsize(path), dtype=np.uint8)
         size_mb = data.nbytes // (1024 * 1024)
+    else:
+        data = np.random.default_rng(0).integers(
+            0, 255, size_mb * 1024 * 1024, dtype=np.uint8)
     results = []
     for bs_mult in block_sizes:
         for qd in queue_depths:

@@ -61,10 +61,34 @@ def xla_attention(q, k, v, causal: bool = True,
 FLASH_MIN_SEQ = 4096
 
 
+# engine-configured block-sparse layout (config.sparse_attention →
+# set_sparse_config at engine init); used when impl == "blocksparse"
+_SPARSE_CONFIG = None
+
+
+def set_sparse_config(sparsity) -> None:
+    """Install the layout for impl='blocksparse' (engine wires the
+    ds_config sparse_attention block here)."""
+    global _SPARSE_CONFIG
+    _SPARSE_CONFIG = sparsity
+
+
 def multi_head_attention(q, k, v, causal: bool = True, impl: str = "auto",
                          segment_ids: Optional[jax.Array] = None) -> jax.Array:
     """Dispatching entry point used by the model zoo."""
     seq = q.shape[1]
+    if impl == "blocksparse":
+        if _SPARSE_CONFIG is None:
+            raise ValueError(
+                "attn_impl='blocksparse' needs a sparse_attention config "
+                "block (or ops.attention.set_sparse_config)")
+        if segment_ids is not None:
+            raise NotImplementedError(
+                "blocksparse attention does not take segment_ids")
+        from deepspeed_tpu.ops.pallas.blocksparse_attention import \
+            blocksparse_attention
+
+        return blocksparse_attention(q, k, v, _SPARSE_CONFIG, causal=causal)
     want_flash = (
         impl == "flash"
         or (impl == "auto" and _flash_available() and seq >= FLASH_MIN_SEQ

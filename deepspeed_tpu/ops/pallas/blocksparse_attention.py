@@ -177,6 +177,31 @@ def make_sparsity_config(mode: str, **kwargs) -> SparsityConfig:
     return MODES[mode](**kwargs)
 
 
+def from_config(cfg) -> SparsityConfig:
+    """Build a layout from the engine's ``sparse_attention`` config block
+    (config.SparseAttentionConfig; 'bslongformer' is the reference's name
+    for the longformer mode)."""
+    mode = cfg.mode
+    if mode == "dense":
+        return DenseSparsityConfig(cfg.block)
+    if mode == "fixed":
+        return FixedSparsityConfig(cfg.block, cfg.num_local_blocks,
+                                   cfg.num_global_blocks)
+    if mode == "bslongformer":
+        return LongformerSparsityConfig(cfg.block,
+                                        cfg.num_sliding_window_blocks,
+                                        cfg.num_global_blocks)
+    if mode == "bigbird":
+        return BigBirdSparsityConfig(cfg.block, cfg.num_random_blocks,
+                                     cfg.num_sliding_window_blocks,
+                                     cfg.num_global_blocks)
+    if mode == "variable":
+        return VariableSparsityConfig(cfg.block,
+                                      cfg.local_window_blocks,
+                                      cfg.global_block_indices)
+    raise ValueError(f"unknown sparse attention mode '{mode}'")
+
+
 # ---------------------------------------------------------------------------
 # attention
 # ---------------------------------------------------------------------------
@@ -197,7 +222,10 @@ def blocksparse_attention(q, k, v, sparsity: SparsityConfig,
     path is used off-TPU. Causal composes with any layout.
     """
     B, S, N, D = q.shape
-    layout = sparsity.make_layout(S)
+    # layout from the block-padded length; the expanded mask trims back to
+    # S (ragged tails just use a partially-filled last block)
+    padded = int(np.ceil(S / sparsity.block)) * sparsity.block
+    layout = sparsity.make_layout(padded)
     scale = scale if scale is not None else D ** -0.5
 
     mask = jnp.asarray(_expand_mask(layout, sparsity.block, S, S))

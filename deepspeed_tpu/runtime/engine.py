@@ -140,6 +140,20 @@ class Engine:
         from deepspeed_tpu.runtime import activation_checkpointing as act_ckpt
 
         act_ckpt.configure(config.activation_checkpointing)
+        if config.sparse_attention is not None:
+            from deepspeed_tpu.ops import attention as attn_ops
+            from deepspeed_tpu.ops.pallas.blocksparse_attention import \
+                from_config as sparse_from_config
+
+            attn_ops.set_sparse_config(
+                sparse_from_config(config.sparse_attention))
+            if getattr(getattr(model, "config", None), "attn_impl",
+                       None) != "blocksparse":
+                logger.warning(
+                    "sparse_attention configured but the model's "
+                    "attn_impl is not 'blocksparse' — dense attention "
+                    "will run; set attn_impl='blocksparse' on the model "
+                    "config to activate the layout")
 
         self.micro_batch_size = config.train_micro_batch_size_per_chip
         self.gradient_accumulation_steps = config.gradient_accumulation_steps
@@ -721,7 +735,7 @@ class Engine:
     def train(self, mode: bool = True):
         """Mode toggles are meaningless for pure functions; kept for the
         reference's nn.Module-style call sites."""
-        self.warn_unscaled_loss = True
+        del mode
         return self
 
     def eval(self):

@@ -97,6 +97,45 @@ def test_engine_parity_methods(devices):
                                       strict=True)
 
 
+def test_bench_io_write_refuses_existing(tmp_path):
+    p = tmp_path / "precious.bin"
+    p.write_bytes(b"data")
+    with pytest.raises(FileExistsError, match="refusing"):
+        bench_io(str(p), size_mb=1, block_sizes=(1,), queue_depths=(4,),
+                 out=lambda s: None)
+    assert p.read_bytes() == b"data"
+
+
+def test_sparse_attention_config_wires_into_attention(devices):
+    """ds_config sparse_attention + model attn_impl='blocksparse' runs the
+    block-sparse path end-to-end through the engine."""
+    from deepspeed_tpu.ops import attention as attn_ops
+
+    tiny = TransformerConfig(
+        vocab_size=64, hidden_size=32, num_layers=2, num_heads=4,
+        max_seq_len=32, pos_emb="learned", norm="layernorm",
+        activation="gelu", tie_embeddings=True, remat=False,
+        attn_impl="blocksparse")
+    cfg = {"train_micro_batch_size_per_chip": 2,
+           "optimizer": {"type": "adamw", "params": {"lr": 1e-2}},
+           "sparse_attention": {"mode": "fixed", "block": 8,
+                                "num_local_blocks": 2},
+           "steps_per_print": 1000}
+    engine, *_ = dstpu.initialize(model=TransformerLM(tiny), config=cfg)
+    assert attn_ops._SPARSE_CONFIG is not None
+    gb = engine.micro_batch_size * engine.dp_world_size
+    rng = np.random.default_rng(0)
+    fixed = {"input_ids": rng.integers(0, 64, (gb, 16)).astype(np.int32)}
+
+    def it():
+        while True:
+            yield fixed
+
+    losses = [float(engine.train_batch(it())) for _ in range(4)]
+    assert losses[-1] < losses[0]
+    attn_ops.set_sparse_config(None)
+
+
 def test_bench_io_read_only_guards(tmp_path):
     with pytest.raises(FileNotFoundError):
         bench_io(str(tmp_path / "nope.bin"), size_mb=1, block_sizes=(1,),

@@ -52,6 +52,24 @@ def test_longformer_structure():
     assert not lay[4, 6]  # outside window
 
 
+def test_from_engine_config_block():
+    from deepspeed_tpu.config.config import Config
+    from deepspeed_tpu.ops.pallas.blocksparse_attention import from_config
+
+    c = Config.from_dict({"sparse_attention": {
+        "mode": "bslongformer", "block": 16,
+        "num_sliding_window_blocks": 5, "num_global_blocks": 2}})
+    cfg = from_config(c.sparse_attention)
+    assert isinstance(cfg, LongformerSparsityConfig)
+    assert cfg.num_sliding_window_blocks == 5
+    lay = cfg.make_layout(8 * 16)
+    assert lay[:, :2].all()  # two global columns
+    import pytest as _pytest
+
+    with _pytest.raises(ValueError, match="sparse_attention.mode"):
+        Config.from_dict({"sparse_attention": {"mode": "zzz"}})
+
+
 def test_density_decreases():
     dense = layout_density(DenseSparsityConfig(BLOCK).make_layout(256))
     lf = layout_density(

@@ -84,6 +84,19 @@ def test_valid_batch_table():
             assert any(batch % (mb * w) == 0 for mb in (2, 4))
 
 
+def test_cli_main(tmp_path, capsys):
+    import json
+
+    from deepspeed_tpu.elasticity.elasticity import main
+
+    p = tmp_path / "ds_config.json"
+    p.write_text(json.dumps(BASE))
+    assert main([str(p), "--chips", "8"]) == 0
+    out = json.loads(capsys.readouterr().out)
+    assert out["deployment_chips"] == 8
+    assert out["train_batch_size"] % (out["micro_batch_per_chip"] * 8) == 0
+
+
 def test_config_aliases():
     e = ElasticityConfig.from_dict({"enabled": True, "min_gpus": 3,
                                     "max_gpus": 9})
