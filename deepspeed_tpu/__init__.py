@@ -37,6 +37,32 @@ def init_inference(*args, **kwargs):
     return _init_inference(*args, **kwargs)
 
 
+def tp_model_init(*args, **kwargs):
+    """Shard a parameter tree for tensor parallelism (reference
+    deepspeed.tp_model_init __init__.py:408)."""
+    from deepspeed_tpu.module_inject.auto_tp import \
+        tp_model_init as _tp_model_init
+
+    return _tp_model_init(*args, **kwargs)
+
+
+def ep_model_init(*args, **kwargs):
+    """Restack + shard an HF MoE tree for expert parallelism (reference
+    AutoEP module_inject/auto_ep.py:273)."""
+    from deepspeed_tpu.module_inject.auto_ep import \
+        ep_model_init as _ep_model_init
+
+    return _ep_model_init(*args, **kwargs)
+
+
+def init_compression(*args, **kwargs):
+    """Build compression state from a config (reference
+    deepspeed.compression.compress.init_compression)."""
+    from deepspeed_tpu.compression import init_compression as _init
+
+    return _init(*args, **kwargs)
+
+
 def add_config_arguments(parser):
     """Augment an argparse parser with --deepspeed flags (reference
     __init__.py:305)."""

@@ -74,6 +74,8 @@ class CheckpointIO:
             state["opt_inner"] = e.opt_state.inner
         if getattr(e, "_onebit_state", None) is not None:
             state["onebit"] = e._onebit_state
+        if getattr(e, "_zeropp_state", None) is not None:
+            state["zeropp"] = e._zeropp_state
         return state
 
     def _abstract_state(self) -> Dict[str, Any]:
@@ -265,6 +267,9 @@ class CheckpointIO:
                                          abstract)
 
         e.params = restored["params"]
+        if getattr(e, "_zeropp_state", None) is not None and \
+                "zeropp" in restored:
+            e._zeropp_state = restored["zeropp"]
         if getattr(e, "_onebit_state", None) is not None and "onebit" in restored:
             e._onebit_state = restored["onebit"]
         if getattr(e, "_offload", None) is not None:

@@ -315,6 +315,10 @@ class SparseAttentionConfig(ConfigModel):
             raise ValueError(
                 f"sparse_attention.mode must be dense|fixed|variable|"
                 f"bigbird|bslongformer, got {self.mode!r}")
+        if self.attention not in ("unidirectional", "bidirectional"):
+            raise ValueError(
+                f"sparse_attention.attention must be unidirectional|"
+                f"bidirectional, got {self.attention!r}")
 
 
 @register_config_model
@@ -424,8 +428,9 @@ class Config(ConfigModel):
             "comms_logger": CommsLoggerConfig, "flops_profiler": FlopsProfilerConfig,
             "checkpoint": CheckpointConfig, "compile": CompileConfig,
             "data_efficiency": DataEfficiencyConfig,
-            "sparse_attention": SparseAttentionConfig,
         }
+        # sparse_attention stays None unless configured (Optional block:
+        # "not present" must be distinguishable from "defaults")
         for name, klass in defaultable.items():
             if getattr(self, name) is None:
                 setattr(self, name, klass())

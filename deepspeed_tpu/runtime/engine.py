@@ -140,8 +140,9 @@ class Engine:
         from deepspeed_tpu.runtime import activation_checkpointing as act_ckpt
 
         act_ckpt.configure(config.activation_checkpointing)
+        from deepspeed_tpu.ops import attention as attn_ops
+
         if config.sparse_attention is not None:
-            from deepspeed_tpu.ops import attention as attn_ops
             from deepspeed_tpu.ops.pallas.blocksparse_attention import \
                 from_config as sparse_from_config
 
@@ -154,6 +155,15 @@ class Engine:
                     "attn_impl is not 'blocksparse' — dense attention "
                     "will run; set attn_impl='blocksparse' on the model "
                     "config to activate the layout")
+            if config.sparse_attention.attention == "bidirectional":
+                logger.warning(
+                    "sparse_attention.attention='bidirectional': "
+                    "causality comes from the model (the LM stack is "
+                    "causal); the layout is applied either way")
+        else:
+            # a previous engine in this process may have installed a
+            # layout into the process-global dispatcher — clear it
+            attn_ops.set_sparse_config(None)
 
         self.micro_batch_size = config.train_micro_batch_size_per_chip
         self.gradient_accumulation_steps = config.gradient_accumulation_steps
@@ -199,6 +209,20 @@ class Engine:
         self._offload_device = (off_cfg.device if off_cfg is not None
                                 else "none") or "none"
         self._offload = None  # built in _build_state when enabled
+
+        # -- ZeRO++ quantized-collective step (runtime/zeropp.py) ---------
+        from deepspeed_tpu.runtime.zeropp import zeropp_enabled
+
+        self._zeropp = (zeropp_enabled(config) and not self._onebit
+                        and self._offload_device == "none")
+        self._zeropp_state = None
+        zq = config.zero_optimization
+        if (zq.zero_quantized_weights or zq.zero_quantized_gradients) \
+                and not self._zeropp:
+            logger.warning(
+                "ZeRO++ flags (qwZ/qgZ) are only wired for stages 1-2 "
+                "without optimizer offload / 1-bit optimizers — the "
+                "quantized-collective step is disabled for this config")
 
         # -- state init (sharded; zero.Init analog is in abstract init) ---
         self._rng = jax.random.PRNGKey(seed if seed is not None else config.seed)
@@ -311,6 +335,36 @@ class Engine:
                                             step=rep))
             with jax.set_mesh(mesh) if hasattr(jax, "set_mesh") else _nullctx():
                 self.params, self._onebit_state = jax.jit(
+                    init_fn, out_shardings=out_sh)(self._rng)
+            self.opt_state = None
+        elif self._zeropp:
+            # ZeRO++ quantized-collective step: fp32 masters live as
+            # [dp, shard] arrays (the ZeRO-1/2 partition), params
+            # replicated in compute dtype
+            from deepspeed_tpu.runtime.zeropp import (ZeroppState,
+                                                      build_zeropp_step)
+
+            ocfg_params = dict((self.config.optimizer.params or {})
+                               if self.config.optimizer else {})
+            z = self.config.zero_optimization
+            init_fn, step_fn = build_zeropp_step(
+                self.model, mesh, self.gradient_accumulation_steps,
+                base_lr=self._config_lr(), lr_schedule=self.lr_schedule,
+                betas=tuple(ocfg_params.get("betas", (0.9, 0.999))),
+                eps=float(ocfg_params.get("eps", 1e-8)),
+                weight_decay=float(ocfg_params.get("weight_decay", 0.01)),
+                grad_clip=self.config.gradient_clipping,
+                qg_enabled=z.zero_quantized_gradients, qg_bits=8,
+                qw_enabled=z.zero_quantized_weights, qw_bits=8,
+                compute_dtype=cdt, param_shardings=param_sh)
+            self._zeropp_step_fn = step_fn
+            rep = NamedSharding(mesh, P())
+            sh = NamedSharding(mesh, P("dp"))
+            master_sh = jax.tree.map(lambda _: sh, param_sh)
+            out_sh = (param_sh, ZeroppState(master=master_sh, m=master_sh,
+                                            v=master_sh, step=rep))
+            with jax.set_mesh(mesh) if hasattr(jax, "set_mesh") else _nullctx():
+                self.params, self._zeropp_state = jax.jit(
                     init_fn, out_shardings=out_sh)(self._rng)
             self.opt_state = None
         elif self._offload_device in ("cpu", "nvme"):
@@ -459,6 +513,9 @@ class Engine:
         if self._onebit:
             self._jit_onebit = jax.jit(self._onebit_step_fn,
                                        donate_argnums=(0, 1))
+        if self._zeropp:
+            self._jit_zeropp = jax.jit(self._zeropp_step_fn,
+                                       donate_argnums=(0, 1))
         # offload resharding hops: host-updated (optimizer-sharded) tree →
         # param sharding = the "allgather updated partitions" collective,
         # compiled by XLA over ICI; and grad-acc → optimizer sharding.
@@ -519,6 +576,10 @@ class Engine:
             self.params, self._onebit_state, metrics = self._jit_onebit(
                 self.params, self._onebit_state, batches)
             self.step_count = self._onebit_state.step
+        elif self._zeropp:
+            self.params, self._zeropp_state, metrics = self._jit_zeropp(
+                self.params, self._zeropp_state, batches)
+            self.step_count = self._zeropp_state.step
         elif self._offload is not None:
             scale = (self.loss_scale_state.scale if self.config.fp16.enabled
                      else jnp.asarray(1.0, jnp.float32))
@@ -535,11 +596,11 @@ class Engine:
 
     def forward(self, batch, *args, **kwargs):
         """Micro-step path: compute loss (grads cached for backward)."""
-        if self._onebit:
+        if self._onebit or self._zeropp:
             raise RuntimeError(
-                "1-bit optimizers support the fused train_batch() path "
-                "only (the compressed allreduce lives inside the compiled "
-                "step); use engine.train_batch(data_iter)")
+                "1-bit/ZeRO++ quantized optimizers support the fused "
+                "train_batch() path only (the compressed collective lives "
+                "inside the compiled step); use engine.train_batch(...)")
         self.timers(FORWARD_GLOBAL_TIMER).start()
         batch = self.shard_batch(batch)
         scale = (self.loss_scale_state.scale if self.config.fp16.enabled

@@ -1,0 +1,197 @@
+"""ZeRO++ train step: quantized gradient reduce (qgZ) + quantized weight
+all-gather (qwZ).
+
+Reference: ZeRO++ (docs/_tutorials/zeropp.md — "4x less communication"):
+``all_to_all_quant_reduce`` (runtime/comm/coalesced_collectives.py:31,
+int8 two-level gradient reduce) and quantized weight all-gather
+(``_allgather_params`` with quantizer kernels, csrc/quantization/). The
+engine flags are ``zero_optimization.zero_quantized_gradients`` and
+``zero_quantized_weights``.
+
+TPU-native expression (same pattern as the 1-bit optimizers,
+runtime/onebit.py): GSPMD's automatically inserted collectives cannot be
+quantized, so the train step runs inside a ``jax.shard_map`` MANUAL over
+the dp axis. Per step and per parameter:
+
+  local grads → blockwise-int8 quantize → all-to-all → local dequant+sum
+  (= the qgZ reduce-scatter, ops/pallas/quantization.quantized_psum_scatter)
+  → Adam on this rank's fp32 master shard (the ZeRO-1/2 partition)
+  → [int8-quantized] all-gather of the updated shards back to params.
+
+Gradient-sync wire volume drops 4x (bf16→int8 both directions) — the
+reference's headline — at the cost of quantization noise bounded by the
+blockwise scales.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from deepspeed_tpu.utils.logging import log_dist
+
+QUANT_BLOCK = 256
+
+
+class ZeroppState(NamedTuple):
+    master: Any  # dict leaf-path → [dp, shard] fp32 (P('dp') on dim 0)
+    m: Any
+    v: Any
+    step: jax.Array
+
+
+def _pad_len(n: int, dp: int) -> int:
+    unit = dp * QUANT_BLOCK
+    return int(np.ceil(n / unit)) * unit
+
+
+def build_zeropp_step(model, mesh, gas: int, base_lr: float,
+                      lr_schedule: Optional[Callable], betas, eps: float,
+                      weight_decay: float, grad_clip: float,
+                      qg_enabled: bool, qg_bits: int, qw_enabled: bool,
+                      qw_bits: int, compute_dtype, param_shardings):
+    """Returns (init_fn(rng) → (params, state), jit step_fn)."""
+    from deepspeed_tpu.ops.pallas.quantization import (
+        quantized_all_gather, quantized_psum_scatter)
+
+    dp = mesh.shape["dp"] * mesh.shape.get("fsdp", 1)
+    if mesh.shape.get("fsdp", 1) > 1:
+        raise ValueError("ZeRO++ quantized step shards over 'dp'; use a "
+                         "dp-only data topology (fsdp=1)")
+    b1, b2 = betas
+
+    # shapes fixed at build: trace the model's abstract params
+    abstract = jax.eval_shape(model.init, jax.random.PRNGKey(0))
+    leaves, treedef = jax.tree_util.tree_flatten(abstract)
+    shapes = [x.shape for x in leaves]
+    sizes = [int(np.prod(s)) for s in shapes]
+    pads = [_pad_len(n, dp) for n in sizes]
+
+    def _flat_pad(g, n, n_pad):
+        flat = g.reshape(-1).astype(jnp.float32)
+        return jnp.pad(flat, (0, n_pad - n))
+
+    # -- init ------------------------------------------------------------
+    def init_fn(rng):
+        p32 = model.init(rng)
+        flat = [
+
+            _flat_pad(x, n, n_pad).reshape(dp, n_pad // dp)
+            for x, n, n_pad in zip(jax.tree.leaves(p32), sizes, pads)
+        ]
+        master = jax.tree.unflatten(treedef, flat)
+        zeros = jax.tree.map(jnp.zeros_like, master)
+        params = jax.tree.map(lambda x: x.astype(compute_dtype), p32)
+        return params, ZeroppState(master=master, m=zeros,
+                                   v=jax.tree.map(jnp.zeros_like, zeros),
+                                   step=jnp.zeros((), jnp.int32))
+
+    # -- manual region ---------------------------------------------------
+    def local_step(params, master, m, v, step, batches):
+        from deepspeed_tpu.runtime import sharding as shard_lib
+
+        with shard_lib.disable_constraints():
+            return _local_step_inner(params, master, m, v, step, batches)
+
+    def _local_step_inner(params, master, m, v, step, batches):
+        def total_loss(p):
+            def body(carry, mb):
+                loss, _aux = model.loss(p, mb)
+                return carry + loss / gas, loss
+
+            total, losses = lax.scan(body, jnp.asarray(0.0, jnp.float32),
+                                     batches)
+            return total, losses
+
+        (_, losses), grads = jax.value_and_grad(
+            total_loss, has_aux=True)(params)
+
+        # qgZ: quantized reduce-scatter per leaf → this rank's grad shard.
+        # The collective quantizes the last dim and scatters dim 0, so the
+        # flat vector goes in as [rows, QUANT_BLOCK] (rows divisible by dp
+        # by construction of _pad_len).
+        g_shards = []
+        for g, n, n_pad in zip(jax.tree.leaves(grads), sizes, pads):
+            flat = _flat_pad(g, n, n_pad).reshape(-1, QUANT_BLOCK)
+            if qg_enabled:
+                red = quantized_psum_scatter(flat, "dp", bits=qg_bits,
+                                             block=QUANT_BLOCK)
+            else:  # qwZ-only config: exact (unquantized) grad reduce
+                red = lax.psum_scatter(flat, "dp", scatter_dimension=0,
+                                       tiled=True) / lax.axis_size("dp")
+            g_shards.append(red.reshape(-1))
+
+        sq = sum(jnp.sum(gs.astype(jnp.float32) ** 2) for gs in g_shards)
+        gnorm = jnp.sqrt(lax.psum(sq, "dp"))
+        scale = (jnp.minimum(1.0, grad_clip / (gnorm + 1e-6))
+                 if grad_clip and grad_clip > 0 else jnp.asarray(1.0))
+
+        step = step + 1
+        lr = (lr_schedule(step) if lr_schedule is not None
+              else jnp.asarray(base_lr, jnp.float32))
+        master_l = jax.tree.leaves(master)
+        m_l = jax.tree.leaves(m)
+        v_l = jax.tree.leaves(v)
+        new_master, new_m, new_v, new_params = [], [], [], []
+        for i, gs in enumerate(g_shards):
+            g_ = gs.astype(jnp.float32) * scale
+            mm = master_l[i][0]  # local [shard]
+            mi = b1 * m_l[i][0] + (1 - b1) * g_
+            vi = b2 * v_l[i][0] + (1 - b2) * g_ * g_
+            mhat = mi / (1 - b1 ** step.astype(jnp.float32))
+            vhat = vi / (1 - b2 ** step.astype(jnp.float32))
+            upd = lr * (mhat / (jnp.sqrt(vhat) + eps)
+                        + weight_decay * mm)
+            mm = mm - upd
+            # qwZ: the "allgather updated partitions" collective, int8
+            if qw_enabled:
+                full = quantized_all_gather(
+                    mm.reshape(-1, QUANT_BLOCK), "dp", bits=qw_bits,
+                    block=QUANT_BLOCK).reshape(-1)
+            else:
+                full = lax.all_gather(mm, "dp", axis=0, tiled=True)
+            new_params.append(full[: sizes[i]].reshape(shapes[i])
+                              .astype(compute_dtype))
+            new_master.append(mm[None])
+            new_m.append(mi[None])
+            new_v.append(vi[None])
+        loss_avg = lax.pmean(jnp.mean(losses), "dp")
+        unf = lambda ls: jax.tree.unflatten(treedef, ls)
+        return (unf(new_params), unf(new_master), unf(new_m), unf(new_v),
+                step, loss_avg, gnorm, lr)
+
+    batch_spec = P(None, ("dp", "fsdp", "ep"))
+    rep = P()
+    shard_spec = P("dp")
+
+    mapped = jax.shard_map(
+        local_step, mesh=mesh,
+        in_specs=(rep, shard_spec, shard_spec, shard_spec, rep, batch_spec),
+        out_specs=(rep, shard_spec, shard_spec, shard_spec, rep, rep, rep,
+                   rep),
+        check_vma=False)
+
+    def step_fn(params, state: ZeroppState, batches):
+        (new_p, master, m, v, step, loss, gnorm, lr) = mapped(
+            params, state.master, state.m, state.v, state.step, batches)
+        metrics = {"loss": loss, "grad_norm": gnorm, "lr": lr,
+                   "overflow": jnp.asarray(False)}
+        return new_p, ZeroppState(master, m, v, step), metrics
+
+    log_dist(
+        f"ZeRO++ step: dp={dp}, "
+        + (f"qgZ=int{qg_bits}" if qg_enabled else "qgZ=off")
+        + (f", qwZ=int{qw_bits}" if qw_enabled else ", qwZ=off"),
+        ranks=[0])
+    return init_fn, step_fn
+
+
+def zeropp_enabled(config) -> bool:
+    z = config.zero_optimization
+    return (z.stage in (1, 2)
+            and (z.zero_quantized_gradients or z.zero_quantized_weights))
