@@ -267,9 +267,25 @@ class CheckpointIO:
                                          abstract)
 
         e.params = restored["params"]
-        if getattr(e, "_zeropp_state", None) is not None and \
-                "zeropp" in restored:
-            e._zeropp_state = restored["zeropp"]
+        if getattr(e, "_zeropp_state", None) is not None:
+            if load_optimizer_states and "zeropp" in restored:
+                e._zeropp_state = restored["zeropp"]
+            else:
+                # no optimizer state requested/present: re-seed the fp32
+                # masters from the restored params or the next step's
+                # all-gather would roll the model back to init (same
+                # hazard as the offload reinit_masters path below)
+                from deepspeed_tpu.runtime.zeropp import \
+                    reseed_state_from_params
+
+                logger.warning(
+                    "ZeRO++ state not restored: masters re-seeded from "
+                    "params, moments reset")
+                new = reseed_state_from_params(
+                    e.params, e._zeropp_state, e.mesh.shape["dp"])
+                e._zeropp_state = jax.tree.map(
+                    lambda x, old: jax.device_put(x, old.sharding),
+                    new, e._zeropp_state)
         if getattr(e, "_onebit_state", None) is not None and "onebit" in restored:
             e._onebit_state = restored["onebit"]
         if getattr(e, "_offload", None) is not None:

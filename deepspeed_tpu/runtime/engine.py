@@ -211,17 +211,16 @@ class Engine:
         self._offload = None  # built in _build_state when enabled
 
         # -- ZeRO++ quantized-collective step (runtime/zeropp.py) ---------
-        from deepspeed_tpu.runtime.zeropp import zeropp_enabled
-
-        self._zeropp = (zeropp_enabled(config) and not self._onebit
-                        and self._offload_device == "none")
+        self._zeropp = (self._zeropp_applicable(config)
+                        and not self._onebit and client_optimizer is None)
         self._zeropp_state = None
         zq = config.zero_optimization
         if (zq.zero_quantized_weights or zq.zero_quantized_gradients) \
                 and not self._zeropp:
             logger.warning(
                 "ZeRO++ flags (qwZ/qgZ) are only wired for stages 1-2 "
-                "without optimizer offload / 1-bit optimizers — the "
+                "with an adam/adamw optimizer, bf16, no optimizer "
+                "offload, no MoE, and no 1-bit optimizer — the "
                 "quantized-collective step is disabled for this config")
 
         # -- state init (sharded; zero.Init analog is in abstract init) ---
@@ -287,6 +286,21 @@ class Engine:
             return self.config.optimizer.params["lr"]
         return 1e-3
 
+    @staticmethod
+    def _zeropp_applicable(config) -> bool:
+        """ZeRO++ step preconditions that depend only on the config (the
+        1-bit exclusion is checked at the call sites)."""
+        from deepspeed_tpu.runtime.zeropp import zeropp_enabled
+
+        off = config.zero_optimization.offload_optimizer
+        offdev = (off.device if off is not None else "none") or "none"
+        opt = ((config.optimizer.type if config.optimizer else "")
+               or "adamw").lower().replace("_", "").replace("-", "")
+        return (zeropp_enabled(config) and offdev == "none"
+                and not config.fp16.enabled
+                and not config.moe.enabled
+                and opt in ("adam", "adamw", "fusedadam", "fusedadamw"))
+
     def _default_mesh(self, topology) -> Mesh:
         if topology is not None:
             return topo.build_mesh(topology)
@@ -295,10 +309,19 @@ class Engine:
                      tp=cfg.tensor_parallel.size,
                      sp=cfg.sequence_parallel.size,
                      ep=cfg.moe.ep_size if cfg.moe.enabled else 1)
-        if cfg.zero_optimization.stage >= 1:
+        if self._zeropp_applicable(cfg):
+            # the quantized-collective step shards its masters over dp
+            sizes.update(dp=-1, fsdp=1)
+        elif cfg.zero_optimization.stage >= 1:
+            # hpZ and MiCS are the same construction: shard state within a
+            # group of `size` chips (ICI), replicate across groups (DCN) —
+            # fsdp=group, dp=replicas (reference mics.py / hpZ
+            # partition_parameters.py:1806)
             hpz = cfg.zero_optimization.zero_hpz_partition_size
-            if hpz > 1:
-                sizes.update(fsdp=hpz, dp=-1)
+            mics = cfg.zero_optimization.mics_shard_size
+            group = hpz if hpz > 1 else (mics if mics > 0 else 0)
+            if group > 1:
+                sizes.update(fsdp=group, dp=-1)
             else:
                 sizes.update(fsdp=-1, dp=1)
         else:

@@ -59,10 +59,13 @@ def build_zeropp_step(model, mesh, gas: int, base_lr: float,
     from deepspeed_tpu.ops.pallas.quantization import (
         quantized_all_gather, quantized_psum_scatter)
 
-    dp = mesh.shape["dp"] * mesh.shape.get("fsdp", 1)
-    if mesh.shape.get("fsdp", 1) > 1:
-        raise ValueError("ZeRO++ quantized step shards over 'dp'; use a "
-                         "dp-only data topology (fsdp=1)")
+    for ax in ("fsdp", "tp", "sp", "ep", "pp"):
+        if mesh.shape.get(ax, 1) > 1:
+            raise ValueError(
+                f"ZeRO++ quantized step is manual over 'dp' only; mesh "
+                f"axis {ax}={mesh.shape[ax]} is unsupported (grads would "
+                "not reduce across it)")
+    dp = mesh.shape["dp"]
     b1, b2 = betas
 
     # shapes fixed at build: trace the model's abstract params
@@ -189,6 +192,26 @@ def build_zeropp_step(model, mesh, gas: int, base_lr: float,
         + (f", qwZ=int{qw_bits}" if qw_enabled else ", qwZ=off"),
         ranks=[0])
     return init_fn, step_fn
+
+
+def reseed_state_from_params(params, state: ZeroppState, dp: int
+                             ) -> ZeroppState:
+    """Rebuild fp32 masters (zeroed moments) from restored params — the
+    recovery path when a checkpoint lacks (or skips) optimizer state, so
+    the next step's all-gather doesn't roll the model back to init
+    (mirrors the offload reinit_masters hazard guard)."""
+    leaves, treedef = jax.tree_util.tree_flatten(params)
+    flat = []
+    for x in leaves:
+        n = int(np.prod(x.shape))
+        n_pad = _pad_len(n, dp)
+        f = jnp.pad(x.reshape(-1).astype(jnp.float32), (0, n_pad - n))
+        flat.append(f.reshape(dp, n_pad // dp))
+    master = jax.tree_util.tree_unflatten(treedef, flat)
+    zeros = jax.tree.map(jnp.zeros_like, master)
+    return ZeroppState(master=master, m=zeros,
+                       v=jax.tree.map(jnp.zeros_like, zeros),
+                       step=state.step)
 
 
 def zeropp_enabled(config) -> bool:

@@ -76,6 +76,30 @@ def test_hpz_params_intra_slice_opt_global(devices):
     assert plan.opt_spec(("embed", "mlp")) == P(("dp", "fsdp"), "tp")
 
 
+def test_mics_shard_size_builds_group_mesh(devices):
+    """MiCS: mics_shard_size picks the fsdp group extent in the engine's
+    default mesh (same construction as hpZ — shard within the group,
+    replicate across groups)."""
+    import deepspeed_tpu as dstpu
+    from deepspeed_tpu.models.transformer import (TransformerConfig,
+                                                  TransformerLM)
+
+    tiny = TransformerConfig(vocab_size=64, hidden_size=32, num_layers=2,
+                             num_heads=4, max_seq_len=32, remat=False,
+                             pos_emb="learned", norm="layernorm",
+                             activation="gelu")
+    cfg = {"train_micro_batch_size_per_chip": 1,
+           "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+           "zero_optimization": {"stage": 3, "mics_shard_size": 2},
+           "steps_per_print": 1000}
+    engine, *_ = dstpu.initialize(model=TransformerLM(tiny), config=cfg)
+    assert engine.mesh.shape["fsdp"] == 2
+    assert engine.mesh.shape["dp"] == 4
+    # params sharded 2-ways within the group (replicated across dp groups)
+    wq = engine.params["layers"]["attn"]["wq"]
+    assert wq.addressable_shards[0].data.shape[1] == wq.shape[1] // 2
+
+
 def test_plan_applies_to_tree(devices):
     mesh = build_mesh(TopologyConfig(dp=1, fsdp=8))
     plan = _plan(3, mesh)

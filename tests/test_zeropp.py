@@ -42,9 +42,11 @@ TOPO = {"dp": -1, "fsdp": 1}  # ZeRO++ step shards over dp
 
 
 def test_qgz_trains(devices):
+    # no explicit topology: the default mesh must pick dp=-1 for ZeRO++
     engine = make_engine({"zero_optimization": {
-        "stage": 1, "zero_quantized_gradients": True}}, topology=TOPO)
+        "stage": 1, "zero_quantized_gradients": True}})
     assert engine._zeropp
+    assert engine.mesh.shape["dp"] == 8 and engine.mesh.shape["fsdp"] == 1
     it = data_iter(engine.micro_batch_size * engine.dp_world_size)
     losses = [float(engine.train_batch(it)) for _ in range(8)]
     assert losses[-1] < losses[0] - 0.3, losses
@@ -84,6 +86,44 @@ def test_zeropp_checkpoint_roundtrip(devices, tmp_path):
         next(it2)  # advance the iterator to the same position
     l_new = [float(engine2.train_batch(it2)) for _ in range(2)]
     np.testing.assert_allclose(l_new, l_ref, rtol=1e-4)
+
+
+def test_load_without_optimizer_states_reseeds(devices, tmp_path):
+    engine = make_engine({"zero_optimization": {
+        "stage": 1, "zero_quantized_gradients": True}}, topology=TOPO)
+    it = data_iter(engine.micro_batch_size * engine.dp_world_size)
+    for _ in range(2):
+        engine.train_batch(it)
+    engine.save_checkpoint(str(tmp_path))
+    trained = engine.module_state_dict()
+
+    engine2 = make_engine({"zero_optimization": {
+        "stage": 1, "zero_quantized_gradients": True}}, topology=TOPO)
+    engine2.load_checkpoint(str(tmp_path), load_optimizer_states=False)
+    # params restored AND the next step must not roll back to init
+    key = next(iter(trained))
+    np.testing.assert_allclose(
+        np.asarray(engine2.module_state_dict()[key], np.float32),
+        np.asarray(trained[key], np.float32))
+    it2 = data_iter(engine2.micro_batch_size * engine2.dp_world_size)
+    engine2.train_batch(it2)
+    after = np.asarray(engine2.module_state_dict()[key], np.float32)
+    drift = np.abs(after - np.asarray(trained[key], np.float32)).mean()
+    assert drift < 0.1, "post-load step rolled params back to init"
+
+
+def test_unsupported_optimizer_disables_zeropp(devices):
+    from unittest import mock
+
+    from deepspeed_tpu.runtime import engine as engine_mod
+
+    with mock.patch.object(engine_mod.logger, "warning") as warn:
+        engine = make_engine({
+            "optimizer": {"type": "lion", "params": {"lr": 1e-3}},
+            "zero_optimization": {"stage": 1,
+                                  "zero_quantized_gradients": True}})
+    assert not engine._zeropp  # lion falls back to the standard path
+    assert any("only wired" in str(c.args[0]) for c in warn.call_args_list)
 
 
 def test_flags_warn_when_not_wired(devices):
