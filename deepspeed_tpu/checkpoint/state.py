@@ -263,8 +263,20 @@ class CheckpointIO:
         self._validate_tag(meta, tag)
 
         abstract = self._abstract_state()
-        restored = self.ckpt_engine.load(os.path.join(ckpt_dir, STATE_DIR),
-                                         abstract)
+        state_path = os.path.join(ckpt_dir, STATE_DIR)
+        if not load_optimizer_states:
+            # don't read optimizer payloads (~3x param bytes) only to
+            # discard them — the re-seed paths below rebuild from params.
+            # Older orbax can't subset-restore; fall back to a full read.
+            subset = dict(abstract)
+            for key in ("opt_master", "opt_inner", "zeropp"):
+                subset.pop(key, None)
+            try:
+                restored = self.ckpt_engine.load(state_path, subset)
+            except ValueError:
+                restored = self.ckpt_engine.load(state_path, abstract)
+        else:
+            restored = self.ckpt_engine.load(state_path, abstract)
 
         e.params = restored["params"]
         if getattr(e, "_zeropp_state", None) is not None:
@@ -316,11 +328,26 @@ class CheckpointIO:
                 e._offload.reinit_masters(
                     e._jit_to_opt_sharding(jax.tree.map(
                         lambda x: x.astype("float32"), e.params)))
-        elif load_optimizer_states and "opt_master" in restored:
-            from deepspeed_tpu.runtime.optimizer import MixedPrecisionState
+        elif e.opt_state is not None:
+            from deepspeed_tpu.runtime.optimizer import (MixedPrecisionState,
+                                                         init_mixed_precision)
 
-            e.opt_state = MixedPrecisionState(
-                master=restored["opt_master"], inner=restored["opt_inner"])
+            if load_optimizer_states and "opt_master" in restored:
+                e.opt_state = MixedPrecisionState(
+                    master=restored["opt_master"],
+                    inner=restored["opt_inner"])
+            else:
+                # masters drive the next update — re-seed them from the
+                # restored params or the step rolls the model back to init
+                logger.warning("optimizer state not restored: masters "
+                               "re-seeded from params, moments reset")
+                opt_sh = jax.tree.map(lambda a: a.sharding,
+                                      e.opt_state.master)
+                p32 = jax.jit(
+                    lambda p: jax.tree.map(
+                        lambda x: x.astype("float32"), p),
+                    out_shardings=opt_sh)(e.params)
+                e.opt_state = init_mixed_precision(p32, e.tx)
         e.step_count = restored["step_count"]
         e.loss_scale_state = restored["loss_scale"]
         e.global_steps = int(meta.get("global_steps", int(e.step_count)))

@@ -1,0 +1,7 @@
+"""Compile-time model transformation passes (reference: deepspeed/compile/)."""
+
+from deepspeed_tpu.compile.passes import (  # noqa: F401
+    PASSES,
+    compile_model,
+    register_pass,
+)

@@ -1,0 +1,190 @@
+"""DeepCompile-analog pass pipeline.
+
+Reference: ``deepspeed/compile/`` (``make_backend`` backend.py:246 with
+passes ``zero1_compile / zero3_compile / prefetch / selective_gather /
+offload_parameters / offload_adam_states / offload_activation /
+sp_compile / long_context_checkpointing``) + ``csrc/compile/`` native
+helpers — graph passes that rewrite a captured fx graph to insert
+gather/reduce/offload scheduling.
+
+TPU mapping: most of what DeepCompile inserts by graph surgery is what
+XLA/GSPMD *already does* given the right declarations — so the passes
+here operate on the *declarations* (model config + engine config) before
+``initialize``, not on a captured graph:
+
+  | reference pass               | this pipeline                          |
+  |------------------------------|----------------------------------------|
+  | zero1/zero3_compile          | sharding plan from zero stage (native: |
+  |                              | runtime/sharding.py; pass validates)   |
+  | prefetch / selective_gather  | XLA latency-hiding scheduler (no-op,   |
+  |                              | reported)                              |
+  | offload_parameters           | zero_optimization.offload_param check  |
+  | offload_adam_states          | offload_optimizer → host tier          |
+  | offload_activation           | remat policy 'offload_dots_host'       |
+  | sp_compile                   | AutoSP strategy selection              |
+  | long_context_checkpointing   | enable remat + tiled/chunked compute   |
+  |                              | above a sequence-length threshold      |
+
+Usage (before building the engine)::
+
+    model, report = compile_model(model, config, mesh)
+    engine, *_ = dstpu.initialize(model=model, config=config)
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from deepspeed_tpu.utils.logging import log_dist, logger
+
+LONG_CONTEXT_THRESHOLD = 32768
+
+
+@dataclasses.dataclass
+class PassResult:
+    name: str
+    applied: bool
+    note: str = ""
+
+
+PASSES: List[Tuple[str, Callable]] = []
+
+
+def register_pass(name: str):
+    """Register a pass fn(model, config, mesh) → (model, PassResult)."""
+    def deco(fn):
+        PASSES.append((name, fn))
+        return fn
+
+    return deco
+
+
+def _model_cfg(model):
+    return getattr(model, "config", None)
+
+
+@register_pass("zero_compile")
+def _zero_compile(model, config, mesh):
+    """zero1/zero3_compile analog: the sharding plan IS the compiled
+    gather/reduce schedule; validate stage vs mesh so misdeclarations
+    surface at compile time, not step time."""
+    stage = config.zero_optimization.stage
+    note = f"stage {stage} → declarative sharding plan (GSPMD collectives)"
+    if mesh is not None and stage >= 1:
+        data = mesh.shape.get("dp", 1) * mesh.shape.get("fsdp", 1)
+        if data == 1:
+            note += "; WARNING: no data-parallel extent, nothing to shard"
+    return model, PassResult("zero_compile", True, note)
+
+
+@register_pass("prefetch")
+def _prefetch(model, config, mesh):
+    return model, PassResult(
+        "prefetch", False,
+        "no-op on TPU: XLA's latency-hiding scheduler overlaps the "
+        "param all-gathers DeepCompile prefetches by hand")
+
+
+@register_pass("selective_gather")
+def _selective_gather(model, config, mesh):
+    thresh = config.zero_optimization.param_persistence_threshold
+    return model, PassResult(
+        "selective_gather", bool(thresh),
+        f"persistence threshold {thresh}: small params stay replicated"
+        if thresh else "off (param_persistence_threshold=0)")
+
+
+@register_pass("offload_parameters")
+def _offload_parameters(model, config, mesh):
+    off = config.zero_optimization.offload_param
+    on = off is not None and (off.device or "none") != "none"
+    return model, PassResult(
+        "offload_parameters", on,
+        f"param offload tier ({off.device})" if on else "off")
+
+
+@register_pass("offload_adam_states")
+def _offload_adam(model, config, mesh):
+    off = config.zero_optimization.offload_optimizer
+    on = off is not None and (off.device or "none") != "none"
+    return model, PassResult(
+        "offload_adam_states", on,
+        f"host optimizer tier ({off.device})" if on else "off")
+
+
+@register_pass("offload_activation")
+def _offload_activation(model, config, mesh):
+    on = (config.activation_checkpointing.cpu_checkpointing
+          or config.activation_checkpointing.policy == "offload_dots_host")
+    return model, PassResult(
+        "offload_activation", on,
+        "checkpointed dots spill to pinned host memory" if on else "off")
+
+
+@register_pass("sp_compile")
+def _sp_compile(model, config, mesh):
+    """AutoSP (reference compile/passes/sp_compile.py + sequence/auto_sp)."""
+    from deepspeed_tpu.parallel.auto_sp import auto_wrap_model_for_sp
+
+    sp = mesh.shape.get("sp", 1) if mesh is not None else 1
+    if sp <= 1 or _model_cfg(model) is None:
+        return model, PassResult("sp_compile", False, "no sp axis")
+    new = auto_wrap_model_for_sp(model, mesh)
+    mode = getattr(_model_cfg(new), "sp_mode", None)
+    return new, PassResult("sp_compile", True, f"sp={sp} → {mode}")
+
+
+@register_pass("long_context_checkpointing")
+def _long_context(model, config, mesh):
+    """Reference compile/passes/long_context_checkpointing.py: auto-insert
+    activation checkpointing (+ tiled compute) for long sequences."""
+    cfg = _model_cfg(model)
+    if cfg is None or getattr(cfg, "max_seq_len", 0) < LONG_CONTEXT_THRESHOLD:
+        return model, PassResult("long_context_checkpointing", False,
+                                 "sequence below threshold")
+    changes = {}
+    if not getattr(cfg, "remat", True):
+        changes["remat"] = True
+    if getattr(cfg, "tiled_logits", 0) <= 1:
+        changes["tiled_logits"] = max(8, cfg.max_seq_len // 4096)
+    if getattr(cfg, "attn_chunks", 0) <= 1 and cfg.max_seq_len >= 131072:
+        changes["attn_chunks"] = cfg.max_seq_len // 16384
+    if not changes:
+        return model, PassResult("long_context_checkpointing", False,
+                                 "already configured")
+    new_cfg = dataclasses.replace(cfg, **changes)
+    return type(model)(new_cfg), PassResult(
+        "long_context_checkpointing", True,
+        f"seq={cfg.max_seq_len}: set {sorted(changes)}")
+
+
+def compile_model(model, config, mesh=None,
+                  passes: Optional[List[str]] = None
+                  ) -> Tuple[Any, List[PassResult]]:
+    """Run the pass pipeline (reference make_backend compile/backend.py:246
+    — there a torch.compile backend, here a pre-initialize transform).
+
+    ``passes``: subset of pass names to run (default: all registered).
+    Returns (possibly-rebuilt model, per-pass report).
+    """
+    report: List[PassResult] = []
+    selected = set(passes) if passes is not None else None
+    if selected is not None:
+        known = {name for name, _ in PASSES}
+        unknown = selected - known
+        if unknown:
+            raise ValueError(f"unknown compile passes {sorted(unknown)}; "
+                             f"registered: {sorted(known)}")
+    for name, fn in PASSES:
+        if selected is not None and name not in selected:
+            continue
+        try:
+            model, res = fn(model, config, mesh)
+        except Exception as e:  # a pass must never break the build
+            logger.warning(f"compile pass '{name}' failed: {e}")
+            res = PassResult(name, False, f"error: {e}")
+        report.append(res)
+    applied = [r.name for r in report if r.applied]
+    log_dist(f"compile passes applied: {applied or 'none'}", ranks=[0])
+    return model, report

@@ -57,6 +57,21 @@ class CheckpointEngine(abc.ABC):
         return True
 
 
+def _restore(ckptr, path: str, abstract_tree: Any):
+    """Restore with subset semantics: an abstract tree naming fewer
+    top-level entries than the checkpoint holds (e.g. optimizer state
+    skipped on load) reads only those entries."""
+    import orbax.checkpoint as ocp
+
+    if abstract_tree is None:
+        return ckptr.restore(path)
+    try:
+        return ckptr.restore(path, args=ocp.args.StandardRestore(
+            abstract_tree, partial_restore=True))
+    except TypeError:  # older orbax without partial_restore
+        return ckptr.restore(path, abstract_tree)
+
+
 class SyncCheckpointEngine(CheckpointEngine):
     """Blocking orbax save/restore (TorchCheckpointEngine analog)."""
 
@@ -70,7 +85,7 @@ class SyncCheckpointEngine(CheckpointEngine):
         import orbax.checkpoint as ocp
 
         with ocp.StandardCheckpointer() as ckptr:
-            return ckptr.restore(path, abstract_tree)
+            return _restore(ckptr, path, abstract_tree)
 
 
 class DecoupledCheckpointEngine(CheckpointEngine):
@@ -119,7 +134,7 @@ class DecoupledCheckpointEngine(CheckpointEngine):
         # loads never race an in-flight save of the same tree
         self._checkpointer().wait_until_finished()
         with ocp.StandardCheckpointer() as ckptr:
-            return ckptr.restore(path, abstract_tree)
+            return _restore(ckptr, path, abstract_tree)
 
     def commit(self, tag: str) -> bool:
         self._checkpointer().wait_until_finished()

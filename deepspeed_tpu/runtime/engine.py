@@ -129,6 +129,9 @@ class Engine:
         self.config = config
 
         # -- mesh (engine.py:1627 _configure_distributed_model analog) ----
+        # known before mesh selection: a client optimizer disqualifies the
+        # ZeRO++ step, so the default mesh must not assume it
+        self._client_optimizer_present = client_optimizer is not None
         if mesh is None:
             mesh = self._default_mesh(topology)
         self.mesh = mesh
@@ -211,8 +214,7 @@ class Engine:
         self._offload = None  # built in _build_state when enabled
 
         # -- ZeRO++ quantized-collective step (runtime/zeropp.py) ---------
-        self._zeropp = (self._zeropp_applicable(config)
-                        and not self._onebit and client_optimizer is None)
+        self._zeropp = self._zeropp_applicable(config) and not self._onebit
         self._zeropp_state = None
         zq = config.zero_optimization
         if (zq.zero_quantized_weights or zq.zero_quantized_gradients) \
@@ -286,19 +288,27 @@ class Engine:
             return self.config.optimizer.params["lr"]
         return 1e-3
 
-    @staticmethod
-    def _zeropp_applicable(config) -> bool:
-        """ZeRO++ step preconditions that depend only on the config (the
-        1-bit exclusion is checked at the call sites)."""
+    def _zeropp_applicable(self, config) -> bool:
+        """ZeRO++ step preconditions knowable from config + ctor args (the
+        1-bit exclusion is checked at the call sites). Model-parallel
+        axes, hpZ/MiCS grouping, fp16, MoE, offload, and client
+        optimizers all fall back to the standard path (with a warning)."""
         from deepspeed_tpu.runtime.zeropp import zeropp_enabled
 
-        off = config.zero_optimization.offload_optimizer
+        z = config.zero_optimization
+        off = z.offload_optimizer
         offdev = (off.device if off is not None else "none") or "none"
         opt = ((config.optimizer.type if config.optimizer else "")
                or "adamw").lower().replace("_", "").replace("-", "")
         return (zeropp_enabled(config) and offdev == "none"
                 and not config.fp16.enabled
                 and not config.moe.enabled
+                and not getattr(self, "_client_optimizer_present", False)
+                and config.tensor_parallel.size == 1
+                and config.sequence_parallel.size == 1
+                and config.pipeline.stages == 1
+                and z.zero_hpz_partition_size <= 1
+                and z.mics_shard_size <= 0
                 and opt in ("adam", "adamw", "fusedadam", "fusedadamw"))
 
     def _default_mesh(self, topology) -> Mesh:

@@ -50,6 +50,18 @@ def _pad_len(n: int, dp: int) -> int:
     return int(np.ceil(n / unit)) * unit
 
 
+def _masters_from_leaves(leaves, dp: int):
+    """Param leaves → fp32 master layout [dp, shard] (the single home of
+    the pad/reshape invariant; used at init and at checkpoint re-seed)."""
+    out = []
+    for x in leaves:
+        n = int(np.prod(x.shape))
+        n_pad = _pad_len(n, dp)
+        f = jnp.pad(x.reshape(-1).astype(jnp.float32), (0, n_pad - n))
+        out.append(f.reshape(dp, n_pad // dp))
+    return out
+
+
 def build_zeropp_step(model, mesh, gas: int, base_lr: float,
                       lr_schedule: Optional[Callable], betas, eps: float,
                       weight_decay: float, grad_clip: float,
@@ -82,12 +94,8 @@ def build_zeropp_step(model, mesh, gas: int, base_lr: float,
     # -- init ------------------------------------------------------------
     def init_fn(rng):
         p32 = model.init(rng)
-        flat = [
-
-            _flat_pad(x, n, n_pad).reshape(dp, n_pad // dp)
-            for x, n, n_pad in zip(jax.tree.leaves(p32), sizes, pads)
-        ]
-        master = jax.tree.unflatten(treedef, flat)
+        master = jax.tree.unflatten(
+            treedef, _masters_from_leaves(jax.tree.leaves(p32), dp))
         zeros = jax.tree.map(jnp.zeros_like, master)
         params = jax.tree.map(lambda x: x.astype(compute_dtype), p32)
         return params, ZeroppState(master=master, m=zeros,
@@ -201,13 +209,8 @@ def reseed_state_from_params(params, state: ZeroppState, dp: int
     the next step's all-gather doesn't roll the model back to init
     (mirrors the offload reinit_masters hazard guard)."""
     leaves, treedef = jax.tree_util.tree_flatten(params)
-    flat = []
-    for x in leaves:
-        n = int(np.prod(x.shape))
-        n_pad = _pad_len(n, dp)
-        f = jnp.pad(x.reshape(-1).astype(jnp.float32), (0, n_pad - n))
-        flat.append(f.reshape(dp, n_pad // dp))
-    master = jax.tree_util.tree_unflatten(treedef, flat)
+    master = jax.tree_util.tree_unflatten(treedef,
+                                          _masters_from_leaves(leaves, dp))
     zeros = jax.tree.map(jnp.zeros_like, master)
     return ZeroppState(master=master, m=zeros,
                        v=jax.tree.map(jnp.zeros_like, zeros),

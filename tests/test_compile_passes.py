@@ -1,0 +1,88 @@
+"""DeepCompile-analog pass tests (reference analog: tests/unit/compile/)."""
+
+import numpy as np
+import pytest
+
+from deepspeed_tpu.compile import PASSES, compile_model, register_pass
+from deepspeed_tpu.config.config import Config
+from deepspeed_tpu.models.transformer import TransformerConfig, TransformerLM
+from deepspeed_tpu.parallel import topology as topo
+
+TINY = dict(vocab_size=64, hidden_size=32, num_layers=2, num_heads=4,
+            pos_emb="learned", norm="layernorm", activation="gelu",
+            tie_embeddings=True)
+
+
+def test_pipeline_runs_and_reports(devices):
+    model = TransformerLM(TransformerConfig(max_seq_len=128, **TINY))
+    cfg = Config.from_dict({"train_micro_batch_size_per_chip": 1,
+                            "zero_optimization": {"stage": 3}})
+    mesh = topo.build_mesh(topo.TopologyConfig(fsdp=-1, dp=1))
+    model2, report = compile_model(model, cfg, mesh)
+    names = {r.name for r in report}
+    assert {"zero_compile", "sp_compile",
+            "long_context_checkpointing"} <= names
+    zero = next(r for r in report if r.name == "zero_compile")
+    assert zero.applied and "stage 3" in zero.note
+
+
+def test_long_context_pass_enables_tiling(devices):
+    model = TransformerLM(TransformerConfig(max_seq_len=131072, remat=False,
+                                            **TINY))
+    cfg = Config.from_dict({"train_micro_batch_size_per_chip": 1})
+    model2, report = compile_model(model, cfg, None)
+    lc = next(r for r in report if r.name == "long_context_checkpointing")
+    assert lc.applied
+    assert model2.config.remat is True
+    assert model2.config.tiled_logits > 1
+    assert model2.config.attn_chunks > 1
+    # short context untouched
+    short = TransformerLM(TransformerConfig(max_seq_len=1024, remat=False,
+                                            **TINY))
+    short2, report = compile_model(short, cfg, None)
+    assert short2 is short
+
+
+def test_sp_pass_wraps_model(devices):
+    mesh = topo.build_mesh(topo.TopologyConfig(sp=4, dp=-1))
+    model = TransformerLM(TransformerConfig(max_seq_len=128, **TINY))
+    cfg = Config.from_dict({"train_micro_batch_size_per_chip": 1})
+    model2, report = compile_model(model, cfg, mesh, passes=["sp_compile"])
+    assert model2.config.sequence_parallel
+    assert len(report) == 1
+
+
+def test_custom_pass_registration(devices):
+    calls = []
+
+    @register_pass("my_custom_pass")
+    def my_pass(model, config, mesh):
+        from deepspeed_tpu.compile.passes import PassResult
+
+        calls.append(1)
+        return model, PassResult("my_custom_pass", True, "hi")
+
+    try:
+        model = TransformerLM(TransformerConfig(max_seq_len=64, **TINY))
+        cfg = Config.from_dict({"train_micro_batch_size_per_chip": 1})
+        _, report = compile_model(model, cfg, None,
+                                  passes=["my_custom_pass"])
+        assert calls and report[0].note == "hi"
+    finally:
+        PASSES[:] = [(n, f) for n, f in PASSES if n != "my_custom_pass"]
+
+
+def test_pass_failure_does_not_break_build(devices):
+    @register_pass("broken_pass")
+    def broken(model, config, mesh):
+        raise RuntimeError("boom")
+
+    try:
+        model = TransformerLM(TransformerConfig(max_seq_len=64, **TINY))
+        cfg = Config.from_dict({"train_micro_batch_size_per_chip": 1})
+        model2, report = compile_model(model, cfg, None,
+                                       passes=["broken_pass"])
+        assert model2 is model
+        assert not report[0].applied and "boom" in report[0].note
+    finally:
+        PASSES[:] = [(n, f) for n, f in PASSES if n != "broken_pass"]
