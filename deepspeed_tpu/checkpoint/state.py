@@ -266,14 +266,17 @@ class CheckpointIO:
         state_path = os.path.join(ckpt_dir, STATE_DIR)
         if not load_optimizer_states:
             # don't read optimizer payloads (~3x param bytes) only to
-            # discard them — the re-seed paths below rebuild from params.
-            # Older orbax can't subset-restore; fall back to a full read.
+            # discard them — the re-seed paths below rebuild from params
+            from deepspeed_tpu.runtime.checkpoint_engine import load_partial
+
             subset = dict(abstract)
-            for key in ("opt_master", "opt_inner", "zeropp"):
+            for key in ("opt_master", "opt_inner", "zeropp", "onebit"):
                 subset.pop(key, None)
             try:
-                restored = self.ckpt_engine.load(state_path, subset)
-            except ValueError:
+                restored = load_partial(state_path, subset)
+            except Exception as err:  # fall back to a full read
+                logger.warning(f"partial restore unavailable ({err}); "
+                               "reading the full checkpoint")
                 restored = self.ckpt_engine.load(state_path, abstract)
         else:
             restored = self.ckpt_engine.load(state_path, abstract)
@@ -298,8 +301,31 @@ class CheckpointIO:
                 e._zeropp_state = jax.tree.map(
                     lambda x, old: jax.device_put(x, old.sharding),
                     new, e._zeropp_state)
-        if getattr(e, "_onebit_state", None) is not None and "onebit" in restored:
-            e._onebit_state = restored["onebit"]
+        if getattr(e, "_onebit_state", None) is not None:
+            if load_optimizer_states and "onebit" in restored:
+                e._onebit_state = restored["onebit"]
+            else:
+                # same rollback hazard as the paths below: the 1-bit
+                # masters drive the next update, so re-seed from params
+                import jax.numpy as jnp
+
+                from deepspeed_tpu.runtime.onebit import OneBitState
+
+                logger.warning("1-bit optimizer state not restored: "
+                               "masters re-seeded from params, moments "
+                               "and error feedback reset")
+                st = e._onebit_state
+                master_sh = jax.tree.map(lambda a: a.sharding, st.master)
+                master = jax.jit(
+                    lambda p: jax.tree.map(
+                        lambda x: x.astype("float32"), p),
+                    out_shardings=master_sh)(e.params)
+                e._onebit_state = OneBitState(
+                    master=master,
+                    m=jax.tree.map(jnp.zeros_like, st.m),
+                    v=jax.tree.map(jnp.zeros_like, st.v),
+                    error=jax.tree.map(jnp.zeros_like, st.error),
+                    step=st.step)
         if getattr(e, "_offload", None) is not None:
             import numpy as np
 

@@ -1,0 +1,142 @@
+"""Evoformer attention (DS4Science / AlphaFold-family workloads).
+
+Reference: ``csrc/deepspeed4science/evoformer_attn/`` (~14.9k LoC of
+CUTLASS kernels) — memory-efficient fused attention for the Evoformer
+block's two patterns, exposed as ``DS4Sci_EvoformerAttention``:
+
+  * MSA row/column attention: per-(sequence-)row attention over the MSA
+    tensor with an additive pair bias;
+  * triangle attention (starting/ending node): attention over the pair
+    representation biased by the third edge, with a sigmoid gate.
+
+Both are softmax attention with (1) an additive bias term broadcast over
+a leading batch group and (2) an output gate — exactly the structure XLA
+fuses well and the flash kernel streams. The TPU design therefore
+composes the existing pieces instead of porting CUTLASS: einsum QK^T
+with fp32 accumulation, bias add, streaming softmax via chunked scan
+when the pair dimension is long (the CUTLASS kernels' memory win), and
+a fused sigmoid-gated output projection.
+
+API parity: ``evoformer_attention(q, k, v, biases, gate=None)`` accepts
+the reference's layout [*, H(eads) dims last]: q/k/v [B, N, S, h, d]
+(B batch, N MSA rows or node axis, S keys, h heads, d head dim) and a
+list of biases broadcastable to the score shape [B, N, h, S, S].
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -1e30
+CHUNK_THRESHOLD = 1024  # queries per chunk when S is long
+
+
+def _attention_core(q, k, v, biases: Sequence[jax.Array]) -> jax.Array:
+    """Dense scores path: q,k,v [..., S, h, d]; biases broadcast to
+    [..., h, Sq, Sk]. fp32 softmax (reference kernels accumulate fp32)."""
+    d = q.shape[-1]
+    scores = jnp.einsum("...qhd,...khd->...hqk", q, k,
+                        preferred_element_type=jnp.float32)
+    scores = scores / jnp.sqrt(jnp.asarray(d, jnp.float32))
+    for b in biases:
+        scores = scores + b.astype(jnp.float32)
+    probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+    return jnp.einsum("...hqk,...khd->...qhd", probs, v)
+
+
+def _chunked_attention(q, k, v, biases: Sequence[jax.Array],
+                       chunk: int) -> jax.Array:
+    """Query-chunked scan: peak score memory drops from O(Sq*Sk) to
+    O(chunk*Sk) — the CUTLASS kernels' memory-efficiency, expressed as
+    compiler-friendly control flow (lax.scan over query chunks)."""
+    Sq = q.shape[-3]
+    n_chunks = Sq // chunk
+
+    def body(_, qc_and_bias):
+        qc, bc = qc_and_bias
+        return None, _attention_core(qc, k, v, bc)
+
+    # [..., Sq, h, d] → [n, ..., chunk, h, d] with the chunk axis leading
+    def split_q(x):
+        lead = x.shape[:-3]
+        return jnp.moveaxis(
+            x.reshape(*lead, n_chunks, chunk, *x.shape[-2:]), -4, 0)
+
+    def split_bias(b):
+        lead = b.shape[:-2]
+        return jnp.moveaxis(
+            b.reshape(*lead, n_chunks, chunk, b.shape[-1]), -3, 0)
+
+    qs = split_q(q)
+    bs = [split_bias(jnp.broadcast_to(
+        b, (*q.shape[:-3], q.shape[-2], Sq, k.shape[-3]))) for b in biases]
+    _, out = jax.lax.scan(body, None, (qs, list(bs)))
+    # [n, ..., chunk, h, d] → [..., Sq, h, d]
+    out = jnp.moveaxis(out, 0, -4)
+    return out.reshape(*q.shape[:-3], Sq, *q.shape[-2:])
+
+
+def evoformer_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                        biases: Optional[List[jax.Array]] = None,
+                        gate: Optional[jax.Array] = None,
+                        chunk_size: int = 0) -> jax.Array:
+    """DS4Sci_EvoformerAttention parity entry.
+
+    q, k, v: [..., S, h, d] (any number of leading batch/row axes).
+    biases:  list of arrays broadcastable to [..., h, Sq, Sk]
+             (MSA mask bias, pair bias, triangle-edge bias, ...).
+    gate:    optional sigmoid gate, same shape as the output (the
+             reference's gating fused into the epilogue).
+    chunk_size: 0 = auto (chunk when Sq > CHUNK_THRESHOLD).
+    """
+    biases = list(biases or [])
+    Sq = q.shape[-3]
+    chunk = chunk_size or (CHUNK_THRESHOLD if Sq > CHUNK_THRESHOLD else 0)
+    if chunk and Sq % chunk == 0 and chunk < Sq:
+        out = _chunked_attention(q, k, v, biases, chunk)
+    else:
+        out = _attention_core(q, k, v, biases)
+    if gate is not None:
+        out = jax.nn.sigmoid(gate.astype(jnp.float32)).astype(out.dtype) * out
+    return out
+
+
+# -- Evoformer block patterns (reference test coverage shapes) --------------
+
+def msa_row_attention(msa: jax.Array, q_w, k_w, v_w, pair_bias: jax.Array,
+                      gate_w=None, num_heads: int = 8):
+    """MSA row-wise gated self-attention with pair bias
+    (DS4Sci_EvoformerAttention's primary call pattern).
+
+    msa: [B, R, S, C] (rows R, sequence S, channels C);
+    pair_bias: [B, h, S, S] added to every row's scores.
+    q_w/k_w/v_w/gate_w: [C, h, d] projections.
+    """
+    def proj(w):
+        return jnp.einsum("brsc,chd->brshd", msa, w.astype(msa.dtype))
+
+    q, k, v = proj(q_w), proj(k_w), proj(v_w)
+    gate = proj(gate_w) if gate_w is not None else None
+    bias = pair_bias[:, None]  # broadcast over rows: [B, 1, h, S, S]
+    return evoformer_attention(q, k, v, [bias], gate=gate)
+
+
+def triangle_attention(pair: jax.Array, q_w, k_w, v_w,
+                       edge_bias_w, gate_w=None):
+    """Triangle attention (starting node): attention along the second
+    pair axis, biased by a learned projection of the third edge.
+
+    pair: [B, I, J, C]; edge_bias_w: [C, h] → bias [B, h, J, J]
+    broadcast over I.
+    """
+    def proj(w):
+        return jnp.einsum("bijc,chd->bijhd", pair, w.astype(pair.dtype))
+
+    q, k, v = proj(q_w), proj(k_w), proj(v_w)
+    gate = proj(gate_w) if gate_w is not None else None
+    # bias from the (j, k) edges: [B, J, K, h] → [B, h, J, K]
+    bias = jnp.einsum("bjkc,ch->bhjk", pair, edge_bias_w.astype(pair.dtype))
+    return evoformer_attention(q, k, v, [bias[:, None]], gate=gate)

@@ -58,18 +58,21 @@ class CheckpointEngine(abc.ABC):
 
 
 def _restore(ckptr, path: str, abstract_tree: Any):
-    """Restore with subset semantics: an abstract tree naming fewer
-    top-level entries than the checkpoint holds (e.g. optimizer state
-    skipped on load) reads only those entries."""
-    import orbax.checkpoint as ocp
-
     if abstract_tree is None:
         return ckptr.restore(path)
-    try:
-        return ckptr.restore(path, args=ocp.args.StandardRestore(
-            abstract_tree, partial_restore=True))
-    except TypeError:  # older orbax without partial_restore
-        return ckptr.restore(path, abstract_tree)
+    return ckptr.restore(path, abstract_tree)
+
+
+def load_partial(path: str, subset_tree: Any):
+    """Restore only the entries named by ``subset_tree`` (which may omit
+    top-level keys the checkpoint holds — optimizer payloads skipped on
+    load). StandardRestore has no partial mode; the PyTree layer does."""
+    import orbax.checkpoint as ocp
+
+    with ocp.PyTreeCheckpointer() as ckptr:
+        rargs = ocp.checkpoint_utils.construct_restore_args(subset_tree)
+        return ckptr.restore(path, args=ocp.args.PyTreeRestore(
+            item=subset_tree, restore_args=rargs, partial_restore=True))
 
 
 class SyncCheckpointEngine(CheckpointEngine):

@@ -220,10 +220,12 @@ class Engine:
         if (zq.zero_quantized_weights or zq.zero_quantized_gradients) \
                 and not self._zeropp:
             logger.warning(
-                "ZeRO++ flags (qwZ/qgZ) are only wired for stages 1-2 "
-                "with an adam/adamw optimizer, bf16, no optimizer "
-                "offload, no MoE, and no 1-bit optimizer — the "
-                "quantized-collective step is disabled for this config")
+                "ZeRO++ flags (qwZ/qgZ) are only wired for: ZeRO stage "
+                "1-2, adam/adamw (no client optimizer), bf16, no "
+                "optimizer offload, no MoE, no tp/sp/pp axes, no "
+                "hpZ/MiCS grouping, no 1-bit optimizer — this config "
+                "fails one of those, so the quantized-collective step "
+                "is disabled and the standard path runs")
 
         # -- state init (sharded; zero.Init analog is in abstract init) ---
         self._rng = jax.random.PRNGKey(seed if seed is not None else config.seed)
