@@ -135,5 +135,5 @@ def test_flags_warn_when_not_wired(devices):
         engine = make_engine({"zero_optimization": {
             "stage": 3, "zero_quantized_gradients": True}})
     assert not engine._zeropp
-    assert any("only wired for stages 1-2" in str(c.args[0])
+    assert any("only wired" in str(c.args[0])
                for c in warn.call_args_list)
