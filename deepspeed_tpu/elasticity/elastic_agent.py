@@ -1,0 +1,198 @@
+"""Elastic training agent: supervise workers, restart on membership change.
+
+Reference: ``DSElasticAgent`` (deepspeed/elasticity/elastic_agent.py:32)
+extends torch-elastic's LocalElasticAgent — it monitors the worker group,
+and on failure or scale-up/down event tears the group down and restarts
+it against a new rendezvous, with the elastic batch config
+(elasticity/elasticity.py:233) keeping the global batch size valid across
+node counts.
+
+TPU re-design: there is no torch-elastic rendezvous; group membership is
+the set of reachable hosts (hostfile, callable, or TPU pod metadata), and
+a "restart" relaunches the per-host processes with a fresh JAX
+coordinator. Workers are expected to resume from their latest checkpoint
+(engine.load_checkpoint finds the ``latest`` tag) — the agent only
+manages processes and topology, exactly like the reference splits agent
+(process lifecycle) from elasticity (batch-size math).
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import time
+from typing import Callable, Dict, List, Optional, Sequence
+
+from deepspeed_tpu.elasticity.elasticity import compute_elastic_config
+from deepspeed_tpu.utils.logging import logger
+
+
+class WorkerGroupFailure(RuntimeError):
+    pass
+
+
+class ElasticAgent:
+    """Supervises one worker process per host; restarts the whole group on
+    membership change or worker failure, up to ``max_restarts`` times.
+
+    Parameters
+    ----------
+    cmd_builder: (hosts, restart_count) -> list of argv lists, one per host.
+        Rebuilt every (re)start so the coordinator address / world size
+        track the current membership.
+    membership_fn: () -> list of live hostnames. Polled every
+        ``poll_interval`` seconds; any change triggers a restart.
+    min_nodes / max_nodes: admissible group size (reference
+        launcher/runner.py:88-102 --min_elastic_nodes/--max_elastic_nodes).
+    ds_config: optional config dict; when it enables elasticity the agent
+        validates each new node count against compute_elastic_config
+        before restarting (invalid counts are waited out, not crashed on).
+    """
+
+    def __init__(self, cmd_builder: Callable[[Sequence[str], int],
+                                             List[List[str]]],
+                 membership_fn: Callable[[], List[str]],
+                 min_nodes: int = 1, max_nodes: int = 64,
+                 max_restarts: int = 100, poll_interval: float = 5.0,
+                 ds_config: Optional[Dict] = None,
+                 env: Optional[Dict[str, str]] = None):
+        if min_nodes < 1 or max_nodes < min_nodes:
+            raise ValueError(f"bad node range [{min_nodes}, {max_nodes}]")
+        self.cmd_builder = cmd_builder
+        self.membership_fn = membership_fn
+        self.min_nodes = min_nodes
+        self.max_nodes = max_nodes
+        self.max_restarts = max_restarts
+        self.poll_interval = poll_interval
+        self.ds_config = ds_config
+        self.env = dict(env or {})
+        self.restart_count = 0
+        self._procs: List[subprocess.Popen] = []
+
+    # -- membership --------------------------------------------------------
+    def _admissible(self, hosts: Sequence[str]) -> bool:
+        n = len(hosts)
+        if not self.min_nodes <= n <= self.max_nodes:
+            return False
+        if self.ds_config and self.ds_config.get(
+                "elasticity", {}).get("enabled", False):
+            try:
+                compute_elastic_config(self.ds_config,
+                                       target_deployment_size=n)
+            except Exception as e:
+                logger.warning(
+                    f"elastic agent: {n} nodes has no valid elastic batch "
+                    f"config ({e}); waiting for membership change")
+                return False
+        return True
+
+    def _wait_for_quorum(self) -> List[str]:
+        while True:
+            hosts = sorted(self.membership_fn())
+            if self._admissible(hosts):
+                return hosts
+            time.sleep(self.poll_interval)
+
+    # -- process lifecycle -------------------------------------------------
+    def _start(self, hosts: Sequence[str]) -> None:
+        env = dict(os.environ, **self.env)
+        env["DSTPU_ELASTIC_RESTART_COUNT"] = str(self.restart_count)
+        env["DSTPU_ELASTIC_WORLD"] = ",".join(hosts)
+        cmds = self.cmd_builder(hosts, self.restart_count)
+        self._procs = [subprocess.Popen(c, env=env) for c in cmds]
+        logger.info(f"elastic agent: started {len(self._procs)} workers "
+                    f"on {list(hosts)} (restart {self.restart_count})")
+
+    def _stop(self) -> None:
+        for p in self._procs:
+            if p.poll() is None:
+                p.terminate()
+        deadline = time.time() + 10
+        for p in self._procs:
+            try:
+                p.wait(timeout=max(0.1, deadline - time.time()))
+            except subprocess.TimeoutExpired:
+                p.kill()
+                p.wait()
+        self._procs = []
+
+    #: grace period for the remaining workers to exit after the first
+    #: clean worker exit (a finished SPMD program drains within seconds;
+    #: longer means the survivors are stuck in a collective with the
+    #: departed rank and the round must be torn down)
+    drain_grace = 30.0
+
+    def _poll_group(self) -> Optional[int]:
+        """None while all workers run; 0 = all exited cleanly; 1 = a
+        worker failed (restart now); -1 = partial clean exit (grace)."""
+        rcs = [p.poll() for p in self._procs]
+        if any(rc not in (None, 0) for rc in rcs):
+            return 1
+        if all(rc is not None for rc in rcs):
+            return 0
+        if any(rc is not None for rc in rcs):
+            return -1
+        return None
+
+    # -- main loop ---------------------------------------------------------
+    def run(self) -> int:
+        """Supervise until clean exit; returns the final returncode."""
+        while True:
+            hosts = self._wait_for_quorum()
+            self._start(hosts)
+            try:
+                rc = self._supervise(hosts)
+            finally:
+                self._stop()
+            if rc == 0:
+                logger.info("elastic agent: worker group exited cleanly")
+                return 0
+            self.restart_count += 1
+            if self.restart_count > self.max_restarts:
+                raise WorkerGroupFailure(
+                    f"worker group failed {self.restart_count} times "
+                    f"(max_restarts={self.max_restarts})")
+            logger.warning(
+                f"elastic agent: restarting group "
+                f"({self.restart_count}/{self.max_restarts})")
+
+    def _supervise(self, hosts: Sequence[str]) -> int:
+        """Run one group round; returns aggregate rc (1 = needs restart)."""
+        drain_deadline = None
+        while True:
+            rc = self._poll_group()
+            if rc == 0:
+                return 0
+            if rc == 1:
+                return 1
+            if rc == -1:
+                if drain_deadline is None:
+                    drain_deadline = time.time() + self.drain_grace
+                elif time.time() > drain_deadline:
+                    logger.warning(
+                        "elastic agent: workers still running "
+                        f"{self.drain_grace}s after a peer exited cleanly "
+                        "(likely deadlocked collective); restarting group")
+                    return 1
+            current = sorted(self.membership_fn())
+            if current != list(hosts):
+                logger.warning(
+                    f"elastic agent: membership changed {list(hosts)} -> "
+                    f"{current}; restarting group")
+                return 1
+            time.sleep(self.poll_interval)
+
+
+def hostfile_membership(path: str) -> Callable[[], List[str]]:
+    """Membership source that re-reads a hostfile each poll (hosts may be
+    added/removed between rounds, the reference's scale-up/down event)."""
+
+    def poll() -> List[str]:
+        from deepspeed_tpu.launcher.runner import parse_hostfile
+
+        try:
+            return list(parse_hostfile(path))
+        except (OSError, ValueError):
+            return []
+
+    return poll

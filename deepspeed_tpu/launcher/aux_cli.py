@@ -1,0 +1,127 @@
+"""Aux fleet CLIs: ``dstpu-ssh`` and ``dstpu-nvme-tune``.
+
+Reference: ``bin/ds_ssh`` (run a command on every hostfile host) and
+``bin/ds_nvme_tune`` (sweep AIO knobs on the NVMe scratch volume and
+persist the winning configuration for the swap stack to pick up).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+from typing import List
+
+from deepspeed_tpu.launcher.runner import parse_hostfile
+
+DEFAULT_HOSTFILE = "/job/hostfile"
+TUNE_OUTPUT = os.path.expanduser("~/.dstpu_nvme_config.json")
+
+
+# ---------------------------------------------------------------------------
+# dstpu-ssh
+# ---------------------------------------------------------------------------
+
+def ssh_main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="dstpu-ssh",
+        description="run a shell command on every host in the hostfile "
+                    "(reference bin/ds_ssh)")
+    ap.add_argument("-H", "--hostfile", default=DEFAULT_HOSTFILE)
+    ap.add_argument("--sequential", action="store_true",
+                    help="one host at a time instead of parallel fan-out")
+    ap.add_argument("command", nargs=argparse.REMAINDER,
+                    help="command to run on each host")
+    args = ap.parse_args(argv)
+    if not args.command:
+        ap.error("no command given")
+    cmd = " ".join(args.command)
+    try:
+        hosts: List[str] = list(parse_hostfile(args.hostfile))
+    except (OSError, ValueError) as e:
+        print(f"dstpu-ssh: cannot read hostfile {args.hostfile}: {e}",
+              file=sys.stderr)
+        return 2
+
+    def launch(host):
+        return subprocess.Popen(
+            ["ssh", "-o", "StrictHostKeyChecking=no", host, cmd])
+
+    rc = 0
+    if args.sequential:
+        for h in hosts:
+            rc |= launch(h).wait()
+    else:
+        procs = [launch(h) for h in hosts]
+        for p in procs:
+            rc |= p.wait()
+    return rc
+
+
+# ---------------------------------------------------------------------------
+# dstpu-nvme-tune
+# ---------------------------------------------------------------------------
+
+def nvme_tune_main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="dstpu-nvme-tune",
+        description="sweep AIO block size / queue depth on an NVMe scratch "
+                    "dir and save the fastest config (reference "
+                    "bin/ds_nvme_tune); the swap stack reads the saved "
+                    "config via deepspeed_tpu.runtime.swap_tensor")
+    ap.add_argument("nvme_dir", help="directory on the NVMe volume to tune")
+    ap.add_argument("--size-mb", type=int, default=256)
+    ap.add_argument("--block-mults", type=int, nargs="+",
+                    default=[1, 2, 4, 8, 16])
+    ap.add_argument("--queue-depths", type=int, nargs="+",
+                    default=[4, 8, 16, 32, 64])
+    ap.add_argument("-o", "--output", default=TUNE_OUTPUT,
+                    help=f"where to save the best config "
+                         f"(default {TUNE_OUTPUT})")
+    args = ap.parse_args(argv)
+
+    from deepspeed_tpu.launcher.bench_cli import bench_io
+    from deepspeed_tpu.ops.native.aio import (DEFAULT_BLOCK_SIZE,
+                                              DEFAULT_THREADS)
+
+    scratch = os.path.join(args.nvme_dir, ".dstpu_nvme_tune.scratch")
+    try:  # a previous interrupted sweep may have left its scratch behind
+        os.unlink(scratch)
+    except OSError:
+        pass
+    results = bench_io(scratch, args.size_mb, args.block_mults,
+                       args.queue_depths, read=True, write=True)
+    best = {}
+    for op in ("read", "write"):
+        rows = [r for r in results if r["op"] == op]
+        if rows:
+            best[op] = max(rows, key=lambda r: r["gbps"])
+    # single config serving both directions: highest min(read,write) speed
+    by_key = {}
+    for r in results:
+        by_key.setdefault((r["block_kb"], r["queue_depth"]), {})[r["op"]] = r
+    combined = [(min(v[o]["gbps"] for o in v), k) for k, v in by_key.items()]
+    (block_kb, queue_depth) = max(combined)[1]
+    config = {
+        "aio": {
+            "block_size": block_kb * 1024,
+            "queue_depth": queue_depth,
+            # the sweep varies block size / queue depth only; keep the
+            # library default rather than writing an unmeasured value
+            "thread_count": DEFAULT_THREADS,
+        },
+        "best_read": best.get("read"),
+        "best_write": best.get("write"),
+        "nvme_dir": os.path.abspath(args.nvme_dir),
+        "default_block_size": DEFAULT_BLOCK_SIZE,
+    }
+    with open(args.output, "w") as f:
+        json.dump(config, f, indent=2)
+    print(json.dumps({"saved": args.output, "aio": config["aio"]}))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(ssh_main())

@@ -45,6 +45,11 @@ def parse_args(argv=None):
     p.add_argument("--mpi_managed", action="store_true")
     p.add_argument("--module", action="store_true",
                    help="run user_script as a module (python -m)")
+    p.add_argument("--bind_cores_to_rank", action="store_true",
+                   help="pin host threads to this rank's NUMA core slice "
+                        "(reference launch.py --bind_cores_to_rank)")
+    p.add_argument("--bind_core_list", default=None,
+                   help="restrict binding to these cores, '0-15,32-47'")
     p.add_argument("user_script")
     p.add_argument("user_args", nargs=argparse.REMAINDER)
     return p.parse_args(argv)
@@ -60,6 +65,14 @@ def main(argv=None) -> int:
     os.environ["LOCAL_RANK"] = "0"  # one process per host on TPU
     if args.world_info:
         os.environ["DSTPU_WORLD_INFO"] = args.world_info
+
+    if args.bind_cores_to_rank:
+        from deepspeed_tpu.utils.numa import bind_current_process
+
+        # one process per host: local slice index 0 of 1, so binding here
+        # mainly restricts to --bind_core_list and sets OMP_NUM_THREADS
+        cores = bind_current_process(0, 1, args.bind_core_list)
+        logger.info(f"bound process to cores {cores}")
 
     import jax
 

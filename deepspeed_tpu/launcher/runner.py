@@ -145,6 +145,15 @@ class MultiNodeRunner:
         raise NotImplementedError
 
     @property
+    def bind_args(self) -> List[str]:
+        out = []
+        if getattr(self.args, "bind_cores_to_rank", False):
+            out.append("--bind_cores_to_rank")
+        if getattr(self.args, "bind_core_list", None):
+            out.append(f"--bind_core_list={self.args.bind_core_list}")
+        return out
+
+    @property
     def user_arguments(self) -> List[str]:
         return list(self.args.user_args or [])
 
@@ -173,7 +182,8 @@ class SSHRunner(MultiNodeRunner):
                 f"--coordinator_address={coordinator} "
                 f"--process_id={idx} --num_processes={len(hosts)} "
                 f"--world_info={self.world_info} "
-                f"{shlex.quote(self.args.user_script)} "
+                + "".join(f"{shlex.quote(a)} " for a in self.bind_args)
+                + f"{shlex.quote(self.args.user_script)} "
                 + " ".join(map(shlex.quote, self.user_arguments))
             )
             cmds.append(["ssh", "-o", "StrictHostKeyChecking=no", host,
@@ -194,6 +204,9 @@ class GCERunner(MultiNodeRunner):
         return which("gcloud") is not None
 
     def get_cmd(self, environment, active_resources) -> List[str]:
+        if self.bind_args:
+            logger.warning("gce launcher runs the script directly (no "
+                           "dstpu-launch); --bind_cores_to_rank is ignored")
         exports = " ".join(
             f"export {k}={shlex.quote(v)};" for k, v in environment.items())
         inner = (f"{exports} {sys.executable} "
@@ -225,6 +238,7 @@ class SlurmRunner(MultiNodeRunner):
                 f"--coordinator_address={hosts[0]}:{self.args.coordinator_port}",
                 f"--num_processes={len(hosts)}",
                 f"--world_info={self.world_info}",
+                *self.bind_args,
                 self.args.user_script] + self.user_arguments
         return cmd
 
@@ -251,6 +265,7 @@ class MPIRunner(MultiNodeRunner):
                 f"--coordinator_address={hosts[0]}:{self.args.coordinator_port}",
                 f"--num_processes={len(hosts)}",
                 f"--world_info={self.world_info}",
+                *self.bind_args,
                 self.args.user_script] + self.user_arguments
         return cmd
 
@@ -283,6 +298,18 @@ def parse_args(argv=None):
     p.add_argument("--tpu_zone", default=os.environ.get("TPU_ZONE", ""))
     p.add_argument("--dry_run", action="store_true",
                    help="print the per-host commands, do not execute")
+    p.add_argument("--bind_cores_to_rank", action="store_true",
+                   help="pin each worker's host threads to its NUMA core "
+                        "slice (forwarded to dstpu-launch)")
+    p.add_argument("--bind_core_list", default=None,
+                   help="restrict binding to these cores, '0-15,32-47'")
+    p.add_argument("--elastic_training", action="store_true",
+                   help="supervise workers with the elastic agent: restart "
+                        "on failure/membership change (reference "
+                        "runner.py:88-102)")
+    p.add_argument("--min_elastic_nodes", type=int, default=1)
+    p.add_argument("--max_elastic_nodes", type=int, default=64)
+    p.add_argument("--max_restarts", type=int, default=100)
     p.add_argument("user_script", nargs="?", default=None)
     p.add_argument("user_args", nargs=argparse.REMAINDER)
     return p.parse_args(argv)
@@ -302,19 +329,68 @@ def main(argv=None) -> int:
     active = parse_inclusion_exclusion(pool, args.include, args.exclude)
     world_info = encode_world_info(dict(active))
 
-    if len(active) == 1 and next(iter(active)) == "localhost":
+    if args.elastic_training and not args.hostfile:
+        raise RuntimeError("--elastic_training requires --hostfile")
+
+    if not args.elastic_training and \
+            len(active) == 1 and next(iter(active)) == "localhost":
         # single-host: exec in place, no ssh (reference runner does the
         # same for single-node jobs)
         cmd = [sys.executable, args.user_script] + list(args.user_args or [])
         if args.dry_run:
             print(shlex.join(cmd))
             return 0
+        if args.bind_cores_to_rank:
+            # bind in the parent; the child inherits affinity + OMP env
+            from deepspeed_tpu.utils.numa import bind_current_process
+
+            cores = bind_current_process(0, 1, args.bind_core_list)
+            logger.info(f"bound to cores {cores}")
         return subprocess.call(cmd)
 
     env = {"DSTPU_WORLD_INFO": world_info}
     runner = RUNNERS[args.launcher](args, world_info)
     if not args.dry_run and not runner.backend_exists():
         raise RuntimeError(f"launcher backend {args.launcher!r} not found")
+
+    if args.elastic_training:
+        from deepspeed_tpu.elasticity.elastic_agent import ElasticAgent
+
+        def filtered_pool() -> "OrderedDict[str, int]":
+            # re-read + re-filter every round so scale-up/down respects
+            # --include/--exclude just like the initial launch
+            return parse_inclusion_exclusion(
+                parse_hostfile(args.hostfile), args.include, args.exclude)
+
+        def membership():
+            try:
+                return list(filtered_pool())
+            except (OSError, ValueError):
+                return []
+
+        def build_cmds(hosts, restart_count):
+            try:
+                slots = filtered_pool()
+            except (OSError, ValueError):
+                # hostfile mid-rewrite: fall back to the membership list
+                # (slots are informational on TPU; launch is per host)
+                slots = {}
+            pool = OrderedDict((h, slots.get(h, 1)) for h in hosts)
+            wi = encode_world_info(dict(pool))
+            r = RUNNERS[args.launcher](args, wi)
+            cmds = r.get_cmd({"DSTPU_WORLD_INFO": wi}, pool)
+            return [cmds] if isinstance(cmds[0], str) else cmds
+
+        if args.dry_run:
+            for c in build_cmds(membership() or list(active), 0):
+                print(shlex.join(c))
+            return 0
+        agent = ElasticAgent(
+            build_cmds, membership,
+            min_nodes=args.min_elastic_nodes,
+            max_nodes=args.max_elastic_nodes,
+            max_restarts=args.max_restarts)
+        return agent.run()
     cmds = runner.get_cmd(env, active)
     if isinstance(cmds[0], str):
         cmds = [cmds]  # single fan-out command (gce/slurm/mpi)

@@ -26,6 +26,37 @@ DEFAULT_BLOCK_SIZE = 1 << 20
 DEFAULT_QUEUE_DEPTH = 32
 DEFAULT_THREADS = 8
 
+_TUNED_CONFIG_ENV = "DSTPU_NVME_CONFIG"
+_TUNED_CONFIG_DEFAULT = os.path.expanduser("~/.dstpu_nvme_config.json")
+
+
+_tuned_cache = None
+
+
+def tuned_aio_defaults() -> dict:
+    """AIO knobs saved by ``dstpu-nvme-tune`` (reference ds_nvme_tune
+    writes the optimal aio config for the swap stack). Returns the
+    built-in defaults when no tuned file exists or it is malformed.
+    Parsed once per process (per config path)."""
+    global _tuned_cache
+    path = os.environ.get(_TUNED_CONFIG_ENV, _TUNED_CONFIG_DEFAULT)
+    if _tuned_cache is not None and _tuned_cache[0] == path:
+        return _tuned_cache[1]
+    try:
+        import json
+
+        with open(path) as f:
+            aio = json.load(f)["aio"]
+        out = {"block_size": int(aio["block_size"]),
+               "queue_depth": int(aio["queue_depth"]),
+               "num_threads": int(aio.get("thread_count", DEFAULT_THREADS))}
+    except (OSError, KeyError, ValueError, TypeError, IndexError):
+        out = {"block_size": DEFAULT_BLOCK_SIZE,
+               "queue_depth": DEFAULT_QUEUE_DEPTH,
+               "num_threads": DEFAULT_THREADS}
+    _tuned_cache = (path, out)
+    return out
+
 
 def _as_bytes_view(arr: np.ndarray) -> np.ndarray:
     assert arr.flags["C_CONTIGUOUS"], "aio buffers must be contiguous"
@@ -73,9 +104,14 @@ class AsyncIOHandle:
     number of failed requests (0 == success).
     """
 
-    def __init__(self, block_size: int = DEFAULT_BLOCK_SIZE,
-                 queue_depth: int = DEFAULT_QUEUE_DEPTH,
-                 num_threads: int = DEFAULT_THREADS):
+    def __init__(self, block_size: Optional[int] = None,
+                 queue_depth: Optional[int] = None,
+                 num_threads: Optional[int] = None):
+        if None in (block_size, queue_depth, num_threads):
+            tuned = tuned_aio_defaults()
+            block_size = block_size or tuned["block_size"]
+            queue_depth = queue_depth or tuned["queue_depth"]
+            num_threads = num_threads or tuned["num_threads"]
         self.block_size = block_size
         self.queue_depth = queue_depth
         self.num_threads = num_threads

@@ -1,0 +1,98 @@
+"""NUMA / CPU-core binding helpers for launched worker processes.
+
+Reference: deepspeed/utils/numa.py (get_numa_cores, check_for_numactl,
+parse_range_list) used by launcher/launch.py ``--bind_cores_to_rank`` to
+pin each local rank to a distinct core range. On TPU hosts the analog
+matters for the host-side threads (data loading, AIO swap workers, host
+optimizers): pinning them away from the runtime's dispatch threads
+removes jitter.
+
+Pure-procfs implementation (no numactl dependency): node topology is read
+from /sys/devices/system/node; binding uses ``os.sched_setaffinity``.
+"""
+
+from __future__ import annotations
+
+import glob
+import os
+import re
+from typing import Dict, List, Optional, Sequence
+
+
+def parse_range(rng: str) -> List[int]:
+    """'0-3' -> [0,1,2,3]; '7' -> [7]."""
+    rng = rng.strip()
+    if "-" in rng:
+        lo, hi = rng.split("-", 1)
+        return list(range(int(lo), int(hi) + 1))
+    return [int(rng)]
+
+
+def parse_range_list(spec: str) -> List[int]:
+    """'0-3,8,10-11' -> [0,1,2,3,8,10,11] (reference numa.py parse_range_list)."""
+    out: List[int] = []
+    for part in spec.split(","):
+        part = part.strip()
+        if part:
+            out.extend(parse_range(part))
+    return sorted(set(out))
+
+
+def get_numa_cores() -> List[List[int]]:
+    """Per-NUMA-node core id lists, [[node0 cores...], [node1 cores...], ...].
+
+    Falls back to a single node holding every online CPU when the sysfs
+    topology is unavailable (containers often mask it).
+    """
+    nodes: Dict[int, List[int]] = {}
+    for path in glob.glob("/sys/devices/system/node/node[0-9]*/cpulist"):
+        m = re.search(r"node(\d+)", path)
+        if not m:
+            continue
+        try:
+            with open(path) as f:
+                nodes[int(m.group(1))] = parse_range_list(f.read())
+        except OSError:
+            continue
+    if nodes:
+        return [nodes[k] for k in sorted(nodes)]
+    return [sorted(os.sched_getaffinity(0))]
+
+
+def cores_for_rank(local_rank: int, local_size: int,
+                   cores: Optional[Sequence[int]] = None) -> List[int]:
+    """Even, NUMA-contiguous slice of host cores for one local rank.
+
+    Mirrors the reference launcher's --bind_cores_to_rank split
+    (launch.py --bind_core_list): cores are divided into ``local_size``
+    contiguous chunks; remainder cores go to the leading ranks.
+    """
+    if not 0 <= local_rank < local_size:
+        raise ValueError(f"local_rank {local_rank} not in [0, {local_size})")
+    if cores is None:
+        cores = [c for node in get_numa_cores() for c in node]
+    cores = list(cores)
+    n = len(cores)
+    base, rem = divmod(n, local_size)
+    if base == 0:
+        # more ranks than cores: round-robin single cores
+        return [cores[local_rank % n]]
+    start = local_rank * base + min(local_rank, rem)
+    count = base + (1 if local_rank < rem else 0)
+    return cores[start:start + count]
+
+
+def bind_current_process(local_rank: int, local_size: int,
+                         core_list: Optional[str] = None) -> List[int]:
+    """Pin the calling process to its rank's core slice; returns the slice.
+
+    ``core_list`` optionally restricts the pool ('0-15,32-47' syntax).
+    """
+    pool = parse_range_list(core_list) if core_list else None
+    chosen = cores_for_rank(local_rank, local_size, pool)
+    try:
+        os.sched_setaffinity(0, chosen)
+    except OSError:  # insufficient privileges / masked cpus: best effort
+        pass
+    os.environ["OMP_NUM_THREADS"] = str(max(1, len(chosen)))
+    return chosen

@@ -1,0 +1,231 @@
+"""Elastic agent + numa binding + aux CLI tests.
+
+Reference behaviors: DSElasticAgent restart-on-failure
+(elasticity/elastic_agent.py:32), ds_ssh / ds_nvme_tune CLIs,
+utils/numa.py core partitioning.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from deepspeed_tpu.elasticity.elastic_agent import (
+    ElasticAgent, WorkerGroupFailure, hostfile_membership)
+from deepspeed_tpu.utils import numa
+
+
+def _local_cmds(script):
+    def build(hosts, restart_count):
+        return [[sys.executable, "-c", script.format(rc=restart_count)]
+                for _ in hosts]
+
+    return build
+
+
+class TestElasticAgent:
+    def test_clean_exit(self):
+        agent = ElasticAgent(_local_cmds("import sys; sys.exit(0)"),
+                             lambda: ["a", "b"], poll_interval=0.05)
+        assert agent.run() == 0
+        assert agent.restart_count == 0
+
+    def test_restart_then_success(self, tmp_path):
+        marker = tmp_path / "failed_once"
+        script = (
+            "import os, sys\n"
+            f"m = {str(marker)!r}\n"
+            "if not os.path.exists(m):\n"
+            "    open(m, 'w').close(); sys.exit(1)\n"
+            "sys.exit(0)\n")
+
+        def build(hosts, rc):
+            return [[sys.executable, "-c", script] for _ in hosts]
+
+        agent = ElasticAgent(build, lambda: ["a"], poll_interval=0.05,
+                             max_restarts=3)
+        assert agent.run() == 0
+        assert agent.restart_count == 1
+
+    def test_max_restarts_exhausted(self):
+        agent = ElasticAgent(_local_cmds("import sys; sys.exit(1)"),
+                             lambda: ["a"], poll_interval=0.02,
+                             max_restarts=2)
+        with pytest.raises(WorkerGroupFailure):
+            agent.run()
+
+    def test_membership_change_restarts(self):
+        memberships = iter([["a", "b"], ["a", "b"], ["a"], ["a"]])
+        seen_worlds = []
+
+        def membership():
+            try:
+                m = next(memberships)
+            except StopIteration:
+                m = ["a"]
+            return m
+
+        def build(hosts, rc):
+            seen_worlds.append(list(hosts))
+            if len(seen_worlds) == 1:
+                # first round: long-running workers the agent must preempt
+                return [[sys.executable, "-c", "import time; time.sleep(30)"]
+                        for _ in hosts]
+            return [[sys.executable, "-c", "import sys; sys.exit(0)"]
+                    for _ in hosts]
+
+        agent = ElasticAgent(build, membership, poll_interval=0.05,
+                             max_restarts=5)
+        assert agent.run() == 0
+        assert seen_worlds[0] == ["a", "b"]
+        assert seen_worlds[-1] == ["a"]
+
+    def test_quorum_respects_elastic_config(self):
+        # node counts without a valid elastic batch config are waited out
+        ds_config = {"elasticity": {
+            "enabled": True, "max_train_batch_size": 64,
+            "micro_batch_sizes": [4], "min_gpus": 2, "max_gpus": 16,
+            "min_time": 0, "version": 0.1}}
+        agent = ElasticAgent(_local_cmds("import sys; sys.exit(0)"),
+                             lambda: ["a"], ds_config=ds_config,
+                             poll_interval=0.01)
+        assert not agent._admissible(["a"])  # min_gpus=2
+        assert agent._admissible(["a", "b"])
+
+    def test_hostfile_membership(self, tmp_path):
+        hf = tmp_path / "hostfile"
+        hf.write_text("h1 slots=4\nh2 slots=4\n")
+        poll = hostfile_membership(str(hf))
+        assert poll() == ["h1", "h2"]
+        hf.write_text("h1 slots=4\n")
+        assert poll() == ["h1"]
+        os.unlink(hf)
+        assert poll() == []
+
+
+class TestNuma:
+    def test_parse_range_list(self):
+        assert numa.parse_range_list("0-3,8,10-11") == [0, 1, 2, 3, 8, 10, 11]
+
+    def test_get_numa_cores_nonempty(self):
+        nodes = numa.get_numa_cores()
+        assert nodes and all(isinstance(c, int) for n in nodes for c in n)
+
+    def test_cores_for_rank_partition(self):
+        cores = list(range(10))
+        slices = [numa.cores_for_rank(r, 3, cores) for r in range(3)]
+        assert [c for s in slices for c in s] == cores  # exact cover
+        assert [len(s) for s in slices] == [4, 3, 3]  # remainder leads
+
+    def test_more_ranks_than_cores(self):
+        assert numa.cores_for_rank(5, 8, [0, 1]) == [1]
+
+    def test_bind_current_process_sets_omp(self, monkeypatch):
+        monkeypatch.delenv("OMP_NUM_THREADS", raising=False)
+        chosen = numa.bind_current_process(0, 1)
+        assert os.environ["OMP_NUM_THREADS"] == str(len(chosen))
+
+
+class TestAuxCli:
+    def test_nvme_tune_writes_config(self, tmp_path):
+        from deepspeed_tpu.launcher.aux_cli import nvme_tune_main
+
+        out = tmp_path / "tuned.json"
+        rc = nvme_tune_main([str(tmp_path), "--size-mb", "1",
+                             "--block-mults", "1", "--queue-depths", "4",
+                             "-o", str(out)])
+        assert rc == 0
+        cfg = json.loads(out.read_text())
+        assert cfg["aio"]["block_size"] > 0
+        assert cfg["aio"]["queue_depth"] == 4
+
+    def test_tuned_defaults_roundtrip(self, tmp_path, monkeypatch):
+        from deepspeed_tpu.ops.native.aio import (AsyncIOHandle,
+                                                  tuned_aio_defaults)
+
+        cfgf = tmp_path / "nvme.json"
+        cfgf.write_text(json.dumps({"aio": {
+            "block_size": 2097152, "queue_depth": 7, "thread_count": 3}}))
+        monkeypatch.setenv("DSTPU_NVME_CONFIG", str(cfgf))
+        assert tuned_aio_defaults()["queue_depth"] == 7
+        h = AsyncIOHandle()
+        assert (h.block_size, h.queue_depth, h.num_threads) == (2097152, 7, 3)
+        h.close()
+
+    def test_ssh_cli_requires_command(self, capsys):
+        from deepspeed_tpu.launcher.aux_cli import ssh_main
+
+        with pytest.raises(SystemExit):
+            ssh_main(["-H", "/nonexistent"])
+
+    def test_elastic_flags_dry_run(self, tmp_path):
+        # --elastic_training without hostfile errors cleanly
+        from deepspeed_tpu.launcher.runner import main
+
+        script = tmp_path / "t.py"
+        script.write_text("print('hi')\n")
+        with pytest.raises(RuntimeError, match="hostfile"):
+            main(["--elastic_training", str(script)])
+
+    def test_elastic_dry_run_prints_not_launches(self, tmp_path, capsys):
+        from deepspeed_tpu.launcher.runner import main
+
+        hf = tmp_path / "hostfile"
+        hf.write_text("h1 slots=4\nh2 slots=4\n")
+        script = tmp_path / "t.py"
+        script.write_text("pass\n")
+        rc = main(["-H", str(hf), "--elastic_training", "--dry_run",
+                   str(script)])
+        assert rc == 0
+        out = capsys.readouterr().out
+        assert "ssh" in out and "h1" in out and "h2" in out
+
+    def test_elastic_membership_respects_exclude(self, tmp_path, capsys):
+        from deepspeed_tpu.launcher.runner import main
+
+        hf = tmp_path / "hostfile"
+        hf.write_text("h1 slots=4\nbad slots=4\n")
+        script = tmp_path / "t.py"
+        script.write_text("pass\n")
+        rc = main(["-H", str(hf), "-e", "bad", "--elastic_training",
+                   "--dry_run", str(script)])
+        assert rc == 0
+        out = capsys.readouterr().out
+        assert "bad" not in out and "h1" in out
+
+    def test_bind_flags_forwarded(self, tmp_path, capsys):
+        from deepspeed_tpu.launcher.runner import main
+
+        hf = tmp_path / "hostfile"
+        hf.write_text("h1 slots=4\nh2 slots=4\n")
+        script = tmp_path / "t.py"
+        script.write_text("pass\n")
+        rc = main(["-H", str(hf), "--bind_cores_to_rank",
+                   "--bind_core_list", "0-3", "--dry_run", str(script)])
+        assert rc == 0
+        out = capsys.readouterr().out
+        assert "--bind_cores_to_rank" in out
+        assert "--bind_core_list=0-3" in out
+
+    def test_partial_clean_exit_triggers_restart(self):
+        # one rank exits 0 while peers hang: after drain_grace the agent
+        # must tear the round down instead of waiting forever
+        calls = []
+
+        def build(hosts, rc):
+            calls.append(rc)
+            if rc == 0:
+                return [
+                    [sys.executable, "-c", "import sys; sys.exit(0)"],
+                    [sys.executable, "-c", "import time; time.sleep(60)"],
+                ]
+            return [[sys.executable, "-c", "import sys; sys.exit(0)"]
+                    for _ in hosts]
+
+        agent = ElasticAgent(build, lambda: ["a", "b"], poll_interval=0.05,
+                             max_restarts=2)
+        agent.drain_grace = 0.3
+        assert agent.run() == 0
+        assert calls == [0, 1]
