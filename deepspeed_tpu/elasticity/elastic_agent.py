@@ -68,8 +68,22 @@ class ElasticAgent:
         self.env = dict(env or {})
         self.restart_count = 0
         self._procs: List[subprocess.Popen] = []
+        self._last_membership: List[str] = []
 
     # -- membership --------------------------------------------------------
+    def _poll_membership(self) -> List[str]:
+        """Current membership; a raising/transiently-broken source (e.g. a
+        hostfile mid-rewrite) returns the last known good value instead of
+        [] so a healthy group is not torn down on a read glitch."""
+        try:
+            hosts = sorted(self.membership_fn())
+        except Exception as e:
+            logger.warning(f"elastic agent: membership poll failed ({e}); "
+                           "keeping last known membership")
+            return self._last_membership
+        self._last_membership = hosts
+        return hosts
+
     def _admissible(self, hosts: Sequence[str]) -> bool:
         n = len(hosts)
         if not self.min_nodes <= n <= self.max_nodes:
@@ -88,7 +102,7 @@ class ElasticAgent:
 
     def _wait_for_quorum(self) -> List[str]:
         while True:
-            hosts = sorted(self.membership_fn())
+            hosts = self._poll_membership()
             if self._admissible(hosts):
                 return hosts
             time.sleep(self.poll_interval)
@@ -99,7 +113,13 @@ class ElasticAgent:
         env["DSTPU_ELASTIC_RESTART_COUNT"] = str(self.restart_count)
         env["DSTPU_ELASTIC_WORLD"] = ",".join(hosts)
         cmds = self.cmd_builder(hosts, self.restart_count)
-        self._procs = [subprocess.Popen(c, env=env) for c in cmds]
+        self._procs = []
+        try:
+            for c in cmds:
+                self._procs.append(subprocess.Popen(c, env=env))
+        except Exception:
+            self._stop()  # don't leak the workers spawned before the error
+            raise
         logger.info(f"elastic agent: started {len(self._procs)} workers "
                     f"on {list(hosts)} (restart {self.restart_count})")
 
@@ -174,7 +194,7 @@ class ElasticAgent:
                         f"{self.drain_grace}s after a peer exited cleanly "
                         "(likely deadlocked collective); restarting group")
                     return 1
-            current = sorted(self.membership_fn())
+            current = self._poll_membership()
             if current != list(hosts):
                 logger.warning(
                     f"elastic agent: membership changed {list(hosts)} -> "
@@ -190,9 +210,8 @@ def hostfile_membership(path: str) -> Callable[[], List[str]]:
     def poll() -> List[str]:
         from deepspeed_tpu.launcher.runner import parse_hostfile
 
-        try:
-            return list(parse_hostfile(path))
-        except (OSError, ValueError):
-            return []
+        # raises on a missing/mid-rewrite hostfile; the agent holds the
+        # last known membership across such transients
+        return list(parse_hostfile(path))
 
     return poll

@@ -150,8 +150,13 @@ def bench_io(path: str, size_mb: int = 256, block_sizes=(1, 8, 16),
     results = []
     for bs_mult in block_sizes:
         for qd in queue_depths:
+            # pin every knob: a stale tuned config must not parameterize
+            # the benchmark that tuned configs are derived from
+            from deepspeed_tpu.ops.native.aio import DEFAULT_THREADS
+
             handle = AsyncIOHandle(block_size=bs_mult * DEFAULT_BLOCK_SIZE,
-                                   queue_depth=qd)
+                                   queue_depth=qd,
+                                   num_threads=DEFAULT_THREADS)
             if write:
                 t0 = time.perf_counter()
                 handle.pwrite(data, path)

@@ -363,10 +363,9 @@ def main(argv=None) -> int:
                 parse_hostfile(args.hostfile), args.include, args.exclude)
 
         def membership():
-            try:
-                return list(filtered_pool())
-            except (OSError, ValueError):
-                return []
+            # raises on a mid-rewrite hostfile; the agent keeps the last
+            # known membership across such transients
+            return list(filtered_pool())
 
         def build_cmds(hosts, restart_count):
             try:
@@ -378,7 +377,13 @@ def main(argv=None) -> int:
             pool = OrderedDict((h, slots.get(h, 1)) for h in hosts)
             wi = encode_world_info(dict(pool))
             r = RUNNERS[args.launcher](args, wi)
-            cmds = r.get_cmd({"DSTPU_WORLD_INFO": wi}, pool)
+            # exported on the remote side too (ssh builds exports from
+            # this dict; local-process env alone never crosses ssh)
+            cmds = r.get_cmd({
+                "DSTPU_WORLD_INFO": wi,
+                "DSTPU_ELASTIC_RESTART_COUNT": str(restart_count),
+                "DSTPU_ELASTIC_WORLD": ",".join(hosts),
+            }, pool)
             return [cmds] if isinstance(cmds[0], str) else cmds
 
         if args.dry_run:

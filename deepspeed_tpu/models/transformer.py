@@ -282,11 +282,16 @@ def _layer(cfg: TransformerConfig, x, layer_params, positions):
     dt = effective_dtype(cfg.dtype)
     x = x.astype(dt)
 
+    from jax.ad_checkpoint import checkpoint_name
+
     # attention
     y = _norm(x, layer_params["ln1"], cfg.norm, cfg.norm_eps)
-    q = jnp.einsum("bsh,hnd->bsnd", y, ap["wq"].astype(dt))
-    k = jnp.einsum("bsh,hnd->bsnd", y, ap["wk"].astype(dt))
-    v = jnp.einsum("bsh,hnd->bsnd", y, ap["wv"].astype(dt))
+    q = checkpoint_name(
+        jnp.einsum("bsh,hnd->bsnd", y, ap["wq"].astype(dt)), "qkv_proj")
+    k = checkpoint_name(
+        jnp.einsum("bsh,hnd->bsnd", y, ap["wk"].astype(dt)), "qkv_proj")
+    v = checkpoint_name(
+        jnp.einsum("bsh,hnd->bsnd", y, ap["wv"].astype(dt)), "qkv_proj")
     if cfg.pos_emb == "rope":
         q = _rope(q, positions, cfg.rope_theta)
         k = _rope(k, positions, cfg.rope_theta)
@@ -297,9 +302,10 @@ def _layer(cfg: TransformerConfig, x, layer_params, positions):
         rep = cfg.num_heads // cfg.kv_heads
         k = jnp.repeat(k, rep, axis=2)
         v = jnp.repeat(v, rep, axis=2)
-    attn = _attention(q, k, v, cfg)
+    attn = checkpoint_name(_attention(q, k, v, cfg), "attn_kernel_out")
     attn = jnp.einsum("bsnd,ndh->bsh", attn, ap["wo"].astype(dt))
-    x = x + constrain_activation(attn, ("batch", "seq", "embed"))
+    x = x + constrain_activation(
+        checkpoint_name(attn, "attn_out"), ("batch", "seq", "embed"))
 
     # mlp
     y = _norm(x, layer_params["ln2"], cfg.norm, cfg.norm_eps)
@@ -312,8 +318,10 @@ def _layer(cfg: TransformerConfig, x, layer_params, positions):
         else:
             act = jax.nn.relu if cfg.activation == "relu" else jax.nn.gelu
             z = act(jnp.einsum("bsh,hf->bsf", y, mp["wi"].astype(dt)))
-        z = constrain_activation(z, ("batch", "seq", "mlp"))
-        return jnp.einsum("bsf,fh->bsh", z, mp["wo"].astype(dt))
+        z = constrain_activation(
+            checkpoint_name(z, "mlp_wi"), ("batch", "seq", "mlp"))
+        return checkpoint_name(
+            jnp.einsum("bsf,fh->bsh", z, mp["wo"].astype(dt)), "mlp_out")
 
     if cfg.tiled_mlp > 1:
         # position-wise → chunk the sequence (ALST TiledMLP analog):

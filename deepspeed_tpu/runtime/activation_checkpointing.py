@@ -47,6 +47,15 @@ POLICIES = {
     # disable remat entirely
     "none": "everything",
     "everything": "everything",
+    # named selective saves (checkpoint_name annotations in
+    # models/transformer.py _layer): trade HBM for skipped recompute of
+    # just those projections — the [S,S] score transient is never saved
+    "save_qkv_proj": ("names", ("qkv_proj",)),
+    "save_attn_out": ("names", ("attn_out",)),
+    "save_qkv_attn_out": ("names", ("qkv_proj", "attn_kernel_out",
+                                    "attn_out")),
+    "save_attn_mlp": ("names", ("qkv_proj", "attn_kernel_out", "attn_out",
+                                "mlp_out")),
 }
 
 _GLOBAL_CONFIG: dict = {}
@@ -96,6 +105,8 @@ def resolve_policy(name: Optional[str] = None,
                          f"'{name}' (choose from {sorted(POLICIES)})")
     if canonical == "everything":
         return "everything"  # remat explicitly disabled: offload n/a
+    if isinstance(canonical, tuple) and canonical[0] == "names":
+        return jax.checkpoint_policies.save_only_these_names(*canonical[1])
     if cpu_checkpointing or _GLOBAL_CONFIG.get("cpu_checkpointing"):
         canonical = "offload_dots_host"
     if canonical == "nothing_saveable":

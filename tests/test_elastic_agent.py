@@ -102,7 +102,39 @@ class TestElasticAgent:
         hf.write_text("h1 slots=4\n")
         assert poll() == ["h1"]
         os.unlink(hf)
-        assert poll() == []
+        with pytest.raises(OSError):
+            poll()  # agent keeps last-known membership across this
+
+    def test_membership_glitch_keeps_last_known(self):
+        polls = iter([["a", "b"], RuntimeError("mid-rewrite"), ["a", "b"]])
+
+        def membership():
+            v = next(polls)
+            if isinstance(v, Exception):
+                raise v
+            return v
+
+        agent = ElasticAgent(_local_cmds("import sys; sys.exit(0)"),
+                             membership, poll_interval=0.01)
+        assert agent._poll_membership() == ["a", "b"]
+        assert agent._poll_membership() == ["a", "b"]  # glitch → last known
+        assert agent._poll_membership() == ["a", "b"]
+
+    def test_start_failure_does_not_leak_workers(self, tmp_path):
+        marker = tmp_path / "started"
+
+        def build(hosts, rc):
+            return [
+                [sys.executable, "-c",
+                 f"import time,os; open({str(marker)!r},'w').close(); "
+                 "time.sleep(60)"],
+                ["/nonexistent-binary-xyz"],
+            ]
+
+        agent = ElasticAgent(build, lambda: ["a", "b"], poll_interval=0.01)
+        with pytest.raises(FileNotFoundError):
+            agent._start(["a", "b"])
+        assert agent._procs == []  # first worker was reaped, not leaked
 
 
 class TestNuma:
