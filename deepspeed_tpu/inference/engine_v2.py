@@ -69,6 +69,13 @@ class InferenceEngineV2:
         self.max_seqs = max_seqs_per_step
         self.max_blocks_per_seq = max_blocks_per_seq
         self._step_fn = jax.jit(partial(model_runner.ragged_forward, self.cfg))
+        # decode-only steps use the Pallas paged-attention kernel (no
+        # per-token context gather). Pallas under GSPMD needs shard_map;
+        # until then the kernel path is single-shard (tp == 1) only.
+        self._use_paged_kernel = (
+            self.mesh is None or self.mesh.shape.get("tp", 1) == 1)
+        self._decode_fn = jax.jit(
+            partial(model_runner.ragged_decode_forward, self.cfg))
         log_dist(
             f"InferenceEngineV2: kv_blocks={kv_blocks}x{kv_block_size} "
             f"budget={max_tokens_per_step}tok/{max_seqs_per_step}seq",
@@ -115,15 +122,34 @@ class InferenceEngineV2:
             return {}
         batch = build_ragged_batch(scheduled, self.max_tokens, self.max_seqs,
                                    self.max_blocks_per_seq)
+        # steady-state decode (one token per sequence): tokens line up
+        # with slots, so the compact paged-kernel path applies
+        decode_only = (self._use_paged_kernel
+                       and all(len(nt) == 1 for _, nt, _ in scheduled))
         with self.mesh:
-            logits, new_kv = self._step_fn(
-                self.params, self.kv_cache.data,
-                jnp.asarray(batch.token_ids), jnp.asarray(batch.token_seq),
-                jnp.asarray(batch.token_pos), jnp.asarray(batch.block_table),
-                jnp.asarray(batch.num_tokens, jnp.int32))
+            if decode_only:
+                # compact per-slot arrays: token i belongs to slot i; pad
+                # out to max_seqs (token budget may be smaller than the
+                # slot budget)
+                n = batch.num_tokens
+                d_tok = np.zeros(self.max_seqs, np.int32)
+                d_pos = np.zeros(self.max_seqs, np.int32)
+                d_tok[:n] = batch.token_ids[:n]
+                d_pos[:n] = batch.token_pos[:n]
+                logits, new_kv = self._decode_fn(
+                    self.params, self.kv_cache.data,
+                    jnp.asarray(d_tok), jnp.asarray(d_pos),
+                    jnp.asarray(batch.block_table),
+                    jnp.asarray(batch.ctx_lens))
+            else:
+                logits, new_kv = self._step_fn(
+                    self.params, self.kv_cache.data,
+                    jnp.asarray(batch.token_ids), jnp.asarray(batch.token_seq),
+                    jnp.asarray(batch.token_pos), jnp.asarray(batch.block_table),
+                    jnp.asarray(batch.num_tokens, jnp.int32))
         self.kv_cache.data = new_kv
 
-        logits_np = np.asarray(logits)  # [T, V] fp32
+        logits_np = np.asarray(logits)  # [T, V] fp32 (or [S, V] decode)
         emitted: Dict[int, int] = {}
         for slot, (seq, new_tokens, start_pos) in enumerate(scheduled):
             n = len(new_tokens)
@@ -131,7 +157,8 @@ class InferenceEngineV2:
             completed_prompt = seq.seen_tokens >= len(seq.input_tokens)
             if not completed_prompt:
                 continue  # mid-prefill: no logits consumed
-            row = logits_np[batch.last_token_index[slot]]
+            row = logits_np[slot if decode_only
+                            else batch.last_token_index[slot]]
             tok = _sample_np(row, temperature, seed + slot + seq.seen_tokens)
             seq.generated.append(int(tok))
             emitted[seq.uid] = int(tok)

@@ -201,3 +201,61 @@ def ragged_forward(cfg: TransformerConfig, params, kv_data: jax.Array,
     x, new_kv = lax.scan(layer_body, x, (params["layers"], kv_data))
     x = _norm(x, params["final_norm"], cfg.norm, cfg.norm_eps)
     return _unembed(cfg, params, x), new_kv
+
+
+# ---------------------------------------------------------------------------
+# decode-only ragged path: Pallas paged-attention kernel (v2 engine)
+# ---------------------------------------------------------------------------
+
+
+def ragged_decode_forward(cfg: TransformerConfig, params, kv_data: jax.Array,
+                          token_ids: jax.Array, token_pos: jax.Array,
+                          block_table: jax.Array, context_lens: jax.Array
+                          ) -> Tuple[jax.Array, jax.Array]:
+    """One decode step: exactly one new token per live slot.
+
+    Reference: the blocked-flash decode kernels of inference/v2
+    (ragged_ops/blocked_flash + linear_blocked_kv_rotary) — here the KV
+    append is an XLA scatter and attention is the Pallas paged kernel
+    (ops/pallas/paged_attention.py), so no per-token context is ever
+    gathered. Dead slots have context_lens == 0: their K/V writes are
+    routed to the scratch page and their logits are zeros.
+
+    kv_data      [L, num_blocks, bs, 2, nkv, hd]
+    token_ids    [S] int32;  token_pos [S];  block_table [S, Bm]
+    context_lens [S] = token_pos + 1 for live slots, 0 for dead
+
+    Returns (logits [S, V] fp32, kv_data').
+    """
+    from deepspeed_tpu.ops.pallas.paged_attention import paged_decode_attention
+
+    S = token_ids.shape[0]
+    bs = kv_data.shape[2]
+    dt = effective_dtype(cfg.dtype)
+    alive = context_lens > 0
+
+    x = params["embed"]["tokens"].astype(dt)[token_ids]  # [S, H]
+    if cfg.pos_emb == "learned":
+        x = x + params["embed"]["positions"].astype(dt)[token_pos]
+
+    scratch = kv_data.shape[1] - 1
+    page = block_table[jnp.arange(S), token_pos // bs]
+    page = jnp.where(alive, page, scratch)
+    offset = jnp.where(alive, token_pos % bs, bs - 1)
+
+    def layer_body(x, inputs):
+        layer_params, kv_layer = inputs
+        y = _norm(x, layer_params["ln1"], cfg.norm, cfg.norm_eps)
+        q, k, v = _qkv(cfg, layer_params, y, token_pos)  # q [S,nh,hd]
+        kv_layer = kv_layer.at[page, offset, 0].set(k.astype(kv_layer.dtype))
+        kv_layer = kv_layer.at[page, offset, 1].set(v.astype(kv_layer.dtype))
+        attn = paged_decode_attention(q.astype(dt), kv_layer, block_table,
+                                      context_lens)
+        attn = jnp.einsum("snd,ndh->sh", attn.astype(dt),
+                          layer_params["attn"]["wo"].astype(dt))
+        x = x + attn
+        return _mlp(cfg, layer_params, x), kv_layer
+
+    x, new_kv = lax.scan(layer_body, x, (params["layers"], kv_data))
+    x = _norm(x, params["final_norm"], cfg.norm, cfg.norm_eps)
+    return _unembed(cfg, params, x), new_kv

@@ -66,7 +66,7 @@ def main(argv=None) -> int:
     if args.world_info:
         os.environ["DSTPU_WORLD_INFO"] = args.world_info
 
-    if args.bind_cores_to_rank:
+    if args.bind_cores_to_rank or args.bind_core_list:
         from deepspeed_tpu.utils.numa import bind_current_process
 
         # one process per host: local slice index 0 of 1, so binding here

@@ -66,9 +66,12 @@ def parse_hostfile(path_or_lines) -> "OrderedDict[str, int]":
 
 def parse_inclusion_exclusion(resource_pool: Dict[str, int],
                               include: str = "",
-                              exclude: str = "") -> "OrderedDict[str, int]":
+                              exclude: str = "",
+                              strict: bool = True) -> "OrderedDict[str, int]":
     """Filter hosts: ``host1@host2`` selects hosts; ``host1:0,2`` selects
-    slots (reference runner.py:310 syntax)."""
+    slots (reference runner.py:310 syntax). ``strict=False`` skips filter
+    hosts missing from the pool instead of raising — elastic polling uses
+    it, since a scaled-down hostfile legitimately drops filtered hosts."""
     if include and exclude:
         raise ValueError("--include and --exclude are mutually exclusive")
 
@@ -89,7 +92,7 @@ def parse_inclusion_exclusion(resource_pool: Dict[str, int],
     if include:
         sel = parse_spec(include)
         for host in sel:
-            if host not in pool:
+            if host not in pool and strict:
                 raise ValueError(f"--include host {host!r} not in hostfile")
         return OrderedDict(
             (h, len(sel[h]) if sel[h] is not None else pool[h])
@@ -97,8 +100,9 @@ def parse_inclusion_exclusion(resource_pool: Dict[str, int],
     if exclude:
         sel = parse_spec(exclude)
         for host in sel:
-            if host not in pool:
+            if host not in pool and strict:
                 raise ValueError(f"--exclude host {host!r} not in hostfile")
+        sel = {h: v for h, v in sel.items() if h in pool}
         out = OrderedDict()
         for h, slots in pool.items():
             if h not in sel:
@@ -340,7 +344,7 @@ def main(argv=None) -> int:
         if args.dry_run:
             print(shlex.join(cmd))
             return 0
-        if args.bind_cores_to_rank:
+        if args.bind_cores_to_rank or args.bind_core_list:
             # bind in the parent; the child inherits affinity + OMP env
             from deepspeed_tpu.utils.numa import bind_current_process
 
@@ -358,9 +362,11 @@ def main(argv=None) -> int:
 
         def filtered_pool() -> "OrderedDict[str, int]":
             # re-read + re-filter every round so scale-up/down respects
-            # --include/--exclude just like the initial launch
+            # --include/--exclude just like the initial launch; non-strict
+            # so a scaled-down hostfile missing a filter host is fine
             return parse_inclusion_exclusion(
-                parse_hostfile(args.hostfile), args.include, args.exclude)
+                parse_hostfile(args.hostfile), args.include, args.exclude,
+                strict=False)
 
         def membership():
             # raises on a mid-rewrite hostfile; the agent keeps the last

@@ -1,0 +1,158 @@
+"""Paged (blocked-KV) decode attention kernel.
+
+Reference: the ragged inference ops in
+``inference/v2/kernels/ragged_ops/blocked_flash`` — CUDA flash attention
+reading K/V directly from paged cache blocks via a block table, so decode
+never materializes a per-token contiguous context.
+
+TPU re-design: one Pallas kernel per sequence walks that sequence's pages
+(innermost grid dim) with the block table as a scalar-prefetch operand —
+the page id feeds the BlockSpec index_map, so the next page's DMA is
+issued ahead of the body (the TPU analog of the reference's async-copy
+pipeline). Online-softmax accumulation over pages in fp32 scratch; GQA
+handled by grouping query heads per kv head (static in-kernel loop, since
+Mosaic block shapes cannot tile the kv-head axis independently).
+
+Layout matches inference/ragged/kv_cache.py: one layer's pool is
+``kv[num_blocks, block_size, 2, kv_heads, head_dim]`` — the same array is
+fetched one page per grid step; the kernel reads K from plane 0 and V
+from plane 1 of the same block.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+
+
+def _interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+def _visit(q_ref, kv_ref, m_ref, l_ref, acc_ref, visible, *, bs: int,
+           nkv: int, gp: int, scale: float):
+    """Fold one K/V page into the online-softmax state."""
+    for n in range(nkv):  # static unroll over kv heads
+        rows = slice(n * gp, (n + 1) * gp)
+        q = q_ref[0, n].astype(jnp.float32) * scale   # [gp, hd]
+        k = kv_ref[0, :, 0, n].astype(jnp.float32)    # [bs, hd]
+        v = kv_ref[0, :, 1, n].astype(jnp.float32)    # [bs, hd]
+
+        sc = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        sc = jax.lax.select(visible, sc, jnp.full_like(sc, NEG_INF))
+
+        m_prev = m_ref[rows, :1]                      # [gp, 1]
+        m_cur = jnp.max(sc, axis=1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        alpha = jnp.exp(m_prev - m_new)
+        # explicit zero for masked columns: when every score so far is
+        # the NEG_INF sentinel, exp(sc - m_new) == exp(0) would count them
+        e = jnp.exp(sc - m_new)
+        p = jax.lax.select(visible, e, jnp.zeros_like(e))
+
+        l_new = alpha * l_ref[rows, :1] + jnp.sum(p, axis=1, keepdims=True)
+        acc_ref[rows, :] = acc_ref[rows, :] * alpha + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m_ref[rows, :] = jnp.broadcast_to(m_new, (gp, m_ref.shape[1]))
+        l_ref[rows, :] = jnp.broadcast_to(l_new, (gp, l_ref.shape[1]))
+
+
+def _kernel(bt_ref, ctx_ref, q_ref, kv_ref, out_ref,
+            m_ref, l_ref, acc_ref, *, bs: int, nkv: int, gp: int,
+            scale: float):
+    s = pl.program_id(0)
+    j = pl.program_id(1)
+    nj = pl.num_programs(1)
+
+    @pl.when(j == 0)
+    def _init():
+        m_ref[...] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    ctx = ctx_ref[s]
+    cols = j * bs + jax.lax.broadcasted_iota(jnp.int32, (gp, bs), 1)
+    visible = cols < ctx
+
+    @pl.when(j * bs < ctx)  # pages past the context: no compute (and the
+    def _visit_page():      # index_map re-requests the same page: no DMA)
+        _visit(q_ref, kv_ref, m_ref, l_ref, acc_ref, visible,
+               bs=bs, nkv=nkv, gp=gp, scale=scale)
+    @pl.when(j == nj - 1)
+    def _finalize():
+        for n in range(nkv):
+            rows = slice(n * gp, (n + 1) * gp)
+            l = l_ref[rows, :1]
+            l = jax.lax.select(l == 0.0, jnp.ones_like(l), l)  # dead slots
+            out_ref[0, n] = (acc_ref[rows, :] / l).astype(out_ref.dtype)
+
+
+def paged_decode_attention(q: jax.Array, kv_layer: jax.Array,
+                           block_table: jax.Array, context_lens: jax.Array,
+                           scale: float = None) -> jax.Array:
+    """Decode attention over a paged KV pool.
+
+    q            [S, num_heads, head_dim] — one query token per sequence
+    kv_layer     [num_blocks, block_size, 2, kv_heads, head_dim]
+    block_table  [S, max_pages] int32 page ids (entries past the context
+                 may be stale/scratch; they are read but masked)
+    context_lens [S] int32 — keys visible per sequence (including the
+                 token written this step); 0 marks a dead slot (output 0)
+
+    Returns [S, num_heads, head_dim] in q.dtype.
+    """
+    S, nh, hd = q.shape
+    nb, bs, _, nkv, _ = kv_layer.shape
+    Bm = block_table.shape[1]
+    if nh % nkv:
+        raise ValueError(f"num_heads {nh} not a multiple of kv_heads {nkv}")
+    g = nh // nkv
+    gp = max(8, -(-g // 8) * 8)  # pad head group to the fp32 sublane tile
+    if scale is None:
+        scale = 1.0 / (hd ** 0.5)
+
+    qg = q.reshape(S, nkv, g, hd)
+    if gp != g:
+        qg = jnp.pad(qg, ((0, 0), (0, 0), (0, gp - g), (0, 0)))
+
+    def page(s, j, bt, ctx):
+        # clamp beyond-context iterations to the last live page: Mosaic
+        # skips the DMA when consecutive grid steps request the same block
+        last = jax.lax.max(ctx[s] - 1, 0) // bs
+        j_eff = jax.lax.min(j, last)
+        return jax.lax.min(jax.lax.max(bt[s, j_eff], 0), nb - 1)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(S, Bm),
+        in_specs=[
+            pl.BlockSpec((1, nkv, gp, hd), lambda s, j, bt, ctx: (s, 0, 0, 0)),
+            pl.BlockSpec((1, bs, 2, nkv, hd),
+                         lambda s, j, bt, ctx: (page(s, j, bt, ctx),
+                                                0, 0, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, nkv, gp, hd),
+                               lambda s, j, bt, ctx: (s, 0, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((nkv * gp, 128), jnp.float32),  # running max
+            pltpu.VMEM((nkv * gp, 128), jnp.float32),  # running denom
+            pltpu.VMEM((nkv * gp, hd), jnp.float32),   # weighted-value acc
+        ],
+    )
+    out = pl.pallas_call(
+        functools.partial(_kernel, bs=bs, nkv=nkv, gp=gp,
+                          scale=float(scale)),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((S, nkv, gp, hd), q.dtype),
+        interpret=_interpret(),
+    )(block_table.astype(jnp.int32), context_lens.astype(jnp.int32),
+      qg, kv_layer)
+    return out[:, :, :g, :].reshape(S, nh, hd)

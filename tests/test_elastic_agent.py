@@ -105,6 +105,19 @@ class TestElasticAgent:
         with pytest.raises(OSError):
             poll()  # agent keeps last-known membership across this
 
+    def test_nonstrict_filter_tolerates_scaled_down_hostfile(self):
+        # elastic polling must keep working after the hostfile drops a
+        # host named in --include/--exclude
+        from deepspeed_tpu.launcher.runner import parse_inclusion_exclusion
+
+        pool = {"h1": 4}
+        with pytest.raises(ValueError):
+            parse_inclusion_exclusion(pool, exclude="gone")
+        assert dict(parse_inclusion_exclusion(
+            pool, exclude="gone", strict=False)) == {"h1": 4}
+        assert dict(parse_inclusion_exclusion(
+            pool, include="h1@gone", strict=False)) == {"h1": 4}
+
     def test_membership_glitch_keeps_last_known(self):
         polls = iter([["a", "b"], RuntimeError("mid-rewrite"), ["a", "b"]])
 

@@ -150,6 +150,19 @@ class TestInferenceEngineV2:
         ref = v1.generate(prompt[None], max_new_tokens=3)[0, len(prompt):]
         assert results[7] == ref.tolist()
 
+    def test_paged_kernel_matches_gather_path(self, tiny):
+        """Decode via the Pallas paged kernel == the gather ragged path."""
+        prompts = {1: [5, 9, 2, 14, 7], 2: [3, 1, 4]}
+
+        def run(use_kernel):
+            v2 = self._make(tiny)
+            v2._use_paged_kernel = use_kernel
+            v2.put(list(prompts), [np.asarray(p) for p in prompts.values()],
+                   max_new_tokens=5)
+            return v2.generate_all()
+
+        assert run(True) == run(False)
+
     def test_kv_released_on_finish(self, tiny):
         v2 = self._make(tiny)
         free0 = v2.kv_cache.free_blocks
