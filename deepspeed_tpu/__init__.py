@@ -15,9 +15,30 @@ Public entry points (parity with reference deepspeed/__init__.py):
 
 from deepspeed_tpu.version import __version__, git_hash, git_branch
 
-from deepspeed_tpu import comm  # noqa: F401
-from deepspeed_tpu.config.config import Config, load_config  # noqa: F401
-from deepspeed_tpu.parallel.topology import TopologyConfig, build_mesh  # noqa: F401
+# Everything below imports jax transitively; resolve lazily (PEP 562) so
+# host-side CLI processes (dstpu runner/ssh fan-out, elastic agent) that
+# only need logging/hostfile parsing never pay the jax import, and
+# launch.py can bind cores before jax spins up its thread pools.
+_LAZY_EXPORTS = {
+    "comm": ("deepspeed_tpu.comm", None),
+    "Config": ("deepspeed_tpu.config.config", "Config"),
+    "load_config": ("deepspeed_tpu.config.config", "load_config"),
+    "TopologyConfig": ("deepspeed_tpu.parallel.topology", "TopologyConfig"),
+    "build_mesh": ("deepspeed_tpu.parallel.topology", "build_mesh"),
+}
+
+
+def __getattr__(name):
+    if name in _LAZY_EXPORTS:
+        import importlib
+
+        mod, attr = _LAZY_EXPORTS[name]
+        module = importlib.import_module(mod)
+        value = module if attr is None else getattr(module, attr)
+        globals()[name] = value  # cache for next access
+        return value
+    raise AttributeError(
+        f"module 'deepspeed_tpu' has no attribute {name!r}")
 
 
 def initialize(*args, **kwargs):

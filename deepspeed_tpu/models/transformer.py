@@ -442,7 +442,11 @@ class TransformerLM:
         self.config = config
 
     def init(self, rng) -> Dict[str, Any]:
-        return init_params(self.config, rng)
+        from deepspeed_tpu.utils.init_on_device import OnDevice
+
+        # under `with OnDevice(device="meta")` this returns the abstract
+        # tree (reference OnDevice/zero.Init construction-time behavior)
+        return OnDevice.apply(init_params, self.config, rng)
 
     def abstract_params(self, rng=None):
         """Shapes/dtypes without materializing (the zero.Init analog's

@@ -143,6 +143,10 @@ class Engine:
         from deepspeed_tpu.runtime import activation_checkpointing as act_ckpt
 
         act_ckpt.configure(config.activation_checkpointing)
+        from deepspeed_tpu.utils import memory as mem_util
+
+        mem_util.configure(config.memory_breakdown)
+        mem_util.see_memory_usage("engine init: before model setup")
         from deepspeed_tpu.ops import attention as attn_ops
 
         if config.sparse_attention is not None:
@@ -283,6 +287,7 @@ class Engine:
             f"{config.zero_optimization.stage}, dp={self.dp_world_size}, "
             f"micro={self.micro_batch_size}, gas="
             f"{self.gradient_accumulation_steps}", ranks=[0])
+        mem_util.see_memory_usage("engine init: ready")
 
     # ------------------------------------------------------------------
     def _config_lr(self) -> float:

@@ -125,8 +125,14 @@ class ShardingPlan:
 
     # tree-level helpers ----------------------------------------------------
     def param_shardings(self, spec_tree):
-        return jax.tree.map(
-            lambda ax: NamedSharding(self.mesh, self.param_spec(ax)),
+        from jax.tree_util import keystr, tree_map_with_path
+
+        # z3-leaf-marked paths keep params replicated over data axes
+        # (grad/opt shardings are unaffected, like the reference where
+        # leaf modules change fetch behavior, not partitioning of state)
+        return tree_map_with_path(
+            lambda kp, ax: NamedSharding(
+                self.mesh, z3_leaf_spec(keystr(kp), self.param_spec(ax))),
             spec_tree,
             is_leaf=_is_axes_leaf,
         )
@@ -148,6 +154,64 @@ class ShardingPlan:
 
 def _is_axes_leaf(x) -> bool:
     return isinstance(x, tuple) and all(isinstance(a, (str, type(None))) for a in x)
+
+
+# ---------------------------------------------------------------------------
+# z3 leaf modules (reference deepspeed/utils/z3_leaf_module.py:149)
+# ---------------------------------------------------------------------------
+# The reference marks modules whose params must be fetched/released as one
+# unit instead of per-submodule (granularity control for the ZeRO-3
+# coordinator). The GSPMD analog: params under a marked subtree are kept
+# REPLICATED over the data axes (dp/fsdp) instead of fully sharded — the
+# "always resident as a unit" behavior — while tp/ep sharding still
+# applies. Patterns are substrings of the param path (jax.tree keystr).
+
+_Z3_LEAF_PATTERNS: list = []
+_DATA_AXES = ("dp", "fsdp")
+
+
+def set_z3_leaf_modules(patterns) -> list:
+    """Mark param-path substrings as leaf units (reference
+    set_z3_leaf_modules takes module classes; paths are the tree-world
+    handle). Returns the active pattern list."""
+    if isinstance(patterns, str):
+        patterns = [patterns]
+    for p in patterns:
+        if p not in _Z3_LEAF_PATTERNS:
+            _Z3_LEAF_PATTERNS.append(p)
+    return list(_Z3_LEAF_PATTERNS)
+
+
+def unset_z3_leaf_modules(patterns=None) -> list:
+    if patterns is None:
+        _Z3_LEAF_PATTERNS.clear()
+    else:
+        for p in ([patterns] if isinstance(patterns, str) else patterns):
+            if p in _Z3_LEAF_PATTERNS:
+                _Z3_LEAF_PATTERNS.remove(p)
+    return list(_Z3_LEAF_PATTERNS)
+
+
+def get_z3_leaf_modules() -> list:
+    return list(_Z3_LEAF_PATTERNS)
+
+
+def z3_leaf_spec(path: str, spec: PartitionSpec) -> PartitionSpec:
+    """Strip data axes from a spec when ``path`` matches a leaf pattern."""
+    if not _Z3_LEAF_PATTERNS or not any(p in path for p in _Z3_LEAF_PATTERNS):
+        return spec
+    out = []
+    for entry in spec:
+        if entry is None:
+            out.append(None)
+        elif isinstance(entry, str):
+            out.append(None if entry in _DATA_AXES else entry)
+        else:
+            kept = tuple(a for a in entry if a not in _DATA_AXES)
+            out.append(kept[0] if len(kept) == 1 else (kept or None))
+    while out and out[-1] is None:
+        out.pop()
+    return PartitionSpec(*out)
 
 
 def make_sharding_plan(config: Config, mesh: Mesh) -> ShardingPlan:

@@ -1,0 +1,73 @@
+"""Memory breadcrumbs.
+
+Reference: ``see_memory_usage`` (deepspeed/utils/timer.py + engine
+breadcrumbs) prints allocated/reserved accelerator memory and host RSS at
+checkpoints through engine construction; gated by the ``memory_breakdown``
+config.
+
+TPU: device numbers come from ``Device.memory_stats()`` (PJRT; absent on
+some backends, then only host stats print), host RSS from /proc.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import jax
+
+from deepspeed_tpu.utils.logging import logger
+
+
+def _host_mem_gb() -> dict:
+    out = {}
+    try:
+        with open("/proc/self/status") as f:
+            for line in f:
+                if line.startswith(("VmRSS", "VmHWM")):
+                    k, v = line.split(":", 1)
+                    out[k] = round(int(v.split()[0]) / 1024 / 1024, 2)
+    except OSError:
+        pass
+    return out
+
+
+def device_memory_stats(device=None) -> Optional[dict]:
+    device = device or jax.local_devices()[0]
+    stats = getattr(device, "memory_stats", lambda: None)()
+    if not stats:
+        return None
+    gb = 1024 ** 3
+    return {
+        "in_use_gb": round(stats.get("bytes_in_use", 0) / gb, 3),
+        "peak_gb": round(stats.get("peak_bytes_in_use", 0) / gb, 3),
+        "limit_gb": round(stats.get("bytes_limit", 0) / gb, 3),
+        "largest_free_block_gb": round(
+            stats.get("largest_free_block_bytes", 0) / gb, 3),
+    }
+
+
+MEMORY_BREAKDOWN = False  # set from config.memory_breakdown at engine init
+
+
+def configure(enabled: bool) -> None:
+    global MEMORY_BREAKDOWN
+    MEMORY_BREAKDOWN = bool(enabled)
+
+
+def see_memory_usage(message: str, force: bool = False) -> Optional[dict]:
+    """Log device + host memory with ``message`` (reference signature:
+    breadcrumbs are no-ops unless force or memory_breakdown config)."""
+    if not (force or MEMORY_BREAKDOWN):
+        return None
+    dev = device_memory_stats()
+    host = _host_mem_gb()
+    parts = [message]
+    if dev:
+        parts.append(f"device in_use={dev['in_use_gb']}GB "
+                     f"peak={dev['peak_gb']}GB limit={dev['limit_gb']}GB")
+    if host:
+        parts.append(f"host rss={host.get('VmRSS')}GB "
+                     f"hwm={host.get('VmHWM')}GB")
+    logger.info(" | ".join(parts))
+    return dev
