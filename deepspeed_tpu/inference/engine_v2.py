@@ -76,6 +76,8 @@ class InferenceEngineV2:
             self.mesh is None or self.mesh.shape.get("tp", 1) == 1)
         self._decode_fn = jax.jit(
             partial(model_runner.ragged_decode_forward, self.cfg))
+        self._prefill_fn = jax.jit(
+            partial(model_runner.ragged_prefill_forward, self.cfg))
         log_dist(
             f"InferenceEngineV2: kv_blocks={kv_blocks}x{kv_block_size} "
             f"budget={max_tokens_per_step}tok/{max_seqs_per_step}seq",
@@ -126,8 +128,16 @@ class InferenceEngineV2:
         # with slots, so the compact paged-kernel path applies
         decode_only = (self._use_paged_kernel
                        and all(len(nt) == 1 for _, nt, _ in scheduled))
+        seg_plan = None
+        if self._use_paged_kernel and not decode_only:
+            seg_plan = self._plan_prefill_segments(scheduled)
         with self.mesh:
-            if decode_only:
+            if seg_plan is not None:
+                n_segs = seg_plan[0].shape[0]
+                logits, new_kv = self._prefill_fn(
+                    self.params, self.kv_cache.data, *seg_plan,
+                    jnp.asarray(batch.block_table[:n_segs]))
+            elif decode_only:
                 # compact per-slot arrays: token i belongs to slot i; pad
                 # out to max_seqs (token budget may be smaller than the
                 # slot budget)
@@ -157,8 +167,12 @@ class InferenceEngineV2:
             completed_prompt = seq.seen_tokens >= len(seq.input_tokens)
             if not completed_prompt:
                 continue  # mid-prefill: no logits consumed
-            row = logits_np[slot if decode_only
-                            else batch.last_token_index[slot]]
+            if seg_plan is not None:
+                row = logits_np[slot, n - 1]
+            elif decode_only:
+                row = logits_np[slot]
+            else:
+                row = logits_np[batch.last_token_index[slot]]
             tok = _sample_np(row, temperature, seed + slot + seq.seen_tokens)
             seq.generated.append(int(tok))
             emitted[seq.uid] = int(tok)
@@ -168,6 +182,32 @@ class InferenceEngineV2:
                 seq.done = True
         self._release_finished()
         return emitted
+
+    def _plan_prefill_segments(self, scheduled):
+        """Per-slot padded chunk layout for the Pallas prefill kernel, or
+        None when per-segment padding would outweigh the flat layout
+        (then the gather path runs). Tq is bucketed to powers of two so
+        jit compiles a handful of programs."""
+        longest = max(len(nt) for _, nt, _ in scheduled)
+        tq = 8
+        while tq < longest:
+            tq *= 2
+        S = 1  # segment-count bucket: slots are ordered, so the forward
+        while S < len(scheduled):  # runs on the leading S rows only
+            S *= 2
+        S = min(S, self.max_seqs)
+        # the padded layout materializes S*tq token rows (incl. [S,tq,V]
+        # fp32 logits); cap the blowup over the flat token budget
+        if S * tq > 2 * self.max_tokens:
+            return None
+        toks = np.zeros((S, tq), np.int32)
+        pos0 = np.zeros(S, np.int32)
+        nreal = np.zeros(S, np.int32)
+        for slot, (seq, nt, sp) in enumerate(scheduled):
+            toks[slot, :len(nt)] = nt
+            pos0[slot] = sp
+            nreal[slot] = len(nt)
+        return jnp.asarray(toks), jnp.asarray(pos0), jnp.asarray(nreal)
 
     def _release_finished(self) -> None:
         for uid in [s.uid for s in self.state.seqs.values() if s.done]:

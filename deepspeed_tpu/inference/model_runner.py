@@ -204,6 +204,66 @@ def ragged_forward(cfg: TransformerConfig, params, kv_data: jax.Array,
 
 
 # ---------------------------------------------------------------------------
+# segmented prefill path: Pallas chunked-prefill kernel (v2 engine)
+# ---------------------------------------------------------------------------
+
+
+def ragged_prefill_forward(cfg: TransformerConfig, params,
+                           kv_data: jax.Array, seg_tokens: jax.Array,
+                           seg_pos0: jax.Array, seg_nreal: jax.Array,
+                           block_table: jax.Array
+                           ) -> Tuple[jax.Array, jax.Array]:
+    """Prefill chunks, one segment per sequence slot.
+
+    Reference: the SplitFuse prefill path of inference/v2 (blocked flash
+    over new chunks + paged history). Each segment s runs ``nreal[s]``
+    new tokens at absolute positions pos0[s].. through the paged cache;
+    padded rows (qi >= nreal) and dead segments (nreal == 0) write to the
+    scratch page and emit garbage logits the engine never reads.
+
+    seg_tokens [S, Tq] int32; seg_pos0/seg_nreal [S]; block_table [S, Bm]
+    Returns (logits [S, Tq, V] fp32, kv_data').
+    """
+    from deepspeed_tpu.ops.pallas.paged_attention import \
+        paged_prefill_attention
+
+    S, Tq = seg_tokens.shape
+    bs = kv_data.shape[2]
+    dt = effective_dtype(cfg.dtype)
+
+    qi = jnp.arange(Tq)[None, :]                      # [1, Tq]
+    pos = seg_pos0[:, None] + qi                      # [S, Tq]
+    real = qi < seg_nreal[:, None]                    # [S, Tq]
+    ctx_lens = seg_pos0 + seg_nreal                   # [S]
+
+    x = params["embed"]["tokens"].astype(dt)[seg_tokens]  # [S, Tq, H]
+    if cfg.pos_emb == "learned":
+        x = x + params["embed"]["positions"].astype(dt)[pos]
+
+    scratch = kv_data.shape[1] - 1
+    page = jnp.take_along_axis(block_table, pos // bs, axis=1)  # [S, Tq]
+    page = jnp.where(real, page, scratch)
+    offset = jnp.where(real, pos % bs, bs - 1)
+
+    def layer_body(x, inputs):
+        layer_params, kv_layer = inputs
+        y = _norm(x, layer_params["ln1"], cfg.norm, cfg.norm_eps)
+        q, k, v = _qkv(cfg, layer_params, y, pos)  # q [S,Tq,nh,hd]
+        kv_layer = kv_layer.at[page, offset, 0].set(k.astype(kv_layer.dtype))
+        kv_layer = kv_layer.at[page, offset, 1].set(v.astype(kv_layer.dtype))
+        attn = paged_prefill_attention(q.astype(dt), kv_layer, block_table,
+                                       seg_pos0, ctx_lens)
+        attn = jnp.einsum("stnd,ndh->sth", attn.astype(dt),
+                          layer_params["attn"]["wo"].astype(dt))
+        x = x + attn
+        return _mlp(cfg, layer_params, x), kv_layer
+
+    x, new_kv = lax.scan(layer_body, x, (params["layers"], kv_data))
+    x = _norm(x, params["final_norm"], cfg.norm, cfg.norm_eps)
+    return _unembed(cfg, params, x), new_kv
+
+
+# ---------------------------------------------------------------------------
 # decode-only ragged path: Pallas paged-attention kernel (v2 engine)
 # ---------------------------------------------------------------------------
 

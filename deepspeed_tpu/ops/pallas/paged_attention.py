@@ -35,34 +35,43 @@ def _interpret() -> bool:
     return jax.default_backend() != "tpu"
 
 
+def _fold_page(q, k, v, visible, m_ref, l_ref, acc_ref, rows: slice,
+               nrows: int):
+    """Fold one K/V page into the online-softmax state for one kv head.
+
+    q [nrows, hd] fp32 (pre-scaled); k/v [bs, hd] fp32; visible
+    [nrows, bs]; scratch refs indexed at ``rows``.
+    """
+    sc = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                             preferred_element_type=jnp.float32)
+    sc = jax.lax.select(visible, sc, jnp.full_like(sc, NEG_INF))
+
+    m_prev = m_ref[rows, :1]                      # [nrows, 1]
+    m_cur = jnp.max(sc, axis=1, keepdims=True)
+    m_new = jnp.maximum(m_prev, m_cur)
+    alpha = jnp.exp(m_prev - m_new)
+    # explicit zero for masked columns: when every score so far is
+    # the NEG_INF sentinel, exp(sc - m_new) == exp(0) would count them
+    e = jnp.exp(sc - m_new)
+    p = jax.lax.select(visible, e, jnp.zeros_like(e))
+
+    l_new = alpha * l_ref[rows, :1] + jnp.sum(p, axis=1, keepdims=True)
+    acc_ref[rows, :] = acc_ref[rows, :] * alpha + jax.lax.dot_general(
+        p, v, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    m_ref[rows, :] = jnp.broadcast_to(m_new, (nrows, m_ref.shape[1]))
+    l_ref[rows, :] = jnp.broadcast_to(l_new, (nrows, l_ref.shape[1]))
+
+
 def _visit(q_ref, kv_ref, m_ref, l_ref, acc_ref, visible, *, bs: int,
            nkv: int, gp: int, scale: float):
-    """Fold one K/V page into the online-softmax state."""
+    """Fold one K/V page into the online-softmax state (decode)."""
     for n in range(nkv):  # static unroll over kv heads
-        rows = slice(n * gp, (n + 1) * gp)
         q = q_ref[0, n].astype(jnp.float32) * scale   # [gp, hd]
         k = kv_ref[0, :, 0, n].astype(jnp.float32)    # [bs, hd]
         v = kv_ref[0, :, 1, n].astype(jnp.float32)    # [bs, hd]
-
-        sc = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                                 preferred_element_type=jnp.float32)
-        sc = jax.lax.select(visible, sc, jnp.full_like(sc, NEG_INF))
-
-        m_prev = m_ref[rows, :1]                      # [gp, 1]
-        m_cur = jnp.max(sc, axis=1, keepdims=True)
-        m_new = jnp.maximum(m_prev, m_cur)
-        alpha = jnp.exp(m_prev - m_new)
-        # explicit zero for masked columns: when every score so far is
-        # the NEG_INF sentinel, exp(sc - m_new) == exp(0) would count them
-        e = jnp.exp(sc - m_new)
-        p = jax.lax.select(visible, e, jnp.zeros_like(e))
-
-        l_new = alpha * l_ref[rows, :1] + jnp.sum(p, axis=1, keepdims=True)
-        acc_ref[rows, :] = acc_ref[rows, :] * alpha + jax.lax.dot_general(
-            p, v, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
-        m_ref[rows, :] = jnp.broadcast_to(m_new, (gp, m_ref.shape[1]))
-        l_ref[rows, :] = jnp.broadcast_to(l_new, (gp, l_ref.shape[1]))
+        _fold_page(q, k, v, visible, m_ref, l_ref, acc_ref,
+                   slice(n * gp, (n + 1) * gp), gp)
 
 
 def _kernel(bt_ref, ctx_ref, q_ref, kv_ref, out_ref,
@@ -93,6 +102,118 @@ def _kernel(bt_ref, ctx_ref, q_ref, kv_ref, out_ref,
             l = l_ref[rows, :1]
             l = jax.lax.select(l == 0.0, jnp.ones_like(l), l)  # dead slots
             out_ref[0, n] = (acc_ref[rows, :] / l).astype(out_ref.dtype)
+
+
+def _prefill_kernel(pos0_ref, ctx_ref, bt_ref, q_ref, kv_ref, out_ref,
+                    m_ref, l_ref, acc_ref, *, bs: int, nkv: int, g: int,
+                    tq: int, scale: float):
+    s = pl.program_id(0)
+    j = pl.program_id(1)
+    nj = pl.num_programs(1)
+    rows = tq * g  # row layout per kv head: query-major, group-minor
+
+    @pl.when(j == 0)
+    def _init():
+        m_ref[...] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    pos0 = pos0_ref[s]
+    ctx = ctx_ref[s]
+    # query absolute position per row (row r = query r // g, group r % g)
+    qpos = pos0 + jax.lax.broadcasted_iota(jnp.int32, (rows, bs), 0) // g
+    cols = j * bs + jax.lax.broadcasted_iota(jnp.int32, (rows, bs), 1)
+    # causal within the segment + bounded by the segment's total context;
+    # dead/padded segments have ctx == 0 -> nothing visible
+    visible = jnp.logical_and(cols <= qpos, cols < ctx)
+
+    @pl.when(j * bs < ctx)
+    def _visit_page():
+        for n in range(nkv):
+            q = q_ref[0, :, n].reshape(rows, q_ref.shape[-1])
+            q = q.astype(jnp.float32) * scale           # [rows, hd]
+            k = kv_ref[0, :, 0, n].astype(jnp.float32)  # [bs, hd]
+            v = kv_ref[0, :, 1, n].astype(jnp.float32)
+            _fold_page(q, k, v, visible, m_ref, l_ref, acc_ref,
+                       slice(n * rows, (n + 1) * rows), rows)
+
+    @pl.when(j == nj - 1)
+    def _finalize():
+        for n in range(nkv):
+            rsl = slice(n * rows, (n + 1) * rows)
+            l = l_ref[rsl, :1]
+            l = jax.lax.select(l == 0.0, jnp.ones_like(l), l)
+            out = (acc_ref[rsl, :] / l).astype(out_ref.dtype)
+            out_ref[0, :, n] = out.reshape(tq, g, out_ref.shape[-1])
+
+
+def paged_prefill_attention(q: jax.Array, kv_layer: jax.Array,
+                            block_table: jax.Array, seg_pos0: jax.Array,
+                            context_lens: jax.Array,
+                            scale: float = None) -> jax.Array:
+    """Chunked-prefill attention over paged KV (SplitFuse chunk step).
+
+    Each segment is one sequence's contiguous chunk of ``Tq`` new tokens
+    (queries at absolute positions pos0..pos0+Tq-1), already scattered
+    into the paged cache. Queries attend their sequence's full paged
+    history causally.
+
+    q            [S, Tq, num_heads, head_dim] (padded rows have garbage;
+                 their outputs are well-defined zeros only if the whole
+                 segment is dead — callers slice real rows out)
+    kv_layer     [num_blocks, block_size, 2, kv_heads, head_dim]
+    block_table  [S, max_pages]
+    seg_pos0     [S] absolute position of each segment's first query
+    context_lens [S] keys visible to the segment's LAST query (pos0 +
+                 n_real_tokens); 0 marks a dead segment
+
+    Returns [S, Tq, num_heads, head_dim] in q.dtype.
+    """
+    S, tq, nh, hd = q.shape
+    nb, bs, _, nkv, _ = kv_layer.shape
+    Bm = block_table.shape[1]
+    if nh % nkv:
+        raise ValueError(f"num_heads {nh} not a multiple of kv_heads {nkv}")
+    g = nh // nkv
+    if (tq * g) % 8:
+        raise ValueError(f"Tq*group ({tq}*{g}) must be a multiple of 8")
+    if scale is None:
+        scale = 1.0 / (hd ** 0.5)
+
+    qg = q.reshape(S, tq, nkv, g, hd)
+
+    def page(s, j, pos0, ctx, bt):
+        last = jax.lax.max(ctx[s] - 1, 0) // bs
+        j_eff = jax.lax.min(j, last)
+        return jax.lax.min(jax.lax.max(bt[s, j_eff], 0), nb - 1)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=3,
+        grid=(S, Bm),
+        in_specs=[
+            pl.BlockSpec((1, tq, nkv, g, hd),
+                         lambda s, j, pos0, ctx, bt: (s, 0, 0, 0, 0)),
+            pl.BlockSpec((1, bs, 2, nkv, hd),
+                         lambda s, j, pos0, ctx, bt: (page(s, j, pos0, ctx,
+                                                          bt), 0, 0, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, tq, nkv, g, hd),
+                               lambda s, j, pos0, ctx, bt: (s, 0, 0, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((nkv * tq * g, 128), jnp.float32),
+            pltpu.VMEM((nkv * tq * g, 128), jnp.float32),
+            pltpu.VMEM((nkv * tq * g, hd), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        functools.partial(_prefill_kernel, bs=bs, nkv=nkv, g=g, tq=tq,
+                          scale=float(scale)),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((S, tq, nkv, g, hd), q.dtype),
+        interpret=_interpret(),
+    )(seg_pos0.astype(jnp.int32), context_lens.astype(jnp.int32),
+      block_table.astype(jnp.int32), qg, kv_layer)
+    return out.reshape(S, tq, nh, hd)
 
 
 def paged_decode_attention(q: jax.Array, kv_layer: jax.Array,

@@ -49,6 +49,9 @@ def get_base_optimizer(
     weight_decay = p.pop("weight_decay", 0.01 if name in ADAMW_ALIASES else 0.0)
     p.pop("torch_adam", None)
     p.pop("adam_w_mode", None)
+    muon_extra = {k: p.pop(k) for k in
+                  ("ns_steps", "nesterov", "adam_b1", "adam_b2")
+                  if k in p} if name == "muon" else {}
     if p:
         logger.warning(f"optimizer '{opt_config.type}': ignoring params {sorted(p)}")
 
@@ -72,8 +75,17 @@ def get_base_optimizer(
     elif name == "adafactor":
         tx = optax.adafactor(lr_arg)
     elif name == "muon":
-        tx = optax.contrib.muon(lr_arg, beta=betas[0],
-                                weight_decay=weight_decay)
+        # reference runtime/zero/muon/: NS-orthogonalized momentum on 2D
+        # weights, Adam on the rest. The distributed Newton-Schulz
+        # (_apply_distributed_muon_update, stage3.py:1537) is implicit:
+        # NS matmuls run on sharded fp32 masters under GSPMD, so the
+        # iteration is already computed cooperatively across dp/fsdp
+        tx = optax.contrib.muon(
+            lr_arg, beta=betas[0], eps=eps, weight_decay=weight_decay,
+            ns_steps=int(muon_extra.get("ns_steps", 5)),
+            nesterov=bool(muon_extra.get("nesterov", True)),
+            adam_b1=muon_extra.get("adam_b1", 0.9),
+            adam_b2=muon_extra.get("adam_b2", 0.999))
     else:
         raise ValueError(f"unknown optimizer type '{opt_config.type}'")
     return tx, lr

@@ -151,8 +151,8 @@ class TestInferenceEngineV2:
         assert results[7] == ref.tolist()
 
     def test_paged_kernel_matches_gather_path(self, tiny):
-        """Decode via the Pallas paged kernel == the gather ragged path."""
-        prompts = {1: [5, 9, 2, 14, 7], 2: [3, 1, 4]}
+        """Decode+prefill via the Pallas paged kernels == gather path."""
+        prompts = {1: [5, 9, 2, 14, 7], 2: [3, 1, 4], 3: [2] * 17}
 
         def run(use_kernel):
             v2 = self._make(tiny)

@@ -72,6 +72,66 @@ def test_dead_slot_outputs_zero():
     assert np.all(np.isfinite(out))
 
 
+class TestPrefill:
+    @pytest.mark.parametrize("nh,nkv", [(8, 8), (8, 2)])
+    def test_matches_dense_causal(self, nh, nkv):
+        from deepspeed_tpu.ops.pallas.paged_attention import \
+            paged_prefill_attention
+
+        rng = np.random.default_rng(3)
+        S, tq, hd, bs, Bm = 2, 8, 64, 16, 4
+        # segment 0: 8 fresh tokens on 11 of history; segment 1: chunk
+        # starting at position 0 (no history)
+        pos0 = np.array([11, 0], np.int32)
+        n_real = np.array([8, 8], np.int32)
+        ctx = pos0 + n_real
+        q, kv, table = _build_case(rng, S, nh, nkv, hd, bs, Bm, ctx)
+        qc = rng.standard_normal((S, tq, nh, hd)).astype(np.float32)
+
+        out = np.asarray(paged_prefill_attention(
+            jnp.asarray(qc), jnp.asarray(kv), jnp.asarray(table),
+            jnp.asarray(pos0), jnp.asarray(ctx)))
+
+        for s in range(S):
+            rows = []
+            for t in range(ctx[s]):
+                page, off = table[s, t // bs], t % bs
+                rows.append(kv[page, off])
+            keys = np.stack([r[0] for r in rows])
+            values = np.stack([r[1] for r in rows])
+            for qi in range(tq):
+                vis = pos0[s] + qi + 1  # causal: keys 0..pos0+qi
+                want = _dense_reference(qc[s, qi], keys[:vis], values[:vis])
+                np.testing.assert_allclose(
+                    out[s, qi], want, rtol=2e-5, atol=2e-5,
+                    err_msg=f"seg {s} q {qi}")
+
+    def test_dead_segment_zero(self):
+        from deepspeed_tpu.ops.pallas.paged_attention import \
+            paged_prefill_attention
+
+        rng = np.random.default_rng(4)
+        S, nh, nkv, tq, hd, bs, Bm = 2, 8, 8, 8, 64, 16, 2
+        ctx = np.array([9, 0], np.int32)
+        q, kv, table = _build_case(rng, S, nh, nkv, hd, bs, Bm, ctx)
+        qc = rng.standard_normal((S, tq, nh, hd)).astype(np.float32)
+        out = np.asarray(paged_prefill_attention(
+            jnp.asarray(qc), jnp.asarray(kv), jnp.asarray(table),
+            jnp.asarray([1, 0], np.int32), jnp.asarray(ctx)))
+        assert np.all(out[1] == 0.0) and np.all(np.isfinite(out))
+
+    def test_row_alignment_validation(self):
+        from deepspeed_tpu.ops.pallas.paged_attention import \
+            paged_prefill_attention
+
+        q = jnp.zeros((1, 3, 8, 64))  # Tq*g = 3 -> not sublane aligned
+        kv = jnp.zeros((4, 16, 2, 8, 64))
+        with pytest.raises(ValueError, match="multiple of 8"):
+            paged_prefill_attention(q, kv, jnp.zeros((1, 2), jnp.int32),
+                                    jnp.zeros(1, jnp.int32),
+                                    jnp.ones(1, jnp.int32))
+
+
 def test_bf16_and_jit_stability():
     rng = np.random.default_rng(2)
     S, nh, nkv, hd, bs, Bm = 4, 12, 4, 64, 16, 8
