@@ -216,3 +216,73 @@ class Autotuner:
                   "w") as f:
             json.dump([r.to_dict() for r in self.results], f, indent=2,
                       default=str)
+
+
+# ---------------------------------------------------------------------------
+# dstpu-autotune CLI (reference: `deepspeed --autotuning tune`,
+# launcher/runner.py:407 entry into Autotuner.tune)
+# ---------------------------------------------------------------------------
+
+
+def main(argv=None) -> int:
+    import argparse
+
+    ap = argparse.ArgumentParser(
+        prog="dstpu-autotune",
+        description="search micro-batch / ZeRO stage / remat for a zoo "
+                    "model on the attached chips; prints the best config")
+    ap.add_argument("--model", default="gpt2-125m",
+                    help="zoo preset name (models/zoo.py)")
+    ap.add_argument("--seq", type=int, default=1024)
+    ap.add_argument("--config", default=None,
+                    help="base ds_config JSON file (default: bf16+adamw)")
+    ap.add_argument("--micro-batch-sizes", type=int, nargs="+", default=None)
+    ap.add_argument("--zero-stages", type=int, nargs="+", default=None)
+    ap.add_argument("--remat", type=int, nargs="+", default=None,
+                    help="0/1 values to try")
+    ap.add_argument("--fast", action="store_true",
+                    help="rank by compiled memory only (no timed runs)")
+    ap.add_argument("--steps", type=int, default=3)
+    ap.add_argument("--results-dir", default=None)
+    args = ap.parse_args(argv)
+
+    import numpy as np
+
+    from deepspeed_tpu.models.zoo import get_model
+
+    if args.config:
+        with open(args.config) as f:
+            base = json.load(f)
+    else:
+        base = {"optimizer": {"type": "adamw", "params": {"lr": 1e-4}},
+                "bf16": {"enabled": True}, "steps_per_print": 1_000_000}
+
+    def model_factory():
+        return get_model(args.model, max_seq_len=args.seq)
+
+    vocab = model_factory().config.vocab_size
+    rng = np.random.default_rng(0)
+
+    def batch_fn(global_batch):
+        return {"input_ids": rng.integers(
+            0, vocab, (global_batch, args.seq + 1)).astype(np.int32)}
+
+    space = {}
+    if args.micro_batch_sizes:
+        space["micro_batch_sizes"] = args.micro_batch_sizes
+    if args.zero_stages:
+        space["zero_stages"] = args.zero_stages
+    if args.remat is not None:
+        space["remat"] = [bool(v) for v in args.remat]
+    tuner = Autotuner(model_factory, base, batch_fn,
+                      tuning_space=space or None,
+                      results_dir=args.results_dir)
+    best = tuner.tune(fast=args.fast, measure_steps=args.steps)
+    if best is None:
+        print(json.dumps({"error": "no viable config"}))
+        return 1
+    # surface the winning remat choice (a model flag, not a config key)
+    # as a top-level entry so the printed config reproduces the result
+    best["remat"] = bool(best.pop("_remat", False))
+    print(json.dumps(best))
+    return 0

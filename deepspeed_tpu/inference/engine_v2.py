@@ -192,6 +192,13 @@ class InferenceEngineV2:
         tq = 8
         while tq < longest:
             tq *= 2
+        # kernel scratch is (Tq*num_heads) rows of (2*128 + head_dim) fp32
+        # VMEM; keep it well under the ~16MB/core budget or the Mosaic
+        # compile fails at serve time (gather path has no such limit)
+        scratch_bytes = (tq * self.cfg.num_heads
+                         * (256 + self.cfg.head_dim) * 4)
+        if scratch_bytes > 4 * 1024 * 1024:
+            return None
         S = 1  # segment-count bucket: slots are ordered, so the forward
         while S < len(scheduled):  # runs on the leading S rows only
             S *= 2

@@ -58,3 +58,9 @@ def mesh_2x4():
     from deepspeed_tpu.parallel.topology import TopologyConfig, build_mesh
 
     return build_mesh(TopologyConfig(dp=1, fsdp=2, tp=4))
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "slow: long-running measured benchmarks (reference "
+        "'nightly' marker analog)")

@@ -70,3 +70,16 @@ def test_measured_tune(tmp_path, devices):
     assert best is not None
     timed = [r for r in t.results if r.ran]
     assert timed and timed[0].metric_value > 0
+
+
+def test_cli_fast_mode(capsys, devices):
+    import json
+
+    from deepspeed_tpu.autotuning.autotuner import main
+
+    rc = main(["--model", "tiny", "--seq", "32", "--fast",
+               "--micro-batch-sizes", "1", "--zero-stages", "1"])
+    assert rc == 0
+    best = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert best["train_micro_batch_size_per_chip"] == 1
+    assert best["remat"] is False

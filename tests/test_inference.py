@@ -163,6 +163,25 @@ class TestInferenceEngineV2:
 
         assert run(True) == run(False)
 
+    def test_mixed_decode_prefill_batches(self, tiny):
+        """A prompt admitted mid-decode creates mixed batches (decode
+        tokens + a prefill chunk in one step); kernel and gather paths
+        must agree."""
+        def run(use_kernel):
+            v2 = self._make(tiny)
+            v2._use_paged_kernel = use_kernel
+            v2.put([1], [np.asarray([5, 9, 2], np.int32)], max_new_tokens=6)
+            out = {1: []}
+            for tok in (v2.step(), v2.step()):
+                for uid, t in tok.items():
+                    out.setdefault(uid, []).append(t)
+            v2.put([2], [np.asarray([4] * 9, np.int32)], max_new_tokens=4)
+            for uid, toks in v2.generate_all().items():
+                out.setdefault(uid, []).extend(toks)
+            return out
+
+        assert run(True) == run(False)
+
     def test_kv_released_on_finish(self, tiny):
         v2 = self._make(tiny)
         free0 = v2.kv_cache.free_blocks
