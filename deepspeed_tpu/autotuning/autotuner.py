@@ -108,8 +108,12 @@ class Autotuner:
         cfg = dict(cfg)
         remat = cfg.pop("_remat", False)
         model = self.model_factory()
-        if remat and hasattr(model, "config"):
-            model.config.remat = True
+        if hasattr(model, "config") and hasattr(model.config, "remat"):
+            # set BOTH ways: models default remat=True, so a remat=False
+            # candidate must actually disable it or the sweep is a no-op
+            import dataclasses as _dc
+
+            model.config = _dc.replace(model.config, remat=bool(remat))
         engine, *_ = dstpu.initialize(model=model, config=cfg)
         return engine
 

@@ -58,6 +58,11 @@ class TransformerConfig:
     dtype: Any = jnp.bfloat16
     param_dtype: Any = jnp.float32
     remat: bool = True
+    # ZeRO-Infinity param offload: layer params live in pinned host
+    # memory; the scan fetches one layer per step (and the remat replay
+    # re-fetches it for backward) so HBM never holds the full stack.
+    # Set by the engine from zero_optimization.offload_param.
+    param_host_offload: bool = False
     # None defers to the engine's activation_checkpointing.policy config;
     # an explicit name here wins over the config
     remat_policy: Optional[str] = None
@@ -363,6 +368,36 @@ def apply_hidden(cfg: TransformerConfig, params: Dict[str, Any],
         # (remat is applied per stage inside pipelined_layers)
         x = pipelined_layers(
             lambda c, lp: layer_fn(c, lp, positions), params["layers"], x)
+    elif cfg.param_host_offload:
+        # ZeRO-Infinity streaming: layer params live in pinned host
+        # memory (engine placement); each scan step fetches ONE layer to
+        # device INSIDE the rematerialized body, so neither the forward
+        # nor the saved residuals ever hold the full stack in HBM — the
+        # remat replay re-fetches for backward, and the cotangent of the
+        # fetch is a device→host transfer, landing grads host-side
+        # (reference: swap_tensor/partitioned_param_swapper.py semantics,
+        # compiled by XLA instead of hand-scheduled copies).
+        def fetch_layer(i):
+            return jax.tree.map(
+                lambda a: jax.device_put(
+                    lax.dynamic_index_in_dim(a, i, keepdims=False),
+                    jax.memory.Space.Device),
+                params["layers"])
+
+        def fetched_layer_fn(carry, i):
+            return layer_fn(carry, fetch_layer(i), positions)
+
+        if cfg.remat:
+            from deepspeed_tpu.runtime.activation_checkpointing import \
+                checkpoint_wrapper
+
+            fetched_layer_fn = checkpoint_wrapper(fetched_layer_fn,
+                                                  policy=cfg.remat_policy)
+
+        def host_scan_body(carry, i):
+            return fetched_layer_fn(carry, i), None
+
+        x, _ = lax.scan(host_scan_body, x, jnp.arange(cfg.num_layers))
     else:
         if cfg.remat:
             from deepspeed_tpu.runtime.activation_checkpointing import \

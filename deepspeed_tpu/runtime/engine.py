@@ -422,6 +422,9 @@ class Engine:
 
             ocfg = self.config.optimizer
             off = self.config.zero_optimization.offload_optimizer
+            poff = self.config.zero_optimization.offload_param
+            host_prefixes = (("['layers']",) if poff is not None
+                             and poff.device != "none" else ())
             self._offload = HostOffloadOptimizer(
                 p32,
                 optimizer_name=(ocfg.type if ocfg else "adamw") or "adamw",
@@ -429,7 +432,8 @@ class Engine:
                 compute_dtype=cdt,
                 grad_clip=self.config.gradient_clipping,
                 nvme_path=(off.nvme_path
-                           if self._offload_device == "nvme" else None))
+                           if self._offload_device == "nvme" else None),
+                host_memory_leaf_prefixes=host_prefixes)
             cast = jax.jit(
                 lambda t: _constrain_tree(
                     jax.tree.map(lambda m: m.astype(cdt), t), param_sh),
@@ -449,6 +453,7 @@ class Engine:
                 self.params, self.opt_state = jax.jit(init_fn)(self._rng)
         self._param_shardings = param_sh
         self._opt_shardings = opt_sh
+        self._setup_param_host_offload()
         # scalars live replicated on the mesh so every jitted fn (and every
         # checkpoint restore) sees one consistent device set
         rep = NamedSharding(mesh, P())
@@ -561,6 +566,20 @@ class Engine:
         # compiled by XLA over ICI; and grad-acc → optimizer sharding.
         self._jit_reshard_to_params = jax.jit(lambda t: t,
                                               out_shardings=param_sh)
+        if getattr(self, "_param_host_offload", False) and \
+                isinstance(param_sh, dict) and "layers" in param_sh:
+            # updated layer params land straight in pinned host memory —
+            # the full stack must never materialize in HBM (the point of
+            # offload_param). XLA rejects host-kind out_shardings on
+            # replicated leaves inside jit ("side-effect ops cannot be
+            # replicated"), so this reshard runs as an out-of-jit
+            # device_put over a sharding tree instead.
+            host_sh = dict(param_sh)
+            host_sh["layers"] = jax.tree.map(
+                lambda s: s.with_memory_kind("pinned_host"),
+                param_sh["layers"])
+            self._jit_reshard_to_params = lambda t: jax.device_put(
+                t, host_sh)
         self._jit_to_opt_sharding = jax.jit(
             lambda t: t, out_shardings=opt_sh)
         self._jit_fwd_bwd = jax.jit(fwd_bwd)
@@ -692,6 +711,74 @@ class Engine:
         self._after_step(metrics)
         self.timers(STEP_GLOBAL_TIMER).stop()
 
+    def _setup_param_host_offload(self) -> None:
+        """ZeRO-Infinity param tier (reference offload_config.py:21
+        offload_param + partitioned_param_swapper semantics): layer
+        params move to pinned host memory and the model's scan streams
+        one layer at a time to HBM (models/transformer.py
+        param_host_offload path). Requires the host optimizer tier."""
+        pcfg = self.config.zero_optimization.offload_param
+        self._param_host_offload = bool(
+            pcfg is not None and pcfg.device != "none")
+        if not self._param_host_offload:
+            return
+        if pcfg.device == "nvme":
+            logger.warning("offload_param.device='nvme': layer params are "
+                           "held in pinned host RAM (the NVMe tier applies "
+                           "to optimizer state); proceeding with cpu "
+                           "placement")
+        if self._offload is None:
+            if self._onebit or self._zeropp:
+                raise ValueError(
+                    "offload_param does not compose with 1-bit/ZeRO++ "
+                    "quantized optimizers (their fused step keeps all "
+                    "state on device); drop the quantized optimizer or "
+                    "the offload_param block")
+            raise ValueError(
+                "offload_param requires offload_optimizer (the ZeRO-"
+                "Infinity pairing): add zero_optimization."
+                "offload_optimizer.device='cpu'")
+        if self.mesh.shape.get("pp", 1) > 1:
+            raise ValueError("offload_param does not compose with the "
+                             "pipeline-parallel layer path yet")
+        mcfg = getattr(self.model, "config", None)
+        if mcfg is None or not hasattr(mcfg, "param_host_offload"):
+            raise ValueError("offload_param needs a model whose config "
+                             "supports param_host_offload (TransformerLM)")
+        updates = {}
+        if not mcfg.param_host_offload:
+            updates["param_host_offload"] = True
+        if not getattr(mcfg, "remat", True):
+            # without remat every fetched layer is saved as a backward
+            # residual and the full stack materializes in HBM anyway —
+            # force the streaming-compatible mode on
+            logger.warning("offload_param requires per-layer remat to "
+                           "keep the stack out of HBM; enabling remat")
+            updates["remat"] = True
+        if updates:
+            import dataclasses as _dc
+
+            self.model.config = _dc.replace(mcfg, **updates)
+        self.params = self._place_layer_params_on_host(self.params)
+        log_dist("offload_param: layer params pinned to host memory; "
+                 "the compiled step streams one layer at a time", ranks=[0])
+
+    def _place_layer_params_on_host(self, params):
+        # host copies are staged in FP32: sub-32-bit host->device streaming
+        # is not supported by current TPU runtimes, and fp32 is the master
+        # precision anyway (the layer body casts to compute dtype right
+        # after the fetch, so HBM holds one fp32 layer transiently)
+        if not isinstance(params, dict) or "layers" not in params:
+            return params
+        host_layers = jax.tree.map(
+            lambda a: jax.device_put(
+                a.astype(jnp.float32),
+                a.sharding.with_memory_kind("pinned_host")),
+            params["layers"])
+        out = dict(params)
+        out["layers"] = host_layers
+        return out
+
     def _offload_apply(self, grads, loss):
         """Host-side optimizer step (ZeRO-Offload boundary): device grads
         → native CPU optimizer → resharded device params."""
@@ -703,6 +790,8 @@ class Engine:
             grads, self.params, lr=lr, grad_scale=scale,
             skip_on_nonfinite=fp16)
         if not overflow:
+            # reshard targets host memory kind for layers under
+            # offload_param (out_shardings in _build_step_fns)
             self.params = self._jit_reshard_to_params(new_tree)
             self.step_count = self.step_count + 1
         if fp16:
@@ -900,8 +989,12 @@ class Engine:
                         load_module_strict: bool = True,
                         load_optimizer_states: bool = True,
                         load_lr_scheduler_states: bool = True):
-        return self._ckpt_io.load(load_dir, tag=tag,
-                                  load_optimizer_states=load_optimizer_states)
+        out = self._ckpt_io.load(load_dir, tag=tag,
+                                 load_optimizer_states=load_optimizer_states)
+        if getattr(self, "_param_host_offload", False):
+            # restored leaves come back in device memory; re-pin layers
+            self.params = self._place_layer_params_on_host(self.params)
+        return out
 
 
 class _OptimizerView:

@@ -67,7 +67,12 @@ class HostOffloadOptimizer:
     def __init__(self, params, optimizer_name: str = "adamw",
                  optimizer_params: Optional[dict] = None,
                  compute_dtype=None, grad_clip: float = 0.0,
-                 nvme_path: Optional[str] = None):
+                 nvme_path: Optional[str] = None,
+                 host_memory_leaf_prefixes: Tuple[str, ...] = ()):
+        # leaves whose path starts with one of these prefixes are uploaded
+        # into pinned HOST memory instead of HBM (ZeRO-Infinity
+        # offload_param pairing: the engine streams them per layer)
+        self.host_memory_leaf_prefixes = tuple(host_memory_leaf_prefixes)
         optimizer_params = dict(optimizer_params or {})
         self.lr = float(optimizer_params.pop("lr", 1e-3))
         name = optimizer_name.lower()
@@ -284,17 +289,34 @@ class HostOffloadOptimizer:
                     self._swap_out(key, master)
 
         # 4) upload: rebuild each leaf WITH THE GRAD (optimizer) SHARDING;
-        # the engine reshards to the param sharding under jit.
+        # the engine reshards to the param sharding under jit. Leaves
+        # marked host-memory never touch HBM: they upload into pinned
+        # host buffers and the engine's reshard keeps them there.
         new_leaves = []
         for (path, gleaf), pleaf in zip(zip(g_paths, g_leaves), p_leaves):
             cdt = pleaf.dtype
+            to_host = any(path.startswith(p)
+                          for p in self.host_memory_leaf_prefixes)
+            sharding = (gleaf.sharding.with_memory_kind("pinned_host")
+                        if to_host else gleaf.sharding)
             bufs = []
             for shard in gleaf.addressable_shards:
                 key = (path, _index_key(shard.index))
-                bufs.append(jax.device_put(updated[key].astype(cdt, copy=False),
-                                           shard.device))
+                if to_host:
+                    # host-memory leaves stay FP32 (master precision;
+                    # sub-32-bit host->device streaming is unsupported);
+                    # pleaf.dtype is fp32 for them, so updated[] is too
+                    from jax.sharding import SingleDeviceSharding
+
+                    piece = np.ascontiguousarray(updated[key],
+                                                 dtype=np.float32)
+                    bufs.append(jax.device_put(piece, SingleDeviceSharding(
+                        shard.device, memory_kind="pinned_host")))
+                else:
+                    piece = updated[key].astype(cdt, copy=False)
+                    bufs.append(jax.device_put(piece, shard.device))
             new_leaves.append(jax.make_array_from_single_device_arrays(
-                gleaf.shape, gleaf.sharding, bufs))
+                gleaf.shape, sharding, bufs))
         new_tree = jax.tree_util.tree_unflatten(g_treedef, new_leaves)
         return new_tree, gnorm, overflow
 
@@ -448,6 +470,10 @@ class HostOffloadOptimizer:
         for path, pleaf in zip(p_paths, p_leaves):
             cdt = pleaf.dtype
             gshape, sharding = self._leaf_layout[path]
+            to_host = any(path.startswith(p)
+                          for p in self.host_memory_leaf_prefixes)
+            if to_host:
+                sharding = sharding.with_memory_kind("pinned_host")
             bufs = []
             idx_map = sharding.addressable_devices_indices_map(gshape)
             for device, index in idx_map.items():
@@ -461,7 +487,13 @@ class HostOffloadOptimizer:
                     piece = f32_to_bf16(master).view(_BF16).reshape(shape)
                 else:
                     piece = master.reshape(shape).astype(cdt)
-                bufs.append(jax.device_put(piece, device))
+                if to_host:
+                    from jax.sharding import SingleDeviceSharding
+
+                    bufs.append(jax.device_put(piece, SingleDeviceSharding(
+                        device, memory_kind="pinned_host")))
+                else:
+                    bufs.append(jax.device_put(piece, device))
             new_leaves.append(jax.make_array_from_single_device_arrays(
                 gshape, sharding, bufs))
         return jax.tree_util.tree_unflatten(p_treedef, new_leaves)

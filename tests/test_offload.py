@@ -346,3 +346,91 @@ def test_offload_lion(devices):
     # lion default lr 1e-2 is hot; it still must not diverge on memorization
     losses = [float(engine.train_batch(it)) for _ in range(8)]
     assert losses[-1] < losses[0] + 0.5, losses
+
+
+# ---------------------------------------------------------------------------
+# ZeRO-Infinity param tier (offload_param: host-resident layer params)
+# ---------------------------------------------------------------------------
+
+def make_infinity_engine(micro=2, gas=1):
+    cfg = {
+        "train_micro_batch_size_per_chip": micro,
+        "gradient_accumulation_steps": gas,
+        "optimizer": {"type": "adamw", "params": {"lr": 1e-2}},
+        "zero_optimization": {
+            "stage": 2,
+            "offload_optimizer": {"device": "cpu"},
+            "offload_param": {"device": "cpu"},
+        },
+        "steps_per_print": 100,
+    }
+    engine, *_ = dstpu.initialize(model=TransformerLM(TINY), config=cfg)
+    return engine
+
+
+def _layer_memory_kinds(params):
+    return {l.sharding.memory_kind for l in jax.tree.leaves(params["layers"])}
+
+
+def test_param_offload_trains_and_stays_on_host(devices):
+    engine = make_infinity_engine()
+    assert _layer_memory_kinds(engine.params) == {"pinned_host"}
+    it = data_iter(engine.micro_batch_size * engine.dp_world_size)
+    losses = [float(engine.train_batch(it)) for _ in range(8)]
+    assert losses[-1] < losses[0] - 0.3, losses
+    # placement survives the update/reshard cycle
+    assert _layer_memory_kinds(engine.params) == {"pinned_host"}
+
+
+def test_param_offload_matches_plain_offload(devices):
+    a = make_engine(zero_stage=2, offload_device="cpu")
+    b = make_infinity_engine()
+    it1 = data_iter(a.micro_batch_size * a.dp_world_size, seed=5)
+    it2 = data_iter(b.micro_batch_size * b.dp_world_size, seed=5)
+    l1 = [float(a.train_batch(it1)) for _ in range(4)]
+    l2 = [float(b.train_batch(it2)) for _ in range(4)]
+    np.testing.assert_allclose(l1, l2, rtol=3e-3)
+
+
+def test_param_offload_checkpoint_roundtrip(tmp_path, devices):
+    engine = make_infinity_engine()
+    it = data_iter(engine.micro_batch_size * engine.dp_world_size)
+    for _ in range(2):
+        engine.train_batch(it)
+    engine.save_checkpoint(str(tmp_path), tag="t")
+    engine2 = make_infinity_engine()
+    engine2.load_checkpoint(str(tmp_path), tag="t")
+    assert _layer_memory_kinds(engine2.params) == {"pinned_host"}
+    b = next(data_iter(engine.micro_batch_size * engine.dp_world_size))
+
+    def scalar_loss(e):
+        out = e.eval_batch(b)
+        return float(out[0] if isinstance(out, tuple) else out)
+
+    np.testing.assert_allclose(scalar_loss(engine), scalar_loss(engine2),
+                               rtol=1e-5)
+
+
+def test_param_offload_requires_offload_optimizer(devices):
+    cfg = {
+        "train_micro_batch_size_per_chip": 2,
+        "optimizer": {"type": "adamw", "params": {"lr": 1e-2}},
+        "zero_optimization": {"stage": 2,
+                              "offload_param": {"device": "cpu"}},
+    }
+    with pytest.raises(ValueError, match="offload_param requires"):
+        dstpu.initialize(model=TransformerLM(TINY), config=cfg)
+
+
+def test_param_offload_rejects_quantized_optimizers(devices):
+    cfg = {
+        "train_micro_batch_size_per_chip": 2,
+        "optimizer": {"type": "onebitadam", "params": {"lr": 1e-2}},
+        "zero_optimization": {"stage": 1,
+                              "offload_optimizer": {"device": "cpu"},
+                              "offload_param": {"device": "cpu"}},
+    }
+    # rejected upstream by the 1-bit validator (offload incompatibility
+    # is caught before the offload_param pairing check)
+    with pytest.raises(ValueError, match="incompatible with"):
+        dstpu.initialize(model=TransformerLM(TINY), config=cfg)
