@@ -45,6 +45,8 @@ def _qkv(cfg: TransformerConfig, layer_params, y, positions):
 
 
 def _mlp(cfg: TransformerConfig, layer_params, x):
+    if "moe" in layer_params:
+        return _moe_mlp(cfg, layer_params, x)
     mp = layer_params["mlp"]
     dt = x.dtype
     y = _norm(x, layer_params["ln2"], cfg.norm, cfg.norm_eps)
@@ -55,6 +57,25 @@ def _mlp(cfg: TransformerConfig, layer_params, x):
     else:
         z = jax.nn.gelu(jnp.einsum("...h,hf->...f", y, mp["wi"].astype(dt)))
     return x + jnp.einsum("...f,fh->...h", z, mp["wo"].astype(dt))
+
+
+def _moe_mlp(cfg, layer_params, x):
+    """MoE FFN for the inference runners (reference: inference/v2
+    model_implementations mixtral/qwen_v2_moe — moe_gather/moe_scatter +
+    top_k_gating ragged kernels). Token dropping is disabled: serving
+    must route every token (capacity = tokens, the reference's
+    no-drop inference dispatch)."""
+    import dataclasses
+
+    from deepspeed_tpu.parallel.moe import moe_ffn
+
+    y = _norm(x, layer_params["ln2"], cfg.norm, cfg.norm_eps)
+    flat = y[None] if y.ndim == 2 else y  # [1,T,H] / [S,Tq,H] groups
+    gate = dataclasses.replace(cfg.gate, drop_tokens=False)
+    out, _aux = moe_ffn(flat, layer_params["moe"]["router"],
+                        layer_params["moe"]["experts"], gate,
+                        activation=cfg.activation, train=False)
+    return x + (out[0] if y.ndim == 2 else out)
 
 
 def _unembed(cfg: TransformerConfig, params, x):

@@ -167,6 +167,33 @@ def apply(cfg: MoETransformerConfig, params, tokens, positions=None,
         x, aux_total = pipelined_layers(
             lambda c, lp: layer_fn(c, lp, positions, train),
             params["layers"], x, with_aux=True)
+    elif cfg.param_host_offload:
+        # ZeRO-Infinity streaming for the expert stack (mirrors
+        # models/transformer.py): each scan step fetches one layer's
+        # params — including its experts, the bulk of an MoE model —
+        # inside the rematerialized body, so HBM holds one layer's
+        # experts at a time
+        def fetch_layer(i):
+            return jax.tree.map(
+                lambda a: jax.device_put(
+                    lax.dynamic_index_in_dim(a, i, keepdims=False),
+                    jax.memory.Space.Device),
+                params["layers"])
+
+        def fetched_fn(x, i):
+            return layer_fn(x, fetch_layer(i), positions, train)
+
+        if cfg.remat:
+            fetched_fn = jax.checkpoint(fetched_fn)
+
+        def host_body(carry, i):
+            x, aux = carry
+            x, l_aux = fetched_fn(x, i)
+            return (x, aux + l_aux), None
+
+        (x, aux_total), _ = lax.scan(
+            host_body, (x, jnp.asarray(0.0, jnp.float32)),
+            jnp.arange(cfg.num_layers))
     else:
         if cfg.remat:
             layer_fn = jax.checkpoint(layer_fn)

@@ -434,11 +434,23 @@ class Engine:
                 nvme_path=(off.nvme_path
                            if self._offload_device == "nvme" else None),
                 host_memory_leaf_prefixes=host_prefixes)
+            host_layers = None
+            if host_prefixes and isinstance(p32, dict) and "layers" in p32:
+                # pin the TRUE fp32 masters host-side before the compute-
+                # dtype cast (casting first would store bf16-rounded
+                # values relabeled fp32, and waste a d2h round trip)
+                host_layers = jax.tree.map(
+                    lambda a: jax.device_put(
+                        a, a.sharding.with_memory_kind("pinned_host")),
+                    p32["layers"])
             cast = jax.jit(
                 lambda t: _constrain_tree(
                     jax.tree.map(lambda m: m.astype(cdt), t), param_sh),
                 donate_argnums=(0,))
             self.params = cast(p32)
+            if host_layers is not None:
+                self.params = dict(self.params)
+                self.params["layers"] = host_layers
             self.opt_state = None
         else:
             def init_fn(rng):
@@ -728,12 +740,10 @@ class Engine:
                            "to optimizer state); proceeding with cpu "
                            "placement")
         if self._offload is None:
-            if self._onebit or self._zeropp:
-                raise ValueError(
-                    "offload_param does not compose with 1-bit/ZeRO++ "
-                    "quantized optimizers (their fused step keeps all "
-                    "state on device); drop the quantized optimizer or "
-                    "the offload_param block")
+            # (1-bit/ZeRO++ cannot reach here: their validators/gating
+            # already reject or disable themselves under optimizer
+            # offload, so _offload is always set when offload_optimizer
+            # is configured)
             raise ValueError(
                 "offload_param requires offload_optimizer (the ZeRO-"
                 "Infinity pairing): add zero_optimization."
@@ -770,13 +780,17 @@ class Engine:
         # after the fetch, so HBM holds one fp32 layer transiently)
         if not isinstance(params, dict) or "layers" not in params:
             return params
-        host_layers = jax.tree.map(
-            lambda a: jax.device_put(
+
+        def pin(a):
+            if getattr(a.sharding, "memory_kind", None) == "pinned_host" \
+                    and a.dtype == jnp.float32:
+                return a  # already staged (init pins the fp32 masters)
+            return jax.device_put(
                 a.astype(jnp.float32),
-                a.sharding.with_memory_kind("pinned_host")),
-            params["layers"])
+                a.sharding.with_memory_kind("pinned_host"))
+
         out = dict(params)
-        out["layers"] = host_layers
+        out["layers"] = jax.tree.map(pin, params["layers"])
         return out
 
     def _offload_apply(self, grads, loss):

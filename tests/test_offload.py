@@ -422,7 +422,9 @@ def test_param_offload_requires_offload_optimizer(devices):
         dstpu.initialize(model=TransformerLM(TINY), config=cfg)
 
 
-def test_param_offload_rejects_quantized_optimizers(devices):
+def test_onebit_offload_combination_rejected(devices):
+    # 1-bit + optimizer offload is rejected by the 1-bit validator before
+    # offload_param pairing is even considered
     cfg = {
         "train_micro_batch_size_per_chip": 2,
         "optimizer": {"type": "onebitadam", "params": {"lr": 1e-2}},
@@ -430,7 +432,30 @@ def test_param_offload_rejects_quantized_optimizers(devices):
                               "offload_optimizer": {"device": "cpu"},
                               "offload_param": {"device": "cpu"}},
     }
-    # rejected upstream by the 1-bit validator (offload incompatibility
-    # is caught before the offload_param pairing check)
     with pytest.raises(ValueError, match="incompatible with"):
         dstpu.initialize(model=TransformerLM(TINY), config=cfg)
+
+
+def test_param_offload_moe_model(devices):
+    """The expert stack (the bulk of an MoE model) streams from host
+    memory too (moe_transformer.apply param_host_offload path)."""
+    from deepspeed_tpu.models.zoo import get_model
+
+    model = get_model("tiny-moe")
+    cfg = {
+        "train_micro_batch_size_per_chip": 2,
+        "optimizer": {"type": "adamw", "params": {"lr": 1e-2}},
+        "zero_optimization": {"stage": 2,
+                              "offload_optimizer": {"device": "cpu"},
+                              "offload_param": {"device": "cpu"}},
+    }
+    engine, *_ = dstpu.initialize(model=model, config=cfg)
+    assert _layer_memory_kinds(engine.params) == {"pinned_host"}
+    rng = np.random.default_rng(0)
+    fixed = {"input_ids": rng.integers(
+        0, 256, (engine.micro_batch_size * engine.dp_world_size,
+                 17)).astype(np.int32)}
+    it = iter([fixed] * 20)
+    losses = [float(engine.train_batch(it)) for _ in range(6)]
+    assert losses[-1] < losses[0] - 0.2, losses
+    assert _layer_memory_kinds(engine.params) == {"pinned_host"}
