@@ -1,0 +1,168 @@
+"""Load HuggingFace checkpoints into zoo parameter trees.
+
+Reference: the checkpoint-loading half of ``module_inject`` — policies
+map HF module weights onto the reference's fused/TP layouts
+(module_inject/load_checkpoint.py, containers/llama.py). TPU re-design:
+a pure tensor-name mapping from an HF ``state_dict`` onto the stacked
+pytree of ``models/transformer.py`` — sharding happens afterwards via
+AutoTP/engine placement, so loading is layout-only.
+
+Covered: the Llama family (Llama-2/3, Mistral, and other
+``{q,k,v,o}_proj / gate,up,down_proj`` models without attention
+biases). Qwen2 loads with a warning (its qkv biases are dropped —
+the zoo layout is bias-free); GPT-2/OPT/Falcon need bias support in
+TransformerLM first and are rejected with a clear error.
+
+Rope parity: both sides use the rotate-half convention, so projection
+weights map 1:1 (no row permutation needed).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+from deepspeed_tpu.models.transformer import TransformerConfig, TransformerLM
+from deepspeed_tpu.utils.logging import logger
+
+
+def _to_np(t) -> np.ndarray:
+    if isinstance(t, np.ndarray):
+        return t
+    if hasattr(t, "detach"):  # torch tensor
+        return t.detach().to("cpu").float().numpy()
+    return np.asarray(t)
+
+
+def config_from_hf(hf_config, **overrides) -> TransformerConfig:
+    """HF LlamaConfig/MistralConfig/Qwen2Config → TransformerConfig."""
+    get = lambda k, d=None: getattr(hf_config, k, d)
+    if get("rope_scaling"):
+        raise ValueError(
+            "rope_scaling is not supported yet (Llama-3.1+ scaled rope "
+            "would silently produce wrong logits); load a base-rope "
+            "checkpoint or strip rope_scaling knowingly")
+    head_dim = get("head_dim")
+    if head_dim and head_dim != get("hidden_size") // get(
+            "num_attention_heads"):
+        raise ValueError(
+            f"explicit head_dim={head_dim} != hidden//heads "
+            f"({get('hidden_size')}//{get('num_attention_heads')}); the "
+            "zoo layout derives head_dim and cannot load this model")
+    if get("sliding_window"):
+        logger.warning(
+            f"HF config sets sliding_window={get('sliding_window')}; the "
+            "loaded model attends the full causal context — outputs "
+            "diverge from transformers beyond the window length")
+    cfg = TransformerConfig(
+        vocab_size=get("vocab_size"),
+        hidden_size=get("hidden_size"),
+        num_layers=get("num_hidden_layers"),
+        num_heads=get("num_attention_heads"),
+        num_kv_heads=get("num_key_value_heads",
+                         get("num_attention_heads")),
+        ffn_size=get("intermediate_size"),
+        max_seq_len=get("max_position_embeddings", 4096),
+        pos_emb="rope", norm="rmsnorm", activation="swiglu",
+        tie_embeddings=bool(get("tie_word_embeddings", False)),
+        rope_theta=float(get("rope_theta", 10000.0)),
+        norm_eps=float(get("rms_norm_eps", 1e-5)),
+    )
+    import dataclasses
+
+    return dataclasses.replace(cfg, **overrides) if overrides else cfg
+
+
+def load_hf_llama_state_dict(state_dict: Dict[str, Any],
+                             cfg: TransformerConfig) -> Dict[str, Any]:
+    """HF Llama-family ``state_dict`` → stacked zoo param tree.
+
+    HF linear weights are [out, in] (torch Linear); ours are [in, out]
+    einsum operands, so every projection transposes on load.
+    """
+    sd = {k.removeprefix("model."): v for k, v in state_dict.items()}
+    if "layers.0.self_attn.q_proj.weight" not in sd:
+        known = sorted(sd)[:8]
+        raise ValueError(
+            "state_dict is not a Llama-family checkpoint (expected "
+            f"layers.N.self_attn.q_proj.weight; got e.g. {known}). GPT-2/"
+            "OPT/Falcon layouts need bias support and are not loadable "
+            "yet.")
+    dropped = [k for k in sd if k.endswith(
+        ("q_proj.bias", "k_proj.bias", "v_proj.bias"))]
+    if dropped:
+        logger.warning(
+            f"HF load: dropping {len(dropped)} attention bias tensors "
+            "(Qwen2-style qkv biases; the zoo layout is bias-free — "
+            "expect small numeric drift)")
+
+    L, h = cfg.num_layers, cfg.hidden_size
+    nh, nkv, hd = cfg.num_heads, cfg.kv_heads, cfg.head_dim
+    pd = cfg.param_dtype
+
+    def per_layer(name):
+        return np.stack([_to_np(sd[f"layers.{i}.{name}"]) for i in range(L)])
+
+    wq = per_layer("self_attn.q_proj.weight")    # [L, nh*hd, H]
+    wk = per_layer("self_attn.k_proj.weight")    # [L, nkv*hd, H]
+    wv = per_layer("self_attn.v_proj.weight")
+    wo = per_layer("self_attn.o_proj.weight")    # [L, H, nh*hd]
+    wg = per_layer("mlp.gate_proj.weight")       # [L, F, H]
+    wi = per_layer("mlp.up_proj.weight")
+    wdown = per_layer("mlp.down_proj.weight")    # [L, H, F]
+
+    import jax.numpy as jnp
+
+    def j(x):
+        return jnp.asarray(x, pd)
+
+    params: Dict[str, Any] = {
+        "embed": {"tokens": j(_to_np(sd["embed_tokens.weight"]))},
+        "layers": {
+            "attn": {
+                "wq": j(wq.transpose(0, 2, 1).reshape(L, h, nh, hd)),
+                "wk": j(wk.transpose(0, 2, 1).reshape(L, h, nkv, hd)),
+                "wv": j(wv.transpose(0, 2, 1).reshape(L, h, nkv, hd)),
+                "wo": j(wo.transpose(0, 2, 1).reshape(L, nh, hd, h)),
+            },
+            "mlp": {
+                "wg": j(wg.transpose(0, 2, 1)),          # [L, H, F]
+                "wi": j(wi.transpose(0, 2, 1)),
+                "wo": j(wdown.transpose(0, 2, 1)),       # [L, F, H]
+            },
+            "ln1": {"scale": j(per_layer("input_layernorm.weight"))},
+            "ln2": {"scale": j(per_layer(
+                "post_attention_layernorm.weight"))},
+        },
+        "final_norm": {"scale": j(_to_np(sd["norm.weight"]))},
+    }
+    if not cfg.tie_embeddings:
+        # tied checkpoints ship no lm_head: fall back to the embedding
+        lm_head = sd.get("lm_head.weight", sd["embed_tokens.weight"])
+        params["unembed"] = {"kernel": j(_to_np(lm_head).T)}
+    return params
+
+
+def from_hf_pretrained(model_or_path, config: Optional[TransformerConfig]
+                       = None, **overrides):
+    """HF model instance or local path → (TransformerLM, params).
+
+    Reference entry analog: ``deepspeed.init_inference(model, ...)``
+    consuming an HF model; here the weights move into the TPU-native
+    tree once and the HF/torch object can be dropped.
+    """
+    if isinstance(model_or_path, str):
+        from transformers import AutoConfig, AutoModelForCausalLM
+
+        hf_cfg = AutoConfig.from_pretrained(model_or_path)
+        hf_model = AutoModelForCausalLM.from_pretrained(model_or_path)
+    else:
+        hf_model = model_or_path
+        hf_cfg = hf_model.config
+    if config is not None and overrides:
+        raise ValueError("pass either config= or field overrides, not "
+                         "both (overrides would be silently ignored)")
+    cfg = config or config_from_hf(hf_cfg, **overrides)
+    params = load_hf_llama_state_dict(hf_model.state_dict(), cfg)
+    return TransformerLM(cfg), params
