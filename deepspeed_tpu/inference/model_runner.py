@@ -38,6 +38,10 @@ def _qkv(cfg: TransformerConfig, layer_params, y, positions):
     q = jnp.einsum("...h,hnd->...nd", y, ap["wq"].astype(dt))
     k = jnp.einsum("...h,hnd->...nd", y, ap["wk"].astype(dt))
     v = jnp.einsum("...h,hnd->...nd", y, ap["wv"].astype(dt))
+    if cfg.use_biases:
+        q = q + ap["bq"].astype(dt)
+        k = k + ap["bk"].astype(dt)
+        v = v + ap["bv"].astype(dt)
     if cfg.pos_emb == "rope":
         q = _rope(q, positions, cfg.rope_theta)
         k = _rope(k, positions, cfg.rope_theta)
@@ -55,8 +59,15 @@ def _mlp(cfg: TransformerConfig, layer_params, x):
         u = jnp.einsum("...h,hf->...f", y, mp["wi"].astype(dt))
         z = jax.nn.silu(g) * u
     else:
-        z = jax.nn.gelu(jnp.einsum("...h,hf->...f", y, mp["wi"].astype(dt)))
-    return x + jnp.einsum("...f,fh->...h", z, mp["wo"].astype(dt))
+        act = jax.nn.relu if cfg.activation == "relu" else jax.nn.gelu
+        pre = jnp.einsum("...h,hf->...f", y, mp["wi"].astype(dt))
+        if cfg.use_biases:
+            pre = pre + mp["bi"].astype(dt)
+        z = act(pre)
+    out = jnp.einsum("...f,fh->...h", z, mp["wo"].astype(dt))
+    if cfg.use_biases:
+        out = out + mp["bo"].astype(dt)
+    return x + out
 
 
 def _moe_mlp(cfg, layer_params, x):
@@ -139,6 +150,8 @@ def forward_with_cache(cfg: TransformerConfig, params, tokens: jax.Array,
         attn = jnp.einsum("bnsm,bmnd->bsnd", probs, v_all.astype(dt))
         attn = jnp.einsum("bsnd,ndh->bsh", attn,
                           layer_params["attn"]["wo"].astype(dt))
+        if cfg.use_biases:
+            attn = attn + layer_params["attn"]["bo"].astype(dt)
         x = x + attn
         return _mlp(cfg, layer_params, x), kv_layer
 
@@ -216,6 +229,8 @@ def ragged_forward(cfg: TransformerConfig, params, kv_data: jax.Array,
         attn = jnp.einsum("tnm,tmnd->tnd", probs, v_seq.astype(dt))
         attn = jnp.einsum("tnd,ndh->th", attn,
                           layer_params["attn"]["wo"].astype(dt))
+        if cfg.use_biases:
+            attn = attn + layer_params["attn"]["bo"].astype(dt)
         x = x + attn
         return _mlp(cfg, layer_params, x), kv_layer
 
@@ -276,6 +291,8 @@ def ragged_prefill_forward(cfg: TransformerConfig, params,
                                        seg_pos0, ctx_lens)
         attn = jnp.einsum("stnd,ndh->sth", attn.astype(dt),
                           layer_params["attn"]["wo"].astype(dt))
+        if cfg.use_biases:
+            attn = attn + layer_params["attn"]["bo"].astype(dt)
         x = x + attn
         return _mlp(cfg, layer_params, x), kv_layer
 
@@ -334,6 +351,8 @@ def ragged_decode_forward(cfg: TransformerConfig, params, kv_data: jax.Array,
                                       context_lens)
         attn = jnp.einsum("snd,ndh->sh", attn.astype(dt),
                           layer_params["attn"]["wo"].astype(dt))
+        if cfg.use_biases:
+            attn = attn + layer_params["attn"]["bo"].astype(dt)
         x = x + attn
         return _mlp(cfg, layer_params, x), kv_layer
 

@@ -144,6 +144,88 @@ def load_hf_llama_state_dict(state_dict: Dict[str, Any],
     return params
 
 
+def config_from_hf_gpt2(hf_config, **overrides) -> TransformerConfig:
+    """HF GPT2Config → TransformerConfig (learned positions, layernorm,
+    gelu_new ≈ jax.nn.gelu tanh approximation, tied embeddings,
+    projection biases)."""
+    get = lambda k, d=None: getattr(hf_config, k, d)
+    cfg = TransformerConfig(
+        vocab_size=get("vocab_size"),
+        hidden_size=get("n_embd"),
+        num_layers=get("n_layer"),
+        num_heads=get("n_head"),
+        ffn_size=4 * get("n_embd") if get("n_inner") is None
+        else get("n_inner"),
+        max_seq_len=get("n_positions", 1024),
+        pos_emb="learned", norm="layernorm", activation="gelu",
+        tie_embeddings=True, use_biases=True,
+        norm_eps=float(get("layer_norm_epsilon", 1e-5)),
+    )
+    import dataclasses
+
+    return dataclasses.replace(cfg, **overrides) if overrides else cfg
+
+
+def load_hf_gpt2_state_dict(state_dict: Dict[str, Any],
+                            cfg: TransformerConfig) -> Dict[str, Any]:
+    """HF GPT-2 ``state_dict`` → stacked zoo tree.
+
+    GPT-2 uses Conv1D modules whose weights are already [in, out] — no
+    transpose; c_attn fuses q/k/v on the output dim.
+    """
+    sd = {k.removeprefix("transformer."): v for k, v in state_dict.items()}
+    if "h.0.attn.c_attn.weight" not in sd:
+        raise ValueError(
+            "state_dict is not a GPT-2 layout (expected "
+            "h.N.attn.c_attn.weight)")
+    L, h = cfg.num_layers, cfg.hidden_size
+    nh, hd = cfg.num_heads, cfg.head_dim
+
+    def per_layer(name):
+        return np.stack([_to_np(sd[f"h.{i}.{name}"]) for i in range(L)])
+
+    import jax.numpy as jnp
+
+    def j(x):
+        return jnp.asarray(x, cfg.param_dtype)
+
+    cattn_w = per_layer("attn.c_attn.weight")      # [L, H, 3H]
+    cattn_b = per_layer("attn.c_attn.bias")        # [L, 3H]
+    wq, wk, wv = np.split(cattn_w, 3, axis=2)      # [L, H, H] each
+    bq, bk, bv = np.split(cattn_b, 3, axis=1)      # [L, H]
+    return {
+        "embed": {
+            "tokens": j(_to_np(sd["wte.weight"])),
+            "positions": j(_to_np(sd["wpe.weight"])[:cfg.max_seq_len]),
+        },
+        "layers": {
+            "attn": {
+                "wq": j(wq.reshape(L, h, nh, hd)),
+                "wk": j(wk.reshape(L, h, nh, hd)),
+                "wv": j(wv.reshape(L, h, nh, hd)),
+                "wo": j(per_layer("attn.c_proj.weight")
+                        .reshape(L, nh, hd, h)),
+                "bq": j(bq.reshape(L, nh, hd)),
+                "bk": j(bk.reshape(L, nh, hd)),
+                "bv": j(bv.reshape(L, nh, hd)),
+                "bo": j(per_layer("attn.c_proj.bias")),
+            },
+            "mlp": {
+                "wi": j(per_layer("mlp.c_fc.weight")),        # [L, H, F]
+                "bi": j(per_layer("mlp.c_fc.bias")),
+                "wo": j(per_layer("mlp.c_proj.weight")),      # [L, F, H]
+                "bo": j(per_layer("mlp.c_proj.bias")),
+            },
+            "ln1": {"scale": j(per_layer("ln_1.weight")),
+                    "bias": j(per_layer("ln_1.bias"))},
+            "ln2": {"scale": j(per_layer("ln_2.weight")),
+                    "bias": j(per_layer("ln_2.bias"))},
+        },
+        "final_norm": {"scale": j(_to_np(sd["ln_f.weight"])),
+                       "bias": j(_to_np(sd["ln_f.bias"]))},
+    }
+
+
 def from_hf_pretrained(model_or_path, config: Optional[TransformerConfig]
                        = None, **overrides):
     """HF model instance or local path → (TransformerLM, params).
@@ -163,6 +245,10 @@ def from_hf_pretrained(model_or_path, config: Optional[TransformerConfig]
     if config is not None and overrides:
         raise ValueError("pass either config= or field overrides, not "
                          "both (overrides would be silently ignored)")
-    cfg = config or config_from_hf(hf_cfg, **overrides)
-    params = load_hf_llama_state_dict(hf_model.state_dict(), cfg)
+    if getattr(hf_cfg, "model_type", "") == "gpt2":
+        cfg = config or config_from_hf_gpt2(hf_cfg, **overrides)
+        params = load_hf_gpt2_state_dict(hf_model.state_dict(), cfg)
+    else:
+        cfg = config or config_from_hf(hf_cfg, **overrides)
+        params = load_hf_llama_state_dict(hf_model.state_dict(), cfg)
     return TransformerLM(cfg), params

@@ -58,6 +58,10 @@ class TransformerConfig:
     dtype: Any = jnp.bfloat16
     param_dtype: Any = jnp.float32
     remat: bool = True
+    # projection biases (GPT-2/OPT-family checkpoints; Llama-family has
+    # none). Zoo presets stay bias-free; the HF loader enables this when
+    # the source layout carries biases.
+    use_biases: bool = False
     # ZeRO-Infinity param offload: layer params live in pinned host
     # memory; the scan fetches one layer per step (and the remat replay
     # re-fetches it for backward) so HBM never holds the full stack.
@@ -149,6 +153,10 @@ def init_params(cfg: TransformerConfig, rng: jax.Array) -> Dict[str, Any]:
                     lambda k: _dense_init(k, (nh, hd, h), 1.0 / math.sqrt(nh * hd), pd),
                     keys[4],
                 ),
+                **({"bq": jnp.zeros((L, nh, hd), pd),
+                    "bk": jnp.zeros((L, nkv, hd), pd),
+                    "bv": jnp.zeros((L, nkv, hd), pd),
+                    "bo": jnp.zeros((L, h), pd)} if cfg.use_biases else {}),
             },
             "mlp": _init_mlp(cfg, keys[5], L),
             "ln1": {"scale": jnp.ones((L, h), pd)},
@@ -183,6 +191,9 @@ def _init_mlp(cfg, key, L):
     }
     if cfg.activation == "swiglu":
         mlp["wg"] = stack(lambda k: _dense_init(k, (h, f), dtype=pd), ks[2])
+    if cfg.use_biases:
+        mlp["bi"] = jnp.zeros((L, f), pd)
+        mlp["bo"] = jnp.zeros((L, h), pd)
     return mlp
 
 
@@ -211,6 +222,13 @@ def logical_axes(cfg: TransformerConfig) -> Dict[str, Any]:
         axes["layers"]["ln1"]["bias"] = ("layers", "embed")
         axes["layers"]["ln2"]["bias"] = ("layers", "embed")
         axes["final_norm"]["bias"] = ("embed",)
+    if cfg.use_biases:
+        axes["layers"]["attn"]["bq"] = ("layers", "heads", "head_dim")
+        axes["layers"]["attn"]["bk"] = ("layers", "kv_heads", "head_dim")
+        axes["layers"]["attn"]["bv"] = ("layers", "kv_heads", "head_dim")
+        axes["layers"]["attn"]["bo"] = ("layers", "embed")
+        axes["layers"]["mlp"]["bi"] = ("layers", "mlp")
+        axes["layers"]["mlp"]["bo"] = ("layers", "embed")
     if cfg.pos_emb == "learned":
         axes["embed"]["positions"] = ("seq", "embed")
     if cfg.activation == "swiglu":
@@ -291,12 +309,16 @@ def _layer(cfg: TransformerConfig, x, layer_params, positions):
 
     # attention
     y = _norm(x, layer_params["ln1"], cfg.norm, cfg.norm_eps)
-    q = checkpoint_name(
-        jnp.einsum("bsh,hnd->bsnd", y, ap["wq"].astype(dt)), "qkv_proj")
-    k = checkpoint_name(
-        jnp.einsum("bsh,hnd->bsnd", y, ap["wk"].astype(dt)), "qkv_proj")
-    v = checkpoint_name(
-        jnp.einsum("bsh,hnd->bsnd", y, ap["wv"].astype(dt)), "qkv_proj")
+    q = jnp.einsum("bsh,hnd->bsnd", y, ap["wq"].astype(dt))
+    k = jnp.einsum("bsh,hnd->bsnd", y, ap["wk"].astype(dt))
+    v = jnp.einsum("bsh,hnd->bsnd", y, ap["wv"].astype(dt))
+    if cfg.use_biases:
+        q = q + ap["bq"].astype(dt)
+        k = k + ap["bk"].astype(dt)
+        v = v + ap["bv"].astype(dt)
+    q = checkpoint_name(q, "qkv_proj")
+    k = checkpoint_name(k, "qkv_proj")
+    v = checkpoint_name(v, "qkv_proj")
     if cfg.pos_emb == "rope":
         q = _rope(q, positions, cfg.rope_theta)
         k = _rope(k, positions, cfg.rope_theta)
@@ -309,6 +331,8 @@ def _layer(cfg: TransformerConfig, x, layer_params, positions):
         v = jnp.repeat(v, rep, axis=2)
     attn = checkpoint_name(_attention(q, k, v, cfg), "attn_kernel_out")
     attn = jnp.einsum("bsnd,ndh->bsh", attn, ap["wo"].astype(dt))
+    if cfg.use_biases:
+        attn = attn + ap["bo"].astype(dt)
     x = x + constrain_activation(
         checkpoint_name(attn, "attn_out"), ("batch", "seq", "embed"))
 
@@ -322,11 +346,16 @@ def _layer(cfg: TransformerConfig, x, layer_params, positions):
             z = jax.nn.silu(g) * u
         else:
             act = jax.nn.relu if cfg.activation == "relu" else jax.nn.gelu
-            z = act(jnp.einsum("bsh,hf->bsf", y, mp["wi"].astype(dt)))
+            pre = jnp.einsum("bsh,hf->bsf", y, mp["wi"].astype(dt))
+            if cfg.use_biases:
+                pre = pre + mp["bi"].astype(dt)
+            z = act(pre)
         z = constrain_activation(
             checkpoint_name(z, "mlp_wi"), ("batch", "seq", "mlp"))
-        return checkpoint_name(
-            jnp.einsum("bsf,fh->bsh", z, mp["wo"].astype(dt)), "mlp_out")
+        out = jnp.einsum("bsf,fh->bsh", z, mp["wo"].astype(dt))
+        if cfg.use_biases:
+            out = out + mp["bo"].astype(dt)
+        return checkpoint_name(out, "mlp_out")
 
     if cfg.tiled_mlp > 1:
         # position-wise → chunk the sequence (ALST TiledMLP analog):

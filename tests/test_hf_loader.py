@@ -93,3 +93,42 @@ def test_qwen_style_biases_warn_not_fail():
     cfg = config_from_hf(hf.config)
     params = load_hf_llama_state_dict(sd, cfg)
     assert params["layers"]["attn"]["wq"].shape == (3, 64, 4, 16)
+
+
+def _tiny_gpt2():
+    cfg = transformers.GPT2Config(
+        vocab_size=96, n_positions=64, n_embd=48, n_layer=2, n_head=4,
+        activation_function="gelu_new")
+    torch.manual_seed(1)
+    return transformers.GPT2LMHeadModel(cfg)
+
+
+def test_gpt2_logits_match():
+    hf = _tiny_gpt2().eval()
+    model, params = from_hf_pretrained(
+        hf, **{"dtype": jnp.float32, "param_dtype": jnp.float32,
+               "remat": False, "attn_impl": "xla"})
+    assert model.config.use_biases and model.config.tie_embeddings
+    tokens = np.array([[2, 5, 9, 1, 7, 3]], np.int32)
+    with torch.no_grad():
+        ref = hf(torch.tensor(tokens.astype(np.int64))).logits.numpy()
+    got = np.asarray(model.apply(params, jnp.asarray(tokens)))
+    np.testing.assert_allclose(got, ref, rtol=3e-4, atol=3e-4)
+
+
+def test_gpt2_generation_matches(devices):
+    from deepspeed_tpu.inference import init_inference
+
+    hf = _tiny_gpt2().eval()
+    model, params = from_hf_pretrained(
+        hf, **{"dtype": jnp.float32, "param_dtype": jnp.float32,
+               "remat": False, "attn_impl": "xla"})
+    eng = init_inference(model, params=params, dtype=jnp.float32,
+                         max_seq_len=32)
+    prompt = np.array([[3, 8, 2]], np.int32)
+    ours = eng.generate(prompt, max_new_tokens=5)[0, 3:]
+    with torch.no_grad():
+        ref = hf.generate(torch.tensor(prompt.astype(np.int64)),
+                          max_new_tokens=5, do_sample=False,
+                          pad_token_id=0).numpy()[0, 3:]
+    np.testing.assert_array_equal(ours, ref)
