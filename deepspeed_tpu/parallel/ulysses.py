@@ -80,3 +80,56 @@ def ulysses_attention(q, k, v, causal: bool = True, impl: str = "auto",
                   "ulysses_out")
     # head-sharded -> seq-sharded (all-to-all #2)
     return _constrain(out, P(BATCH, "sp", "tp", None))
+
+
+# ---------------------------------------------------------------------------
+# sequence-sharded data feeding (reference UlyssesSPDataLoaderAdapter,
+# runtime/sequence_parallel/ulysses_sp.py:564 — each sp rank feeds its
+# sequence chunk so multi-M-token batches never materialize whole on one
+# host)
+# ---------------------------------------------------------------------------
+
+
+class UlyssesSPDataLoaderAdapter:
+    """Wrap a host batch iterator so token tensors land sequence-sharded
+    over the ``sp`` mesh axis (batch dim over the data axes, dim 1 over
+    sp). Single-process: one device_put with the seq-sharded layout.
+    Multi-host: each process contributes only its local shard via
+    ``make_array_from_process_local_data`` — the ALST contract where no
+    host ever holds the full sequence.
+    """
+
+    def __init__(self, loader, mesh, sp_axis: str = "sp",
+                 seq_dim: int = 1):
+        from deepspeed_tpu.parallel.topology import BATCH_AXES
+
+        self.loader = loader
+        self.mesh = mesh
+        self.sp_axis = sp_axis
+        self.seq_dim = seq_dim
+        batch_axes = tuple(a for a in BATCH_AXES
+                           if mesh.shape.get(a, 1) >= 1)
+        spec = [batch_axes] + [None] * 8
+        spec[seq_dim] = sp_axis
+        self._spec = spec
+
+    def shard(self, batch):
+        import jax
+        import numpy as np
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        def put(x):
+            x = np.asarray(x)
+            if x.ndim <= self.seq_dim:
+                sh = NamedSharding(self.mesh, P(self._spec[0]))
+            else:
+                sh = NamedSharding(self.mesh, P(*self._spec[:x.ndim]))
+            if jax.process_count() > 1:
+                return jax.make_array_from_process_local_data(sh, x)
+            return jax.device_put(x, sh)
+
+        return jax.tree.map(put, batch)
+
+    def __iter__(self):
+        for batch in self.loader:
+            yield self.shard(batch)

@@ -186,3 +186,20 @@ def test_tiled_model_loss_matches_dense_model(devices):
         stream = it()
         outs[tiled] = [float(engine.train_batch(stream)) for _ in range(3)]
     np.testing.assert_allclose(outs[True], outs[False], rtol=2e-3)
+
+
+def test_ulysses_sp_dataloader_adapter(devices):
+    from deepspeed_tpu.parallel.topology import TopologyConfig, build_mesh
+    from deepspeed_tpu.parallel.ulysses import UlyssesSPDataLoaderAdapter
+
+    mesh = build_mesh(TopologyConfig(dp=4, sp=2))
+    batches = [{"input_ids": np.arange(8 * 16).reshape(8, 16)
+                .astype(np.int32)}]
+    adapter = UlyssesSPDataLoaderAdapter(iter(batches), mesh)
+    out = next(iter(adapter))["input_ids"]
+    assert out.shape == (8, 16)
+    spec = out.sharding.spec
+    assert "sp" in str(spec[1])  # seq dim sharded over sp
+    # values survive the resharding
+    np.testing.assert_array_equal(
+        np.asarray(out), np.arange(8 * 16).reshape(8, 16))
