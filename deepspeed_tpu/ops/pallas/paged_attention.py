@@ -130,9 +130,10 @@ def _prefill_kernel(pos0_ref, ctx_ref, bt_ref, q_ref, kv_ref, out_ref,
     @pl.when(j * bs < ctx)
     def _visit_page():
         for n in range(nkv):
-            q = q_ref[0, :, n].reshape(rows, q_ref.shape[-1])
-            q = q.astype(jnp.float32) * scale           # [rows, hd]
-            k = kv_ref[0, :, 0, n].astype(jnp.float32)  # [bs, hd]
+            # q layout is [S, nkv, tq*g, hd] (wrapper pre-transposes):
+            # only leading-dim integer indexing, which Mosaic supports
+            q = q_ref[0, n].astype(jnp.float32) * scale  # [rows, hd]
+            k = kv_ref[0, :, 0, n].astype(jnp.float32)   # [bs, hd]
             v = kv_ref[0, :, 1, n].astype(jnp.float32)
             _fold_page(q, k, v, visible, m_ref, l_ref, acc_ref,
                        slice(n * rows, (n + 1) * rows), rows)
@@ -143,8 +144,7 @@ def _prefill_kernel(pos0_ref, ctx_ref, bt_ref, q_ref, kv_ref, out_ref,
             rsl = slice(n * rows, (n + 1) * rows)
             l = l_ref[rsl, :1]
             l = jax.lax.select(l == 0.0, jnp.ones_like(l), l)
-            out = (acc_ref[rsl, :] / l).astype(out_ref.dtype)
-            out_ref[0, :, n] = out.reshape(tq, g, out_ref.shape[-1])
+            out_ref[0, n] = (acc_ref[rsl, :] / l).astype(out_ref.dtype)
 
 
 def paged_prefill_attention(q: jax.Array, kv_layer: jax.Array,
@@ -180,7 +180,11 @@ def paged_prefill_attention(q: jax.Array, kv_layer: jax.Array,
     if scale is None:
         scale = 1.0 / (hd ** 0.5)
 
-    qg = q.reshape(S, tq, nkv, g, hd)
+    # [S, Tq, nh, hd] -> [S, nkv, Tq*g, hd]: per-kv-head rows, query-
+    # major / group-minor (matches the kernel's qpos = row // g)
+    qg = (q.reshape(S, tq, nkv, g, hd)
+          .transpose(0, 2, 1, 3, 4)
+          .reshape(S, nkv, tq * g, hd))
 
     def page(s, j, pos0, ctx, bt):
         last = jax.lax.max(ctx[s] - 1, 0) // bs
@@ -191,14 +195,14 @@ def paged_prefill_attention(q: jax.Array, kv_layer: jax.Array,
         num_scalar_prefetch=3,
         grid=(S, Bm),
         in_specs=[
-            pl.BlockSpec((1, tq, nkv, g, hd),
-                         lambda s, j, pos0, ctx, bt: (s, 0, 0, 0, 0)),
+            pl.BlockSpec((1, nkv, tq * g, hd),
+                         lambda s, j, pos0, ctx, bt: (s, 0, 0, 0)),
             pl.BlockSpec((1, bs, 2, nkv, hd),
                          lambda s, j, pos0, ctx, bt: (page(s, j, pos0, ctx,
                                                           bt), 0, 0, 0, 0)),
         ],
-        out_specs=pl.BlockSpec((1, tq, nkv, g, hd),
-                               lambda s, j, pos0, ctx, bt: (s, 0, 0, 0, 0)),
+        out_specs=pl.BlockSpec((1, nkv, tq * g, hd),
+                               lambda s, j, pos0, ctx, bt: (s, 0, 0, 0)),
         scratch_shapes=[
             pltpu.VMEM((nkv * tq * g, 128), jnp.float32),
             pltpu.VMEM((nkv * tq * g, 128), jnp.float32),
@@ -209,11 +213,13 @@ def paged_prefill_attention(q: jax.Array, kv_layer: jax.Array,
         functools.partial(_prefill_kernel, bs=bs, nkv=nkv, g=g, tq=tq,
                           scale=float(scale)),
         grid_spec=grid_spec,
-        out_shape=jax.ShapeDtypeStruct((S, tq, nkv, g, hd), q.dtype),
+        out_shape=jax.ShapeDtypeStruct((S, nkv, tq * g, hd), q.dtype),
         interpret=_interpret(),
     )(seg_pos0.astype(jnp.int32), context_lens.astype(jnp.int32),
       block_table.astype(jnp.int32), qg, kv_layer)
-    return out.reshape(S, tq, nh, hd)
+    return (out.reshape(S, nkv, tq, g, hd)
+            .transpose(0, 2, 1, 3, 4)
+            .reshape(S, tq, nh, hd))
 
 
 def paged_decode_attention(q: jax.Array, kv_layer: jax.Array,
