@@ -132,3 +132,24 @@ def test_gpt2_generation_matches(devices):
                           max_new_tokens=5, do_sample=False,
                           pad_token_id=0).numpy()[0, 3:]
     np.testing.assert_array_equal(ours, ref)
+
+
+def test_gpt2_serves_through_ragged_engine(devices):
+    from deepspeed_tpu.inference import InferenceEngineV2
+
+    hf = _tiny_gpt2().eval()
+    model, params = from_hf_pretrained(
+        hf, **{"dtype": jnp.float32, "param_dtype": jnp.float32,
+               "remat": False, "attn_impl": "xla"})
+    v2 = InferenceEngineV2(model, params=params, dtype=jnp.float32,
+                           kv_blocks=64, kv_block_size=8,
+                           max_tokens_per_step=32, max_seqs_per_step=4,
+                           max_blocks_per_seq=8)
+    prompt = np.array([3, 8, 2, 5], np.int32)
+    v2.put([1], [prompt], max_new_tokens=5)
+    got = v2.generate_all()[1]
+    with torch.no_grad():
+        ref = hf.generate(torch.tensor(prompt[None].astype(np.int64)),
+                          max_new_tokens=5, do_sample=False,
+                          pad_token_id=0).numpy()[0, 4:]
+    assert got == ref.tolist()
