@@ -98,7 +98,17 @@ class CheckpointIO:
         self.ckpt_engine.create(str(tag))
         self.ckpt_engine.save(os.path.join(ckpt_dir, STATE_DIR), self._state())
 
-        if getattr(e, "_offload", None) is not None:
+        if getattr(e, "_zenflow", None) is not None:
+            # ZenFlow owns the masters when active (the HostOffload
+            # instance's copies are stale — saving them would restore a
+            # rollback); snapshot the whole importance-split state
+            import numpy as np
+
+            dst = os.path.join(
+                ckpt_dir, f"zenflow_rank{jax.process_index()}.npy")
+            np.save(dst, np.asarray(e._zenflow.state_dict(),
+                                    dtype=object), allow_pickle=True)
+        elif getattr(e, "_offload", None) is not None:
             # host-resident optimizer shards: one npz per process
             # (reference: per-dp-rank zero checkpoint files engine.py:4003)
             import numpy as np
@@ -326,7 +336,21 @@ class CheckpointIO:
                     v=jax.tree.map(jnp.zeros_like, st.v),
                     error=jax.tree.map(jnp.zeros_like, st.error),
                     step=st.step)
-        if getattr(e, "_offload", None) is not None:
+        if getattr(e, "_zenflow", None) is not None:
+            import numpy as np
+
+            zf_path = os.path.join(
+                ckpt_dir, f"zenflow_rank{jax.process_index()}.npy")
+            if load_optimizer_states and os.path.exists(zf_path):
+                e._zenflow.load_state_dict(
+                    np.load(zf_path, allow_pickle=True).item())
+            else:
+                # rebuild importance-split state from the restored params
+                from deepspeed_tpu.runtime.zenflow import ZenFlowOptimizer
+
+                e._zenflow = ZenFlowOptimizer(e.params, e._zenflow.cfg,
+                                              lr=e._zenflow.lr)
+        elif getattr(e, "_offload", None) is not None:
             import numpy as np
 
             path = os.path.join(

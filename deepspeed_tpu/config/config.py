@@ -126,6 +126,27 @@ class OffloadOptimizerConfig(ConfigModel):
 
 @register_config_model
 @dataclass
+class ZenFlowBlockConfig(ConfigModel):
+    """Reference: ZenFlowConfig (runtime/zenflow/zenflow_config.py) —
+    importance-split offloaded optimization: top-k coordinates update on
+    device every step, the rest in an overlapped host pass."""
+
+    topk_ratio: float = 0.01
+    update_interval: int = 4
+    select_interval: int = 16
+    overlap_step: bool = True
+
+    def validate(self) -> None:
+        if not 0.0 < self.topk_ratio <= 1.0:
+            raise ValueError(
+                f"zenflow.topk_ratio must be in (0, 1], got "
+                f"{self.topk_ratio}")
+        if self.update_interval < 1 or self.select_interval < 1:
+            raise ValueError("zenflow intervals must be >= 1")
+
+
+@register_config_model
+@dataclass
 class ZeroConfig(ConfigModel):
     """Reference: DeepSpeedZeroConfig (runtime/zero/config.py:90).
 
@@ -147,6 +168,8 @@ class ZeroConfig(ConfigModel):
     round_robin_gradients: bool = False
     offload_param: Optional[OffloadParamConfig] = None
     offload_optimizer: Optional[OffloadOptimizerConfig] = None
+    # ZenFlow (stall-free offload): requires offload_optimizer.device=cpu
+    zenflow: Optional[ZenFlowBlockConfig] = None
     sub_group_size: int = 1_000_000_000
     # ZeRO++ (reference docs/_tutorials/zeropp.md): hierarchical partitioning
     # and quantized collectives.

@@ -216,6 +216,14 @@ class Engine:
         self._offload_device = (off_cfg.device if off_cfg is not None
                                 else "none") or "none"
         self._offload = None  # built in _build_state when enabled
+        self._zenflow = None  # built alongside _offload when configured
+        if config.zero_optimization.zenflow is not None and \
+                self._offload_device != "cpu":
+            raise ValueError(
+                "zero_optimization.zenflow requires "
+                "offload_optimizer.device='cpu' (ZenFlow keeps masters "
+                "host-resident; the NVMe swap tier does not apply), got "
+                f"device={self._offload_device!r}")
 
         # -- ZeRO++ quantized-collective step (runtime/zeropp.py) ---------
         self._zeropp = self._zeropp_applicable(config) and not self._onebit
@@ -447,6 +455,9 @@ class Engine:
                 lambda t: _constrain_tree(
                     jax.tree.map(lambda m: m.astype(cdt), t), param_sh),
                 donate_argnums=(0,))
+            # ZenFlow masters must come from the TRUE fp32 init (cast()
+            # below donates p32 and yields bf16-rounded leaves)
+            self._zenflow = self._maybe_build_zenflow(p32)
             self.params = cast(p32)
             if host_layers is not None:
                 self.params = dict(self.params)
@@ -723,6 +734,41 @@ class Engine:
         self._after_step(metrics)
         self.timers(STEP_GLOBAL_TIMER).stop()
 
+    def _maybe_build_zenflow(self, params_fp32):
+        """Config-driven ZenFlow (reference zenflow_stage_1_and_2.py:47
+        enablement via the zero_optimization.zenflow block): replaces the
+        blocking host step with top-k on-device updates + an overlapped
+        host pass. Single-process only (the importance split flattens
+        full leaves host-side); multi-host falls back with a warning."""
+        zf = self.config.zero_optimization.zenflow
+        if zf is None:
+            return None
+        if jax.process_count() > 1:
+            logger.warning("zenflow: multi-host not supported yet; "
+                           "falling back to the blocking offload step")
+            return None
+        if self.config.zero_optimization.offload_param is not None and \
+                self.config.zero_optimization.offload_param.device != "none":
+            logger.warning("zenflow does not compose with offload_param "
+                           "streaming; falling back to the blocking "
+                           "offload step")
+            return None
+        from deepspeed_tpu.runtime.zenflow import (ZenFlowConfig,
+                                                   ZenFlowOptimizer)
+
+        ocfg = self.config.optimizer
+        p = dict((ocfg.params or {}) if ocfg else {})
+        cfg = ZenFlowConfig(
+            topk_ratio=zf.topk_ratio, update_interval=zf.update_interval,
+            select_interval=zf.select_interval,
+            overlap_step=zf.overlap_step,
+            betas=tuple(p.get("betas", (0.9, 0.999))),
+            eps=p.get("eps", 1e-8),
+            weight_decay=p.get("weight_decay", 0.0))
+        return ZenFlowOptimizer(params_fp32, cfg,
+                                lr=p.get("lr", self._base_lr or 1e-3),
+                                param_dtype=self.compute_dtype)
+
     def _setup_param_host_offload(self) -> None:
         """ZeRO-Infinity param tier (reference offload_config.py:21
         offload_param + partitioned_param_swapper semantics): layer
@@ -800,9 +846,29 @@ class Engine:
               else float(self._base_lr or 0.0))
         fp16 = self.config.fp16.enabled
         scale = float(self.loss_scale_state.scale) if fp16 else None
-        new_tree, gnorm, overflow = self._offload.step(
-            grads, self.params, lr=lr, grad_scale=scale,
-            skip_on_nonfinite=fp16)
+        if self._zenflow is not None:
+            import optax
+
+            # one fused coefficient applies unscaling + clipping; gnorm
+            # stays a device scalar (no host sync) unless fp16 needs the
+            # overflow decision
+            gnorm = optax.global_norm(grads)
+            if scale and scale != 1.0:
+                gnorm = gnorm / scale
+            coef = jnp.asarray(1.0 / (scale or 1.0), jnp.float32)
+            clip = self.config.gradient_clipping
+            if clip and clip > 0:
+                coef = coef * jnp.minimum(1.0, clip / (gnorm + 1e-6))
+            if (clip and clip > 0) or (scale and scale != 1.0):
+                grads = jax.tree.map(lambda g: g * coef.astype(g.dtype),
+                                     grads)
+            overflow = bool(fp16 and not np.isfinite(float(gnorm)))
+            new_tree = (None if overflow
+                        else self._zenflow.step(grads, self.params, lr=lr))
+        else:
+            new_tree, gnorm, overflow = self._offload.step(
+                grads, self.params, lr=lr, grad_scale=scale,
+                skip_on_nonfinite=fp16)
         if not overflow:
             # reshard targets host memory kind for layers under
             # offload_param (out_shardings in _build_step_fns)

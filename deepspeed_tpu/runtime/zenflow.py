@@ -99,13 +99,17 @@ class ZenFlowOptimizer:
     """
 
     def __init__(self, params, config: Optional[ZenFlowConfig] = None,
-                 lr: float = 1e-3):
+                 lr: float = 1e-3, param_dtype=None):
+        """``params`` seeds the fp32 masters (pass the fp32 init so master
+        precision is real, not rounded); ``param_dtype`` overrides the
+        dtype of emitted params (the engine's compute dtype)."""
         self.cfg = config or ZenFlowConfig()
         self.lr = float(lr)
         self.steps = 0
         leaves, self._treedef = jax.tree.flatten(params)
         self._shapes = [x.shape for x in leaves]
-        self._dtypes = [x.dtype for x in leaves]
+        self._dtypes = [param_dtype if param_dtype is not None else x.dtype
+                        for x in leaves]
         self._sizes = [int(np.prod(s)) for s in self._shapes]
         self._ks = [max(1, int(np.ceil(self.cfg.topk_ratio * n)))
                     for n in self._sizes]
@@ -291,6 +295,7 @@ class ZenFlowOptimizer:
             "sel_step": list(self._sel_step),
             "protected": [None if p is None else np.asarray(p)
                           for p in self._protected],
+            "updated_since_foldin": list(self._updated_since_foldin),
         }
 
     def load_state_dict(self, sd: Dict[str, Any]):
@@ -306,3 +311,7 @@ class ZenFlowOptimizer:
         self._protected = [None if p is None else jnp.asarray(p)
                            for p in sd.get("protected",
                                            [None] * len(self._acc))]
+        # missing in old checkpoints: assume True (protect) — a spurious
+        # protection is harmless, a missed one reverts device updates
+        self._updated_since_foldin = [bool(b) for b in sd.get(
+            "updated_since_foldin", [True] * len(self._acc))]
