@@ -979,6 +979,67 @@ class Engine:
             return [float(self.lr_schedule(self.step_count))]
         return [self._base_lr or 0.0]
 
+    # ------------------------------------------------------------------
+    # state offload between phases (reference engine.offload_states
+    # engine.py:5573 / reload_states — frees HBM for e.g. RLHF
+    # generation with another model copy)
+    # ------------------------------------------------------------------
+    def offload_states(self, include=None, device: str = "cpu",
+                       pin_memory: bool = True, non_blocking: bool = False):
+        """Move engine-held device state to pinned host memory.
+
+        ``include`` limits the set: any of {"lp_params", "optim_states"}
+        (reference OffloadStateTypeEnum names accepted; grads have no
+        persistent buffer here — they live inside the compiled step).
+        """
+        if device != "cpu":
+            raise ValueError("offload_states supports device='cpu' only")
+        include = set(include or ("lp_params", "optim_states"))
+        known = {"lp_params", "hp_params", "optim_states", "lp_grads",
+                 "contiguous_grad_buffer"}
+        unknown = include - known
+        if unknown:
+            raise ValueError(f"unknown offload_states entries {unknown}")
+
+        def to_host(tree):
+            return jax.tree.map(
+                lambda a: jax.device_put(
+                    a, a.sharding.with_memory_kind("pinned_host"))
+                if isinstance(a, jax.Array)
+                and a.sharding.memory_kind != "pinned_host" else a, tree)
+
+        if include & {"lp_params", "hp_params"}:
+            self.params = to_host(self.params)
+        if "optim_states" in include and self.opt_state is not None:
+            self.opt_state = to_host(self.opt_state)
+        self._states_offloaded = True
+
+    def reload_states(self, non_blocking: bool = False):
+        """Inverse of offload_states: device placement restored."""
+        if not getattr(self, "_states_offloaded", False):
+            return
+
+        def to_device(tree):
+            return jax.tree.map(
+                lambda a: jax.device_put(
+                    a, a.sharding.with_memory_kind("device"))
+                if isinstance(a, jax.Array)
+                and a.sharding.memory_kind == "pinned_host" else a, tree)
+
+        if getattr(self, "_param_host_offload", False):
+            # layer params live on host by design; restore the rest only
+            layers = self.params.get("layers") if isinstance(
+                self.params, dict) else None
+            self.params = to_device(self.params)
+            if layers is not None:
+                self.params = dict(self.params)
+                self.params["layers"] = layers
+        else:
+            self.params = to_device(self.params)
+        if self.opt_state is not None:
+            self.opt_state = to_device(self.opt_state)
+        self._states_offloaded = False
+
     def get_global_grad_norm(self):
         return getattr(self, "_last_grad_norm", None)
 

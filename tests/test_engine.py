@@ -191,3 +191,41 @@ def test_fp16_loss_scaling_engages(devices):
     it = data_iter(engine.micro_batch_size * engine.dp_world_size)
     l = float(engine.train_batch(it))
     assert np.isfinite(l)
+
+
+def test_offload_reload_states(devices):
+    """reference engine.offload_states/reload_states (engine.py:5573):
+    params + optimizer state round-trip through pinned host memory and
+    training resumes identically."""
+    import deepspeed_tpu as dstpu
+    from deepspeed_tpu.models.zoo import get_model
+
+    engine, *_ = dstpu.initialize(
+        model=get_model("tiny", remat=False),
+        config={"train_micro_batch_size_per_chip": 2,
+                "optimizer": {"type": "adamw", "params": {"lr": 1e-2}},
+                "zero_optimization": {"stage": 1}})
+    rng = np.random.default_rng(0)
+    b = {"input_ids": rng.integers(
+        0, 256, (engine.micro_batch_size * engine.dp_world_size,
+                 17)).astype(np.int32)}
+    l0 = float(engine.train_batch(iter([b])))
+
+    engine.offload_states()
+    kinds = {l.sharding.memory_kind
+             for l in jax.tree.leaves(engine.params)}
+    assert kinds == {"pinned_host"}
+    okinds = {l.sharding.memory_kind
+              for l in jax.tree.leaves(engine.opt_state)
+              if isinstance(l, jax.Array)}
+    assert okinds == {"pinned_host"}
+
+    engine.reload_states()
+    kinds = {l.sharding.memory_kind
+             for l in jax.tree.leaves(engine.params)}
+    assert kinds == {"device"}
+    l1 = float(engine.train_batch(iter([b])))
+    assert np.isfinite(l1) and l1 < l0 + 1.0
+
+    with pytest.raises(ValueError, match="unknown offload_states"):
+        engine.offload_states(include=["bogus"])
