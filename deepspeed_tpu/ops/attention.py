@@ -34,11 +34,16 @@ def _flash_available() -> bool:
 
 def xla_attention(q, k, v, causal: bool = True,
                   segment_ids: Optional[jax.Array] = None) -> jax.Array:
-    """Reference attention. q,k,v: [B, S, N, D] (kv heads already repeated).
+    """Reference attention. q: [B, S, Nq, D]; k,v: [B, S, Nkv, D] with
+    Nq a multiple of Nkv (GQA repeats kv heads here).
 
     Softmax in fp32 regardless of input dtype (numerics parity with the
     reference's attn_softmax kernels, csrc/transformer/softmax_kernels.cu).
     """
+    if k.shape[2] != q.shape[2]:  # GQA: repeat kv heads for the einsum
+        rep = q.shape[2] // k.shape[2]
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
     dt = q.dtype
     d = q.shape[-1]
     scores = jnp.einsum("bqnd,bknd->bnqk", q, k).astype(jnp.float32)
@@ -89,21 +94,20 @@ def multi_head_attention(q, k, v, causal: bool = True, impl: str = "auto",
         from deepspeed_tpu.ops.pallas.blocksparse_attention import \
             blocksparse_attention
 
+        if k.shape[2] != q.shape[2]:  # blocksparse kernel is MHA-only
+            rep = q.shape[2] // k.shape[2]
+            k = jnp.repeat(k, rep, axis=2)
+            v = jnp.repeat(v, rep, axis=2)
         return blocksparse_attention(q, k, v, _SPARSE_CONFIG, causal=causal)
     want_flash = (
         impl == "flash"
-        or (impl == "auto" and _flash_available() and seq >= FLASH_MIN_SEQ
-            and causal and segment_ids is None)
+        or (impl == "auto" and _flash_available() and seq >= FLASH_MIN_SEQ)
     )
     if want_flash:
-        try:
-            from deepspeed_tpu.ops.pallas.flash_attention import flash_attention
+        from deepspeed_tpu.ops.pallas.flash_attention import flash_attention
 
-            block = min(512, seq)  # 512x512 measured best on v5e MXU
-            return flash_attention(q, k, v, causal=causal,
-                                   segment_ids=segment_ids,
-                                   block_q=block, block_k=block)
-        except NotImplementedError:
-            if impl == "flash":
-                raise
+        block = min(512, seq)  # 512x512 measured best on v5e MXU
+        return flash_attention(q, k, v, causal=causal,
+                               segment_ids=segment_ids,
+                               block_q=block, block_k=block)
     return xla_attention(q, k, v, causal=causal, segment_ids=segment_ids)

@@ -52,14 +52,81 @@ def test_padded_sequence():
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
 
 
-def test_noncausal_raises_and_dispatcher_falls_back():
+def test_noncausal_kernel_matches_xla():
     q, k, v = _qkv(S=128)
-    with pytest.raises(NotImplementedError):
-        flash_attention(q, k, v, causal=False)
-    # dispatcher silently falls back to XLA
-    out = multi_head_attention(q, k, v, causal=False, impl="auto")
+    out = flash_attention(q, k, v, causal=False, block_q=64, block_k=64)
     ref = xla_attention(q, k, v, causal=False)
-    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-6)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+    # dispatcher path agrees too
+    out = multi_head_attention(q, k, v, causal=False, impl="flash")
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+
+def test_noncausal_padded():
+    """Non-causal with padding: padded keys must not leak into softmax."""
+    q, k, v = _qkv(S=100)
+    out = flash_attention(q, k, v, causal=False, block_q=64, block_k=64)
+    ref = xla_attention(q, k, v, causal=False)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+
+@pytest.mark.parametrize("ratio", [1, 4, 8])
+def test_gqa_forward_backward(ratio):
+    """GQA-native kernel: KV at kv_heads, parity vs repeated-KV dense."""
+    B, S, Nq, D = 2, 128, 8, 32
+    Nkv = Nq // ratio
+    rng = jax.random.PRNGKey(1)
+    q = jax.random.normal(jax.random.fold_in(rng, 0), (B, S, Nq, D))
+    k = jax.random.normal(jax.random.fold_in(rng, 1), (B, S, Nkv, D))
+    v = jax.random.normal(jax.random.fold_in(rng, 2), (B, S, Nkv, D))
+    ref = xla_attention(q, k, v, causal=True)  # repeats kv internally
+    out = flash_attention(q, k, v, causal=True, block_q=64, block_k=64)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+    gr = jax.grad(lambda q, k, v: (xla_attention(q, k, v) ** 2).sum(),
+                  argnums=(0, 1, 2))(q, k, v)
+    gf = jax.grad(
+        lambda q, k, v: (flash_attention(q, k, v, causal=True, block_q=64,
+                                         block_k=64) ** 2).sum(),
+        argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gr, gf):
+        assert a.shape == b.shape
+        np.testing.assert_allclose(np.asarray(b), np.asarray(a), atol=5e-4)
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_segment_ids_packed(causal):
+    """Packed sequences stay on the kernel and mask cross-segment pairs."""
+    B, S = 2, 128
+    q, k, v = _qkv(B=B, S=S)
+    seg = jnp.concatenate([jnp.zeros((B, 48), jnp.int32),
+                           jnp.ones((B, 50), jnp.int32),
+                           jnp.full((B, 30), 2, jnp.int32)], axis=1)
+    ref = xla_attention(q, k, v, causal=causal, segment_ids=seg)
+    out = flash_attention(q, k, v, causal=causal, segment_ids=seg,
+                          block_q=64, block_k=64)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+    gr = jax.grad(lambda q: (xla_attention(
+        q, k, v, causal=causal, segment_ids=seg) ** 2).sum())(q)
+    gf = jax.grad(lambda q: (flash_attention(
+        q, k, v, causal=causal, segment_ids=seg,
+        block_q=64, block_k=64) ** 2).sum())(q)
+    np.testing.assert_allclose(np.asarray(gf), np.asarray(gr), atol=5e-4)
+
+
+def test_segment_ids_gqa_padded():
+    """Segments + GQA + non-block-multiple S all at once."""
+    B, S, Nq, Nkv, D = 1, 100, 4, 2, 32
+    rng = jax.random.PRNGKey(3)
+    q = jax.random.normal(jax.random.fold_in(rng, 0), (B, S, Nq, D))
+    k = jax.random.normal(jax.random.fold_in(rng, 1), (B, S, Nkv, D))
+    v = jax.random.normal(jax.random.fold_in(rng, 2), (B, S, Nkv, D))
+    seg = (jnp.arange(S)[None, :] >= 40).astype(jnp.int32)
+    ref = xla_attention(q, k, v, causal=True, segment_ids=seg)
+    out = flash_attention(q, k, v, causal=True, segment_ids=seg,
+                          block_q=64, block_k=64)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
 
 
 def test_dispatcher_impl_flash_used_in_model():
