@@ -3,14 +3,17 @@
 Prints ONE JSON line:
   {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
 
-The configuration follows BASELINE.json's first config (GPT-2 125M class,
-ZeRO-1 single chip). ``vs_baseline`` is measured tokens/sec/chip divided
-by the recorded baseline in BASELINE.json's ``published`` dict when
-present, else MFU-normalized 1.0x (no published number exists yet — first
-runs establish it).
+Default configuration is BASELINE.json's north-star class: Llama-3-8B
+layer geometry (h=4096, ffn=14336, 32q/8kv GQA, RoPE, swiglu, RMSNorm)
+under ZeRO-3 — depth cut to the 3 layers that fit one 16GB chip with
+full fp32 Adam state resident (see docs/roofline.md for the breakdown
+and the 8B projection). ``vs_baseline`` divides by the recorded number
+in BASELINE.json's ``published`` dict.
 
-Env knobs: BENCH_MODEL (zoo name), BENCH_SEQ, BENCH_MICRO, BENCH_STEPS,
-BENCH_PEAK_TFLOPS (defaults to the detected chip's bf16 peak).
+Env knobs: BENCH_MODEL (zoo name; "gpt2-125m" restores the round-1
+config), BENCH_SEQ, BENCH_MICRO, BENCH_STEPS, BENCH_LAYERS, BENCH_VOCAB,
+BENCH_ZERO_STAGE, BENCH_REMAT_POLICY, BENCH_PEAK_TFLOPS (defaults to the
+detected chip's bf16 peak).
 """
 
 from __future__ import annotations
@@ -51,12 +54,17 @@ def main():
     n_chips = len(jax.devices())
     on_tpu = jax.default_backend() == "tpu"
 
-    model_name = os.environ.get("BENCH_MODEL", "gpt2-125m")
-    seq = int(os.environ.get("BENCH_SEQ", 1024 if on_tpu else 128))
-    # 224 measured best on v5e-1 with the Pallas flash kernel (block 512):
-    # no [S,S] score transient, so batches 2.3x the old xla-attn limit fit;
-    # 256 OOMs. 74.9k tok/s vs 55.2k at the old micro=96 xla-attn default.
-    micro = int(os.environ.get("BENCH_MICRO", 224 if on_tpu else 1))
+    model_name = os.environ.get("BENCH_MODEL", "llama3-8b")
+    llama_headline = model_name == "llama3-8b"
+    seq = int(os.environ.get("BENCH_SEQ", 2048 if llama_headline else 1024))
+    if not on_tpu:
+        seq = int(os.environ.get("BENCH_SEQ", 128))
+    # Measured on v5e-1 (see docs/roofline.md):
+    #  - llama3-8b geometry: 3 layers + fp32 Adam state fill 16GB HBM;
+    #    micro=8 with attn-out saved remat → 19.2k tok/s, MFU 0.450.
+    #  - gpt2-125m: micro=224 with flash block-512 → ~75k tok/s, MFU 0.33.
+    micro_default = 8 if llama_headline else 224
+    micro = int(os.environ.get("BENCH_MICRO", micro_default if on_tpu else 1))
     steps = int(os.environ.get("BENCH_STEPS", 10 if on_tpu else 3))
     warmup = 3 if on_tpu else 1
 
@@ -66,22 +74,36 @@ def main():
     remat = bool(int(os.environ.get("BENCH_REMAT", "1")))
     tiled = int(os.environ.get("BENCH_TILED_LOGITS", "8"))
     attn = os.environ.get("BENCH_ATTN", "auto")
-    # full remat (save only the residual stream) measures fastest here:
-    # saved matmul outputs at micro=64 would cost ~10GB HBM
-    policy = os.environ.get("BENCH_REMAT_POLICY", "nothing_saveable")
+    # gpt2: full remat (save only the residual stream) measures fastest —
+    # saved matmul outputs at micro=224 would cost ~10GB HBM.
+    # llama geometry: saving the attention output block is free at micro=8
+    # and skips the flash-kernel recompute in the backward.
+    policy = os.environ.get(
+        "BENCH_REMAT_POLICY",
+        "save_attn_out" if llama_headline else "nothing_saveable")
     overrides = dict(max_seq_len=seq, remat=remat, tiled_logits=tiled,
                      attn_impl=attn, remat_policy=policy)
+    if llama_headline:
+        # depth that fits one 16GB chip with full fp32 Adam resident;
+        # vocab cut so layer matmuls dominate FLOPs like the 32L model
+        overrides["num_layers"] = int(os.environ.get("BENCH_LAYERS", 3))
+        overrides["vocab_size"] = int(os.environ.get("BENCH_VOCAB", 8192))
     if not on_tpu:  # CPU smoke: shrink the model
         overrides.update(num_layers=2, hidden_size=256, num_heads=8,
                          vocab_size=2048)
+        if llama_headline:
+            overrides.update(num_kv_heads=4, ffn_size=512)
     model = get_model(model_name, **overrides)
 
+    zero_stage_default = 3 if llama_headline else (1 if n_chips > 1 else 0)
     config = {
         "train_micro_batch_size_per_chip": micro,
         "gradient_accumulation_steps": 1,
         "optimizer": {"type": "adamw",
                       "params": {"lr": 1e-4, "weight_decay": 0.1}},
-        "zero_optimization": {"stage": 1 if n_chips > 1 else 0},
+        "zero_optimization": {
+            "stage": int(os.environ.get("BENCH_ZERO_STAGE",
+                                        zero_stage_default))},
         "bf16": {"enabled": True},
         "steps_per_print": 1_000_000,
     }
@@ -93,7 +115,9 @@ def main():
             "stage": 2 if n_chips == 1 else 1,
             "offload_optimizer": {"device": "cpu"},
         }
-    topology = {"dp": 1, "fsdp": -1} if n_chips > 1 else None
+    zero_stage = config["zero_optimization"]["stage"]
+    topology = ({"dp": 1, "fsdp": -1} if (n_chips > 1 or zero_stage == 3)
+                else None)
     engine, _, _, _ = dstpu.initialize(model=model, config=config,
                                        topology=topology)
 
@@ -129,11 +153,15 @@ def main():
             baseline = json.load(f).get("published", {}) or {}
     except Exception:
         pass
-    base_tps = baseline.get("gpt2_125m_tokens_per_sec_per_chip")
+    base_key = ("llama3_8b_geom_tokens_per_sec_per_chip" if llama_headline
+                else "gpt2_125m_tokens_per_sec_per_chip")
+    base_tps = baseline.get(base_key)
     vs_baseline = (tok_per_sec_chip / base_tps) if base_tps else 1.0
 
+    desc = (f"{model_name}-geometry({model.config.num_layers}L)"
+            if llama_headline else model_name)
     print(json.dumps({
-        "metric": f"{model_name} zero1 train tokens/sec/chip "
+        "metric": f"{desc} zero{zero_stage} train tokens/sec/chip "
                   f"(seq={seq}, micro={micro}, {'tpu' if on_tpu else 'cpu-sim'})",
         "value": round(tok_per_sec_chip, 1),
         "unit": "tokens/s/chip",

@@ -125,10 +125,11 @@ def _moe_layer(cfg: MoETransformerConfig, x, layer_params, positions,
     if cfg.pos_emb == "rope":
         q = tfm._rope(q, positions, cfg.rope_theta)
         k = tfm._rope(k, positions, cfg.rope_theta)
-    if cfg.kv_heads < cfg.num_heads:
-        rep = cfg.num_heads // cfg.kv_heads
-        k = jnp.repeat(k, rep, axis=2)
-        v = jnp.repeat(v, rep, axis=2)
+    if cfg.sequence_parallel or cfg.attn_chunks > 1:
+        # head-split SP paths need equal q/kv head counts; the plain
+        # path keeps KV grouped for the GQA-native flash kernel
+        from deepspeed_tpu.ops.attention import repeat_kv_heads
+        k, v = repeat_kv_heads(q, k, v)
     attn = tfm._attention(q, k, v, cfg)
     attn = jnp.einsum("bsnd,ndh->bsh", attn, ap["wo"].astype(dt))
     x = x + constrain_activation(attn, ("batch", "seq", "embed"))

@@ -325,14 +325,12 @@ def _layer(cfg: TransformerConfig, x, layer_params, positions):
     q = constrain_activation(q, ("batch", "seq", "heads", None))
     k = constrain_activation(k, ("batch", "seq", "heads", None))
     v = constrain_activation(v, ("batch", "seq", "heads", None))
-    if cfg.kv_heads < cfg.num_heads and (
-            cfg.sequence_parallel or cfg.attn_chunks > 1):
+    if cfg.sequence_parallel or cfg.attn_chunks > 1:
         # GQA: the SP all-to-all / chunked paths split on the head axis
         # and need equal q/kv head counts; the plain path keeps KV at
         # kv_heads — the flash kernel reads grouped KV natively.
-        rep = cfg.num_heads // cfg.kv_heads
-        k = jnp.repeat(k, rep, axis=2)
-        v = jnp.repeat(v, rep, axis=2)
+        from deepspeed_tpu.ops.attention import repeat_kv_heads
+        k, v = repeat_kv_heads(q, k, v)
     attn = checkpoint_name(_attention(q, k, v, cfg), "attn_kernel_out")
     attn = jnp.einsum("bsnd,ndh->bsh", attn, ap["wo"].astype(dt))
     if cfg.use_biases:

@@ -32,6 +32,20 @@ def _flash_available() -> bool:
         return False
 
 
+def repeat_kv_heads(q, k, v):
+    """Repeat KV heads up to q's head count, for attention impls that
+    need equal counts (XLA einsum, blocksparse, head-split SP paths).
+
+    Contiguous repeat (q head h ← kv head h // group) — must match the
+    flash kernel's ``_kv_row`` index map (ops/pallas/flash_attention.py).
+    """
+    if k.shape[2] != q.shape[2]:
+        rep = q.shape[2] // k.shape[2]
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
+    return k, v
+
+
 def xla_attention(q, k, v, causal: bool = True,
                   segment_ids: Optional[jax.Array] = None) -> jax.Array:
     """Reference attention. q: [B, S, Nq, D]; k,v: [B, S, Nkv, D] with
@@ -40,10 +54,7 @@ def xla_attention(q, k, v, causal: bool = True,
     Softmax in fp32 regardless of input dtype (numerics parity with the
     reference's attn_softmax kernels, csrc/transformer/softmax_kernels.cu).
     """
-    if k.shape[2] != q.shape[2]:  # GQA: repeat kv heads for the einsum
-        rep = q.shape[2] // k.shape[2]
-        k = jnp.repeat(k, rep, axis=2)
-        v = jnp.repeat(v, rep, axis=2)
+    k, v = repeat_kv_heads(q, k, v)
     dt = q.dtype
     d = q.shape[-1]
     scores = jnp.einsum("bqnd,bknd->bnqk", q, k).astype(jnp.float32)
@@ -94,10 +105,7 @@ def multi_head_attention(q, k, v, causal: bool = True, impl: str = "auto",
         from deepspeed_tpu.ops.pallas.blocksparse_attention import \
             blocksparse_attention
 
-        if k.shape[2] != q.shape[2]:  # blocksparse kernel is MHA-only
-            rep = q.shape[2] // k.shape[2]
-            k = jnp.repeat(k, rep, axis=2)
-            v = jnp.repeat(v, rep, axis=2)
+        k, v = repeat_kv_heads(q, k, v)  # blocksparse kernel is MHA-only
         return blocksparse_attention(q, k, v, _SPARSE_CONFIG, causal=causal)
     want_flash = (
         impl == "flash"

@@ -68,7 +68,8 @@ def chunked_attention(q, k, v, causal: bool = True, q_chunks: int = 4,
                       kv_tile: Optional[int] = None):
     """Exact attention with O(chunk × kv_tile) score memory.
 
-    q,k,v: [B, S, N, D] (kv heads pre-repeated, same contract as
+    q,k,v: [B, S, N, D] (equal q/kv head counts — the head-split chunking
+    needs them; callers repeat GQA KV first. Same contract as
     ops/attention.py multi_head_attention). ``q_chunks``: number of query
     chunks scanned sequentially, each rematted. ``kv_tile``: KV tile
     length (default S/q_chunks rounded up).
