@@ -1,0 +1,29 @@
+/* LD_PRELOAD shim for single/low-core hosts running the multi-device
+ * CPU simulator (XLA_FLAGS=--xla_force_host_platform_device_count=N).
+ *
+ * XLA's CPU client sizes its intra-op thread pool as
+ * max(schedulable_cpus, device_count). On a 1-core host that is exactly
+ * N workers for N virtual devices; when independent collectives race
+ * across devices (each device's one in-flight worker blocks in a
+ * rendezvous), there is no spare worker to execute the partner
+ * collective and the rendezvous aborts after its timeout ("Expected N
+ * threads to join ... only k arrived"). Reporting extra CPUs here gives
+ * the pool headroom: blocked rendezvous threads park while fresh
+ * workers run the other collective. Blocked threads cost no CPU; this
+ * only changes pool sizing, not scheduling semantics.
+ *
+ * Build: cc -shared -fPIC -o affinity_shim.so affinity_shim.c
+ * Used by: deepspeed_tpu/utils/hostsim.py (test workers, dryrun worker).
+ */
+#define _GNU_SOURCE
+#include <sched.h>
+
+#define SHIM_CPUS 32
+
+int sched_getaffinity(pid_t pid, size_t cpusetsize, cpu_set_t *mask) {
+    (void)pid;
+    CPU_ZERO_S(cpusetsize, mask);
+    for (int i = 0; i < SHIM_CPUS && i < 8 * (int)cpusetsize; i++)
+        CPU_SET_S(i, cpusetsize, mask);
+    return 0;
+}

@@ -297,10 +297,43 @@ def _attention(q, k, v, cfg: TransformerConfig, causal: bool = True):
     return multi_head_attention(q, k, v, causal=causal, impl=cfg.attn_impl)
 
 
+def _qwz_fetch_tree(cfg: TransformerConfig, layer_params):
+    """ZeRO++ stage-3 qwZ: route each layer weight through the int8 fsdp
+    all-gather (runtime/sharding.py quantized_param_fetch; no-op unless
+    the engine armed it via configure_qwz). Reference: quantized
+    parameter all-gather in the stage-3 fetch path
+    (partition_parameters.py:1446)."""
+    from deepspeed_tpu.runtime.sharding import (quantized_param_fetch,
+                                                qwz_active,
+                                                qwz_sequence_barrier)
+
+    if not qwz_active():
+        return layer_params
+    axes = logical_axes(cfg)["layers"]
+    token = [None]  # chains fetches on the CPU sim (barrier is a TPU no-op)
+
+    def fetch(p, a, path):
+        if token[0] is not None:
+            p, _ = qwz_sequence_barrier(p, token[0])
+        out = quantized_param_fetch(p, a[1:], path=path)  # drop "layers"
+        if out is not p:
+            token[0] = out
+        return out
+
+    def walk(p, a, path):
+        if isinstance(a, tuple):
+            return fetch(p, a, path)
+        return {k: (walk(p[k], a[k], f"{path}/{k}")
+                    if isinstance(p, dict) and k in a else p[k]) for k in p}
+
+    return walk(layer_params, axes, "layers")
+
+
 def _layer(cfg: TransformerConfig, x, layer_params, positions):
     """One transformer block. x: [B, S, H] in cfg.dtype."""
     from deepspeed_tpu.runtime.sharding import effective_dtype
 
+    layer_params = _qwz_fetch_tree(cfg, layer_params)
     ap, mp = layer_params["attn"], layer_params["mlp"]
     dt = effective_dtype(cfg.dtype)
     x = x.astype(dt)
@@ -452,7 +485,13 @@ def apply(cfg: TransformerConfig, params: Dict[str, Any], tokens: jax.Array,
     if cfg.tie_embeddings:
         logits = jnp.einsum("bsh,vh->bsv", x, params["embed"]["tokens"].astype(dt))
     else:
-        logits = jnp.einsum("bsh,hv->bsv", x, params["unembed"]["kernel"].astype(dt))
+        from deepspeed_tpu.runtime.sharding import (quantized_param_fetch,
+                                                    qwz_sequence_barrier)
+
+        unembed, x = qwz_sequence_barrier(params["unembed"]["kernel"], x)
+        unembed = quantized_param_fetch(unembed, ("embed", "vocab"),
+                                        path="unembed/kernel")
+        logits = jnp.einsum("bsh,hv->bsv", x, unembed.astype(dt))
     logits = constrain_activation(logits, ("batch", "seq", "vocab"))
     return logits.astype(jnp.float32)
 
@@ -477,10 +516,19 @@ def loss_fn(cfg: TransformerConfig, params, batch) -> Tuple[jax.Array, Dict]:
 
         hidden = apply_hidden(cfg, params, inputs)
         if cfg.tie_embeddings:
+            # the table also feeds the token lookup; its gather stays
+            # exact (quantizing it would noise embeddings, not just wire)
             unembed = params["embed"]["tokens"].astype(cfg.dtype)
             transpose = True
         else:
-            unembed = params["unembed"]["kernel"].astype(cfg.dtype)
+            from deepspeed_tpu.runtime.sharding import (
+                quantized_param_fetch, qwz_sequence_barrier)
+
+            unembed, hidden = qwz_sequence_barrier(
+                params["unembed"]["kernel"], hidden)
+            unembed = quantized_param_fetch(
+                unembed, ("embed", "vocab"), path="unembed/kernel")
+            unembed = unembed.astype(cfg.dtype)
             transpose = False
         nll_sum, total = tiled_logits_loss(
             hidden, unembed, labels, mask, cfg.tiled_logits,

@@ -229,15 +229,30 @@ class Engine:
         self._zeropp = self._zeropp_applicable(config) and not self._onebit
         self._zeropp_state = None
         zq = config.zero_optimization
+        # stage-3 qwZ: int8 parameter all-gather in the GSPMD fetch path
+        # (reference partition_parameters.py:1446). Composes with tp/sp/
+        # hpZ/MiCS since it is just a constraint pair around the gather;
+        # armed per-engine via the sharding module switch.
+        self._qwz_stage3 = (zq.stage == 3 and zq.zero_quantized_weights
+                            and not config.moe.enabled)
+        if self._qwz_stage3:
+            log_dist("ZeRO++ qwZ: stage-3 int8 quantized parameter "
+                     "all-gather enabled (fsdp axis)", ranks=[0])
+            if zq.zero_quantized_gradients:
+                logger.warning(
+                    "ZeRO++ qgZ (zero_quantized_gradients) is not wired "
+                    "at stage 3 — gradients reduce at full width; only "
+                    "the qwZ parameter all-gather is quantized")
         if (zq.zero_quantized_weights or zq.zero_quantized_gradients) \
-                and not self._zeropp:
+                and not self._zeropp and not self._qwz_stage3:
             logger.warning(
-                "ZeRO++ flags (qwZ/qgZ) are only wired for: ZeRO stage "
-                "1-2, adam/adamw (no client optimizer), bf16, no "
-                "optimizer offload, no MoE, no tp/sp/pp axes, no "
-                "hpZ/MiCS grouping, no 1-bit optimizer — this config "
-                "fails one of those, so the quantized-collective step "
-                "is disabled and the standard path runs")
+                "ZeRO++ flags (qwZ/qgZ) are wired for: stage 1-2 with "
+                "adam/adamw (no client optimizer), bf16, no optimizer "
+                "offload, no MoE, no tp/sp/pp axes, no hpZ/MiCS "
+                "grouping, no 1-bit optimizer; or stage-3 "
+                "zero_quantized_weights (dense models). This config "
+                "fails those, so the quantized path is disabled and "
+                "the standard step runs")
 
         # -- state init (sharded; zero.Init analog is in abstract init) ---
         self._rng = jax.random.PRNGKey(seed if seed is not None else config.seed)
@@ -495,8 +510,16 @@ class Engine:
         fp16 = cfg.fp16.enabled
         grad_clip = cfg.gradient_clipping
 
+        # trace-scoped qwZ arming: only THIS engine's traces see the
+        # quantized fetch (a second engine in the process must not flip it)
+        qwz_bits = 8 if self._qwz_stage3 else None
+
+        def model_loss(params, batch):
+            with shard_lib.qwz_context(qwz_bits):
+                return self.model.loss(params, batch)
+
         def loss_of(params, batch, scale):
-            loss, aux = self.model.loss(params, batch)
+            loss, aux = model_loss(params, batch)
             return loss * scale, (loss, aux)
 
         def fwd_bwd(params, batch, scale):
@@ -561,7 +584,7 @@ class Engine:
 
             def total_loss(params):
                 def body(carry, mb):
-                    loss, aux = self.model.loss(params, mb)
+                    loss, aux = model_loss(params, mb)
                     return carry + loss * scale / gas, loss
 
                 total, losses = lax.scan(body, jnp.asarray(0.0, jnp.float32),
@@ -607,7 +630,7 @@ class Engine:
             lambda t: t, out_shardings=opt_sh)
         self._jit_fwd_bwd = jax.jit(fwd_bwd)
         self._jit_apply = jax.jit(apply_update, donate_argnums=(0, 1, 2, 3, 4))
-        self._jit_eval = jax.jit(lambda params, batch: self.model.loss(params, batch))
+        self._jit_eval = jax.jit(model_loss)
         self._jit_accumulate = jax.jit(
             lambda acc, g, c: jax.tree.map(lambda a, b: a + b * c, acc, g),
             donate_argnums=(0,))

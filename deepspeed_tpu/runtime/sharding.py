@@ -298,6 +298,174 @@ def effective_dtype(requested):
     return jnp.float32 if _FORCE_F32 else requested
 
 
+# ---------------------------------------------------------------------------
+# ZeRO++ qwZ for stage 3: quantized parameter all-gather
+# ---------------------------------------------------------------------------
+# Reference: the stage-3 fetch path gathers INT8-quantized parameters
+# (partition_parameters.py:1446 ``all_gather_coalesced`` with
+# quantization, kernels csrc/quantization/swizzled_quantize.cu),
+# halving all-gather wire volume vs fp16/bf16.
+#
+# GSPMD expression: inside the train step, each fsdp-sharded weight is
+# blockwise int8-quantized *on its shard* (local op), the int8 payload +
+# scales are forced through the fsdp gather by a pair of sharding
+# constraints (sharded → fsdp-stripped), and dequantized after. XLA's
+# latency-hiding scheduler still prefetches per layer inside the scan,
+# and with hpZ meshes the gather stays intra-fsdp-group by construction.
+# Backward is straight-through: grads flow as if the bf16 weight had
+# been used directly (matching the reference, which quantizes only the
+# gather wire, not the backward).
+
+_QWZ_BITS: Optional[int] = None
+QWZ_BLOCK = 128
+
+
+def configure_qwz(bits: Optional[int]) -> None:
+    """Arm/disarm the quantized stage-3 fetch for model code traced
+    while armed. Engines arm it only around their own traces (via
+    qwz_context) so two engines in one process can't contaminate each
+    other's programs."""
+    global _QWZ_BITS
+    if bits is not None and bits != 8:
+        raise ValueError(f"qwZ stage-3 fetch supports int8 only, got {bits}")
+    _QWZ_BITS = bits
+
+
+class qwz_context:
+    """Trace-scoped qwZ arming: ``with qwz_context(8): model.loss(...)``."""
+
+    def __init__(self, bits: Optional[int]):
+        self._bits = bits
+
+    def __enter__(self):
+        global _QWZ_BITS
+        self._prev = _QWZ_BITS
+        configure_qwz(self._bits)
+
+    def __exit__(self, *a):
+        global _QWZ_BITS
+        _QWZ_BITS = self._prev
+        return False
+
+
+def qwz_active() -> bool:
+    return _QWZ_BITS is not None
+
+
+def _has_fsdp(entry) -> bool:
+    return entry == "fsdp" or (isinstance(entry, tuple) and "fsdp" in entry)
+
+
+def _strip_fsdp(entries):
+    out = []
+    for e in entries:
+        if e is None or e == "fsdp":
+            out.append(None)
+        elif isinstance(e, tuple):
+            kept = tuple(a for a in e if a != "fsdp")
+            out.append(kept[0] if len(kept) == 1 else (kept or None))
+        else:
+            out.append(e)
+    return out
+
+
+def _straight_through(fn):
+    f = jax.custom_vjp(fn)
+    f.defvjp(lambda p: (fn(p), None), lambda _, ct: (ct,))
+    return f
+
+
+def quantized_param_fetch(x, logical_axes: Sequence[Optional[str]],
+                          path: str = ""):
+    """qwZ stage-3 fetch of one weight: int8 all-gather over fsdp.
+
+    No-op unless a qwz_context is armed, a multi-device mesh with
+    fsdp > 1 is active, and the weight actually shards over fsdp with at
+    least one non-fsdp dim to carry the quantization blocks (1-D norm
+    scales/biases stay on the exact bf16 gather — negligible bytes).
+    ``path`` lets z3-leaf-marked params (kept replicated by the plan)
+    opt out — they have no fsdp gather to quantize.
+    """
+    import math
+
+    from jax import numpy as jnp
+
+    from deepspeed_tpu.parallel import topology
+
+    mesh = topology._GLOBAL_MESH
+    if (_QWZ_BITS is None or _CONSTRAINTS_DISABLED or mesh is None
+            or mesh.shape.get("fsdp", 1) <= 1):
+        return x
+    rules = TP_RULES + EP_RULES + PP_RULES + FSDP_RULES  # stage-3 params
+    spec = z3_leaf_spec(path, spec_from_logical(logical_axes, rules))
+    entries = list(spec) + [None] * (len(x.shape) - len(spec))
+    if not any(_has_fsdp(e) for e in entries):
+        return x  # not fsdp-partitioned: nothing to win
+    candidates = [i for i, e in enumerate(entries) if not _has_fsdp(e)]
+    if not candidates:
+        return x
+    unsharded = [i for i in candidates if entries[i] is None]
+    ax = unsharded[-1] if unsharded else candidates[-1]
+    n = x.shape[ax]
+    # blocks must tile evenly within the chosen dim's own sharding
+    div = 1
+    if entries[ax] is not None:
+        axes_ = (entries[ax],) if isinstance(entries[ax], str) \
+            else tuple(entries[ax])
+        for a in axes_:
+            div *= mesh.shape.get(a, 1)
+    if n % max(div, 1) != 0:
+        return x
+    block = math.gcd(n // max(div, 1), QWZ_BLOCK)
+    if block <= 1:
+        return x
+
+    spec_blocked = PartitionSpec(
+        *(entries[:ax] + [entries[ax], None] + entries[ax + 1:]))
+    spec_gathered = PartitionSpec(
+        *_strip_fsdp(entries[:ax] + [entries[ax], None] + entries[ax + 1:]))
+    sh_blocked = NamedSharding(mesh, spec_blocked)
+    sh_gathered = NamedSharding(mesh, spec_gathered)
+    shape = x.shape
+    blocked_shape = shape[:ax] + (n // block, block) + shape[ax + 1:]
+
+    def qdq(p):
+        f = p.reshape(blocked_shape).astype(jnp.float32)
+        s = jnp.max(jnp.abs(f), axis=ax + 1, keepdims=True) / 127.0
+        s = jnp.where(s == 0.0, 1.0, s)
+        # scales: compute on the shard, gather (tiny fp32), then re-slice
+        # the local part for the quantize step. The re-slice makes the
+        # int8 gather data-depend on the scales gather, serializing the
+        # pair — XLA CPU's in-process communicator deadlocks on too many
+        # concurrent all-gathers, and one-outstanding-per-weight is also
+        # the right schedule on TPU (scales ride along, payload follows).
+        s = jax.lax.with_sharding_constraint(s, sh_blocked)
+        s_g = jax.lax.with_sharding_constraint(s, sh_gathered)
+        s_local = jax.lax.with_sharding_constraint(s_g, sh_blocked)
+        q = jnp.round(f / s_local).astype(jnp.int8)
+        # quantize on the shard, gather the int8 payload over fsdp
+        q = jax.lax.with_sharding_constraint(q, sh_blocked)
+        q = jax.lax.with_sharding_constraint(q, sh_gathered)
+        return (q.astype(jnp.float32) * s_g).reshape(shape).astype(p.dtype)
+
+    return _straight_through(qdq)(x)
+
+
+def qwz_sequence_barrier(weight, value):
+    """Schedule a qwZ fetch of ``weight`` after ``value`` is computed.
+
+    Identity for both operands. On the single-process CPU simulator the
+    in-process communicator deadlocks when too many all-gathers block
+    concurrently (8 virtual devices share one core's thread pool), so
+    independent fetches are chained behind the computation that precedes
+    them. On TPU the barrier is skipped — overlapping the gather with
+    upstream compute is exactly what the latency-hiding scheduler should
+    do."""
+    if _QWZ_BITS is None or jax.default_backend() == "tpu":
+        return weight, value
+    return jax.lax.optimization_barrier((weight, value))
+
+
 def constrain_activation(x, logical_axes: Sequence[Optional[str]]):
     """Apply the activation sharding rules to an intermediate value.
 

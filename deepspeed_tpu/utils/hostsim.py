@@ -1,0 +1,74 @@
+"""Helpers for the multi-device CPU simulator on small hosts.
+
+The 8-virtual-device CPU mesh (tests, dryrun) deadlocks on low-core
+hosts when independent collectives race: XLA's CPU thread pool is sized
+max(cores, devices), so every worker can end up blocked in a collective
+rendezvous with no spare worker to run the partner collective (observed
+as "Expected 8 threads to join the rendezvous, but only 4 arrived",
+then abort). csrc/hostsim/affinity_shim.c widens the reported CPU
+affinity so the pool gets headroom; this module compiles it on demand
+and injects LD_PRELOAD into a subprocess env.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import tempfile
+from typing import Dict, Optional
+
+_SHIM_SRC = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))), "csrc", "hostsim",
+    "affinity_shim.c")
+
+
+def build_affinity_shim() -> Optional[str]:
+    """Compile (once) and return the shim path, or None when impossible.
+
+    Per-uid target path (no cross-user /tmp planting) and an atomic
+    rename from a private temp file (concurrent builders race safely —
+    last rename wins with identical content, and no reader ever sees a
+    half-written .so)."""
+    uid = os.getuid() if hasattr(os, "getuid") else 0
+    out = os.path.join(tempfile.gettempdir(),
+                       f"dstpu_affinity_shim_{uid}.so")
+    if os.path.exists(out):
+        return out
+    if not os.path.exists(_SHIM_SRC):
+        return None
+    for cc in ("cc", "gcc", "clang"):
+        fd, tmp = tempfile.mkstemp(suffix=".so",
+                                   dir=tempfile.gettempdir())
+        os.close(fd)
+        try:
+            r = subprocess.run([cc, "-shared", "-fPIC", "-O2", "-o", tmp,
+                                _SHIM_SRC], capture_output=True, timeout=60)
+            if r.returncode == 0:
+                os.replace(tmp, out)
+                return out
+        except (OSError, subprocess.TimeoutExpired):
+            pass
+        finally:
+            if os.path.exists(tmp):
+                os.unlink(tmp)
+    return None
+
+
+def cpu_sim_env(env: Optional[Dict[str, str]] = None,
+                n_devices: int = 8) -> Dict[str, str]:
+    """Subprocess env for an ``n_devices`` CPU-sim worker: thread-pool
+    headroom via the affinity shim when the host has fewer cores than
+    virtual devices (no-op on big hosts)."""
+    env = dict(env if env is not None else os.environ)
+    try:
+        cores = len(os.sched_getaffinity(0))
+    except AttributeError:  # non-Linux
+        cores = os.cpu_count() or 1
+    if cores >= 2 * n_devices:
+        return env
+    shim = build_affinity_shim()
+    if shim:
+        pre = env.get("LD_PRELOAD", "")
+        if shim not in pre:
+            env["LD_PRELOAD"] = f"{shim}:{pre}" if pre else shim
+    return env
