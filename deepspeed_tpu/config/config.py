@@ -246,6 +246,14 @@ class PipelineConfig(ConfigModel):
     stages: int = 1
     partition_method: str = "uniform"  # uniform | parameters
     activation_checkpoint_interval: int = 0
+    # microbatches per pipeline pass (default 2*stages; more amortizes
+    # the bubble) and the 1F1B-depth window: microbatches are run in
+    # waves of `window` (default 2*stages) with per-wave remat, so live
+    # stage-boundary activations stay O(window) no matter how large
+    # `microbatches` grows (the role of TrainSchedule's bounded
+    # in-flight depth, reference pipe/schedule.py:189)
+    microbatches: int = 0  # 0 = auto
+    window: int = 0  # 0 = auto (2*stages)
 
 
 @register_config_model

@@ -323,10 +323,12 @@ def _qwz_fetch_tree(cfg: TransformerConfig, layer_params):
     def walk(p, a, path):
         if isinstance(a, tuple):
             return fetch(p, a, path)
-        return {k: (walk(p[k], a[k], f"{path}/{k}")
+        # keystr-format paths ("['layers']['attn']['wq']") so z3-leaf
+        # patterns match the same strings param_shardings sees
+        return {k: (walk(p[k], a[k], f"{path}['{k}']")
                     if isinstance(p, dict) and k in a else p[k]) for k in p}
 
-    return walk(layer_params, axes, "layers")
+    return walk(layer_params, axes, "['layers']")
 
 
 def _layer(cfg: TransformerConfig, x, layer_params, positions):
@@ -490,7 +492,7 @@ def apply(cfg: TransformerConfig, params: Dict[str, Any], tokens: jax.Array,
 
         unembed, x = qwz_sequence_barrier(params["unembed"]["kernel"], x)
         unembed = quantized_param_fetch(unembed, ("embed", "vocab"),
-                                        path="unembed/kernel")
+                                        path="['unembed']['kernel']")
         logits = jnp.einsum("bsh,hv->bsv", x, unembed.astype(dt))
     logits = constrain_activation(logits, ("batch", "seq", "vocab"))
     return logits.astype(jnp.float32)
@@ -527,7 +529,7 @@ def loss_fn(cfg: TransformerConfig, params, batch) -> Tuple[jax.Array, Dict]:
             unembed, hidden = qwz_sequence_barrier(
                 params["unembed"]["kernel"], hidden)
             unembed = quantized_param_fetch(
-                unembed, ("embed", "vocab"), path="unembed/kernel")
+                unembed, ("embed", "vocab"), path="['unembed']['kernel']")
             unembed = unembed.astype(cfg.dtype)
             transpose = False
         nll_sum, total = tiled_logits_loss(

@@ -18,6 +18,20 @@ of the reference's activation-checkpoint interval (pipe/module.py:340).
 GPipe-flavored: all M forward steps run before backward begins (autodiff
 order), so weight versioning/interleaving issues don't arise; bubble
 fraction is (P-1)/(M+P-1) per direction — choose M >= 2P.
+
+1F1B-depth memory: the reference's TrainSchedule (pipe/schedule.py:189)
+bounds in-flight microbatches to the stage depth so activation memory
+stays O(P) as M grows. Here the M microbatches run in *waves* of
+``window`` (default 2P) with the wave body rematerialized: the backward
+replays one wave at a time, so live stage-boundary activations are
+O(window + P) regardless of M — memory flat as M doubles (asserted via
+compiled memory_analysis in tests/test_pipeline.py).
+
+Tied embeddings (reference TiedLayerSpec pipe/module.py:77 + tied-grad
+allreduce pipe/engine.py:274): structurally unnecessary here — only the
+stacked layer dim shards over pp; embedding/unembed weights stay
+replicated over pp under GSPMD, which inserts the gradient psum across
+their two uses itself (parity test: tests/test_pipeline.py tied test).
 """
 
 from __future__ import annotations
@@ -38,8 +52,34 @@ def pipeline_enabled(mesh: Optional[Mesh]) -> bool:
     return mesh is not None and mesh.shape.get("pp", 1) > 1
 
 
+# trace-scoped schedule defaults (config.pipeline.{microbatches,window}):
+# the engine enters this around its own model traces, so two engines in
+# one process cannot contaminate each other's pipeline schedule
+_CONFIG_MICROBATCHES = 0
+_CONFIG_WINDOW = 0
+
+
+class schedule_defaults:
+    """``with schedule_defaults(m, w): model.loss(...)`` — engine-config
+    defaults for pipelined_layers, scoped to the trace."""
+
+    def __init__(self, microbatches: int = 0, window: int = 0):
+        self._mw = (microbatches, window)
+
+    def __enter__(self):
+        global _CONFIG_MICROBATCHES, _CONFIG_WINDOW
+        self._prev = (_CONFIG_MICROBATCHES, _CONFIG_WINDOW)
+        _CONFIG_MICROBATCHES, _CONFIG_WINDOW = self._mw
+
+    def __exit__(self, *a):
+        global _CONFIG_MICROBATCHES, _CONFIG_WINDOW
+        _CONFIG_MICROBATCHES, _CONFIG_WINDOW = self._prev
+        return False
+
+
 def pipelined_layers(layer_fn: Callable, stacked_params: Any, x: jax.Array,
                      num_microbatches: Optional[int] = None,
+                     window: Optional[int] = None,
                      with_aux: bool = False):
     """Run ``scan(layer_fn)`` over [L, ...]-stacked params as a pp-stage
     pipeline.
@@ -50,16 +90,22 @@ def pipelined_layers(layer_fn: Callable, stacked_params: Any, x: jax.Array,
     aux/z losses — the reference accumulates these across the pipe via the
     engine's loss reduction, pipe/engine.py:592).
     x: [B, S, H]; B must divide into num_microbatches (default 2*pp).
-    Returns [B, S, H] replicated over pp (and the summed aux when
-    ``with_aux``).
+    ``window`` caps in-flight microbatches per rematted wave (1F1B-depth
+    memory; default 2*pp). Returns [B, S, H] replicated over pp (and the
+    summed aux when ``with_aux``).
     """
     mesh = topo.get_global_mesh()
     PP = mesh.shape["pp"]
     B = x.shape[0]
-    M = num_microbatches or min(B, 2 * PP)
+    M = num_microbatches or _CONFIG_MICROBATCHES or min(B, 2 * PP)
+    M = min(M, B)
     while B % M != 0:
         M -= 1
     assert M >= 1
+    W = window or _CONFIG_WINDOW or 2 * PP
+    W = min(W, M)
+    while M % W != 0:
+        W -= 1
 
     L = jax.tree.leaves(stacked_params)[0].shape[0]
     assert L % PP == 0, f"num_layers {L} must divide pp {PP}"
@@ -67,7 +113,6 @@ def pipelined_layers(layer_fn: Callable, stacked_params: Any, x: jax.Array,
     def per_stage(params_stage, xs_local):
         # params_stage leaves: [L/PP, ...]; xs_local: [M, mb, S, H]
         stage = lax.axis_index("pp")
-        steps = M + PP - 1
         fwd_perm = [(i, (i + 1) % PP) for i in range(PP)]
 
         def stage_fn(inp, params_stage):
@@ -84,23 +129,42 @@ def pipelined_layers(layer_fn: Callable, stacked_params: Any, x: jax.Array,
 
         stage_fn = jax.checkpoint(stage_fn)
 
-        def body(carry, t):
-            buf, aux_buf = carry  # arriving from the previous stage
-            mb_idx = jnp.clip(t, 0, M - 1)
-            inp = jnp.where(stage == 0, xs_local[mb_idx], buf)
-            aux_in = jnp.where(stage == 0, 0.0, aux_buf)
-            out, aux_out = stage_fn((inp, aux_in), params_stage)
-            nxt = lax.ppermute(out, "pp", fwd_perm)
-            aux_nxt = lax.ppermute(aux_out, "pp", fwd_perm)
-            is_valid = jnp.logical_and(stage == PP - 1, t >= PP - 1)
-            y = jnp.where(is_valid, out, jnp.zeros_like(out))
-            y_aux = jnp.where(is_valid, aux_out, 0.0)
-            return (nxt, aux_nxt), (y, y_aux)
+        def wave(xs_wave):
+            """One W-microbatch pipeline pass: [W, mb, S, H] →
+            (ys [W, mb, S, H] on the last stage, aux scalar)."""
+            steps = W + PP - 1
 
-        init = (jnp.zeros_like(xs_local[0]), jnp.asarray(0.0, jnp.float32))
-        _, (ys, aux_ys) = lax.scan(body, init, jnp.arange(steps))
-        ys = ys[PP - 1:]  # [M, mb, S, H] — real only on the last stage
-        aux_total = aux_ys[PP - 1:].sum()
+            def body(carry, t):
+                buf, aux_buf = carry  # arriving from the previous stage
+                mb_idx = jnp.clip(t, 0, W - 1)
+                inp = jnp.where(stage == 0, xs_wave[mb_idx], buf)
+                aux_in = jnp.where(stage == 0, 0.0, aux_buf)
+                out, aux_out = stage_fn((inp, aux_in), params_stage)
+                nxt = lax.ppermute(out, "pp", fwd_perm)
+                aux_nxt = lax.ppermute(aux_out, "pp", fwd_perm)
+                is_valid = jnp.logical_and(stage == PP - 1, t >= PP - 1)
+                y = jnp.where(is_valid, out, jnp.zeros_like(out))
+                y_aux = jnp.where(is_valid, aux_out, 0.0)
+                return (nxt, aux_nxt), (y, y_aux)
+
+            init = (jnp.zeros_like(xs_wave[0]),
+                    jnp.asarray(0.0, jnp.float32))
+            _, (ys, aux_ys) = lax.scan(body, init, jnp.arange(steps))
+            return ys[PP - 1:], aux_ys[PP - 1:].sum()
+
+        if W == M:
+            ys, aux_total = wave(xs_local)
+        else:
+            # waves of W microbatches, wave body rematted: the backward
+            # replays one wave at a time, so live boundary activations
+            # stay O(W + P) however large M grows (1F1B-depth memory)
+            wave_ck = jax.checkpoint(wave)
+            xs_waves = xs_local.reshape(M // W, W, *xs_local.shape[1:])
+            _, (ys_w, aux_w) = lax.scan(
+                lambda c, xw: (c, wave_ck(xw)), 0, xs_waves)
+            ys = ys_w.reshape(M, *xs_local.shape[1:])
+            aux_total = aux_w.sum()
+
         # replicate the last stage's result to every stage (out_specs P())
         ys = lax.psum(jnp.where(stage == PP - 1, ys,
                                 jnp.zeros_like(ys)), "pp")

@@ -234,7 +234,14 @@ class Engine:
         # hpZ/MiCS since it is just a constraint pair around the gather;
         # armed per-engine via the sharding module switch.
         self._qwz_stage3 = (zq.stage == 3 and zq.zero_quantized_weights
-                            and not config.moe.enabled)
+                            and not config.moe.enabled
+                            and self.mesh.shape.get("pp", 1) <= 1)
+        if (zq.stage == 3 and zq.zero_quantized_weights
+                and self.mesh.shape.get("pp", 1) > 1):
+            logger.warning(
+                "ZeRO++ qwZ stage-3 is inert under pipeline parallelism "
+                "(the pp stage body traces with sharding constraints "
+                "disabled) — layer gathers stay full-width bf16")
         if self._qwz_stage3:
             log_dist("ZeRO++ qwZ: stage-3 int8 quantized parameter "
                      "all-gather enabled (fsdp axis)", ranks=[0])
@@ -514,8 +521,13 @@ class Engine:
         # quantized fetch (a second engine in the process must not flip it)
         qwz_bits = 8 if self._qwz_stage3 else None
 
+        from deepspeed_tpu.parallel import pipeline as pipe_mod
+
+        pp_defaults = pipe_mod.schedule_defaults(cfg.pipeline.microbatches,
+                                                 cfg.pipeline.window)
+
         def model_loss(params, batch):
-            with shard_lib.qwz_context(qwz_bits):
+            with shard_lib.qwz_context(qwz_bits), pp_defaults:
                 return self.model.loss(params, batch)
 
         def loss_of(params, batch, scale):

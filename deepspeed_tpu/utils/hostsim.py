@@ -32,10 +32,11 @@ def build_affinity_shim() -> Optional[str]:
     uid = os.getuid() if hasattr(os, "getuid") else 0
     out = os.path.join(tempfile.gettempdir(),
                        f"dstpu_affinity_shim_{uid}.so")
-    if os.path.exists(out):
-        return out
     if not os.path.exists(_SHIM_SRC):
-        return None
+        return out if os.path.exists(out) else None
+    if (os.path.exists(out)
+            and os.path.getmtime(out) >= os.path.getmtime(_SHIM_SRC)):
+        return out  # cached build is current (rebuilt when source changes)
     for cc in ("cc", "gcc", "clang"):
         fd, tmp = tempfile.mkstemp(suffix=".so",
                                    dir=tempfile.gettempdir())

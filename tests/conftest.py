@@ -9,6 +9,39 @@ Must run before jax is imported anywhere.
 """
 
 import os
+import sys
+
+def _maybe_reexec_with_affinity_shim(config) -> None:
+    """On hosts with fewer cores than virtual devices, XLA CPU's thread
+    pool (sized max(cores, devices)) can have every worker blocked in a
+    collective rendezvous with no spare to run the partner collective —
+    a flaky fatal abort ("Expected 8 threads to join ... only 4
+    arrived"). The affinity shim (csrc/hostsim/affinity_shim.c) widens
+    the reported CPU count for pool headroom; LD_PRELOAD must be set
+    before process start, so re-exec the identical command line once
+    with it injected (after releasing pytest's capture fds, or the new
+    process writes into the orphaned capture file)."""
+    if (sys.platform != "linux"
+            or os.environ.get("_DSTPU_AFFINITY_REEXEC") == "1"
+            # xdist/execnet workers bootstrap from stdin — re-exec would
+            # re-read an already-consumed stream and hang the session
+            or os.environ.get("PYTEST_XDIST_WORKER")
+            or "-c" in sys.argv[:3]):
+        return
+    from deepspeed_tpu.utils.hostsim import cpu_sim_env
+
+    env = cpu_sim_env(n_devices=8)  # single policy home for the shim
+    if env.get("LD_PRELOAD") == os.environ.get("LD_PRELOAD"):
+        return  # big host, shim unavailable, or already loaded
+    env["_DSTPU_AFFINITY_REEXEC"] = "1"
+    with open("/proc/self/cmdline", "rb") as f:
+        argv = [a.decode() for a in f.read().split(b"\0")[:-1]]
+    exe = argv[0] if os.path.sep in argv[0] else sys.executable
+    cap = config.pluginmanager.getplugin("capturemanager")
+    if cap is not None:
+        cap.stop_global_capturing()
+    os.execve(exe, argv, env)
+
 
 os.environ["JAX_PLATFORMS"] = "cpu"  # force: tests never touch the real TPU
 _flags = os.environ.get("XLA_FLAGS", "")
@@ -64,3 +97,4 @@ def pytest_configure(config):
     config.addinivalue_line(
         "markers", "slow: long-running measured benchmarks (reference "
         "'nightly' marker analog)")
+    _maybe_reexec_with_affinity_shim(config)

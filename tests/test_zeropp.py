@@ -205,7 +205,15 @@ def test_qwz_int8_gather_in_hlo(devices):
     s8_gathers = [l for l in hlo.splitlines()
                   if "all-gather" in l and "s8[" in l]
     assert s8_gathers, "no int8 all-gather found in compiled HLO"
-    shard_lib.configure_qwz(None)
+    # and no full-width float gather of a quantized weight remains (a
+    # regression that double-gathers would still carry these shapes):
+    # per-layer wq/wk/wv [32,4,8], wo [4,8,32], mlp [32,128]/[128,32],
+    # unembed [32,64] (embed [64,32] is legitimately exact — excluded)
+    import re
+    bad = [l for l in hlo.splitlines()
+           if re.search(r"all-gather[^=]*= (f32|bf16)"
+                        r"\[(32,4,8|4,8,32|32,128|128,32|32,64)\]", l)]
+    assert not bad, f"full-width gather of a quantized weight:\n{bad[0]}"
 
 
 def test_qwz_inactive_without_flag(devices):
