@@ -26,7 +26,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from deepspeed_tpu.models.transformer import (
-    TransformerConfig, _norm, _rope)
+    TransformerConfig, _norm, _rope, act_fn)
 from deepspeed_tpu.runtime.sharding import effective_dtype
 
 
@@ -59,7 +59,7 @@ def _mlp(cfg: TransformerConfig, layer_params, x):
         u = jnp.einsum("...h,hf->...f", y, mp["wi"].astype(dt))
         z = jax.nn.silu(g) * u
     else:
-        act = jax.nn.relu if cfg.activation == "relu" else jax.nn.gelu
+        act = act_fn(cfg.activation)
         pre = jnp.einsum("...h,hf->...f", y, mp["wi"].astype(dt))
         if cfg.use_biases:
             pre = pre + mp["bi"].astype(dt)
@@ -152,6 +152,8 @@ def forward_with_cache(cfg: TransformerConfig, params, tokens: jax.Array,
                           layer_params["attn"]["wo"].astype(dt))
         if cfg.use_biases:
             attn = attn + layer_params["attn"]["bo"].astype(dt)
+        if cfg.parallel_block:  # Falcon: both branches read pre-attn x
+            return _mlp(cfg, layer_params, x) + attn, kv_layer
         x = x + attn
         return _mlp(cfg, layer_params, x), kv_layer
 
@@ -231,6 +233,8 @@ def ragged_forward(cfg: TransformerConfig, params, kv_data: jax.Array,
                           layer_params["attn"]["wo"].astype(dt))
         if cfg.use_biases:
             attn = attn + layer_params["attn"]["bo"].astype(dt)
+        if cfg.parallel_block:  # Falcon: both branches read pre-attn x
+            return _mlp(cfg, layer_params, x) + attn, kv_layer
         x = x + attn
         return _mlp(cfg, layer_params, x), kv_layer
 
@@ -293,6 +297,8 @@ def ragged_prefill_forward(cfg: TransformerConfig, params,
                           layer_params["attn"]["wo"].astype(dt))
         if cfg.use_biases:
             attn = attn + layer_params["attn"]["bo"].astype(dt)
+        if cfg.parallel_block:  # Falcon: both branches read pre-attn x
+            return _mlp(cfg, layer_params, x) + attn, kv_layer
         x = x + attn
         return _mlp(cfg, layer_params, x), kv_layer
 
@@ -353,6 +359,8 @@ def ragged_decode_forward(cfg: TransformerConfig, params, kv_data: jax.Array,
                           layer_params["attn"]["wo"].astype(dt))
         if cfg.use_biases:
             attn = attn + layer_params["attn"]["bo"].astype(dt)
+        if cfg.parallel_block:  # Falcon: both branches read pre-attn x
+            return _mlp(cfg, layer_params, x) + attn, kv_layer
         x = x + attn
         return _mlp(cfg, layer_params, x), kv_layer
 

@@ -1,17 +1,24 @@
 """Load HuggingFace checkpoints into zoo parameter trees.
 
-Reference: the checkpoint-loading half of ``module_inject`` — policies
-map HF module weights onto the reference's fused/TP layouts
-(module_inject/load_checkpoint.py, containers/llama.py). TPU re-design:
-a pure tensor-name mapping from an HF ``state_dict`` onto the stacked
-pytree of ``models/transformer.py`` — sharding happens afterwards via
-AutoTP/engine placement, so loading is layout-only.
+Reference: the checkpoint-loading half of ``module_inject`` — per-arch
+policies map HF module weights onto the reference's fused/TP layouts
+(module_inject/load_checkpoint.py, containers/*, and the v2 model
+implementations inference/v2/model_implementations/{llama_v2,mistral,
+mixtral,opt,phi3,qwen_v2,falcon}). TPU re-design: a pure tensor-name
+mapping from an HF ``state_dict`` onto the stacked pytree of
+``models/transformer.py`` (or ``models/moe_transformer.py`` for MoE) —
+sharding happens afterwards via AutoTP/engine placement, so loading is
+layout-only.
 
-Covered: the Llama family (Llama-2/3, Mistral, and other
-``{q,k,v,o}_proj / gate,up,down_proj`` models without attention
-biases). Qwen2 loads with a warning (its qkv biases are dropped —
-the zoo layout is bias-free); GPT-2/OPT/Falcon need bias support in
-TransformerLM first and are rejected with a clear error.
+Covered architectures (``model_type`` dispatch):
+  llama / llama2 / llama3, mistral, qwen2  — {q,k,v,o}_proj layout
+    (Qwen2's qkv biases load exactly; missing o/mlp biases zero-fill)
+  phi3                                     — fused qkv_proj/gate_up_proj
+  mixtral                                  — MoE (router + w1/w2/w3 experts)
+  opt                                      — learned positions (offset 2)
+  falcon                                   — fused query_key_value,
+    parallel attention+MLP block (7B multi-query and classic MHA forms)
+  gpt2                                     — Conv1D fused c_attn
 
 Rope parity: both sides use the rotate-half convention, so projection
 weights map 1:1 (no row permutation needed).
@@ -35,8 +42,25 @@ def _to_np(t) -> np.ndarray:
     return np.asarray(t)
 
 
-def config_from_hf(hf_config, **overrides) -> TransformerConfig:
-    """HF LlamaConfig/MistralConfig/Qwen2Config → TransformerConfig."""
+def _j(x, dtype):
+    import jax.numpy as jnp
+
+    return jnp.asarray(x, dtype)
+
+
+# ---------------------------------------------------------------------------
+# Llama family (llama/llama2/llama3, mistral, qwen2)
+# ---------------------------------------------------------------------------
+
+
+def config_from_hf(hf_config, state_dict=None, **overrides
+                   ) -> TransformerConfig:
+    """HF LlamaConfig/MistralConfig/Qwen2Config → TransformerConfig.
+
+    ``state_dict`` (optional) turns on ``use_biases`` when the
+    checkpoint actually carries projection biases (Qwen2 qkv; Llama with
+    attention_bias/mlp_bias) so no tensor is silently dropped.
+    """
     get = lambda k, d=None: getattr(hf_config, k, d)
     if get("rope_scaling"):
         raise ValueError(
@@ -55,6 +79,11 @@ def config_from_hf(hf_config, **overrides) -> TransformerConfig:
             f"HF config sets sliding_window={get('sliding_window')}; the "
             "loaded model attends the full causal context — outputs "
             "diverge from transformers beyond the window length")
+    use_biases = bool(get("attention_bias") or get("mlp_bias"))
+    if state_dict is not None:
+        use_biases = use_biases or any(
+            k.endswith((".q_proj.bias", ".o_proj.bias", ".up_proj.bias"))
+            for k in state_dict)
     cfg = TransformerConfig(
         vocab_size=get("vocab_size"),
         hidden_size=get("hidden_size"),
@@ -68,10 +97,19 @@ def config_from_hf(hf_config, **overrides) -> TransformerConfig:
         tie_embeddings=bool(get("tie_word_embeddings", False)),
         rope_theta=float(get("rope_theta", 10000.0)),
         norm_eps=float(get("rms_norm_eps", 1e-5)),
+        use_biases=use_biases,
     )
     import dataclasses
 
     return dataclasses.replace(cfg, **overrides) if overrides else cfg
+
+
+def _bias_or_zeros(sd, name, L, shape, per_layer_np):
+    """Stacked bias [L, *shape]; zero when the checkpoint has none (an
+    arch that defines only some biases, e.g. Qwen2's qkv-only)."""
+    if f"layers.0.{name}" in sd:
+        return per_layer_np(name).reshape((L,) + shape)
+    return np.zeros((L,) + shape, np.float32)
 
 
 def load_hf_llama_state_dict(state_dict: Dict[str, Any],
@@ -86,16 +124,15 @@ def load_hf_llama_state_dict(state_dict: Dict[str, Any],
         known = sorted(sd)[:8]
         raise ValueError(
             "state_dict is not a Llama-family checkpoint (expected "
-            f"layers.N.self_attn.q_proj.weight; got e.g. {known}). GPT-2/"
-            "OPT/Falcon layouts need bias support and are not loadable "
-            "yet.")
-    dropped = [k for k in sd if k.endswith(
-        ("q_proj.bias", "k_proj.bias", "v_proj.bias"))]
-    if dropped:
-        logger.warning(
-            f"HF load: dropping {len(dropped)} attention bias tensors "
-            "(Qwen2-style qkv biases; the zoo layout is bias-free — "
-            "expect small numeric drift)")
+            f"layers.N.self_attn.q_proj.weight; got e.g. {known})")
+    bias_keys = [k for k in sd if k.endswith(".bias")]
+    if bias_keys and not cfg.use_biases:
+        raise ValueError(
+            f"checkpoint carries {len(bias_keys)} bias tensors (e.g. "
+            f"{bias_keys[0]}) but the target config has use_biases="
+            "False — loading would silently drop them and change "
+            "logits; build the config via config_from_hf(hf_config, "
+            "state_dict) so biases are detected")
 
     L, h = cfg.num_layers, cfg.hidden_size
     nh, nkv, hd = cfg.num_heads, cfg.kv_heads, cfg.head_dim
@@ -112,25 +149,44 @@ def load_hf_llama_state_dict(state_dict: Dict[str, Any],
     wi = per_layer("mlp.up_proj.weight")
     wdown = per_layer("mlp.down_proj.weight")    # [L, H, F]
 
-    import jax.numpy as jnp
-
     def j(x):
-        return jnp.asarray(x, pd)
+        return _j(x, pd)
 
+    attn: Dict[str, Any] = {
+        "wq": j(wq.transpose(0, 2, 1).reshape(L, h, nh, hd)),
+        "wk": j(wk.transpose(0, 2, 1).reshape(L, h, nkv, hd)),
+        "wv": j(wv.transpose(0, 2, 1).reshape(L, h, nkv, hd)),
+        "wo": j(wo.transpose(0, 2, 1).reshape(L, nh, hd, h)),
+    }
+    mlp: Dict[str, Any] = {
+        "wg": j(wg.transpose(0, 2, 1)),          # [L, H, F]
+        "wi": j(wi.transpose(0, 2, 1)),
+        "wo": j(wdown.transpose(0, 2, 1)),       # [L, F, H]
+    }
+    if cfg.use_biases:
+        attn["bq"] = j(_bias_or_zeros(
+            sd, "self_attn.q_proj.bias", L, (nh, hd), per_layer))
+        attn["bk"] = j(_bias_or_zeros(
+            sd, "self_attn.k_proj.bias", L, (nkv, hd), per_layer))
+        attn["bv"] = j(_bias_or_zeros(
+            sd, "self_attn.v_proj.bias", L, (nkv, hd), per_layer))
+        attn["bo"] = j(_bias_or_zeros(
+            sd, "self_attn.o_proj.bias", L, (h,), per_layer))
+        # swiglu zoo layout has no gate/up biases; mlp_bias checkpoints
+        # carry them — refuse rather than silently drop
+        if "layers.0.mlp.up_proj.bias" in sd:
+            raise ValueError(
+                "mlp_bias=True Llama checkpoints are not supported (the "
+                "swiglu zoo layout has no gate/up bias slots)")
+        # structural parity with init_params(use_biases=True): the
+        # swiglu forward reads only bo; bi exists as a zero slot
+        mlp["bi"] = _j(np.zeros((L, cfg.ffn), np.float32), pd)
+        mlp["bo"] = _j(np.zeros((L, h), np.float32), pd)
     params: Dict[str, Any] = {
         "embed": {"tokens": j(_to_np(sd["embed_tokens.weight"]))},
         "layers": {
-            "attn": {
-                "wq": j(wq.transpose(0, 2, 1).reshape(L, h, nh, hd)),
-                "wk": j(wk.transpose(0, 2, 1).reshape(L, h, nkv, hd)),
-                "wv": j(wv.transpose(0, 2, 1).reshape(L, h, nkv, hd)),
-                "wo": j(wo.transpose(0, 2, 1).reshape(L, nh, hd, h)),
-            },
-            "mlp": {
-                "wg": j(wg.transpose(0, 2, 1)),          # [L, H, F]
-                "wi": j(wi.transpose(0, 2, 1)),
-                "wo": j(wdown.transpose(0, 2, 1)),       # [L, F, H]
-            },
+            "attn": attn,
+            "mlp": mlp,
             "ln1": {"scale": j(per_layer("input_layernorm.weight"))},
             "ln2": {"scale": j(per_layer(
                 "post_attention_layernorm.weight"))},
@@ -142,6 +198,348 @@ def load_hf_llama_state_dict(state_dict: Dict[str, Any],
         lm_head = sd.get("lm_head.weight", sd["embed_tokens.weight"])
         params["unembed"] = {"kernel": j(_to_np(lm_head).T)}
     return params
+
+
+# ---------------------------------------------------------------------------
+# Phi-3 (fused qkv_proj / gate_up_proj; reference
+# inference/v2/model_implementations/phi3)
+# ---------------------------------------------------------------------------
+
+
+def load_hf_phi3_state_dict(state_dict: Dict[str, Any],
+                            cfg: TransformerConfig) -> Dict[str, Any]:
+    """Phi-3 fuses qkv_proj and gate_up_proj; split them into synthetic
+    q/k/v_proj + gate/up_proj keys and delegate to the llama loader (one
+    assembly path, one bias-refusal check)."""
+    if not any(k.endswith("self_attn.qkv_proj.weight") for k in state_dict):
+        raise ValueError(
+            "state_dict is not a Phi-3 layout (expected "
+            "layers.N.self_attn.qkv_proj.weight)")
+    nh, nkv, hd = cfg.num_heads, cfg.kv_heads, cfg.head_dim
+    F = cfg.ffn
+    out: Dict[str, Any] = {}
+    for k, v in state_dict.items():
+        if k.endswith("self_attn.qkv_proj.weight"):
+            a = _to_np(v)  # [(nh+2nkv)*hd, H]
+            base = k[: -len("qkv_proj.weight")]
+            out[base + "q_proj.weight"] = a[: nh * hd]
+            out[base + "k_proj.weight"] = a[nh * hd: nh * hd + nkv * hd]
+            out[base + "v_proj.weight"] = a[nh * hd + nkv * hd:]
+        elif k.endswith("mlp.gate_up_proj.weight"):
+            a = _to_np(v)  # [2F, H]
+            base = k[: -len("gate_up_proj.weight")]
+            out[base + "gate_proj.weight"] = a[:F]
+            out[base + "up_proj.weight"] = a[F:]
+        else:
+            out[k] = v
+    return load_hf_llama_state_dict(out, cfg)
+
+
+# ---------------------------------------------------------------------------
+# OPT (learned positions with offset 2; reference
+# inference/v2/model_implementations/opt, containers/opt.py)
+# ---------------------------------------------------------------------------
+
+
+def config_from_hf_opt(hf_config, **overrides) -> TransformerConfig:
+    get = lambda k, d=None: getattr(hf_config, k, d)
+    if get("word_embed_proj_dim", get("hidden_size")) != get("hidden_size"):
+        raise ValueError(
+            "OPT checkpoints with word_embed_proj_dim != hidden_size "
+            "(350m-style projected embeddings) are not supported")
+    if not get("do_layer_norm_before", True):
+        raise ValueError(
+            "OPT post-layernorm variants (do_layer_norm_before=False, "
+            "e.g. opt-350m) are not supported — the zoo block is pre-norm")
+    act = get("activation_function", "relu")
+    if act not in ("relu", "gelu"):
+        raise ValueError(f"unsupported OPT activation {act!r}")
+    cfg = TransformerConfig(
+        vocab_size=get("vocab_size"),
+        hidden_size=get("hidden_size"),
+        num_layers=get("num_hidden_layers"),
+        num_heads=get("num_attention_heads"),
+        ffn_size=get("ffn_dim"),
+        max_seq_len=get("max_position_embeddings", 2048),
+        pos_emb="learned", norm="layernorm", activation=act,
+        tie_embeddings=bool(get("tie_word_embeddings", True)),
+        use_biases=bool(get("enable_bias", True)),
+        norm_eps=1e-5,
+    )
+    import dataclasses
+
+    return dataclasses.replace(cfg, **overrides) if overrides else cfg
+
+
+def load_hf_opt_state_dict(state_dict: Dict[str, Any],
+                           cfg: TransformerConfig) -> Dict[str, Any]:
+    sd = {k.removeprefix("model.").removeprefix("decoder."): v
+          for k, v in state_dict.items()}
+    if "layers.0.self_attn.q_proj.weight" not in sd or \
+            "embed_positions.weight" not in sd:
+        raise ValueError(
+            "state_dict is not an OPT layout (expected decoder."
+            "layers.N.self_attn.q_proj.weight + embed_positions.weight)")
+    L, h = cfg.num_layers, cfg.hidden_size
+    nh, hd = cfg.num_heads, cfg.head_dim
+
+    def per_layer(name):
+        return np.stack([_to_np(sd[f"layers.{i}.{name}"]) for i in range(L)])
+
+    def j(x):
+        return _j(x, cfg.param_dtype)
+
+    # OPTLearnedPositionalEmbedding indexes at position+2: drop the two
+    # offset rows so our arange(S) lookup lands on the same vectors
+    pos = _to_np(sd["embed_positions.weight"])[2:]
+    params = {
+        "embed": {
+            "tokens": j(_to_np(sd["embed_tokens.weight"])),
+            "positions": j(pos[: cfg.max_seq_len]),
+        },
+        "layers": {
+            "attn": {
+                "wq": j(per_layer("self_attn.q_proj.weight")
+                        .transpose(0, 2, 1).reshape(L, h, nh, hd)),
+                "wk": j(per_layer("self_attn.k_proj.weight")
+                        .transpose(0, 2, 1).reshape(L, h, nh, hd)),
+                "wv": j(per_layer("self_attn.v_proj.weight")
+                        .transpose(0, 2, 1).reshape(L, h, nh, hd)),
+                "wo": j(per_layer("self_attn.out_proj.weight")
+                        .transpose(0, 2, 1).reshape(L, nh, hd, h)),
+                "bq": j(per_layer("self_attn.q_proj.bias")
+                        .reshape(L, nh, hd)),
+                "bk": j(per_layer("self_attn.k_proj.bias")
+                        .reshape(L, nh, hd)),
+                "bv": j(per_layer("self_attn.v_proj.bias")
+                        .reshape(L, nh, hd)),
+                "bo": j(per_layer("self_attn.out_proj.bias")),
+            },
+            "mlp": {
+                "wi": j(per_layer("fc1.weight").transpose(0, 2, 1)),
+                "bi": j(per_layer("fc1.bias")),
+                "wo": j(per_layer("fc2.weight").transpose(0, 2, 1)),
+                "bo": j(per_layer("fc2.bias")),
+            },
+            "ln1": {"scale": j(per_layer("self_attn_layer_norm.weight")),
+                    "bias": j(per_layer("self_attn_layer_norm.bias"))},
+            "ln2": {"scale": j(per_layer("final_layer_norm.weight")),
+                    "bias": j(per_layer("final_layer_norm.bias"))},
+        },
+        "final_norm": {"scale": j(_to_np(sd["final_layer_norm.weight"])),
+                       "bias": j(_to_np(sd["final_layer_norm.bias"]))},
+    }
+    if not cfg.tie_embeddings:
+        lm_head = state_dict.get("lm_head.weight",
+                                 sd["embed_tokens.weight"])
+        params["unembed"] = {"kernel": j(_to_np(lm_head).T)}
+    return params
+
+
+# ---------------------------------------------------------------------------
+# Falcon (fused query_key_value + parallel block; reference
+# inference/v2/model_implementations/falcon, containers/)
+# ---------------------------------------------------------------------------
+
+
+def config_from_hf_falcon(hf_config, **overrides) -> TransformerConfig:
+    get = lambda k, d=None: getattr(hf_config, k, d)
+    if get("alibi"):
+        raise ValueError("alibi Falcon variants are not supported (the "
+                         "zoo block is rotary-only)")
+    if get("new_decoder_architecture"):
+        raise ValueError(
+            "new_decoder_architecture Falcon (40B/180B grouped-qkv "
+            "layout) is not supported yet; 7B-style checkpoints load")
+    nh = get("num_attention_heads")
+    nkv = 1 if get("multi_query", True) else nh
+    cfg = TransformerConfig(
+        vocab_size=get("vocab_size"),
+        hidden_size=get("hidden_size"),
+        num_layers=get("num_hidden_layers"),
+        num_heads=nh,
+        num_kv_heads=nkv,
+        ffn_size=get("ffn_hidden_size") or 4 * get("hidden_size"),
+        max_seq_len=get("max_position_embeddings", 2048),
+        pos_emb="rope", norm="layernorm", activation="gelu",
+        tie_embeddings=bool(get("tie_word_embeddings", True)),
+        use_biases=bool(get("bias", False)),
+        rope_theta=float(get("rope_theta", 10000.0)),
+        norm_eps=float(get("layer_norm_epsilon", 1e-5)),
+        parallel_block=bool(get("parallel_attn", True)),
+    )
+    import dataclasses
+
+    return dataclasses.replace(cfg, **overrides) if overrides else cfg
+
+
+def load_hf_falcon_state_dict(state_dict: Dict[str, Any],
+                              cfg: TransformerConfig) -> Dict[str, Any]:
+    sd = {k.removeprefix("transformer."): v for k, v in state_dict.items()}
+    if "h.0.self_attention.query_key_value.weight" not in sd:
+        raise ValueError(
+            "state_dict is not a Falcon layout (expected "
+            "h.N.self_attention.query_key_value.weight)")
+    if cfg.use_biases:
+        raise ValueError("bias=True Falcon variants are not supported")
+    L, h = cfg.num_layers, cfg.hidden_size
+    nh, nkv, hd = cfg.num_heads, cfg.kv_heads, cfg.head_dim
+
+    def per_layer(name):
+        return np.stack([_to_np(sd[f"h.{i}.{name}"]) for i in range(L)])
+
+    def j(x):
+        return _j(x, cfg.param_dtype)
+
+    qkv = per_layer("self_attention.query_key_value.weight")
+    if nkv == 1:  # multi-query: rows [q (nh*hd), k (hd), v (hd)]
+        wq = qkv[:, : nh * hd]
+        wk = qkv[:, nh * hd: nh * hd + hd]
+        wv = qkv[:, nh * hd + hd:]
+    else:  # classic MHA falcon (rw-1b): per-head interleave [nh, 3, hd]
+        qkv = qkv.reshape(L, nh, 3, hd, h)
+        wq = qkv[:, :, 0].reshape(L, nh * hd, h)
+        wk = qkv[:, :, 1].reshape(L, nh * hd, h)
+        wv = qkv[:, :, 2].reshape(L, nh * hd, h)
+
+    ln_scale = per_layer("input_layernorm.weight")
+    ln_bias = per_layer("input_layernorm.bias")
+    if cfg.parallel_block:
+        # parallel block: one shared input_layernorm; the zoo layout
+        # keeps separate ln1/ln2 slots, so duplicate it (mathematically
+        # identical — same input, same params)
+        ln2_scale, ln2_bias = ln_scale.copy(), ln_bias.copy()
+    else:
+        # sequential falcon (rw family) trains a separate MLP norm
+        ln2_scale = per_layer("post_attention_layernorm.weight")
+        ln2_bias = per_layer("post_attention_layernorm.bias")
+    params = {
+        "embed": {"tokens": j(_to_np(sd["word_embeddings.weight"]))},
+        "layers": {
+            "attn": {
+                "wq": j(wq.transpose(0, 2, 1).reshape(L, h, nh, hd)),
+                "wk": j(wk.transpose(0, 2, 1).reshape(L, h, nkv, hd)),
+                "wv": j(wv.transpose(0, 2, 1).reshape(L, h, nkv, hd)),
+                "wo": j(per_layer("self_attention.dense.weight")
+                        .transpose(0, 2, 1).reshape(L, nh, hd, h)),
+            },
+            "mlp": {
+                "wi": j(per_layer("mlp.dense_h_to_4h.weight")
+                        .transpose(0, 2, 1)),
+                "wo": j(per_layer("mlp.dense_4h_to_h.weight")
+                        .transpose(0, 2, 1)),
+            },
+            "ln1": {"scale": j(ln_scale), "bias": j(ln_bias)},
+            "ln2": {"scale": j(ln2_scale), "bias": j(ln2_bias)},
+        },
+        "final_norm": {"scale": j(_to_np(sd["ln_f.weight"])),
+                       "bias": j(_to_np(sd["ln_f.bias"]))},
+    }
+    if not cfg.tie_embeddings:
+        lm_head = state_dict.get("lm_head.weight",
+                                 sd["word_embeddings.weight"])
+        params["unembed"] = {"kernel": j(_to_np(lm_head).T)}
+    return params
+
+
+# ---------------------------------------------------------------------------
+# Mixtral (MoE; reference inference/v2/model_implementations/mixtral)
+# ---------------------------------------------------------------------------
+
+
+def config_from_hf_mixtral(hf_config, **overrides):
+    from deepspeed_tpu.models.moe_transformer import MoETransformerConfig
+
+    get = lambda k, d=None: getattr(hf_config, k, d)
+    if get("rope_scaling"):
+        raise ValueError("rope_scaling is not supported yet")
+    cfg = MoETransformerConfig(
+        vocab_size=get("vocab_size"),
+        hidden_size=get("hidden_size"),
+        num_layers=get("num_hidden_layers"),
+        num_heads=get("num_attention_heads"),
+        num_kv_heads=get("num_key_value_heads"),
+        ffn_size=get("intermediate_size"),
+        max_seq_len=get("max_position_embeddings", 4096),
+        pos_emb="rope", norm="rmsnorm", activation="swiglu",
+        tie_embeddings=bool(get("tie_word_embeddings", False)),
+        rope_theta=float(get("rope_theta", 1e6)),
+        norm_eps=float(get("rms_norm_eps", 1e-5)),
+        num_experts=get("num_local_experts"),
+        top_k=get("num_experts_per_tok"),
+        # HF routes every token (no capacity drop): match for parity;
+        # training configs may re-enable drop_tokens
+        drop_tokens=False,
+        aux_loss_weight=float(get("router_aux_loss_coef", 0.02) or 0.0),
+    )
+    import dataclasses
+
+    return dataclasses.replace(cfg, **overrides) if overrides else cfg
+
+
+def load_hf_mixtral_state_dict(state_dict: Dict[str, Any], cfg
+                               ) -> Dict[str, Any]:
+    sd = {k.removeprefix("model."): v for k, v in state_dict.items()}
+    if "layers.0.block_sparse_moe.gate.weight" not in sd:
+        raise ValueError(
+            "state_dict is not a Mixtral layout (expected "
+            "layers.N.block_sparse_moe.gate.weight)")
+    L, h = cfg.num_layers, cfg.hidden_size
+    nh, nkv, hd = cfg.num_heads, cfg.kv_heads, cfg.head_dim
+    E = cfg.num_experts
+    pd = cfg.param_dtype
+
+    def per_layer(name):
+        return np.stack([_to_np(sd[f"layers.{i}.{name}"]) for i in range(L)])
+
+    def per_expert(name):
+        # [L, E, out, in] → ours [L, E, in, out]
+        return np.stack([
+            np.stack([_to_np(sd[f"layers.{i}.block_sparse_moe.experts."
+                                f"{e}.{name}"]) for e in range(E)])
+            for i in range(L)]).transpose(0, 1, 3, 2)
+
+    def j(x):
+        return _j(x, pd)
+
+    wq = per_layer("self_attn.q_proj.weight")
+    wk = per_layer("self_attn.k_proj.weight")
+    wv = per_layer("self_attn.v_proj.weight")
+    wo = per_layer("self_attn.o_proj.weight")
+    params = {
+        "embed": {"tokens": j(_to_np(sd["embed_tokens.weight"]))},
+        "layers": {
+            "attn": {
+                "wq": j(wq.transpose(0, 2, 1).reshape(L, h, nh, hd)),
+                "wk": j(wk.transpose(0, 2, 1).reshape(L, h, nkv, hd)),
+                "wv": j(wv.transpose(0, 2, 1).reshape(L, h, nkv, hd)),
+                "wo": j(wo.transpose(0, 2, 1).reshape(L, nh, hd, h)),
+            },
+            "moe": {
+                # HF gate.weight [E, H] → router [H, E]
+                "router": j(per_layer("block_sparse_moe.gate.weight")
+                            .transpose(0, 2, 1)),
+                "experts": {
+                    "wg": j(per_expert("w1.weight")),   # gate
+                    "wo": j(per_expert("w2.weight")),   # down
+                    "wi": j(per_expert("w3.weight")),   # up
+                },
+            },
+            "ln1": {"scale": j(per_layer("input_layernorm.weight"))},
+            "ln2": {"scale": j(per_layer(
+                "post_attention_layernorm.weight"))},
+        },
+        "final_norm": {"scale": j(_to_np(sd["norm.weight"]))},
+    }
+    if not cfg.tie_embeddings:
+        lm_head = sd.get("lm_head.weight", sd["embed_tokens.weight"])
+        params["unembed"] = {"kernel": j(_to_np(lm_head).T)}
+    return params
+
+
+# ---------------------------------------------------------------------------
+# GPT-2 (Conv1D fused c_attn)
+# ---------------------------------------------------------------------------
 
 
 def config_from_hf_gpt2(hf_config, **overrides) -> TransformerConfig:
@@ -157,7 +555,7 @@ def config_from_hf_gpt2(hf_config, **overrides) -> TransformerConfig:
         ffn_size=4 * get("n_embd") if get("n_inner") is None
         else get("n_inner"),
         max_seq_len=get("n_positions", 1024),
-        pos_emb="learned", norm="layernorm", activation="gelu",
+        pos_emb="learned", norm="layernorm", activation="gelu_tanh",
         tie_embeddings=True, use_biases=True,
         norm_eps=float(get("layer_norm_epsilon", 1e-5)),
     )
@@ -184,10 +582,8 @@ def load_hf_gpt2_state_dict(state_dict: Dict[str, Any],
     def per_layer(name):
         return np.stack([_to_np(sd[f"h.{i}.{name}"]) for i in range(L)])
 
-    import jax.numpy as jnp
-
     def j(x):
-        return jnp.asarray(x, cfg.param_dtype)
+        return _j(x, cfg.param_dtype)
 
     cattn_w = per_layer("attn.c_attn.weight")      # [L, H, 3H]
     cattn_b = per_layer("attn.c_attn.bias")        # [L, 3H]
@@ -226,13 +622,20 @@ def load_hf_gpt2_state_dict(state_dict: Dict[str, Any],
     }
 
 
-def from_hf_pretrained(model_or_path, config: Optional[TransformerConfig]
-                       = None, **overrides):
-    """HF model instance or local path → (TransformerLM, params).
+# ---------------------------------------------------------------------------
+# dispatch
+# ---------------------------------------------------------------------------
+
+
+def from_hf_pretrained(model_or_path, config=None, **overrides):
+    """HF model instance or local path → (TransformerLM | MoETransformerLM,
+    params).
 
     Reference entry analog: ``deepspeed.init_inference(model, ...)``
     consuming an HF model; here the weights move into the TPU-native
-    tree once and the HF/torch object can be dropped.
+    tree once and the HF/torch object can be dropped. Dispatches on
+    ``hf_config.model_type``:
+    llama/mistral/qwen2 | phi3 | mixtral | opt | falcon | gpt2.
     """
     if isinstance(model_or_path, str):
         from transformers import AutoConfig, AutoModelForCausalLM
@@ -245,10 +648,25 @@ def from_hf_pretrained(model_or_path, config: Optional[TransformerConfig]
     if config is not None and overrides:
         raise ValueError("pass either config= or field overrides, not "
                          "both (overrides would be silently ignored)")
-    if getattr(hf_cfg, "model_type", "") == "gpt2":
+    sd = hf_model.state_dict()
+    mt = getattr(hf_cfg, "model_type", "")
+    if mt == "gpt2":
         cfg = config or config_from_hf_gpt2(hf_cfg, **overrides)
-        params = load_hf_gpt2_state_dict(hf_model.state_dict(), cfg)
-    else:
-        cfg = config or config_from_hf(hf_cfg, **overrides)
-        params = load_hf_llama_state_dict(hf_model.state_dict(), cfg)
-    return TransformerLM(cfg), params
+        return TransformerLM(cfg), load_hf_gpt2_state_dict(sd, cfg)
+    if mt == "phi3":
+        cfg = config or config_from_hf(hf_cfg, state_dict=sd, **overrides)
+        return TransformerLM(cfg), load_hf_phi3_state_dict(sd, cfg)
+    if mt == "opt":
+        cfg = config or config_from_hf_opt(hf_cfg, **overrides)
+        return TransformerLM(cfg), load_hf_opt_state_dict(sd, cfg)
+    if mt == "falcon":
+        cfg = config or config_from_hf_falcon(hf_cfg, **overrides)
+        return TransformerLM(cfg), load_hf_falcon_state_dict(sd, cfg)
+    if mt == "mixtral":
+        from deepspeed_tpu.models.moe_transformer import MoETransformerLM
+
+        cfg = config or config_from_hf_mixtral(hf_cfg, **overrides)
+        return MoETransformerLM(cfg), load_hf_mixtral_state_dict(sd, cfg)
+    # llama / mistral / qwen2 / other q_proj-layout models
+    cfg = config or config_from_hf(hf_cfg, state_dict=sd, **overrides)
+    return TransformerLM(cfg), load_hf_llama_state_dict(sd, cfg)

@@ -51,7 +51,7 @@ class TransformerConfig:
     max_seq_len: int = 1024
     pos_emb: str = "learned"  # learned | rope | none
     norm: str = "layernorm"  # layernorm | rmsnorm
-    activation: str = "gelu"  # gelu | swiglu | relu
+    activation: str = "gelu"  # gelu (exact erf) | gelu_tanh | swiglu | relu
     tie_embeddings: bool = True
     rope_theta: float = 10000.0
     norm_eps: float = 1e-5
@@ -80,6 +80,9 @@ class TransformerConfig:
     # FPDT-style chunked attention (reference fpdt_layer.py): number of
     # query chunks scanned sequentially, 0/1 = off
     attn_chunks: int = 0
+    # Falcon-style parallel residual: x + attn(ln1(x)) + mlp(ln2(x)),
+    # both branches reading the pre-attention residual
+    parallel_block: bool = False
 
     def __post_init__(self):
         if self.sp_mode not in ("ulysses", "ring"):
@@ -125,6 +128,20 @@ class TransformerConfig:
 # ---------------------------------------------------------------------------
 # parameter init + logical axes
 # ---------------------------------------------------------------------------
+
+
+def act_fn(name: str):
+    """Activation by config name. "gelu" is the exact erf form (HF
+    Falcon/BERT-class 'gelu'); "gelu_tanh"/"gelu_new" is the tanh
+    approximation (GPT-2). The two differ by up to ~4e-4 per activation
+    — enough to flip greedy tokens over a deep stack."""
+    if name == "relu":
+        return jax.nn.relu
+    if name in ("gelu_tanh", "gelu_new"):
+        return partial(jax.nn.gelu, approximate=True)
+    if name == "gelu":
+        return partial(jax.nn.gelu, approximate=False)
+    raise ValueError(f"unknown activation {name!r}")
 
 
 def _dense_init(rng, shape, scale=None, dtype=jnp.float32):
@@ -370,11 +387,17 @@ def _layer(cfg: TransformerConfig, x, layer_params, positions):
     attn = jnp.einsum("bsnd,ndh->bsh", attn, ap["wo"].astype(dt))
     if cfg.use_biases:
         attn = attn + ap["bo"].astype(dt)
-    x = x + constrain_activation(
+    attn = constrain_activation(
         checkpoint_name(attn, "attn_out"), ("batch", "seq", "embed"))
 
-    # mlp
-    y = _norm(x, layer_params["ln2"], cfg.norm, cfg.norm_eps)
+    # mlp: sequential (x + attn first) or parallel (Falcon-style — both
+    # branches read the pre-attention residual; the loader duplicates a
+    # single input_layernorm into ln1/ln2 when the arch has one)
+    if cfg.parallel_block:
+        y = _norm(x, layer_params["ln2"], cfg.norm, cfg.norm_eps)
+    else:
+        x = x + attn
+        y = _norm(x, layer_params["ln2"], cfg.norm, cfg.norm_eps)
 
     def mlp_fn(y):
         if cfg.activation == "swiglu":
@@ -382,7 +405,7 @@ def _layer(cfg: TransformerConfig, x, layer_params, positions):
             u = jnp.einsum("bsh,hf->bsf", y, mp["wi"].astype(dt))
             z = jax.nn.silu(g) * u
         else:
-            act = jax.nn.relu if cfg.activation == "relu" else jax.nn.gelu
+            act = act_fn(cfg.activation)
             pre = jnp.einsum("bsh,hf->bsf", y, mp["wi"].astype(dt))
             if cfg.use_biases:
                 pre = pre + mp["bi"].astype(dt)
@@ -402,7 +425,10 @@ def _layer(cfg: TransformerConfig, x, layer_params, positions):
         z = tiled_mlp(mlp_fn, y, cfg.tiled_mlp)
     else:
         z = mlp_fn(y)
-    return x + constrain_activation(z, ("batch", "seq", "embed"))
+    z = constrain_activation(z, ("batch", "seq", "embed"))
+    if cfg.parallel_block:
+        return x + attn + z
+    return x + z
 
 
 # remat policy names resolve through the activation-checkpointing

@@ -18,7 +18,7 @@ def _gpt2(h, L, heads, vocab=50257, ctx=1024):
     return TransformerConfig(
         vocab_size=vocab, hidden_size=h, num_layers=L, num_heads=heads,
         max_seq_len=ctx, pos_emb="learned", norm="layernorm",
-        activation="gelu", tie_embeddings=True)
+        activation="gelu_tanh", tie_embeddings=True)
 
 
 def _llama(h, L, heads, kv_heads, ffn, vocab=128256, ctx=8192,

@@ -86,13 +86,17 @@ def test_rejects_non_llama_layout():
             config_from_hf(_tiny_llama().config))
 
 
-def test_qwen_style_biases_warn_not_fail():
+def test_bias_checkpoint_refuses_biasless_config():
+    """ADVICE r1: biases must never drop silently — a bias-carrying
+    state_dict with use_biases=False config raises, and config built
+    with the state_dict detects the biases."""
     hf = _tiny_llama()
     sd = dict(hf.state_dict())
     sd["model.layers.0.self_attn.q_proj.bias"] = torch.zeros(64)
-    cfg = config_from_hf(hf.config)
-    params = load_hf_llama_state_dict(sd, cfg)
-    assert params["layers"]["attn"]["wq"].shape == (3, 64, 4, 16)
+    with pytest.raises(ValueError, match="bias"):
+        load_hf_llama_state_dict(sd, config_from_hf(hf.config))
+    cfg = config_from_hf(hf.config, state_dict=sd)
+    assert cfg.use_biases
 
 
 def _tiny_gpt2():
@@ -153,3 +157,129 @@ def test_gpt2_serves_through_ragged_engine(devices):
                           max_new_tokens=5, do_sample=False,
                           pad_token_id=0).numpy()[0, 4:]
     assert got == ref.tolist()
+
+
+# ---------------------------------------------------------------------------
+# per-arch parity (VERDICT r1 #6): logits + greedy through the v1 AND v2
+# engines for Mistral / Qwen2 / Phi-3 / OPT / Falcon / Mixtral
+# (reference: inference/v2/model_implementations/*)
+# ---------------------------------------------------------------------------
+
+F32 = {"dtype": jnp.float32, "param_dtype": jnp.float32,
+       "remat": False, "attn_impl": "xla"}
+
+
+def _perturb_norms(m):
+    """Randomize LayerNorm/RMSNorm weights: at HF's identity init, ln1
+    and ln2 are indistinguishable, which would mask wrong-norm-slot
+    loader bugs (found in review for sequential Falcon)."""
+    with torch.no_grad():
+        for n, p in m.named_parameters():
+            if "norm" in n.lower() or ".ln_" in n:
+                p.add_(torch.randn_like(p) * 0.2)
+    return m
+
+
+def _tiny_hf(arch):
+    torch.manual_seed(7)
+    if arch == "mistral":
+        return _perturb_norms(transformers.MistralForCausalLM(transformers.MistralConfig(
+            vocab_size=128, hidden_size=64, intermediate_size=112,
+            num_hidden_layers=2, num_attention_heads=4,
+            num_key_value_heads=2, max_position_embeddings=64,
+            sliding_window=None, tie_word_embeddings=False)))
+    if arch == "qwen2":
+        return _perturb_norms(transformers.Qwen2ForCausalLM(transformers.Qwen2Config(
+            vocab_size=128, hidden_size=64, intermediate_size=112,
+            num_hidden_layers=2, num_attention_heads=4,
+            num_key_value_heads=2, max_position_embeddings=64,
+            sliding_window=None, use_sliding_window=False,
+            tie_word_embeddings=False)))
+    if arch == "phi3":
+        return _perturb_norms(transformers.Phi3ForCausalLM(transformers.Phi3Config(
+            vocab_size=128, hidden_size=64, intermediate_size=112,
+            num_hidden_layers=2, num_attention_heads=4,
+            num_key_value_heads=2, max_position_embeddings=64,
+            sliding_window=None, tie_word_embeddings=False,
+            pad_token_id=0, bos_token_id=1, eos_token_id=2)))
+    if arch == "opt":
+        return _perturb_norms(transformers.OPTForCausalLM(transformers.OPTConfig(
+            vocab_size=128, hidden_size=64, ffn_dim=112,
+            num_hidden_layers=2, num_attention_heads=4,
+            max_position_embeddings=64, do_layer_norm_before=True,
+            activation_function="relu")))
+    if arch == "falcon-mq":
+        return _perturb_norms(transformers.FalconForCausalLM(transformers.FalconConfig(
+            vocab_size=128, hidden_size=64, num_hidden_layers=2,
+            num_attention_heads=4, multi_query=True, parallel_attn=True,
+            alibi=False, bias=False, new_decoder_architecture=False,
+            max_position_embeddings=64)))
+    if arch == "falcon-mha":
+        return _perturb_norms(transformers.FalconForCausalLM(transformers.FalconConfig(
+            vocab_size=128, hidden_size=64, num_hidden_layers=2,
+            num_attention_heads=4, multi_query=False, parallel_attn=False,
+            alibi=False, bias=False, new_decoder_architecture=False,
+            max_position_embeddings=64)))
+    if arch == "mixtral":
+        return _perturb_norms(transformers.MixtralForCausalLM(transformers.MixtralConfig(
+            vocab_size=128, hidden_size=64, intermediate_size=96,
+            num_hidden_layers=2, num_attention_heads=4,
+            num_key_value_heads=2, num_local_experts=4,
+            num_experts_per_tok=2, max_position_embeddings=64,
+            sliding_window=None, tie_word_embeddings=False)))
+    raise ValueError(arch)
+
+
+ARCHES = ["mistral", "qwen2", "phi3", "opt", "falcon-mq", "falcon-mha",
+          "mixtral"]
+
+
+@pytest.mark.parametrize("arch", ARCHES)
+def test_arch_logits_match(arch):
+    hf = _tiny_hf(arch).eval()
+    model, params = from_hf_pretrained(hf, **F32)
+    tokens = np.array([[1, 5, 9, 2, 7, 3, 11, 4]], np.int32)
+    with torch.no_grad():
+        ref = hf(torch.tensor(tokens.astype(np.int64))).logits.numpy()
+    out = model.apply(params, jnp.asarray(tokens))
+    got = np.asarray(out[0] if isinstance(out, tuple) else out)
+    np.testing.assert_allclose(got, ref, rtol=4e-4, atol=4e-4)
+
+
+@pytest.mark.parametrize("arch", ARCHES)
+def test_arch_greedy_v1_engine(arch, devices):
+    from deepspeed_tpu.inference import init_inference
+
+    hf = _tiny_hf(arch).eval()
+    model, params = from_hf_pretrained(hf, **F32)
+    eng = init_inference(model, params=params, dtype=jnp.float32,
+                         max_seq_len=32)
+    prompt = np.array([[2, 9, 4, 7]], np.int32)
+    ours = eng.generate(prompt, max_new_tokens=6)[0, 4:]
+    with torch.no_grad():
+        ref = hf.generate(torch.tensor(prompt.astype(np.int64)),
+                          max_new_tokens=6, do_sample=False,
+                          pad_token_id=0).numpy()[0, 4:]
+    # HF stops at eos; compare the tokens it produced
+    np.testing.assert_array_equal(ours[:len(ref)], ref)
+
+
+@pytest.mark.parametrize("arch", ARCHES)
+def test_arch_greedy_v2_ragged_engine(arch, devices):
+    from deepspeed_tpu.inference import InferenceEngineV2
+
+    hf = _tiny_hf(arch).eval()
+    model, params = from_hf_pretrained(hf, **F32)
+    v2 = InferenceEngineV2(model, params=params, dtype=jnp.float32,
+                           kv_blocks=64, kv_block_size=8,
+                           max_tokens_per_step=32, max_seqs_per_step=4,
+                           max_blocks_per_seq=8)
+    prompt = np.array([2, 9, 4, 7], np.int32)
+    v2.put([1], [prompt], max_new_tokens=6)
+    got = v2.generate_all()[1]
+    with torch.no_grad():
+        ref = hf.generate(torch.tensor(prompt[None].astype(np.int64)),
+                          max_new_tokens=6, do_sample=False,
+                          pad_token_id=0).numpy()[0, 4:]
+    # HF stops at eos; compare the tokens it produced
+    assert got[:len(ref)] == ref.tolist()
