@@ -54,6 +54,15 @@ class InferenceEngine:
             mesh = topo._GLOBAL_MESH or topo.build_mesh(
                 topo.TopologyConfig(dp=-1))
         self.mesh = mesh
+        tp = mesh.shape.get("tp", 1)
+        for name, heads in (("num_heads", self.cfg.num_heads),
+                            ("kv_heads", self.cfg.kv_heads)):
+            if heads % tp:
+                raise ValueError(
+                    f"tp={tp} does not divide {name}={heads}: the TP "
+                    "placement shards the head axes evenly (reference "
+                    "AutoTP has the same constraint); lower tp or use "
+                    "a model whose head counts divide")
         self.max_batch = max_batch
         self.max_seq_len = max_seq_len or self.cfg.max_seq_len
         self._dtype = dtype

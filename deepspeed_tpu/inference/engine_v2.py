@@ -70,14 +70,27 @@ class InferenceEngineV2:
         self.max_blocks_per_seq = max_blocks_per_seq
         self._step_fn = jax.jit(partial(model_runner.ragged_forward, self.cfg))
         # decode-only steps use the Pallas paged-attention kernel (no
-        # per-token context gather). Pallas under GSPMD needs shard_map;
-        # until then the kernel path is single-shard (tp == 1) only.
-        self._use_paged_kernel = (
-            self.mesh is None or self.mesh.shape.get("tp", 1) == 1)
-        self._decode_fn = jax.jit(
-            partial(model_runner.ragged_decode_forward, self.cfg))
-        self._prefill_fn = jax.jit(
-            partial(model_runner.ragged_prefill_forward, self.cfg))
+        # per-token context gather). On any multi-device mesh the kernel
+        # runs inside a shard_map — manual over tp (q heads / KV heads
+        # co-sharded; needs tp | kv_heads for the GQA grouping), other
+        # axes replicated. Pallas can't run under plain GSPMD, so a bare
+        # multi-chip mesh without the wrap is NOT a kernel-path config.
+        axes = {} if self.mesh is None else dict(self.mesh.shape)
+        self._tp = axes.get("tp", 1)
+        single = self.mesh is None or all(v == 1 for v in axes.values())
+        # v1's constructor (run above) already raised unless tp divides
+        # both head counts, which is exactly the GQA co-sharding the
+        # shard_map wrap needs — every constructible config runs the
+        # kernel path. The flag stays as a manual escape hatch (tests
+        # flip it to compare against the gather path).
+        self._use_paged_kernel = True
+        kernel_mesh = None if single else self.mesh
+        self._decode_fn = jax.jit(partial(
+            model_runner.ragged_decode_forward, self.cfg,
+            mesh=kernel_mesh))
+        self._prefill_fn = jax.jit(partial(
+            model_runner.ragged_prefill_forward, self.cfg,
+            mesh=kernel_mesh))
         log_dist(
             f"InferenceEngineV2: kv_blocks={kv_blocks}x{kv_block_size} "
             f"budget={max_tokens_per_step}tok/{max_seqs_per_step}seq",
@@ -195,7 +208,8 @@ class InferenceEngineV2:
         # kernel scratch is (Tq*num_heads) rows of (2*128 + head_dim) fp32
         # VMEM; keep it well under the ~16MB/core budget or the Mosaic
         # compile fails at serve time (gather path has no such limit)
-        scratch_bytes = (tq * self.cfg.num_heads
+        # per-shard head count under the tp shard_map
+        scratch_bytes = (tq * (self.cfg.num_heads // self._tp)
                          * (256 + self.cfg.head_dim) * 4)
         if scratch_bytes > 4 * 1024 * 1024:
             return None

@@ -248,10 +248,61 @@ def ragged_forward(cfg: TransformerConfig, params, kv_data: jax.Array,
 # ---------------------------------------------------------------------------
 
 
+
+
+def _tp_shard_map(kernel, mesh, q_spec, n_extra: int):
+    """Wrap a Pallas paged-attention kernel for a multi-device mesh.
+
+    Pallas calls can't run under plain GSPMD partitioning; shard_map
+    makes the mesh manual so each shard runs the kernel on its local
+    heads: q sharded on num_heads over tp, the KV pool sharded on
+    kv_heads over tp (contiguous GQA grouping keeps q-head i's kv head
+    on the same shard whenever tp divides kv_heads — the engine gates
+    on that), metadata replicated. Axes other than tp are unmentioned =
+    replicated (the default inference mesh absorbs spare chips into dp).
+    Reference: the TP-sharded ragged kernels of inference/v2
+    (kernels/ragged_ops + TP sharding).
+    """
+    from jax.sharding import PartitionSpec as PS
+
+    kv_spec = PS(None, None, None, "tp", None)
+    in_specs = (q_spec, kv_spec) + (PS(),) * n_extra
+    return jax.shard_map(kernel, mesh=mesh, in_specs=in_specs,
+                         out_specs=q_spec, check_vma=False)
+
+
+def _paged_decode(mesh, q, kv_layer, block_table, context_lens):
+    from deepspeed_tpu.ops.pallas.paged_attention import \
+        paged_decode_attention
+
+    if mesh is None:
+        return paged_decode_attention(q, kv_layer, block_table,
+                                      context_lens)
+    from jax.sharding import PartitionSpec as PS
+
+    fn = _tp_shard_map(paged_decode_attention, mesh,
+                       PS(None, "tp", None), 2)
+    return fn(q, kv_layer, block_table, context_lens)
+
+
+def _paged_prefill(mesh, q, kv_layer, block_table, seg_pos0, ctx_lens):
+    from deepspeed_tpu.ops.pallas.paged_attention import \
+        paged_prefill_attention
+
+    if mesh is None:
+        return paged_prefill_attention(q, kv_layer, block_table,
+                                       seg_pos0, ctx_lens)
+    from jax.sharding import PartitionSpec as PS
+
+    fn = _tp_shard_map(paged_prefill_attention, mesh,
+                       PS(None, None, "tp", None), 3)
+    return fn(q, kv_layer, block_table, seg_pos0, ctx_lens)
+
+
 def ragged_prefill_forward(cfg: TransformerConfig, params,
                            kv_data: jax.Array, seg_tokens: jax.Array,
                            seg_pos0: jax.Array, seg_nreal: jax.Array,
-                           block_table: jax.Array
+                           block_table: jax.Array, *, mesh=None
                            ) -> Tuple[jax.Array, jax.Array]:
     """Prefill chunks, one segment per sequence slot.
 
@@ -264,9 +315,6 @@ def ragged_prefill_forward(cfg: TransformerConfig, params,
     seg_tokens [S, Tq] int32; seg_pos0/seg_nreal [S]; block_table [S, Bm]
     Returns (logits [S, Tq, V] fp32, kv_data').
     """
-    from deepspeed_tpu.ops.pallas.paged_attention import \
-        paged_prefill_attention
-
     S, Tq = seg_tokens.shape
     bs = kv_data.shape[2]
     dt = effective_dtype(cfg.dtype)
@@ -291,8 +339,8 @@ def ragged_prefill_forward(cfg: TransformerConfig, params,
         q, k, v = _qkv(cfg, layer_params, y, pos)  # q [S,Tq,nh,hd]
         kv_layer = kv_layer.at[page, offset, 0].set(k.astype(kv_layer.dtype))
         kv_layer = kv_layer.at[page, offset, 1].set(v.astype(kv_layer.dtype))
-        attn = paged_prefill_attention(q.astype(dt), kv_layer, block_table,
-                                       seg_pos0, ctx_lens)
+        attn = _paged_prefill(mesh, q.astype(dt), kv_layer, block_table,
+                              seg_pos0, ctx_lens)
         attn = jnp.einsum("stnd,ndh->sth", attn.astype(dt),
                           layer_params["attn"]["wo"].astype(dt))
         if cfg.use_biases:
@@ -314,8 +362,8 @@ def ragged_prefill_forward(cfg: TransformerConfig, params,
 
 def ragged_decode_forward(cfg: TransformerConfig, params, kv_data: jax.Array,
                           token_ids: jax.Array, token_pos: jax.Array,
-                          block_table: jax.Array, context_lens: jax.Array
-                          ) -> Tuple[jax.Array, jax.Array]:
+                          block_table: jax.Array, context_lens: jax.Array,
+                          *, mesh=None) -> Tuple[jax.Array, jax.Array]:
     """One decode step: exactly one new token per live slot.
 
     Reference: the blocked-flash decode kernels of inference/v2
@@ -331,8 +379,6 @@ def ragged_decode_forward(cfg: TransformerConfig, params, kv_data: jax.Array,
 
     Returns (logits [S, V] fp32, kv_data').
     """
-    from deepspeed_tpu.ops.pallas.paged_attention import paged_decode_attention
-
     S = token_ids.shape[0]
     bs = kv_data.shape[2]
     dt = effective_dtype(cfg.dtype)
@@ -353,8 +399,8 @@ def ragged_decode_forward(cfg: TransformerConfig, params, kv_data: jax.Array,
         q, k, v = _qkv(cfg, layer_params, y, token_pos)  # q [S,nh,hd]
         kv_layer = kv_layer.at[page, offset, 0].set(k.astype(kv_layer.dtype))
         kv_layer = kv_layer.at[page, offset, 1].set(v.astype(kv_layer.dtype))
-        attn = paged_decode_attention(q.astype(dt), kv_layer, block_table,
-                                      context_lens)
+        attn = _paged_decode(mesh, q.astype(dt), kv_layer, block_table,
+                             context_lens)
         attn = jnp.einsum("snd,ndh->sh", attn.astype(dt),
                           layer_params["attn"]["wo"].astype(dt))
         if cfg.use_biases:

@@ -229,3 +229,100 @@ class TestInferenceEngineV2:
         v2.step()  # prefill allocates 2 of the 3 blocks
         assert v2.kv_cache.free_blocks == 1
         assert not v2.can_schedule(8)  # needs 2 blocks, only 1 free
+
+
+class TestV2UnderTP:
+    """VERDICT r1 #7: TP-sharded v2 serving must keep the Pallas paged
+    kernels (shard_map over tp) instead of falling back to the gather
+    path. Reference: TP sharding of the ragged kernels
+    (inference/v2/kernels/ragged_ops/)."""
+
+    def _make(self, tiny, mesh=None, **kw):
+        from deepspeed_tpu.inference import InferenceEngineV2
+
+        model, params = tiny
+        kw.setdefault("kv_blocks", 64)
+        kw.setdefault("kv_block_size", 8)
+        kw.setdefault("max_tokens_per_step", 32)
+        kw.setdefault("max_seqs_per_step", 4)
+        kw.setdefault("max_blocks_per_seq", 8)
+        return InferenceEngineV2(model, params=params, mesh=mesh,
+                                 dtype=jnp.float32, **kw)
+
+    def test_tp_serve_uses_kernel_and_matches(self, tiny, mesh_2x4):
+        prompts = {1: [5, 9, 2, 14, 7], 2: [3, 1, 4], 3: [2] * 17}
+
+        def run(mesh):
+            from deepspeed_tpu.parallel import topology as topo
+
+            topo._GLOBAL_MESH = None
+            v2 = self._make(tiny, mesh=mesh)
+            assert v2._use_paged_kernel, "kernel path must stay on for tp"
+            v2.put(list(prompts), [np.asarray(p) for p in prompts.values()],
+                   max_new_tokens=5)
+            return v2.generate_all()
+
+        assert run(mesh_2x4) == run(None)
+
+    def test_dp_replicated_mesh_serves_through_kernel(self, tiny, devices):
+        """The default inference mesh absorbs all chips into dp; the
+        kernel must run via shard_map there too (ADVICE r1: a bare
+        multi-device GSPMD mesh is not a supported Pallas config)."""
+        from deepspeed_tpu.parallel.topology import TopologyConfig, build_mesh
+
+        mesh = build_mesh(TopologyConfig(dp=-1))
+        prompts = {7: [4, 8, 15, 16], 9: [23, 42]}
+
+        from deepspeed_tpu.parallel import topology as topo
+
+        topo._GLOBAL_MESH = None
+        v2 = self._make(tiny, mesh=mesh)
+        assert v2._use_paged_kernel
+        v2.put(list(prompts), [np.asarray(p) for p in prompts.values()],
+               max_new_tokens=4)
+        got = v2.generate_all()
+
+        topo._GLOBAL_MESH = None
+        ref = self._make(tiny)
+        ref.put(list(prompts), [np.asarray(p) for p in prompts.values()],
+                max_new_tokens=4)
+        assert got == ref.generate_all()
+
+    def test_gqa_tp_serve_matches(self, devices):
+        """GQA under tp: q-head/kv-head co-sharding alignment (group
+        size 2) — the case a mis-aligned kv spec would corrupt while
+        MHA tests stay green."""
+        from deepspeed_tpu.models.zoo import get_model
+        from deepspeed_tpu.parallel import topology as topo
+        from deepspeed_tpu.parallel.topology import TopologyConfig, build_mesh
+
+        model = get_model("tiny", num_kv_heads=2, dtype=jnp.float32,
+                          param_dtype=jnp.float32)
+        params = model.init(jax.random.PRNGKey(1))
+        prompts = {1: [5, 9, 2, 14, 7], 2: [3, 1, 4]}
+
+        def run(mesh):
+            topo._GLOBAL_MESH = None
+            v2 = self._make((model, params), mesh=mesh)
+            assert v2._use_paged_kernel
+            v2.put(list(prompts), [np.asarray(p) for p in prompts.values()],
+                   max_new_tokens=5)
+            return v2.generate_all()
+
+        tp_mesh = build_mesh(TopologyConfig(dp=4, tp=2))
+        assert run(tp_mesh) == run(None)
+
+    def test_indivisible_kv_heads_raise_clearly(self, devices):
+        """tp that does not divide the head counts cannot co-shard the
+        GQA grouping; the engine must say so, not die in device_put."""
+        from deepspeed_tpu.models.zoo import get_model
+        from deepspeed_tpu.parallel import topology as topo
+        from deepspeed_tpu.parallel.topology import TopologyConfig, build_mesh
+
+        model = get_model("tiny", num_kv_heads=1, dtype=jnp.float32,
+                          param_dtype=jnp.float32)
+        params = model.init(jax.random.PRNGKey(0))
+        topo._GLOBAL_MESH = None
+        mesh = build_mesh(TopologyConfig(dp=4, tp=2))
+        with pytest.raises(ValueError, match="does not divide"):
+            self._make((model, params), mesh=mesh)
