@@ -135,8 +135,15 @@ class ZenFlowBlockConfig(ConfigModel):
     update_interval: int = 4
     select_interval: int = 16
     overlap_step: bool = True
+    # host-optimizer worker parallelism (reference SuperOffload runs a
+    # CPU optimizer worker process, superoffload_utils.py:165; threads
+    # suffice here — the native optimizer releases the GIL)
+    workers: int = 1
 
     def validate(self) -> None:
+        if self.workers < 1:
+            raise ValueError(f"zenflow.workers must be >= 1, got "
+                             f"{self.workers}")
         if not 0.0 < self.topk_ratio <= 1.0:
             raise ValueError(
                 f"zenflow.topk_ratio must be in (0, 1], got "

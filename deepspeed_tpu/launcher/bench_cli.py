@@ -124,7 +124,8 @@ def bench_main(argv=None):
 
 def bench_io(path: str, size_mb: int = 256, block_sizes=(1, 8, 16),
              queue_depths=(4, 16, 32), read: bool = True,
-             write: bool = True, out=print) -> List[dict]:
+             write: bool = True, backends=("threads", "auto"),
+             out=print) -> List[dict]:
     import numpy as np
 
     from deepspeed_tpu.ops.native.aio import (AsyncIOHandle,
@@ -148,7 +149,8 @@ def bench_io(path: str, size_mb: int = 256, block_sizes=(1, 8, 16),
         data = np.random.default_rng(0).integers(
             0, 255, size_mb * 1024 * 1024, dtype=np.uint8)
     results = []
-    for bs_mult in block_sizes:
+    for backend in backends:
+      for bs_mult in block_sizes:
         for qd in queue_depths:
             # pin every knob: a stale tuned config must not parameterize
             # the benchmark that tuned configs are derived from
@@ -156,12 +158,14 @@ def bench_io(path: str, size_mb: int = 256, block_sizes=(1, 8, 16),
 
             handle = AsyncIOHandle(block_size=bs_mult * DEFAULT_BLOCK_SIZE,
                                    queue_depth=qd,
-                                   num_threads=DEFAULT_THREADS)
+                                   num_threads=DEFAULT_THREADS,
+                                   backend=backend)
             if write:
                 t0 = time.perf_counter()
                 handle.pwrite(data, path)
                 dt = time.perf_counter() - t0
                 rec = {"op": "write", "size_mb": size_mb,
+                       "backend": handle.backend,
                        "block_kb": bs_mult * DEFAULT_BLOCK_SIZE // 1024,
                        "queue_depth": qd,
                        "gbps": round(data.nbytes / dt / 1e9, 3)}
@@ -173,6 +177,7 @@ def bench_io(path: str, size_mb: int = 256, block_sizes=(1, 8, 16),
                 handle.pread(buf, path)
                 dt = time.perf_counter() - t0
                 rec = {"op": "read", "size_mb": size_mb,
+                       "backend": handle.backend,
                        "block_kb": bs_mult * DEFAULT_BLOCK_SIZE // 1024,
                        "queue_depth": qd,
                        "gbps": round(data.nbytes / dt / 1e9, 3)}

@@ -104,23 +104,42 @@ class AsyncIOHandle:
     number of failed requests (0 == success).
     """
 
+    BACKENDS = {"auto": 0, "threads": 1, "uring": 2}
+
     def __init__(self, block_size: Optional[int] = None,
                  queue_depth: Optional[int] = None,
-                 num_threads: Optional[int] = None):
+                 num_threads: Optional[int] = None,
+                 backend: Optional[str] = None):
         if None in (block_size, queue_depth, num_threads):
             tuned = tuned_aio_defaults()
             block_size = block_size or tuned["block_size"]
             queue_depth = queue_depth or tuned["queue_depth"]
             num_threads = num_threads or tuned["num_threads"]
+        backend = backend or os.environ.get("DSTPU_AIO_BACKEND", "auto")
+        if backend not in self.BACKENDS:
+            raise ValueError(f"backend must be one of {set(self.BACKENDS)}, "
+                             f"got {backend!r}")
         self.block_size = block_size
         self.queue_depth = queue_depth
         self.num_threads = num_threads
         self._lib = build_native_lib()
         if self._lib is not None:
-            self._h = self._lib.dstpu_aio_create(block_size, queue_depth,
-                                                 num_threads)
+            # io_uring (DeepNVMe-class queue depth) when available;
+            # create2 falls back to the thread pool inside the library
+            self._h = self._lib.dstpu_aio_create2(
+                block_size, queue_depth, num_threads,
+                self.BACKENDS[backend])
+            if not self._h:
+                raise IOError(
+                    f"aio backend {backend!r} unavailable on this host "
+                    "(io_uring_setup refused — seccomp'd container or "
+                    "old kernel); use backend='auto' for the fallback")
             self._pool = None
         else:
+            if backend == "uring":
+                raise IOError(
+                    "aio backend 'uring' needs the native library, which "
+                    "failed to build on this host; use backend='auto'")
             self._h = None
             self._pool = _fut.ThreadPoolExecutor(max_workers=num_threads)
         self._futures: List[_fut.Future] = []
@@ -183,6 +202,14 @@ class AsyncIOHandle:
         errs = self.wait()
         if errs:
             raise IOError(f"aio write of {path} failed ({errs} errors)")
+
+    @property
+    def backend(self) -> str:
+        """Resolved backend: "uring" | "threads" | "python"."""
+        if self._h is None:
+            return "python"
+        return "uring" if self._lib.dstpu_aio_backend(self._h) == 2 \
+            else "threads"
 
     # -- stats -------------------------------------------------------------
     def bytes_read(self) -> int:

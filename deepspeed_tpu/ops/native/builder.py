@@ -120,6 +120,10 @@ def _declare(lib: ctypes.CDLL) -> None:
 
     lib.dstpu_aio_create.restype = vp
     lib.dstpu_aio_create.argtypes = [i32, i32, i32]
+    lib.dstpu_aio_create2.restype = vp
+    lib.dstpu_aio_create2.argtypes = [i32, i32, i32, i32]
+    lib.dstpu_aio_backend.restype = i32
+    lib.dstpu_aio_backend.argtypes = [vp]
     lib.dstpu_aio_destroy.argtypes = [vp]
     for name in ("dstpu_aio_pread", "dstpu_aio_sync_pread"):
         fn = getattr(lib, name)

@@ -797,6 +797,7 @@ class Engine:
             topk_ratio=zf.topk_ratio, update_interval=zf.update_interval,
             select_interval=zf.select_interval,
             overlap_step=zf.overlap_step,
+            workers=getattr(zf, "workers", 1),
             betas=tuple(p.get("betas", (0.9, 0.999))),
             eps=p.get("eps", 1e-8),
             weight_decay=p.get("weight_decay", 0.0))

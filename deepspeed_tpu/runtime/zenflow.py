@@ -46,6 +46,7 @@ class ZenFlowConfig:
     update_interval: int = 4
     select_interval: int = 16  # re-pick important coords every N steps
     overlap_step: bool = True  # async host pass (False = blocking)
+    workers: int = 1  # threads splitting the host pass across leaves
     betas: Tuple[float, float] = (0.9, 0.999)
     eps: float = 1e-8
     weight_decay: float = 0.0
@@ -113,8 +114,11 @@ class ZenFlowOptimizer:
         self._sizes = [int(np.prod(s)) for s in self._shapes]
         self._ks = [max(1, int(np.ceil(self.cfg.topk_ratio * n)))
                     for n in self._sizes]
-        # host fp32 masters + native CPU Adam per leaf
-        self._masters = [np.asarray(x, np.float32).reshape(-1)
+        # host fp32 masters + native CPU Adam per leaf. Explicit copies:
+        # on CPU backends np.asarray(jax_array) can ALIAS the device
+        # buffer, and the host optimizer mutates masters in place — an
+        # aliased master would corrupt the caller's (immutable) params.
+        self._masters = [np.array(x, np.float32).reshape(-1)
                          for x in leaves]
         self._host_opts = [CPUAdam(n, lr=self.lr, betas=self.cfg.betas,
                                    eps=self.cfg.eps,
@@ -127,6 +131,7 @@ class ZenFlowOptimizer:
         self._v = [jnp.zeros(k, jnp.float32) for k in self._ks]
         self._sel_step = [0] * len(self._ks)
         self._worker = _AsyncWorker()
+        self._host_pool = None  # lazy N-worker pool (cfg.workers > 1)
         self._pending_upload: Optional[List[np.ndarray]] = None
         # every coordinate selected since the last fold-in: their grads
         # never reach the host (zeroed at shipment for the current
@@ -189,11 +194,25 @@ class ZenFlowOptimizer:
     # -- host pass -------------------------------------------------------
     def _host_pass(self, host_grads: List[np.ndarray], lr: float,
                    denom: float) -> List[np.ndarray]:
-        out = []
-        for i, hg in enumerate(host_grads):
+        """One host optimizer pass over all leaves. With workers > 1 the
+        leaves split across a thread pool (SuperOffload's N-worker host
+        optimizer, superoffload_utils.py:165 — worker *threads* here:
+        the native CPUAdam releases the GIL, so threads scale across
+        cores without the reference's process plumbing)."""
+        def one(i, hg):
             self._host_opts[i].step(self._masters[i], hg / denom, lr=lr)
-            out.append(self._masters[i].copy())
-        return out
+            return self._masters[i].copy()
+
+        if self.cfg.workers <= 1 or len(host_grads) <= 1:
+            return [one(i, hg) for i, hg in enumerate(host_grads)]
+        if self._host_pool is None:  # one pool for the whole run
+            import concurrent.futures as _fut
+
+            self._host_pool = _fut.ThreadPoolExecutor(
+                max_workers=self.cfg.workers,
+                thread_name_prefix="zenflow-host")
+        return list(self._host_pool.map(one, range(len(host_grads)),
+                                        host_grads))
 
     # -- main ------------------------------------------------------------
     def step(self, grads, params, lr: Optional[float] = None):
@@ -224,7 +243,7 @@ class ZenFlowOptimizer:
                     keep = jnp.concatenate([keep, self._protected[i]])
                 dev_flat = pl_.reshape(-1).astype(jnp.float32)
                 flat = flat.at[keep].set(dev_flat[keep])
-                self._masters[i] = np.asarray(flat)
+                self._masters[i] = np.array(flat)  # copy: host opt mutates
                 self._protected[i] = None
                 self._updated_since_foldin[i] = False
                 new_leaves.append(
@@ -273,6 +292,18 @@ class ZenFlowOptimizer:
             self._pending_upload = done
         return self._pending_upload is not None
 
+    def close(self):
+        """Shut the worker pool down (idempotent; gc-safe)."""
+        if self._host_pool is not None:
+            self._host_pool.shutdown(wait=True)
+            self._host_pool = None
+
+    def __del__(self):  # pragma: no cover - gc timing
+        try:
+            self.close()
+        except Exception:
+            pass
+
     def state_dict(self) -> Dict[str, Any]:
         # never snapshot mid-host-pass: the worker mutates masters and
         # CPUAdam moments in place (a torn copy would restore garbage)
@@ -300,7 +331,7 @@ class ZenFlowOptimizer:
 
     def load_state_dict(self, sd: Dict[str, Any]):
         self.steps = int(sd["steps"])
-        self._masters = [np.asarray(m, np.float32) for m in sd["masters"]]
+        self._masters = [np.array(m, np.float32) for m in sd["masters"]]
         for o, os_ in zip(self._host_opts, sd["host_opt"]):
             o.load_state_dict(os_)
         self._idx = [jnp.asarray(i) for i in sd["idx"]]

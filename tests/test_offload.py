@@ -459,3 +459,43 @@ def test_param_offload_moe_model(devices):
     losses = [float(engine.train_batch(it)) for _ in range(6)]
     assert losses[-1] < losses[0] - 0.2, losses
     assert _layer_memory_kinds(engine.params) == {"pinned_host"}
+
+
+@pytest.mark.parametrize("backend", ["threads", "auto"])
+def test_aio_backend_roundtrip(tmp_path, backend):
+    """io_uring backend (DeepNVMe parity: csrc/aio io_uring queue depth)
+    round-trips bit-exactly and reports which backend engaged; 'auto'
+    prefers io_uring and falls back to threads where unavailable."""
+    h = AsyncIOHandle(block_size=1 << 14, queue_depth=16, num_threads=2,
+                      backend=backend)
+    assert h.backend in ("threads", "uring", "python")
+    if backend == "threads" and h.backend != "python":
+        assert h.backend == "threads"
+    rng = np.random.default_rng(3)
+    arrs = [rng.standard_normal(4097).astype(np.float32) for _ in range(4)]
+    paths = [str(tmp_path / f"u{i}.bin") for i in range(4)]
+    for a, p in zip(arrs, paths):
+        h.async_pwrite(a, p)
+    assert h.wait() == 0
+    outs = [np.empty_like(a) for a in arrs]
+    for o, p in zip(outs, paths):
+        h.async_pread(o, p)
+    assert h.wait() == 0
+    for a, o in zip(arrs, outs):
+        np.testing.assert_array_equal(a, o)
+    assert h.bytes_written() == sum(a.nbytes for a in arrs)
+    h.close()
+
+
+def test_aio_uring_strict_or_skip(tmp_path):
+    try:
+        h = AsyncIOHandle(block_size=4096, backend="uring")
+    except IOError:
+        pytest.skip("io_uring unavailable in this kernel/container")
+    assert h.backend == "uring"
+    a = np.arange(9999, dtype=np.float32)
+    h.pwrite(a, str(tmp_path / "s.bin"))
+    b = np.zeros_like(a)
+    h.pread(b, str(tmp_path / "s.bin"))
+    np.testing.assert_array_equal(a, b)
+    h.close()

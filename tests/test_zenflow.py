@@ -226,3 +226,34 @@ def test_engine_zenflow_applies_grad_clipping(devices):
     run(0.5)
     assert captured[0.0] > 0.5  # unclipped norm exceeds the threshold
     np.testing.assert_allclose(captured[0.5], 0.5, rtol=1e-3)
+
+
+def test_host_pass_workers_match_serial(devices):
+    """SuperOffload-style N-worker host pass must be numerically
+    identical to the serial pass (leaves are independent)."""
+    from deepspeed_tpu.runtime.zenflow import ZenFlowConfig, ZenFlowOptimizer
+
+    rng = np.random.default_rng(0)
+    params = {"a": jnp.asarray(rng.standard_normal((64, 8)), jnp.float32),
+              "b": jnp.asarray(rng.standard_normal(256), jnp.float32),
+              "c": jnp.asarray(rng.standard_normal((16, 16)), jnp.float32)}
+
+    def run(workers):
+        cfg = ZenFlowConfig(topk_ratio=0.05, update_interval=2,
+                            select_interval=4, overlap_step=False,
+                            workers=workers)
+        opt = ZenFlowOptimizer(params, cfg, lr=1e-2)
+        p = dict(params)
+        for s in range(6):
+            g = jax.tree.map(
+                lambda x: jnp.asarray(
+                    np.random.default_rng(100 + s).standard_normal(x.shape),
+                    jnp.float32), p)
+            p = opt.step(g, p)
+        opt.finalize()
+        return p
+
+    p1, p3 = run(1), run(3)
+    for k in params:
+        np.testing.assert_allclose(np.asarray(p3[k]), np.asarray(p1[k]),
+                                   rtol=1e-6)
