@@ -95,6 +95,43 @@ def main():
             overrides.update(num_kv_heads=4, ffn_size=512)
     model = get_model(model_name, **overrides)
 
+    # BENCH_AUTOTUNE=1: let the autotuner pick micro batch + remat policy
+    # (reference: the CLI launches Autotuner.tune() before real training,
+    # launcher/runner.py:407). The chosen settings land in the JSON line.
+    config_source = "measured-defaults"
+    if int(os.environ.get("BENCH_AUTOTUNE", "0")) and on_tpu:
+        from deepspeed_tpu.autotuning.autotuner import Autotuner
+
+        def model_factory():
+            return get_model(model_name, **overrides)
+
+        vocab = model.config.vocab_size
+
+        def batch_fn(global_batch):
+            rng_ = np.random.default_rng(0)
+            return {"input_ids": rng_.integers(
+                0, vocab, (global_batch, seq + 1)).astype(np.int32)}
+
+        space = {
+            "micro_batch_sizes": [micro // 2, micro, micro + micro // 2],
+            "zero_stages": [3 if llama_headline else 0],
+            "remat": [True],
+            "remat_policies": ["nothing_saveable", "save_attn_out"],
+        }
+        tuner = Autotuner(model_factory, {
+            "gradient_accumulation_steps": 1,
+            "optimizer": {"type": "adamw",
+                          "params": {"lr": 1e-4, "weight_decay": 0.1}},
+            "bf16": {"enabled": True}, "steps_per_print": 1_000_000,
+        }, batch_fn, tuning_space=space)
+        best = tuner.tune(top_k=3, measure_steps=3)
+        if best is not None:
+            micro = int(best["train_micro_batch_size_per_chip"])
+            policy = best.get("_remat_policy", policy)
+            overrides["remat_policy"] = policy
+            model = get_model(model_name, **overrides)
+            config_source = "autotuner"
+
     zero_stage_default = 3 if llama_headline else (1 if n_chips > 1 else 0)
     config = {
         "train_micro_batch_size_per_chip": micro,
@@ -167,6 +204,8 @@ def main():
         "unit": "tokens/s/chip",
         "vs_baseline": round(vs_baseline, 3),
         "mfu": round(mfu, 4),
+        "config_source": config_source,
+        "remat_policy": overrides.get("remat_policy", policy),
         "loss": round(float(loss), 4),
         "chips": n_chips,
     }))

@@ -72,6 +72,9 @@ class Autotuner:
                                                 [1, 2, 4, 8]))
         self.zero_stages = list(space.get("zero_stages", [1, 2, 3]))
         self.remat = list(space.get("remat", [False]))
+        # named remat policies (activation_checkpointing registry);
+        # None = keep the model's own policy
+        self.remat_policies = list(space.get("remat_policies", [None]))
         self.hbm_budget = hbm_budget_bytes or self._detect_hbm()
         self.results_dir = results_dir
         self.results: List[AutotunerResult] = []
@@ -83,21 +86,28 @@ class Autotuner:
         try:
             stats = jax.local_devices()[0].memory_stats()
             if stats and "bytes_limit" in stats:
-                return int(stats["bytes_limit"])
+                # leave scheduler/workspace headroom: a candidate whose
+                # compiled peak grazes the limit OOMs at steady state
+                return int(stats["bytes_limit"] * 0.97)
         except Exception:
             pass
-        return 16 * 1024**3
+        # memory_stats() is unavailable on some backends (axon tunnel):
+        # assume a 16GB-class chip minus headroom, not the full 16GiB
+        return int(15.75 * 1024**3 * 0.95)
 
     # -- candidate enumeration (reference tune_space) -------------------
     def candidates(self) -> List[Dict[str, Any]]:
         out = []
-        for mb, stage, remat in itertools.product(
-                self.micro_batch_sizes, self.zero_stages, self.remat):
+        for mb, stage, remat, policy in itertools.product(
+                self.micro_batch_sizes, self.zero_stages, self.remat,
+                self.remat_policies):
             cfg = json.loads(json.dumps(self.base_config))  # deep copy
             cfg["train_micro_batch_size_per_chip"] = int(mb)
             cfg.pop("train_batch_size", None)  # re-derived from micro×gas×dp
             cfg.setdefault("zero_optimization", {})["stage"] = int(stage)
             cfg["_remat"] = bool(remat)
+            if policy is not None:
+                cfg["_remat_policy"] = str(policy)
             out.append(cfg)
         return out
 
@@ -107,13 +117,22 @@ class Autotuner:
 
         cfg = dict(cfg)
         remat = cfg.pop("_remat", False)
+        policy = cfg.pop("_remat_policy", None)
         model = self.model_factory()
         if hasattr(model, "config") and hasattr(model.config, "remat"):
             # set BOTH ways: models default remat=True, so a remat=False
             # candidate must actually disable it or the sweep is a no-op
             import dataclasses as _dc
 
-            model.config = _dc.replace(model.config, remat=bool(remat))
+            if policy is not None and not remat:
+                # a named policy implies remat; record it honestly so the
+                # results file doesn't claim a remat=False run rematted
+                remat = True
+                cfg["_remat"] = True
+            updates = {"remat": bool(remat)}
+            if policy is not None:
+                updates["remat_policy"] = policy
+            model.config = _dc.replace(model.config, **updates)
         engine, *_ = dstpu.initialize(model=model, config=cfg)
         return engine
 

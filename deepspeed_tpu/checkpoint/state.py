@@ -271,6 +271,17 @@ class CheckpointIO:
             with open(meta_path) as f:
                 meta = json.load(f)
         self._validate_tag(meta, tag)
+        # multi-host: every process must be restoring the SAME checkpoint
+        # (a skewed shared-filesystem view or per-host load_dir typo
+        # otherwise desynchronizes training silently — reference
+        # _checkpoint_tag_validation engine.py:4540 +
+        # assert_ints_same_as_other_ranks)
+        from deepspeed_tpu import comm as _comm
+
+        _comm.assert_same_across_processes(
+            "checkpoint_load",
+            [str(tag), int(meta.get("global_steps", -1)),
+             int(load_optimizer_states)])
 
         abstract = self._abstract_state()
         state_path = os.path.join(ckpt_dir, STATE_DIR)
@@ -341,6 +352,15 @@ class CheckpointIO:
 
             zf_path = os.path.join(
                 ckpt_dir, f"zenflow_rank{jax.process_index()}.npy")
+            if load_optimizer_states and not os.path.exists(zf_path):
+                # ADVICE r1: the user asked for optimizer state — a
+                # silent rebuild (fresh moments, bf16-rounded masters)
+                # is a degraded resume; fail like the offload branch
+                raise FileNotFoundError(
+                    f"zenflow optimizer state missing: {zf_path}. Pass "
+                    "load_optimizer_states=False to knowingly re-seed "
+                    "fresh importance-split state from the restored "
+                    "params")
             if load_optimizer_states and os.path.exists(zf_path):
                 e._zenflow.load_state_dict(
                     np.load(zf_path, allow_pickle=True).item())

@@ -35,6 +35,7 @@ from deepspeed_tpu.utils.logging import log_dist, logger
 __all__ = [
     "init_distributed", "is_initialized", "get_world_size", "get_rank",
     "get_local_rank", "get_process_count", "barrier",
+    "assert_same_across_processes",
     "has_all_gather_into_tensor", "has_reduce_scatter_tensor",
     "has_coalescing_manager", "all_reduce", "all_gather", "reduce_scatter",
     "all_to_all", "ppermute", "broadcast", "axis_index", "axis_size",
@@ -129,6 +130,42 @@ def barrier(group: Any = None) -> None:
         from jax.experimental import multihost_utils
 
         multihost_utils.sync_global_devices("deepspeed_tpu.barrier")
+
+
+def assert_same_across_processes(name: str, values) -> None:
+    """Fail loudly when a config-critical value diverges across hosts.
+
+    Reference: ``assert_ints_same_as_other_ranks`` (runtime/zero/
+    utils.py:106) and AutoEP's cross-rank payload digests
+    (moe/ep_tp_dispatch.py:99) — multi-host divergence (mismatched
+    configs, different checkpoints, skewed data pipelines) otherwise
+    corrupts training silently. ``values`` is a scalar/sequence of ints
+    (strings hash to ints); no-op on a single process.
+    """
+    if jax.process_count() <= 1:
+        return
+    import numpy as np
+    from jax.experimental import multihost_utils
+
+    def canon(v):
+        if isinstance(v, str):
+            import zlib
+
+            return zlib.crc32(v.encode())
+        return int(v)
+
+    if isinstance(values, (list, tuple)):
+        local = np.asarray([canon(v) for v in values], np.int64)
+    else:
+        local = np.asarray([canon(values)], np.int64)
+    gathered = np.asarray(multihost_utils.process_allgather(local))
+    if not (gathered == gathered[0]).all():
+        rows = {i: gathered[i].tolist() for i in range(gathered.shape[0])}
+        raise RuntimeError(
+            f"cross-process consistency check failed for {name!r}: "
+            f"processes disagree — per-process values {rows}. All hosts "
+            "must run identical configs/checkpoints (reference "
+            "assert_ints_same_as_other_ranks, runtime/zero/utils.py:106)")
 
 
 # -- capability probes (reference comm/comm.py:325,629) ---------------------

@@ -91,8 +91,14 @@ def nvme_tune_main(argv=None) -> int:
         os.unlink(scratch)
     except OSError:
         pass
-    results = bench_io(scratch, args.size_mb, args.block_mults,
-                       args.queue_depths, read=True, write=True)
+    try:
+        results = bench_io(scratch, args.size_mb, args.block_mults,
+                           args.queue_depths, read=True, write=True)
+    finally:
+        try:  # ADVICE r1: never leave the sweep's scratch on the NVMe
+            os.unlink(scratch)
+        except OSError:
+            pass
     best = {}
     for op in ("read", "write"):
         rows = [r for r in results if r["op"] == op]

@@ -107,11 +107,11 @@ class UlyssesSPDataLoaderAdapter:
         self.mesh = mesh
         self.sp_axis = sp_axis
         self.seq_dim = seq_dim
-        batch_axes = tuple(a for a in BATCH_AXES
-                           if mesh.shape.get(a, 1) >= 1)
-        spec = [batch_axes] + [None] * 8
-        spec[seq_dim] = sp_axis
-        self._spec = spec
+        # ADVICE r1: .get(a, 1) >= 1 was vacuously true; filter to axes
+        # the mesh actually has so user-supplied meshes without dp/fsdp/
+        # ep don't fail at shard time
+        batch_axes = tuple(a for a in BATCH_AXES if a in mesh.shape)
+        self._batch_axes = batch_axes
 
     def shard(self, batch):
         import jax
@@ -120,10 +120,15 @@ class UlyssesSPDataLoaderAdapter:
 
         def put(x):
             x = np.asarray(x)
+            # rank-aware spec (the old version padded 8 trailing dims):
+            # batch axes on dim 0, sp on the sequence dim, rest unsharded
             if x.ndim <= self.seq_dim:
-                sh = NamedSharding(self.mesh, P(self._spec[0]))
+                sh = NamedSharding(self.mesh, P(self._batch_axes))
             else:
-                sh = NamedSharding(self.mesh, P(*self._spec[:x.ndim]))
+                spec = [None] * x.ndim
+                spec[0] = self._batch_axes
+                spec[self.seq_dim] = self.sp_axis
+                sh = NamedSharding(self.mesh, P(*spec))
             if jax.process_count() > 1:
                 return jax.make_array_from_process_local_data(sh, x)
             return jax.device_put(x, sh)

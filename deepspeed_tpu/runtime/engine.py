@@ -312,6 +312,15 @@ class Engine:
 
         n_params = sum(int(np.prod(l.shape)) for l in jax.tree.leaves(
             jax.eval_shape(lambda: self.params)))
+        # multi-host sanity: every process must have resolved the same
+        # topology/batch/model geometry (reference
+        # assert_ints_same_as_other_ranks at ZeRO init)
+        comm.assert_same_across_processes(
+            "engine_init", [
+                self.micro_batch_size, self.gradient_accumulation_steps,
+                self.train_batch_size, config.zero_optimization.stage,
+                n_params,
+            ] + [f"{a}={s}" for a, s in self.mesh.shape.items()])
         log_dist(
             f"engine ready: {n_params/1e6:.1f}M params, zero_stage="
             f"{config.zero_optimization.stage}, dp={self.dp_world_size}, "
@@ -1015,6 +1024,33 @@ class Engine:
             return [float(self.lr_schedule(self.step_count))]
         return [self._base_lr or 0.0]
 
+    def set_lr(self, lr: float) -> None:
+        """Client lr override (the reference-common
+        ``optimizer.param_groups[0]['lr'] = x`` pattern). The compiled
+        step bakes the lr closure at trace time, so this rebuilds the
+        step functions — recompilation happens on the next call (cheap
+        relative to how rarely clients poke lr mid-run)."""
+        if getattr(self, "_onebit", False) or self._zeropp:
+            raise NotImplementedError(
+                "set_lr: 1-bit/ZeRO++ steps bake lr into their compiled "
+                "collective step; configure lr up front")
+        if self._client_optimizer_present:
+            raise NotImplementedError(
+                "set_lr: the engine cannot re-point a client-supplied "
+                "optax transform's lr; rebuild the transform and engine")
+        self._base_lr = float(lr)
+        if self.lr_schedule is not None:
+            logger.warning("set_lr/param_groups override disables the "
+                           "configured lr schedule")
+            self.lr_schedule = None
+        if self.config.optimizer is not None:
+            self.config.optimizer.params = dict(
+                self.config.optimizer.params or {}, lr=float(lr))
+            # rebuild the optax transform: the old tx closed over the
+            # previous lr (state layout is unchanged — same optimizer)
+            self.tx, _ = get_base_optimizer(self.config.optimizer, None)
+        self._build_step_fns()
+
     # ------------------------------------------------------------------
     # state offload between phases (reference engine.offload_states
     # engine.py:5573 / reload_states — frees HBM for e.g. RLHF
@@ -1174,16 +1210,52 @@ class Engine:
         return out
 
 
+class _LRGroup(dict):
+    """One live param group: reading 'lr' reflects the engine; writing
+    'lr' re-points the compiled step (reference clients mutate
+    ``param_groups[0]['lr']`` and expect it to take effect)."""
+
+    def __init__(self, engine: "Engine"):
+        super().__init__()
+        self._engine = engine
+        self._refresh()
+
+    def _refresh(self):
+        # keep the plain-dict view (get()/items()/copy()) in sync with
+        # the engine so every read path reports the live lr
+        dict.__setitem__(self, "lr", self._engine.get_lr()[0])
+
+    def __getitem__(self, key):
+        if key == "lr":
+            self._refresh()
+        return super().__getitem__(key)
+
+    def get(self, key, default=None):
+        if key == "lr":
+            self._refresh()
+        return super().get(key, default)
+
+    def items(self):
+        self._refresh()
+        return super().items()
+
+    def __setitem__(self, key, value):
+        if key == "lr":
+            self._engine.set_lr(float(value))  # raises before storing
+        super().__setitem__(key, value)
+
+
 class _OptimizerView:
     """Duck-types the bits of a torch optimizer users poke (param_groups
     lr); returned as the 2nd element of initialize()'s tuple."""
 
     def __init__(self, engine: Engine):
         self._engine = engine
+        self._groups = [_LRGroup(engine)]
 
     @property
     def param_groups(self):
-        return [{"lr": self._engine.get_lr()[0]}]
+        return self._groups
 
     @property
     def state(self):

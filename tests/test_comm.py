@@ -109,3 +109,37 @@ def test_capability_probes():
 def test_world_queries():
     assert comm.get_world_size() == 8
     assert comm.get_rank() == 0
+
+
+def test_assert_same_across_processes_single_noop():
+    from deepspeed_tpu import comm
+
+    comm.assert_same_across_processes("x", [1, 2, 3])  # 1 process: no-op
+
+
+def test_assert_same_across_processes_detects_divergence(monkeypatch):
+    """Simulated 2-host divergence must raise with per-process values
+    (reference assert_ints_same_as_other_ranks, runtime/zero/utils.py:106)."""
+    import numpy as np
+
+    from deepspeed_tpu import comm
+    from deepspeed_tpu.comm import comm as comm_mod
+
+    monkeypatch.setattr(comm_mod.jax, "process_count", lambda: 2)
+    # patch the real module attribute (a sys.modules fake is bypassed
+    # once jax.experimental.multihost_utils was imported anywhere)
+    from jax.experimental import multihost_utils as mh
+
+    def diverging(local):
+        other = np.array(local)
+        other[0] += 1  # host 1 disagrees
+        return np.stack([np.asarray(local), other])
+
+    monkeypatch.setattr(mh, "process_allgather", diverging)
+    with pytest.raises(RuntimeError, match="consistency check failed"):
+        comm.assert_same_across_processes("micro_batch", [4, 8])
+
+    monkeypatch.setattr(
+        mh, "process_allgather",
+        lambda local: np.stack([np.asarray(local)] * 2))
+    comm.assert_same_across_processes("micro_batch", [4, "tag-a"])
