@@ -255,33 +255,38 @@ class CheckpointIO:
         load_dir = os.path.abspath(load_dir)
         if tag is None:
             latest = os.path.join(load_dir, LATEST_FILE)
-            if not os.path.exists(latest):
-                logger.warning(f"no '{LATEST_FILE}' file at {load_dir}; "
-                               "nothing loaded")
-                return None, None
-            with open(latest) as f:
-                tag = f.read().strip()
-        ckpt_dir = os.path.join(load_dir, str(tag))
-        if not os.path.isdir(ckpt_dir):
-            raise FileNotFoundError(f"checkpoint not found: {ckpt_dir}")
-
+            if os.path.exists(latest):
+                with open(latest) as f:
+                    tag = f.read().strip()
+        ckpt_dir = os.path.join(load_dir, str(tag)) if tag else ""
+        dir_ok = bool(tag) and os.path.isdir(ckpt_dir)
         meta: Dict[str, Any] = {}
-        meta_path = os.path.join(ckpt_dir, METADATA_FILE)
-        if os.path.exists(meta_path):
-            with open(meta_path) as f:
-                meta = json.load(f)
-        self._validate_tag(meta, tag)
-        # multi-host: every process must be restoring the SAME checkpoint
-        # (a skewed shared-filesystem view or per-host load_dir typo
+        if dir_ok:
+            meta_path = os.path.join(ckpt_dir, METADATA_FILE)
+            if os.path.exists(meta_path):
+                with open(meta_path) as f:
+                    meta = json.load(f)
+        # multi-host: every process must see the SAME checkpoint (a
+        # skewed shared-filesystem view or per-host load_dir typo
         # otherwise desynchronizes training silently — reference
         # _checkpoint_tag_validation engine.py:4540 +
-        # assert_ints_same_as_other_ranks)
+        # assert_ints_same_as_other_ranks). The collective runs BEFORE
+        # any per-host early return/raise, or the disagreeing host
+        # would bail out and leave its peers hung inside it.
         from deepspeed_tpu import comm as _comm
 
         _comm.assert_same_across_processes(
             "checkpoint_load",
-            [str(tag), int(meta.get("global_steps", -1)),
+            [str(tag) if tag else "<missing-latest>", int(dir_ok),
+             int(meta.get("global_steps", -1)),
              int(load_optimizer_states)])
+        if tag is None:
+            logger.warning(f"no '{LATEST_FILE}' file at {load_dir}; "
+                           "nothing loaded")
+            return None, None
+        if not dir_ok:
+            raise FileNotFoundError(f"checkpoint not found: {ckpt_dir}")
+        self._validate_tag(meta, tag)
 
         abstract = self._abstract_state()
         state_path = os.path.join(ckpt_dir, STATE_DIR)
@@ -352,12 +357,18 @@ class CheckpointIO:
 
             zf_path = os.path.join(
                 ckpt_dir, f"zenflow_rank{jax.process_index()}.npy")
-            if load_optimizer_states and not os.path.exists(zf_path):
+            from deepspeed_tpu import comm as _comm
+
+            # per-rank file: agree collectively, then fail on ALL ranks
+            # (one rank raising alone would hang its peers' collectives)
+            if _comm.any_process(load_optimizer_states
+                                 and not os.path.exists(zf_path)):
                 # ADVICE r1: the user asked for optimizer state — a
                 # silent rebuild (fresh moments, bf16-rounded masters)
                 # is a degraded resume; fail like the offload branch
                 raise FileNotFoundError(
-                    f"zenflow optimizer state missing: {zf_path}. Pass "
+                    f"zenflow optimizer state missing on at least one "
+                    f"process (this rank's path: {zf_path}). Pass "
                     "load_optimizer_states=False to knowingly re-seed "
                     "fresh importance-split state from the restored "
                     "params")

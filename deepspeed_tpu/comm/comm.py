@@ -35,7 +35,7 @@ from deepspeed_tpu.utils.logging import log_dist, logger
 __all__ = [
     "init_distributed", "is_initialized", "get_world_size", "get_rank",
     "get_local_rank", "get_process_count", "barrier",
-    "assert_same_across_processes",
+    "assert_same_across_processes", "any_process",
     "has_all_gather_into_tensor", "has_reduce_scatter_tensor",
     "has_coalescing_manager", "all_reduce", "all_gather", "reduce_scatter",
     "all_to_all", "ppermute", "broadcast", "axis_index", "axis_size",
@@ -166,6 +166,21 @@ def assert_same_across_processes(name: str, values) -> None:
             f"processes disagree — per-process values {rows}. All hosts "
             "must run identical configs/checkpoints (reference "
             "assert_ints_same_as_other_ranks, runtime/zero/utils.py:106)")
+
+
+def any_process(value: bool) -> bool:
+    """True when ANY process reports ``value`` truthy (collective; every
+    process must call it — the companion to assert_same_across_processes
+    for per-rank conditions like missing per-rank files, where one rank
+    raising alone would leave its peers hung in the next collective)."""
+    if jax.process_count() <= 1:
+        return bool(value)
+    import numpy as np
+    from jax.experimental import multihost_utils
+
+    gathered = np.asarray(multihost_utils.process_allgather(
+        np.asarray([int(bool(value))], np.int64)))
+    return bool(gathered.any())
 
 
 # -- capability probes (reference comm/comm.py:325,629) ---------------------

@@ -59,9 +59,24 @@ def _copy_tree(tree):
 
 @dataclasses.dataclass
 class _QuantSpec:
-    bits: int
+    bits: int            # target bits
     symmetric: bool
     schedule_offset: int
+    start_bits: int = 0  # 0 = no staging (jump straight to target)
+    period: int = 0      # steps between bit halvings (reference
+    #                      quantization_period staged annealing)
+
+    def active_bits(self, step: int) -> Optional[int]:
+        """Bit width in effect at ``step`` (None = not yet quantizing).
+        Staged schedule (reference compression/basic_layer.py QuantAct /
+        scheduler): start_bits at schedule_offset, halving every
+        ``period`` steps until target ``bits``."""
+        if step < self.schedule_offset:
+            return None
+        if not self.start_bits or not self.period:
+            return self.bits
+        halvings = (step - self.schedule_offset) // self.period
+        return max(self.bits, self.start_bits >> halvings)
 
 
 @dataclasses.dataclass
@@ -116,10 +131,13 @@ def init_compression(params, compression_config: Dict[str, Any],
     for gname, offset, p, modules, shared in _iter_groups(
             cfg.get("weight_quantization", {})):
         bits = int(p.get("target_bits", p.get("start_bits", 8)))
+        start = int(p.get("start_bits", 0))
+        period = int(p.get("quantization_period", 0))
         sym = str(p.get("quantization_type", "symmetric")) == "symmetric"
         for path in flat:
             if _match(path, modules):
-                state.quant[path] = _QuantSpec(bits, sym, offset)
+                state.quant[path] = _QuantSpec(
+                    bits, sym, offset, start_bits=start, period=period)
 
     prune_builders: Dict[str, Callable] = {
         "sparse_pruning": lambda w, p: sparse_pruning_mask(
@@ -200,6 +218,8 @@ def apply_masks(params, state: CompressionState, step: int = 10**12):
     re-masking; called after each optimizer step)."""
     import jax
 
+    if not any(step >= m.schedule_offset for m in state.masks.values()):
+        return params  # nothing active: skip the tree copy
     flat = _flatten(params)
     new = _copy_tree(params)
     for path, spec in state.masks.items():
@@ -210,6 +230,35 @@ def apply_masks(params, state: CompressionState, step: int = 10**12):
         if hasattr(w, "sharding"):
             masked = jax.device_put(masked, w.sharding)
         _set_path(new, path, masked)
+    return new
+
+
+def apply_quantization(params, state: CompressionState,
+                       step: int = 10**12):
+    """QAT-by-projection at the bit width the staged schedule dictates
+    for ``step`` (reference: the compressed forward of basic_layer.py;
+    here compression is a projection after the optimizer step, so the
+    next forward computes with quantized weights while fp32 masters keep
+    full precision)."""
+    import jax
+
+    active = {p: q.active_bits(step) for p, q in state.quant.items()}
+    if not any(b is not None and b < 16 for b in active.values()):
+        return params  # nothing active at this step: skip the tree copy
+    flat = _flatten(params)
+    new = _copy_tree(params)
+    for path, q in state.quant.items():
+        bits = active[path]
+        if bits is None or bits >= 16:
+            continue
+        w = flat[path]
+        if getattr(w, "ndim", 0) < 2:
+            continue
+        fq = fake_quantize(jax.numpy.asarray(w), bits=bits,
+                           symmetric=q.symmetric).astype(w.dtype)
+        if hasattr(w, "sharding"):
+            fq = jax.device_put(fq, w.sharding)
+        _set_path(new, path, fq)
     return new
 
 
@@ -249,10 +298,13 @@ class CompressionScheduler:
         self.state = state
 
     def step(self, engine):
-        if not self.state.masks:
+        if not self.state.masks and not self.state.quant:
             return
-        engine.params = apply_masks(engine.params, self.state,
+        params = apply_masks(engine.params, self.state,
+                             step=engine.global_steps)
+        params = apply_quantization(params, self.state,
                                     step=engine.global_steps)
+        engine.params = params
 
     def attach(self, engine):
         engine.register_post_step_hook(lambda e: self.step(e))

@@ -106,10 +106,13 @@ def profile_compiled(fn: Callable, *args, static_argnums=(),
     try:
         mem = compiled.memory_analysis()
         if mem is not None:
+            # donated inputs alias their outputs — counting both sides
+            # double-books every donated buffer (ZeRO state is donated)
             out["peak_bytes"] = float(
                 getattr(mem, "temp_size_in_bytes", 0)
                 + getattr(mem, "output_size_in_bytes", 0)
-                + getattr(mem, "argument_size_in_bytes", 0))
+                + getattr(mem, "argument_size_in_bytes", 0)
+                - getattr(mem, "alias_size_in_bytes", 0))
     except Exception:  # backend may not implement memory analysis
         pass
     return out

@@ -1043,12 +1043,20 @@ class Engine:
             logger.warning("set_lr/param_groups override disables the "
                            "configured lr schedule")
             self.lr_schedule = None
-        if self.config.optimizer is not None:
-            self.config.optimizer.params = dict(
-                self.config.optimizer.params or {}, lr=float(lr))
-            # rebuild the optax transform: the old tx closed over the
-            # previous lr (state layout is unchanged — same optimizer)
-            self.tx, _ = get_base_optimizer(self.config.optimizer, None)
+        if self.config.optimizer is None:
+            # the engine was built with the default transform — pin the
+            # implied optimizer into the config so the rebuild below
+            # carries the new lr (a skipped rebuild would silently keep
+            # the old lr in the compiled step)
+            from deepspeed_tpu.config.config import OptimizerConfig
+
+            self.config.optimizer = OptimizerConfig(
+                type="adamw", params={"lr": float(lr)})
+        self.config.optimizer.params = dict(
+            self.config.optimizer.params or {}, lr=float(lr))
+        # rebuild the optax transform: the old tx closed over the
+        # previous lr (state layout is unchanged — same optimizer)
+        self.tx, _ = get_base_optimizer(self.config.optimizer, None)
         self._build_step_fns()
 
     # ------------------------------------------------------------------

@@ -75,17 +75,23 @@ def get_base_optimizer(
     elif name == "adafactor":
         tx = optax.adafactor(lr_arg)
     elif name == "muon":
-        # reference runtime/zero/muon/: NS-orthogonalized momentum on 2D
-        # weights, Adam on the rest. The distributed Newton-Schulz
-        # (_apply_distributed_muon_update, stage3.py:1537) is implicit:
-        # NS matmuls run on sharded fp32 masters under GSPMD, so the
-        # iteration is already computed cooperatively across dp/fsdp
-        tx = optax.contrib.muon(
-            lr_arg, beta=betas[0], eps=eps, weight_decay=weight_decay,
+        # from-scratch NS-orthogonalized momentum (runtime/muon.py):
+        # path-aware routing covers the zoo's STACKED [L, ...] layer
+        # weights (optax.contrib.muon only treats exactly-2D leaves as
+        # matrices) and the NS matmuls run on ZeRO-sharded momentum
+        # under GSPMD — the distributed Newton-Schulz of the reference
+        # (_apply_distributed_muon_update, stage3.py:1537) without its
+        # gather/scatter hooks
+        from deepspeed_tpu.runtime.muon import muon as _muon
+
+        tx = _muon(
+            lr_arg, beta=betas[0],
+            weight_decay=weight_decay,
             ns_steps=int(muon_extra.get("ns_steps", 5)),
             nesterov=bool(muon_extra.get("nesterov", True)),
             adam_b1=muon_extra.get("adam_b1", 0.9),
-            adam_b2=muon_extra.get("adam_b2", 0.999))
+            adam_b2=muon_extra.get("adam_b2", 0.999),
+            adam_eps=eps)
     else:
         raise ValueError(f"unknown optimizer type '{opt_config.type}'")
     return tx, lr

@@ -58,7 +58,12 @@ def test_hbm_budget_prunes_everything(tmp_path, devices):
                   tuning_space={"micro_batch_sizes": [2],
                                 "zero_stages": [1]},
                   hbm_budget_bytes=1)  # nothing fits in 1 byte
-    assert t.tune(fast=True) is None
+    # the static estimate over-reports vs the allocator, so an
+    # all-over-budget sweep degrades to measuring the smallest-peak
+    # candidates instead of giving up (results still record the
+    # violation)
+    best = t.tune(fast=True)
+    assert best is not None
     assert all(not r.compiled_ok for r in t.results)
 
 

@@ -165,3 +165,64 @@ def test_scheduler_on_engine(devices):
     engine.train_batch(it())
     w = np.asarray(engine.params["layers"]["attn"]["wq"])
     assert (w == 0).mean() >= 0.7  # 25% dense after projection
+
+
+def test_staged_bit_schedule():
+    """start_bits anneal by halving every quantization_period steps
+    (reference staged compression scheduling, compression/scheduler.py)."""
+    from deepspeed_tpu.compression.compress import _QuantSpec
+
+    q = _QuantSpec(bits=4, symmetric=True, schedule_offset=100,
+                   start_bits=16, period=50)
+    assert q.active_bits(0) is None
+    assert q.active_bits(99) is None
+    assert q.active_bits(100) == 16
+    assert q.active_bits(150) == 8
+    assert q.active_bits(200) == 4
+    assert q.active_bits(10_000) == 4  # floor at target
+
+
+def test_scheduler_applies_staged_quantization(devices):
+    import deepspeed_tpu as dstpu
+    from deepspeed_tpu.compression import (CompressionScheduler,
+                                           init_compression)
+    from deepspeed_tpu.models.zoo import get_model
+
+    model = get_model("tiny", vocab_size=64, hidden_size=32, num_layers=2,
+                      num_heads=4, max_seq_len=32, remat=False)
+    engine, *_ = dstpu.initialize(
+        model=model,
+        config={"train_micro_batch_size_per_chip": 2,
+                "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+                "zero_optimization": {"stage": 0},
+                "steps_per_print": 1000},
+        topology={"dp": 8})
+    state = init_compression(engine.params, {
+        "weight_quantization": {
+            "shared_parameters": {"enabled": True, "schedule_offset": 2},
+            "different_groups": {"wq": {
+                "params": {"start_bits": 8, "target_bits": 4,
+                           "quantization_period": 2},
+                "modules": ["mlp"]}}}})
+    CompressionScheduler(state).attach(engine)
+    rng = np.random.default_rng(0)
+    batch = {"input_ids": rng.integers(
+        0, 64, (engine.micro_batch_size * engine.dp_world_size, 17))
+        .astype(np.int32)}
+
+    def it():
+        while True:
+            yield batch
+
+    def mlp_levels():
+        w = np.asarray(engine.params["layers"]["mlp"]["wi"], np.float32)
+        return len(np.unique(w[0]))
+
+    engine.train_batch(it())          # step 1: before offset, no quant
+    assert mlp_levels() > 300
+    for _ in range(3):                # past offset: 8-bit projection
+        engine.train_batch(it())
+    assert mlp_levels() <= 256
+    for _ in range(4):                # annealed to 4-bit
+        engine.train_batch(it())
+    assert mlp_levels() <= 16
