@@ -124,7 +124,7 @@ def main():
                           "params": {"lr": 1e-4, "weight_decay": 0.1}},
             "bf16": {"enabled": True}, "steps_per_print": 1_000_000,
         }, batch_fn, tuning_space=space)
-        best = tuner.tune(top_k=3, measure_steps=3)
+        best = tuner.tune(top_k=4, measure_steps=3)
         if best is not None:
             micro = int(best["train_micro_batch_size_per_chip"])
             policy = best.get("_remat_policy", policy)

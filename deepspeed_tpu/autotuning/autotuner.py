@@ -231,9 +231,22 @@ class Autotuner:
                 return None
             logger.warning(
                 "autotuner: every candidate exceeds the static HBM "
-                "budget; measuring the smallest-peak ones anyway (the "
+                "budget; measuring near-floor candidates anyway (the "
                 "static estimate over-reports vs the allocator)")
-            viable = sorted(compiled, key=lambda r: r.peak_bytes)[:top_k]
+            floor_r = min(compiled, key=lambda r: r.peak_bytes)
+            near = [r for r in compiled
+                    if r.peak_bytes <= floor_r.peak_bytes * 1.5]
+            # keep the big-batch preference within the near-floor band —
+            # pure smallest-peak would only ever measure the tiniest
+            # micro batch (runtime OOMs fail per-trial and lose anyway)
+            # — but always include the floor candidate so an all-OOM
+            # round still falls back to the config most likely to fit
+            near.sort(key=lambda r: (
+                -r.config.get("train_micro_batch_size_per_chip", 0),
+                r.peak_bytes))
+            viable = near[:top_k]
+            if floor_r not in viable:
+                viable[-1] = floor_r
         # prefer larger micro-batch at equal viability: sort by batch desc,
         # peak asc — big batches amortize overhead, the usual TPU winner
         viable.sort(key=lambda r: (

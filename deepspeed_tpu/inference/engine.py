@@ -47,7 +47,8 @@ class InferenceEngine:
     def __init__(self, model: TransformerLM, mesh: Optional[Mesh] = None,
                  params: Optional[Dict[str, Any]] = None,
                  dtype=jnp.bfloat16, max_batch: int = 8,
-                 max_seq_len: Optional[int] = None, seed: int = 0):
+                 max_seq_len: Optional[int] = None, seed: int = 0,
+                 quantize_weights: Optional[str] = None):
         self.model = model
         self.cfg = model.config
         if mesh is None:
@@ -63,6 +64,15 @@ class InferenceEngine:
                     "placement shards the head axes evenly (reference "
                     "AutoTP has the same constraint); lower tp or use "
                     "a model whose head counts divide")
+        if quantize_weights is not None and quantize_weights != "int8":
+            raise ValueError(
+                f"quantize_weights supports 'int8', got "
+                f"{quantize_weights!r}")
+        if quantize_weights is not None and tp > 1:
+            raise ValueError(
+                "quantize_weights does not compose with tp>1 yet "
+                "(blockwise payloads have an extra rank the TP "
+                "specs don't cover); serve unquantized or tp=1")
         self.max_batch = max_batch
         self.max_seq_len = max_seq_len or self.cfg.max_seq_len
         self._dtype = dtype
@@ -81,6 +91,20 @@ class InferenceEngine:
                         jax.random.PRNGKey(seed))
         else:
             params = jax.device_put(params, shardings)
+        if quantize_weights is not None:
+            # weight-only int8 serving (reference MoQ/GroupQuantizer,
+            # module_inject/replace_module.py:44): HBM holds ~4x less
+            # weight; dequant happens lazily at each use inside the
+            # compiled step (inference/weight_quant.py). Arg validation
+            # ran before model materialization.
+            from deepspeed_tpu.inference.weight_quant import (
+                quantize_params, quantized_fraction)
+
+            params = quantize_params(params)
+            log_dist(
+                f"weight-only int8 serving: "
+                f"{quantized_fraction(params):.0%} of weight bytes "
+                "quantized", ranks=[0])
         self.params = params
 
         # jit caches per input shape, so one function serves every

@@ -38,12 +38,14 @@ class InferenceEngineV2:
                  params: Optional[Dict[str, Any]] = None,
                  kv_blocks: int = 256, kv_block_size: int = 16,
                  max_tokens_per_step: int = 128, max_seqs_per_step: int = 16,
-                 max_blocks_per_seq: int = 32, dtype=jnp.bfloat16, seed: int = 0):
+                 max_blocks_per_seq: int = 32, dtype=jnp.bfloat16, seed: int = 0,
+                 quantize_weights: Optional[str] = None):
         from deepspeed_tpu.inference.engine import InferenceEngine
 
         # reuse v1's TP placement logic for params/mesh
         self._v1 = InferenceEngine(model, mesh=mesh, params=params,
-                                   dtype=dtype, seed=seed)
+                                   dtype=dtype, seed=seed,
+                                   quantize_weights=quantize_weights)
         self.model, self.cfg = model, model.config
         self.mesh, self.params = self._v1.mesh, self._v1.params
 
