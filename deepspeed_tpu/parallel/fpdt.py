@@ -52,11 +52,19 @@ def _chunk_vs_kv_tiles(q, k_tiles, v_tiles, q_pos0, causal: bool,
     T = k_tiles.shape[0]
     o, m, l = init_accumulators(B, N, C, D)
 
+    # remat the per-tile block: without this the INNER scan's backward
+    # saves every tile's [C, kv_tile] softmax block as a residual —
+    # stacked to [T, B, N, C, kv_tile] fp32, which is exactly the O(S^2)
+    # memory this path exists to avoid (observed: 8GB temp at 128K)
+    ck_block = jax.checkpoint(
+        lambda q_, k_, v_, qp, kp: block_attn_partial(
+            q_, k_, v_, qp, kp, causal, s_kv))
+
     def body(carry, xs):
         o, m, l = carry
         k_t, v_t, t_idx = xs
         k_pos = t_idx * kv_tile + jnp.arange(kv_tile)
-        blk = block_attn_partial(q, k_t, v_t, q_pos, k_pos, causal, s_kv)
+        blk = ck_block(q, k_t, v_t, q_pos, k_pos)
         return online_merge(o, m, l, blk), None
 
     (o, m, l), _ = lax.scan(body, (o, m, l),

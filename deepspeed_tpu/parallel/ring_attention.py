@@ -56,12 +56,19 @@ def _ring_attn_local(q, k, v, *, axis: str, causal: bool, s_global: int):
     B, _, N, D = q.shape
     o_acc, m_acc, l_acc = init_accumulators(B, N, s_loc, D)
 
+    # remat the per-step block: the ring scan's backward would otherwise
+    # stack every step's [S/p, S/p] softmax block as a residual —
+    # [p, B, N, S/p, S/p] fp32, the O(S^2/p) memory blowup this path
+    # exists to avoid (same leak class as fpdt's inner tile scan)
+    ck_block = jax.checkpoint(
+        lambda q_, k_, v_, qp, kp: block_attn_partial(
+            q_, k_, v_, qp, kp, causal, s_global))
+
     def body(carry, step):
         k_blk, v_blk, o_acc, m_acc, l_acc = carry
         kv_idx = (my_idx - step) % p_size
         k_pos = kv_idx * s_loc + jnp.arange(s_loc)
-        blk = block_attn_partial(q, k_blk, v_blk, q_pos, k_pos, causal,
-                                 s_global)
+        blk = ck_block(q, k_blk, v_blk, q_pos, k_pos)
         o_acc, m_acc, l_acc = online_merge(o_acc, m_acc, l_acc, blk)
         # rotate kv forward around the ring (device i -> i+1)
         perm = [(i, (i + 1) % p_size) for i in range(p_size)]
