@@ -30,7 +30,7 @@ def test_every_dense_preset_runs(name, devices):
 def test_relu_activation_distinct(devices):
     gelu = get_model("gpt2-125m", **SHRINK)
     relu = get_model("opt-1.3b", **SHRINK)
-    assert gelu.config.activation == "gelu"
+    assert gelu.config.activation == "gelu_tanh"  # GPT-2 gelu_new
     assert relu.config.activation == "relu"
     p = relu.init(jax.random.PRNGKey(0))
     g = jax.grad(lambda p: relu.loss(
