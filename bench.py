@@ -65,7 +65,7 @@ def main():
     #  - gpt2-125m: micro=224 with flash block-512 → ~75k tok/s, MFU 0.33.
     micro_default = 8 if llama_headline else 224
     micro = int(os.environ.get("BENCH_MICRO", micro_default if on_tpu else 1))
-    steps = int(os.environ.get("BENCH_STEPS", 10 if on_tpu else 3))
+    steps = int(os.environ.get("BENCH_STEPS", 20 if on_tpu else 3))
     warmup = 3 if on_tpu else 1
 
     # remat costs ~30% extra FLOPs but is what bounds activation memory at
