@@ -9,7 +9,7 @@ with ``pytest --forked`` (.github/workflows/cpu-torch-latest.yml); here
 the affected tests run this worker in a fresh process, where the race
 window has never been observed to close.
 
-Usage: python qwz_worker.py <mode>   (mode: exact | quant | tp)
+Usage: python qwz_worker.py <mode>   (mode: exact | quant | tp | hpz)
 Prints one JSON line with losses.
 """
 
@@ -81,6 +81,13 @@ def main():
         losses = run({"zero_optimization": {
             "stage": 3, "zero_quantized_weights": True}},
             {"dp": 1, "fsdp": 4, "tp": 2})
+    elif mode == "hpz":
+        # hpZ mesh: params shard over fsdp only (gathers stay in-group),
+        # replicated across dp — the quantized gather must compose
+        losses = run({"zero_optimization": {
+            "stage": 3, "zero_quantized_weights": True,
+            "zero_hpz_partition_size": 4}},
+            {"dp": 2, "fsdp": 4})
     else:
         raise SystemExit(f"unknown mode {mode}")
     print(json.dumps({"losses": losses}))

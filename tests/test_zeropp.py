@@ -187,6 +187,13 @@ def test_qwz_stage3_composes_with_tp(devices):
     assert losses[-1] < losses[0] - 0.3, losses
 
 
+def test_qwz_stage3_hpz_mesh(devices):
+    """hpZ grouping (fsdp=4 in-group shards x dp=2 replicas): the int8
+    gather stays intra-fsdp-group by construction and training learns."""
+    losses = _run_qwz_worker("hpz")
+    assert losses[-1] < losses[0] - 0.2, losses
+
+
 def test_qwz_int8_gather_in_hlo(devices):
     """The compiled train step must gather int8 payloads over fsdp, and the
     bf16/f32 gather bytes for the quantized weights must be gone."""
