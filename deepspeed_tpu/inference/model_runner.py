@@ -27,7 +27,8 @@ from jax import lax
 
 from deepspeed_tpu.models.transformer import (
     TransformerConfig, _norm, _rope, act_fn)
-from deepspeed_tpu.runtime.sharding import effective_dtype
+from deepspeed_tpu.runtime.sharding import (effective_dtype,
+                                            vocab_parallel_lookup)
 
 
 def _qkv(cfg: TransformerConfig, layer_params, y, positions):
@@ -122,7 +123,7 @@ def forward_with_cache(cfg: TransformerConfig, params, tokens: jax.Array,
     max_len = cache.shape[2]
     positions = start_pos + jnp.arange(S)[None, :]  # [1, S] broadcasts to B
 
-    x = params["embed"]["tokens"].astype(dt)[tokens]
+    x = vocab_parallel_lookup(params["embed"]["tokens"].astype(dt), tokens)
     if cfg.pos_emb == "learned":
         x = x + params["embed"]["positions"].astype(dt)[positions]
 
@@ -191,7 +192,8 @@ def ragged_forward(cfg: TransformerConfig, params, kv_data: jax.Array,
     rep = cfg.num_heads // cfg.kv_heads
     is_real = jnp.arange(T) < num_tokens  # [T]
 
-    x = params["embed"]["tokens"].astype(dt)[token_ids]  # [T, H]
+    x = vocab_parallel_lookup(
+        params["embed"]["tokens"].astype(dt), token_ids)  # [T, H]
     if cfg.pos_emb == "learned":
         x = x + params["embed"]["positions"].astype(dt)[token_pos]
 
@@ -324,7 +326,8 @@ def ragged_prefill_forward(cfg: TransformerConfig, params,
     real = qi < seg_nreal[:, None]                    # [S, Tq]
     ctx_lens = seg_pos0 + seg_nreal                   # [S]
 
-    x = params["embed"]["tokens"].astype(dt)[seg_tokens]  # [S, Tq, H]
+    x = vocab_parallel_lookup(
+        params["embed"]["tokens"].astype(dt), seg_tokens)  # [S, Tq, H]
     if cfg.pos_emb == "learned":
         x = x + params["embed"]["positions"].astype(dt)[pos]
 
@@ -384,7 +387,8 @@ def ragged_decode_forward(cfg: TransformerConfig, params, kv_data: jax.Array,
     dt = effective_dtype(cfg.dtype)
     alive = context_lens > 0
 
-    x = params["embed"]["tokens"].astype(dt)[token_ids]  # [S, H]
+    x = vocab_parallel_lookup(
+        params["embed"]["tokens"].astype(dt), token_ids)  # [S, H]
     if cfg.pos_emb == "learned":
         x = x + params["embed"]["positions"].astype(dt)[token_pos]
 

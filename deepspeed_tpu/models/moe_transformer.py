@@ -21,7 +21,8 @@ from jax import lax
 
 from deepspeed_tpu.models import transformer as tfm
 from deepspeed_tpu.parallel.moe import GateConfig, moe_ffn
-from deepspeed_tpu.runtime.sharding import constrain_activation
+from deepspeed_tpu.runtime.sharding import (constrain_activation,
+                                            vocab_parallel_lookup)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -151,7 +152,7 @@ def apply(cfg: MoETransformerConfig, params, tokens, positions=None,
     dt = cfg.dtype
     if positions is None:
         positions = jnp.arange(S)[None, :]
-    x = params["embed"]["tokens"].astype(dt)[tokens]
+    x = vocab_parallel_lookup(params["embed"]["tokens"].astype(dt), tokens)
     if cfg.pos_emb == "learned":
         x = x + params["embed"]["positions"].astype(dt)[positions]
     x = constrain_activation(x, ("batch", "seq", "embed"))

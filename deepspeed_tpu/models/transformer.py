@@ -35,7 +35,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from deepspeed_tpu.runtime.sharding import constrain_activation
+from deepspeed_tpu.runtime.sharding import (constrain_activation,
+                                            vocab_parallel_lookup)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -445,7 +446,7 @@ def apply_hidden(cfg: TransformerConfig, params: Dict[str, Any],
     if positions is None:
         positions = jnp.arange(S)[None, :]
 
-    x = params["embed"]["tokens"].astype(dt)[tokens]
+    x = vocab_parallel_lookup(params["embed"]["tokens"].astype(dt), tokens)
     if cfg.pos_emb == "learned":
         x = x + params["embed"]["positions"].astype(dt)[positions]
     x = constrain_activation(x, ("batch", "seq", "embed"))

@@ -245,13 +245,31 @@ class Engine:
         if self._qwz_stage3:
             log_dist("ZeRO++ qwZ: stage-3 int8 quantized parameter "
                      "all-gather enabled (fsdp axis)", ranks=[0])
-            if zq.zero_quantized_gradients:
-                logger.warning(
-                    "ZeRO++ qgZ (zero_quantized_gradients) is not wired "
-                    "at stage 3 — gradients reduce at full width; only "
-                    "the qwZ parameter all-gather is quantized")
+        # stage-3 qgZ: per-group grads (vmap over batch shards) + explicit
+        # int8[/int4 hierarchical] all-to-all reduction (runtime/qgz.py;
+        # reference coalesced_collectives.py:31 all_to_all_quant_reduce)
+        self._qgz_stage3 = (
+            zq.stage == 3 and zq.zero_quantized_gradients
+            and not config.moe.enabled
+            and self.mesh.shape.get("pp", 1) <= 1
+            and self.mesh.shape.get("sp", 1) <= 1
+            and self.mesh.shape.get("ep", 1) <= 1
+            and self.mesh.shape.get("fsdp", 1) > 1)
+        if self._qgz_stage3:
+            log_dist(
+                "ZeRO++ qgZ: stage-3 quantized gradient reduction enabled "
+                f"(int8 over fsdp={self.mesh.shape['fsdp']}"
+                + (f", int4 over dp={self.mesh.shape['dp']}"
+                   if self.mesh.shape.get("dp", 1) > 1 else "") + ")",
+                ranks=[0])
+        elif zq.stage == 3 and zq.zero_quantized_gradients:
+            logger.warning(
+                "ZeRO++ qgZ at stage 3 requires a dense model (no MoE), "
+                "no pp/sp axes, and fsdp > 1 — this config fails that, "
+                "so gradients reduce at full width")
         if (zq.zero_quantized_weights or zq.zero_quantized_gradients) \
-                and not self._zeropp and not self._qwz_stage3:
+                and not self._zeropp and not self._qwz_stage3 \
+                and not self._qgz_stage3:
             logger.warning(
                 "ZeRO++ flags (qwZ/qgZ) are wired for: stage 1-2 with "
                 "adam/adamw (no client optimizer), bf16, no optimizer "
@@ -570,6 +588,14 @@ class Engine:
                        "overflow": overflow}
             return params, opt_state, new_ls, new_step, metrics
 
+        qgz = self._qgz_stage3
+        if qgz:
+            from deepspeed_tpu.runtime.qgz import qgz_reduce_tree
+
+            n_groups = int(np.prod([self.mesh.shape.get(a, 1)
+                                    for a in topo.BATCH_AXES]))
+            group_sh = NamedSharding(self.mesh, P(None, topo.BATCH_AXES))
+
         def train_step(params, opt_state, ls_state, step, batches):
             """Fused GAS boundary: grads of a scan over microbatches —
             one reduction per boundary, remat caps activation memory."""
@@ -583,10 +609,37 @@ class Engine:
                     body, jnp.asarray(0.0, jnp.float32), batches)
                 return total, (losses, ntoks)
 
-            (_, (losses, ntoks)), grads = jax.value_and_grad(
-                total_loss, has_aux=True)(params)
-            grads = jax.tree.map(lambda g: g.astype(jnp.float32), grads)
-            grads = _constrain_tree(grads, grad_sh)
+            if qgz:
+                # qgZ: one gradient per batch-shard group (no implicit
+                # GSPMD reduction), then explicit quantized-wire reduce
+                def per_group(params, mbs):
+                    def body(carry, mb):
+                        scaled, (loss, aux) = loss_of(params, mb, scale)
+                        return (carry + scaled / gas,
+                                (loss, aux.get("ntokens", 0.0)))
+                    total, (losses, ntoks) = lax.scan(
+                        body, jnp.asarray(0.0, jnp.float32), mbs)
+                    return total, (losses, ntoks)
+
+                grouped = jax.tree.map(
+                    lambda x: lax.with_sharding_constraint(
+                        x.reshape(x.shape[0], n_groups,
+                                  x.shape[1] // n_groups, *x.shape[2:]),
+                        group_sh),
+                    batches)
+                (_, (losses_g, ntoks_g)), g_groups = jax.vmap(
+                    jax.value_and_grad(per_group, has_aux=True),
+                    in_axes=(None, 1))(params, grouped)
+                g_groups = jax.tree.map(
+                    lambda g: g.astype(jnp.float32), g_groups)
+                grads = qgz_reduce_tree(g_groups, grad_sh, self.mesh)
+                losses = jnp.mean(losses_g, axis=0)
+                ntoks = jnp.sum(ntoks_g, axis=0)
+            else:
+                (_, (losses, ntoks)), grads = jax.value_and_grad(
+                    total_loss, has_aux=True)(params)
+                grads = jax.tree.map(lambda g: g.astype(jnp.float32), grads)
+                grads = _constrain_tree(grads, grad_sh)
             params, opt_state, new_ls, new_step, metrics = apply_update(
                 params, opt_state, ls_state, step, grads, ntoks)
             metrics["loss"] = jnp.mean(losses)

@@ -466,6 +466,67 @@ def qwz_sequence_barrier(weight, value):
     return jax.lax.optimization_barrier((weight, value))
 
 
+def vocab_parallel_lookup(table, ids, axis: str = "tp"):
+    """Embedding lookup on a vocab-sharded table without GSPMD's
+    replicate-then-partition fallback.
+
+    A plain ``table[ids]`` gathers along the tp-sharded vocab dim; XLA's
+    SPMD partitioner handles that by all-gathering the FULL table to every
+    device first ("SPMD will replicate the tensor and then partition it"
+    — the warning the round-2 multichip dryrun logged). At 128k vocab ×
+    8k hidden that is a 2 GB per-step gather that scales with vocab.
+
+    TPU-first construction (reference bar: the vocab/column-parallel
+    embedding in module_inject/layers.py:678): a shard_map manual ONLY
+    over the vocab axis — each shard masks ids to its own vocab range,
+    gathers locally, zeroes out-of-range rows, and a psum over ``axis``
+    assembles the row each token actually hit. Wire cost: one [*, H]
+    activation psum (the same volume any tp row-parallel matmul pays)
+    instead of a [V, H] table gather. The backward is the mirrored
+    masked scatter-add into the LOCAL shard — no replicated-table grad.
+
+    Falls back to the plain gather when no mesh is set, the axis is
+    unsharded, vocab doesn't tile, or tracing happens inside a manual
+    region (pipeline / 1-bit / zeropp shard_maps).
+    """
+    from deepspeed_tpu.parallel import topology
+
+    mesh = topology._GLOBAL_MESH
+    k = 1 if mesh is None else mesh.shape.get(axis, 1)
+    V = table.shape[0]
+    if _CONSTRAINTS_DISABLED or k <= 1 or V % k != 0:
+        return table[ids]
+    import jax.numpy as jnp
+    from jax import lax
+
+    shard = V // k
+    # XLA's CPU backend miscompiles bf16 inside partial-manual shard_map
+    # regions ("Invalid binary instruction opcode copy" — see
+    # parallel/pipeline.py); the lookup is exact row selection, so an f32
+    # round-trip on the simulator changes nothing numerically.
+    cast = (jax.default_backend() == "cpu" and table.dtype == jnp.bfloat16)
+    out_dtype = table.dtype
+    if cast:
+        table = table.astype(jnp.float32)
+
+    def body(tbl, tok):
+        start = lax.axis_index(axis) * shard
+        local = tok - start
+        valid = (local >= 0) & (local < shard)
+        rows = tbl[jnp.where(valid, local, 0)]
+        rows = rows * valid[..., None].astype(tbl.dtype)
+        return lax.psum(rows, axis)
+
+    out = jax.shard_map(
+        body, mesh=mesh,
+        in_specs=(PartitionSpec(axis), PartitionSpec()),
+        out_specs=PartitionSpec(),
+        axis_names=frozenset({axis}),
+        check_vma=False,
+    )(table, ids)
+    return out.astype(out_dtype) if cast else out
+
+
 def constrain_activation(x, logical_axes: Sequence[Optional[str]]):
     """Apply the activation sharding rules to an intermediate value.
 
