@@ -140,6 +140,27 @@ def get_global_mesh() -> Mesh:
     return _GLOBAL_MESH
 
 
+class use_mesh:
+    """Scope the global mesh to one engine's mesh for the duration of a
+    step/trace. Two engines in one process each set the global mesh at
+    init; whichever initialized LAST would otherwise win inside the
+    other's traces (constraints, vocab-parallel lookups), compiling
+    against the wrong device assignment."""
+
+    def __init__(self, mesh: Mesh):
+        self._mesh = mesh
+
+    def __enter__(self):
+        global _GLOBAL_MESH
+        self._prev = _GLOBAL_MESH
+        _GLOBAL_MESH = self._mesh
+
+    def __exit__(self, *a):
+        global _GLOBAL_MESH
+        _GLOBAL_MESH = self._prev
+        return False
+
+
 def _axis_size(mesh: Optional[Mesh], axis: str) -> int:
     mesh = mesh or get_global_mesh()
     return mesh.shape[axis]

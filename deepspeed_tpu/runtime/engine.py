@@ -228,6 +228,7 @@ class Engine:
         # -- ZeRO++ quantized-collective step (runtime/zeropp.py) ---------
         self._zeropp = self._zeropp_applicable(config) and not self._onebit
         self._zeropp_state = None
+        self._zeropp_lr_override = None  # set_lr under the compiled step
         zq = config.zero_optimization
         # stage-3 qwZ: int8 parameter all-gather in the GSPMD fetch path
         # (reference partition_parameters.py:1446). Composes with tp/sp/
@@ -251,6 +252,7 @@ class Engine:
         self._qgz_stage3 = (
             zq.stage == 3 and zq.zero_quantized_gradients
             and not config.moe.enabled
+            and self._offload_device == "none"  # offload takes grad_step
             and self.mesh.shape.get("pp", 1) <= 1
             and self.mesh.shape.get("sp", 1) <= 1
             and self.mesh.shape.get("ep", 1) <= 1
@@ -265,19 +267,19 @@ class Engine:
         elif zq.stage == 3 and zq.zero_quantized_gradients:
             logger.warning(
                 "ZeRO++ qgZ at stage 3 requires a dense model (no MoE), "
-                "no pp/sp axes, and fsdp > 1 — this config fails that, "
-                "so gradients reduce at full width")
+                "no optimizer offload, no pp/sp/ep axes, and fsdp > 1 — "
+                "this config fails that, so gradients reduce at full width")
         if (zq.zero_quantized_weights or zq.zero_quantized_gradients) \
                 and not self._zeropp and not self._qwz_stage3 \
                 and not self._qgz_stage3:
             logger.warning(
                 "ZeRO++ flags (qwZ/qgZ) are wired for: stage 1-2 with "
                 "adam/adamw (no client optimizer), bf16, no optimizer "
-                "offload, no MoE, no tp/sp/pp axes, no hpZ/MiCS "
-                "grouping, no 1-bit optimizer; or stage-3 "
-                "zero_quantized_weights (dense models). This config "
-                "fails those, so the quantized path is disabled and "
-                "the standard step runs")
+                "offload, no MoE, no sp/pp axes (tp composes), no "
+                "hpZ/MiCS grouping, no 1-bit optimizer; or stage-3 "
+                "zero_quantized_weights/zero_quantized_gradients (dense "
+                "models). This config fails those, so the quantized path "
+                "is disabled and the standard step runs")
 
         # -- state init (sharded; zero.Init analog is in abstract init) ---
         self._rng = jax.random.PRNGKey(seed if seed is not None else config.seed)
@@ -368,7 +370,6 @@ class Engine:
                 and not config.fp16.enabled
                 and not config.moe.enabled
                 and not getattr(self, "_client_optimizer_present", False)
-                and config.tensor_parallel.size == 1
                 and config.sequence_parallel.size == 1
                 and config.pipeline.stages == 1
                 and z.zero_hpz_partition_size <= 1
@@ -751,13 +752,24 @@ class Engine:
         self.tput_timer.start()
         batches = self._next_microbatches(data_iter,
                                           self.gradient_accumulation_steps)
+        with topo.use_mesh(self.mesh):
+            metrics = self._dispatch_train_step(batches)
+        self._after_step(metrics)
+        self.timers(TRAIN_BATCH_TIMER).stop(block=metrics["loss"])
+        return metrics["loss"]
+
+    def _dispatch_train_step(self, batches):
         if self._onebit:
             self.params, self._onebit_state, metrics = self._jit_onebit(
                 self.params, self._onebit_state, batches)
             self.step_count = self._onebit_state.step
         elif self._zeropp:
+            lr_over = jnp.asarray(
+                self._zeropp_lr_override
+                if self._zeropp_lr_override is not None else float("nan"),
+                jnp.float32)
             self.params, self._zeropp_state, metrics = self._jit_zeropp(
-                self.params, self._zeropp_state, batches)
+                self.params, self._zeropp_state, batches, lr_over)
             self.step_count = self._zeropp_state.step
         elif self._offload is not None:
             scale = (self.loss_scale_state.scale if self.config.fp16.enabled
@@ -769,9 +781,7 @@ class Engine:
              self.step_count, metrics) = self._jit_train_step(
                 self.params, self.opt_state, self.loss_scale_state,
                 self.step_count, batches)
-        self._after_step(metrics)
-        self.timers(TRAIN_BATCH_TIMER).stop(block=metrics["loss"])
-        return metrics["loss"]
+        return metrics
 
     def forward(self, batch, *args, **kwargs):
         """Micro-step path: compute loss (grads cached for backward)."""
@@ -784,7 +794,8 @@ class Engine:
         batch = self.shard_batch(batch)
         scale = (self.loss_scale_state.scale if self.config.fp16.enabled
                  else jnp.asarray(1.0, jnp.float32))
-        loss, grads = self._jit_fwd_bwd(self.params, batch, scale)
+        with topo.use_mesh(self.mesh):
+            loss, grads = self._jit_fwd_bwd(self.params, batch, scale)
         self._pending = (loss, grads)
         self.timers(FORWARD_GLOBAL_TIMER).stop(block=loss)
         return loss
@@ -987,7 +998,8 @@ class Engine:
 
     def eval_batch(self, batch):
         batch = self.shard_batch(batch)
-        loss, _aux = self._jit_eval(self.params, batch)
+        with topo.use_mesh(self.mesh):
+            loss, _aux = self._jit_eval(self.params, batch)
         return loss
 
     def set_custom_curriculum_learning_schedule(self, fn):
@@ -1083,9 +1095,19 @@ class Engine:
         step bakes the lr closure at trace time, so this rebuilds the
         step functions — recompilation happens on the next call (cheap
         relative to how rarely clients poke lr mid-run)."""
-        if getattr(self, "_onebit", False) or self._zeropp:
+        if self._zeropp:
+            # the ZeRO++ step takes lr as a runtime operand (NaN = use
+            # the traced schedule), so no rebuild is needed
+            self._zeropp_lr_override = float(lr)
+            self._base_lr = float(lr)
+            if self.lr_schedule is not None:
+                logger.warning("set_lr override disables the configured "
+                               "lr schedule for the ZeRO++ step")
+                self.lr_schedule = None
+            return
+        if getattr(self, "_onebit", False):
             raise NotImplementedError(
-                "set_lr: 1-bit/ZeRO++ steps bake lr into their compiled "
+                "set_lr: 1-bit steps bake lr into their compiled "
                 "collective step; configure lr up front")
         if self._client_optimizer_present:
             raise NotImplementedError(

@@ -28,6 +28,13 @@ module reduces that axis with the wire quantized:
 
 Accuracy contract matches the reference: quantization noise bounded by
 per-block scales, exact in expectation (round-to-nearest, symmetric).
+Loss-weighting semantics: groups average uniformly (1/G), i.e. each
+batch shard's *mean* loss counts equally — the same per-rank-mean
+averaging torch DDP and the reference's data-parallel reduction use.
+With uneven loss_mask populations across shards this differs from the
+engine's exact path, which normalizes by the global token count per
+microbatch; the divergence is zero for unmasked LM batches (equal
+tokens per shard) and bounded by the shard-count imbalance otherwise.
 Memory note: per-group grads are full-width on each device until step 3 —
 the same transient an unquantized unreduced gradient occupies; qgZ trades
 that for 2-4x less reduction wire, its purpose on DCN-bound meshes.

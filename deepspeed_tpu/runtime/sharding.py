@@ -277,6 +277,45 @@ class disable_constraints:
         return False
 
 
+_MANUAL_AXES: frozenset = frozenset()
+
+
+class manual_axes:
+    """Trace-scoped marker for partial-manual shard_map regions: the
+    named axes are MANUAL inside (not addressable by
+    with_sharding_constraint), so activation constraints strip them while
+    the auto axes (tp/sp) stay live. Contrast disable_constraints, which
+    kills everything — needed only where the XLA bug above applies."""
+
+    def __init__(self, axes):
+        self._axes = frozenset(axes)
+
+    def __enter__(self):
+        global _MANUAL_AXES
+        self._prev = _MANUAL_AXES
+        _MANUAL_AXES = _MANUAL_AXES | self._axes
+
+    def __exit__(self, *a):
+        global _MANUAL_AXES
+        _MANUAL_AXES = self._prev
+        return False
+
+
+def _strip_axes_spec(spec, axes) -> PartitionSpec:
+    out = []
+    for e in spec:
+        if e is None:
+            out.append(None)
+        elif isinstance(e, str):
+            out.append(None if e in axes else e)
+        else:
+            kept = tuple(a for a in e if a not in axes)
+            out.append(kept[0] if len(kept) == 1 else (kept or None))
+    while out and out[-1] is None:
+        out.pop()
+    return PartitionSpec(*out)
+
+
 class force_f32:
     """Trace-time override: model bodies compute in f32 (CPU shard_map
     bf16 workaround — see parallel/pipeline.py)."""
@@ -494,8 +533,8 @@ def vocab_parallel_lookup(table, ids, axis: str = "tp"):
     mesh = topology._GLOBAL_MESH
     k = 1 if mesh is None else mesh.shape.get(axis, 1)
     V = table.shape[0]
-    if _CONSTRAINTS_DISABLED or k <= 1 or V % k != 0:
-        return table[ids]
+    if _CONSTRAINTS_DISABLED or _MANUAL_AXES or k <= 1 or V % k != 0:
+        return table[ids]  # (nested shard_map in a manual region: no)
     import jax.numpy as jnp
     from jax import lax
 
@@ -517,6 +556,9 @@ def vocab_parallel_lookup(table, ids, axis: str = "tp"):
         rows = rows * valid[..., None].astype(tbl.dtype)
         return lax.psum(rows, axis)
 
+    # clamp like XLA's gather does, so out-of-range ids embed to the same
+    # row with or without tp instead of silently zeroing under tp
+    ids = jnp.clip(ids, 0, V - 1)
     out = jax.shard_map(
         body, mesh=mesh,
         in_specs=(PartitionSpec(axis), PartitionSpec()),
@@ -542,4 +584,6 @@ def constrain_activation(x, logical_axes: Sequence[Optional[str]]):
     if mesh is None or all(s == 1 for s in mesh.shape.values()):
         return x
     spec = spec_from_logical(logical_axes, ACT_RULES + TP_RULES)
+    if _MANUAL_AXES:
+        spec = _strip_axes_spec(spec, _MANUAL_AXES)
     return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, spec))

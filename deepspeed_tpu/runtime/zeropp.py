@@ -71,12 +71,19 @@ def build_zeropp_step(model, mesh, gas: int, base_lr: float,
     from deepspeed_tpu.ops.pallas.quantization import (
         quantized_all_gather, quantized_psum_scatter)
 
-    for ax in ("fsdp", "tp", "sp", "ep", "pp"):
+    for ax in ("fsdp", "sp", "ep", "pp"):
         if mesh.shape.get(ax, 1) > 1:
             raise ValueError(
                 f"ZeRO++ quantized step is manual over 'dp' only; mesh "
                 f"axis {ax}={mesh.shape[ax]} is unsupported (grads would "
                 "not reduce across it)")
+    # tp composes: the region is manual over dp ONLY (partial-manual
+    # shard_map), so GSPMD still shards the model over tp inside —
+    # activation constraints stay live with the dp axis stripped
+    # (sharding.manual_axes). Caveat: the flat [dp, shard] master layout
+    # keeps optimizer state replicated over tp, and the per-leaf flatten
+    # regathers tp-sharded grads — correct, with extra intra-slice wire;
+    # acceptable because qgZ targets the dp (DCN) axis.
     dp = mesh.shape["dp"]
     b1, b2 = betas
 
@@ -103,13 +110,14 @@ def build_zeropp_step(model, mesh, gas: int, base_lr: float,
                                    step=jnp.zeros((), jnp.int32))
 
     # -- manual region ---------------------------------------------------
-    def local_step(params, master, m, v, step, batches):
+    def local_step(params, master, m, v, step, lr_over, batches):
         from deepspeed_tpu.runtime import sharding as shard_lib
 
-        with shard_lib.disable_constraints():
-            return _local_step_inner(params, master, m, v, step, batches)
+        with shard_lib.manual_axes({"dp"}):
+            return _local_step_inner(params, master, m, v, step, lr_over,
+                                     batches)
 
-    def _local_step_inner(params, master, m, v, step, batches):
+    def _local_step_inner(params, master, m, v, step, lr_over, batches):
         def total_loss(p):
             def body(carry, mb):
                 loss, _aux = model.loss(p, mb)
@@ -145,6 +153,8 @@ def build_zeropp_step(model, mesh, gas: int, base_lr: float,
         step = step + 1
         lr = (lr_schedule(step) if lr_schedule is not None
               else jnp.asarray(base_lr, jnp.float32))
+        # runtime lr override (engine.set_lr): NaN sentinel = use schedule
+        lr = jnp.where(jnp.isnan(lr_over), lr, lr_over)
         master_l = jax.tree.leaves(master)
         m_l = jax.tree.leaves(m)
         v_l = jax.tree.leaves(v)
@@ -176,20 +186,25 @@ def build_zeropp_step(model, mesh, gas: int, base_lr: float,
         return (unf(new_params), unf(new_master), unf(new_m), unf(new_v),
                 step, loss_avg, gnorm, lr)
 
-    batch_spec = P(None, ("dp", "fsdp", "ep"))
+    batch_spec = P(None, "dp")
     rep = P()
     shard_spec = P("dp")
 
     mapped = jax.shard_map(
         local_step, mesh=mesh,
-        in_specs=(rep, shard_spec, shard_spec, shard_spec, rep, batch_spec),
+        in_specs=(rep, shard_spec, shard_spec, shard_spec, rep, rep,
+                  batch_spec),
         out_specs=(rep, shard_spec, shard_spec, shard_spec, rep, rep, rep,
                    rep),
+        axis_names=frozenset({"dp"}),
         check_vma=False)
 
-    def step_fn(params, state: ZeroppState, batches):
+    def step_fn(params, state: ZeroppState, batches, lr_over=None):
+        if lr_over is None:
+            lr_over = jnp.asarray(float("nan"), jnp.float32)
         (new_p, master, m, v, step, loss, gnorm, lr) = mapped(
-            params, state.master, state.m, state.v, state.step, batches)
+            params, state.master, state.m, state.v, state.step, lr_over,
+            batches)
         metrics = {"loss": loss, "grad_norm": gnorm, "lr": lr,
                    "overflow": jnp.asarray(False)}
         return new_p, ZeroppState(master, m, v, step), metrics
