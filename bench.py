@@ -95,6 +95,16 @@ def main():
             overrides.update(num_kv_heads=4, ffn_size=512)
     model = get_model(model_name, **overrides)
 
+    # zero stage + mesh topology decided ONCE, up front: the autotuner's
+    # trial engines must run under the same mesh as the final engine or
+    # the tuned settings are measured against a different program
+    zero_stage_default = 3 if llama_headline else (1 if n_chips > 1 else 0)
+    zero_stage = int(os.environ.get("BENCH_ZERO_STAGE", zero_stage_default))
+    if int(os.environ.get("BENCH_OFFLOAD", "0")):
+        zero_stage = 2 if n_chips == 1 else 1
+    topology = ({"dp": 1, "fsdp": -1} if (n_chips > 1 or zero_stage == 3)
+                else None)
+
     # BENCH_AUTOTUNE=1: let the autotuner pick micro batch + remat policy
     # (reference: the CLI launches Autotuner.tune() before real training,
     # launcher/runner.py:407). The chosen settings land in the JSON line.
@@ -114,7 +124,7 @@ def main():
 
         space = {
             "micro_batch_sizes": [micro // 2, micro, micro + micro // 2],
-            "zero_stages": [3 if llama_headline else 0],
+            "zero_stages": [zero_stage],
             "remat": [True],
             "remat_policies": ["nothing_saveable", "save_attn_out"],
         }
@@ -123,7 +133,7 @@ def main():
             "optimizer": {"type": "adamw",
                           "params": {"lr": 1e-4, "weight_decay": 0.1}},
             "bf16": {"enabled": True}, "steps_per_print": 1_000_000,
-        }, batch_fn, tuning_space=space)
+        }, batch_fn, tuning_space=space, topology=topology)
         best = tuner.tune(top_k=4, measure_steps=3)
         if best is not None:
             micro = int(best["train_micro_batch_size_per_chip"])
@@ -132,15 +142,12 @@ def main():
             model = get_model(model_name, **overrides)
             config_source = "autotuner"
 
-    zero_stage_default = 3 if llama_headline else (1 if n_chips > 1 else 0)
     config = {
         "train_micro_batch_size_per_chip": micro,
         "gradient_accumulation_steps": 1,
         "optimizer": {"type": "adamw",
                       "params": {"lr": 1e-4, "weight_decay": 0.1}},
-        "zero_optimization": {
-            "stage": int(os.environ.get("BENCH_ZERO_STAGE",
-                                        zero_stage_default))},
+        "zero_optimization": {"stage": zero_stage},
         "bf16": {"enabled": True},
         "steps_per_print": 1_000_000,
     }
@@ -148,13 +155,7 @@ def main():
         # ZeRO-Offload mode: fp32 master + Adam state live in host RAM,
         # the chip keeps bf16 params only (capacity benchmark — the
         # reference's "13B on one GPU" claim class)
-        config["zero_optimization"] = {
-            "stage": 2 if n_chips == 1 else 1,
-            "offload_optimizer": {"device": "cpu"},
-        }
-    zero_stage = config["zero_optimization"]["stage"]
-    topology = ({"dp": 1, "fsdp": -1} if (n_chips > 1 or zero_stage == 3)
-                else None)
+        config["zero_optimization"]["offload_optimizer"] = {"device": "cpu"}
     engine, _, _, _ = dstpu.initialize(model=model, config=config,
                                        topology=topology)
 

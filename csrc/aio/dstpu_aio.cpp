@@ -342,9 +342,16 @@ UringHandle* uring_create(int block_size, int queue_depth) {
 
 void uring_destroy(UringHandle* u) {
   {
-    std::lock_guard<std::mutex> lk(u->mu);
+    std::unique_lock<std::mutex> lk(u->mu);
     u->stop.store(true);
-    u->push_sqe(nullptr);  // NOP wakes the reaper
+    // If the SQ is full (close with max in-flight chunks, no prior wait),
+    // a dropped NOP would leave the reaper blocked in GETEVENTS forever
+    // once completions drain — retry like the short-I/O continuation path.
+    while (!u->push_sqe(nullptr)) {  // NOP wakes the reaper
+      lk.unlock();
+      std::this_thread::yield();
+      lk.lock();
+    }
   }
   u->reaper.join();
   for (Request* r : u->inflight) {

@@ -46,8 +46,6 @@ class AutotunerResult:
 
 
 class Autotuner:
-    STATIC_OVERSHOOT = 1.2  # static peak estimate vs allocator reality
-
     """Search over engine configs for a model.
 
     Args:
@@ -58,14 +56,20 @@ class Autotuner:
                       "remat": [...]} — defaults enumerate powers of two
       hbm_budget_bytes: prune candidates whose compiled peak exceeds this
                       (default: detected device memory, else 16 GiB)
+      topology:      mesh topology dict forwarded to every trial engine —
+                      must match the final run's topology or the tuned
+                      settings are measured under a different mesh
     """
+
+    STATIC_OVERSHOOT = 1.2  # static peak estimate vs allocator reality
 
     def __init__(self, model_factory: Callable[[], Any],
                  base_config: Dict[str, Any],
                  batch_fn: Callable[[int], Dict[str, np.ndarray]],
                  tuning_space: Optional[Dict[str, Sequence]] = None,
                  hbm_budget_bytes: Optional[int] = None,
-                 results_dir: Optional[str] = None):
+                 results_dir: Optional[str] = None,
+                 topology: Optional[Dict[str, int]] = None):
         self.model_factory = model_factory
         self.base_config = dict(base_config)
         self.batch_fn = batch_fn
@@ -79,6 +83,7 @@ class Autotuner:
         self.remat_policies = list(space.get("remat_policies", [None]))
         self.hbm_budget = hbm_budget_bytes or self._detect_hbm()
         self.results_dir = results_dir
+        self.topology = dict(topology) if topology else None
         self.results: List[AutotunerResult] = []
 
     @staticmethod
@@ -129,7 +134,8 @@ class Autotuner:
             if policy is not None:
                 updates["remat_policy"] = policy
             model.config = _dc.replace(model.config, **updates)
-        engine, *_ = dstpu.initialize(model=model, config=cfg)
+        engine, *_ = dstpu.initialize(model=model, config=cfg,
+                                      topology=self.topology)
         return engine
 
     @staticmethod

@@ -104,16 +104,20 @@ def nvme_tune_main(argv=None) -> int:
         rows = [r for r in results if r["op"] == op]
         if rows:
             best[op] = max(rows, key=lambda r: r["gbps"])
-    # single config serving both directions: highest min(read,write) speed
+    # single config serving both directions: highest min(read,write) speed.
+    # bench_io sweeps multiple backends — key on backend too, or rows from
+    # the second backend overwrite the first and the pick is meaningless
     by_key = {}
     for r in results:
-        by_key.setdefault((r["block_kb"], r["queue_depth"]), {})[r["op"]] = r
+        key = (r["block_kb"], r["queue_depth"], r.get("backend", "auto"))
+        by_key.setdefault(key, {})[r["op"]] = r
     combined = [(min(v[o]["gbps"] for o in v), k) for k, v in by_key.items()]
-    (block_kb, queue_depth) = max(combined)[1]
+    (block_kb, queue_depth, backend) = max(combined)[1]
     config = {
         "aio": {
             "block_size": block_kb * 1024,
             "queue_depth": queue_depth,
+            "backend": backend,
             # the sweep varies block size / queue depth only; keep the
             # library default rather than writing an unmeasured value
             "thread_count": DEFAULT_THREADS,

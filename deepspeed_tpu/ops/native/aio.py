@@ -49,11 +49,13 @@ def tuned_aio_defaults() -> dict:
             aio = json.load(f)["aio"]
         out = {"block_size": int(aio["block_size"]),
                "queue_depth": int(aio["queue_depth"]),
-               "num_threads": int(aio.get("thread_count", DEFAULT_THREADS))}
+               "num_threads": int(aio.get("thread_count", DEFAULT_THREADS)),
+               "backend": str(aio.get("backend", "auto"))}
     except (OSError, KeyError, ValueError, TypeError, IndexError):
         out = {"block_size": DEFAULT_BLOCK_SIZE,
                "queue_depth": DEFAULT_QUEUE_DEPTH,
-               "num_threads": DEFAULT_THREADS}
+               "num_threads": DEFAULT_THREADS,
+               "backend": "auto"}
     _tuned_cache = (path, out)
     return out
 
@@ -115,7 +117,8 @@ class AsyncIOHandle:
             block_size = block_size or tuned["block_size"]
             queue_depth = queue_depth or tuned["queue_depth"]
             num_threads = num_threads or tuned["num_threads"]
-        backend = backend or os.environ.get("DSTPU_AIO_BACKEND", "auto")
+        backend = (backend or os.environ.get("DSTPU_AIO_BACKEND")
+                   or tuned_aio_defaults()["backend"])
         if backend not in self.BACKENDS:
             raise ValueError(f"backend must be one of {set(self.BACKENDS)}, "
                              f"got {backend!r}")
