@@ -241,6 +241,9 @@ class MoEConfig(ConfigModel):
     use_rts: bool = False
     aux_loss_weight: float = 0.01
     z_loss_weight: float = 0.0
+    # expert execution engine: "auto" | "grouped" (dropless grouped-GEMM,
+    # reference GroupedExperts moe/ep_experts.py:136) | "einsum" (capacity)
+    impl: str = "auto"
 
 
 @register_config_model

@@ -86,7 +86,8 @@ def _moe_mlp(cfg, layer_params, x):
     gate = dataclasses.replace(cfg.gate, drop_tokens=False)
     out, _aux = moe_ffn(flat, layer_params["moe"]["router"],
                         layer_params["moe"]["experts"], gate,
-                        activation=cfg.activation, train=False)
+                        activation=cfg.activation, train=False,
+                        impl=getattr(cfg, "moe_impl", "auto"))
     return x + (out[0] if y.ndim == 2 else out)
 
 

@@ -35,6 +35,8 @@ class MoETransformerConfig(tfm.TransformerConfig):
     drop_tokens: bool = True
     aux_loss_weight: float = 0.01
     z_loss_weight: float = 0.0
+    # "auto" | "grouped" (dropless grouped-GEMM) | "einsum" (capacity pad)
+    moe_impl: str = "auto"
 
     @property
     def gate(self) -> GateConfig:
@@ -138,7 +140,8 @@ def _moe_layer(cfg: MoETransformerConfig, x, layer_params, positions,
     y = tfm._norm(x, layer_params["ln2"], cfg.norm, cfg.norm_eps)
     out, aux = moe_ffn(y, layer_params["moe"]["router"],
                        layer_params["moe"]["experts"], cfg.gate,
-                       activation=cfg.activation, train=train)
+                       activation=cfg.activation, train=train,
+                       impl=cfg.moe_impl)
     l_aux = aux["l_aux"] * cfg.aux_loss_weight
     if cfg.z_loss_weight:
         l_aux = l_aux + aux["l_zloss"] * cfg.z_loss_weight

@@ -109,14 +109,40 @@ def top_k_gating(logits: jax.Array, cfg: GateConfig, capacity: int
     return combine, dispatch, aux
 
 
+def _grouped_ok() -> bool:
+    """Dropless grouped-GEMM path composes with dp/fsdp batch sharding
+    (a shard_map over the batch axes — each shard routes its own tokens,
+    expert weights gather whole per shard, the ZeRO-3 fetch semantic)
+    but not yet with expert/tensor/sequence model sharding — those fall
+    back to the capacity einsum dispatch whose all-to-alls GSPMD
+    partitions."""
+    from deepspeed_tpu.parallel import topology as topo
+
+    mesh = topo._GLOBAL_MESH
+    if mesh is None:
+        return True
+    return all(mesh.shape.get(a, 1) == 1 for a in ("ep", "tp", "sp", "pp"))
+
+
 def moe_ffn(x: jax.Array, router_w: jax.Array, expert_params: Dict[str, jax.Array],
-            cfg: GateConfig, activation: str = "swiglu", train: bool = True
-            ) -> Tuple[jax.Array, Dict[str, jax.Array]]:
+            cfg: GateConfig, activation: str = "swiglu", train: bool = True,
+            impl: str = "auto") -> Tuple[jax.Array, Dict[str, jax.Array]]:
     """Full MoE FFN block (reference MOELayer.forward sharded_moe.py:589).
 
     x: [B, S, H]; router_w: [H, E]; expert_params: wi/wo(/wg) with leading
     expert dim [E, ...] sharded over the ep mesh axis.
+
+    impl: "einsum" = capacity-padded GShard dispatch (drops overflow
+    tokens, pads underflow — fixed E*C flops); "grouped" = dropless
+    grouped-GEMM execution (reference GroupedExperts, ep_experts.py:136 —
+    exact top-k flops regardless of imbalance); "auto" picks grouped
+    whenever the mesh doesn't shard experts/tp/sp.
     """
+    if impl == "auto":
+        impl = "grouped" if _grouped_ok() else "einsum"
+    if impl == "grouped":
+        return moe_ffn_dropless(x, router_w, expert_params, cfg,
+                                activation=activation, train=train)
     B, S, H = x.shape
     dt = x.dtype
     logits = jnp.einsum("bsh,he->bse", x, router_w.astype(dt))
@@ -145,3 +171,139 @@ def moe_ffn(x: jax.Array, router_w: jax.Array, expert_params: Dict[str, jax.Arra
                      combine.astype(dt))
     out = constrain_activation(out, ("batch", "seq", "embed"))
     return out, aux
+
+
+def _dropless_core(x: jax.Array, router_w: jax.Array,
+                   expert_params: Dict[str, jax.Array], cfg: GateConfig,
+                   activation: str) -> Tuple[jax.Array, Dict[str, jax.Array]]:
+    """Single-shard dropless dispatch. Returns (out, per-shard stats);
+    stats are shaped so that an unweighted mean over equal-sized shards
+    reproduces the global statistic exactly (me/ce/zsq/expert_load are
+    all means over local tokens)."""
+    from deepspeed_tpu.ops.pallas.grouped_matmul import gmm
+
+    B, S, H = x.shape
+    E, k = cfg.num_experts, cfg.top_k
+    dt = x.dtype
+    logits = jnp.einsum("bsh,he->bse", x, router_w.astype(dt))
+    gates = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+    top_vals, top_idx = lax.top_k(gates, k)
+    weights = top_vals / jnp.maximum(
+        jnp.sum(top_vals, axis=-1, keepdims=True), 1e-9)
+
+    tokens = B * S
+    flat_x = x.reshape(tokens, H)
+    flat_expert = top_idx.reshape(-1)                       # [tokens*k]
+    flat_w = weights.reshape(-1)
+    token_idx = jnp.repeat(jnp.arange(tokens, dtype=jnp.int32), k)
+
+    # pad the row count to the 128-row MXU tile; padding rows carry zero
+    # combine weight and point at token 0, so they can run through any
+    # expert (assign E-1: real rows already sum to group_sizes, padding
+    # lands in the last group)
+    m0 = tokens * k
+    m = ((m0 + 127) // 128) * 128
+    pad = m - m0
+    if pad:
+        flat_expert = jnp.concatenate(
+            [flat_expert, jnp.full((pad,), E - 1, flat_expert.dtype)])
+        flat_w = jnp.concatenate([flat_w, jnp.zeros((pad,), flat_w.dtype)])
+        token_idx = jnp.concatenate(
+            [token_idx, jnp.zeros((pad,), token_idx.dtype)])
+
+    order = jnp.argsort(flat_expert, stable=True)           # [M]
+    sorted_token = token_idx[order]
+    sorted_w = flat_w[order]
+    group_sizes = jnp.bincount(flat_expert, length=E).astype(jnp.int32)
+
+    sorted_x = flat_x[sorted_token]                         # [M, H] gather
+
+    wi, wo = expert_params["wi"].astype(dt), expert_params["wo"].astype(dt)
+    if activation == "swiglu":
+        wg = expert_params["wg"].astype(dt)
+        hidden = jax.nn.silu(gmm(sorted_x, wg, group_sizes)) \
+            * gmm(sorted_x, wi, group_sizes)
+    else:
+        hidden = jax.nn.gelu(gmm(sorted_x, wi, group_sizes))
+    expert_out = gmm(hidden, wo, group_sizes)               # [M, H]
+
+    contrib = expert_out * sorted_w[:, None].astype(dt)
+    out = jnp.zeros((tokens, H), dt).at[sorted_token].add(contrib)
+    out = out.reshape(B, S, H)
+
+    stats = {
+        "me": jnp.mean(gates, axis=(0, 1)),                          # [E]
+        "ce": jnp.mean(jax.nn.one_hot(top_idx[..., 0], E,
+                                      dtype=jnp.float32), axis=(0, 1)),
+        "zsq": jnp.mean(jax.nn.logsumexp(
+            logits.astype(jnp.float32), axis=-1) ** 2)[None],
+        "expert_load": (jnp.bincount(top_idx.reshape(-1), length=E)
+                        .astype(jnp.float32) / max(tokens, 1)),
+    }
+    return out, stats
+
+
+def _aux_from_stats(stats: Dict[str, jax.Array], cfg: GateConfig
+                    ) -> Dict[str, jax.Array]:
+    """Same aux-loss formulas as top_k_gating, from (globally averaged)
+    routing statistics."""
+    E = cfg.num_experts
+    aux = {"l_aux": jnp.sum(stats["me"] * stats["ce"]) * E,
+           "expert_load": stats["expert_load"]}
+    if cfg.z_loss_weight:
+        aux["l_zloss"] = stats["zsq"][0]
+    return aux
+
+
+def moe_ffn_dropless(x: jax.Array, router_w: jax.Array,
+                     expert_params: Dict[str, jax.Array], cfg: GateConfig,
+                     activation: str = "swiglu", train: bool = True
+                     ) -> Tuple[jax.Array, Dict[str, jax.Array]]:
+    """Dropless MoE FFN via grouped GEMMs (reference GroupedExperts,
+    moe/ep_experts.py:136).
+
+    Tokens sort by chosen expert (stable argsort keeps static shapes:
+    M = B*S*top_k rows always), experts execute as one grouped matmul per
+    projection (ops/pallas/grouped_matmul.py), and outputs scatter-add
+    back weighted by the gate. Exactly top_k expert-FFNs per token —
+    no capacity padding, no token dropping, flops independent of routing
+    imbalance.
+
+    On a mesh with dp/fsdp/ep batch sharding the dispatch runs inside a
+    shard_map over those axes (a Pallas call can't be GSPMD-partitioned):
+    each shard sorts and executes its local tokens against the whole
+    expert stack (gathered per shard — the ZeRO-3 fetch semantic), and
+    routing statistics average across shards so the aux losses equal the
+    global-batch formulas exactly.
+    """
+    from functools import partial
+
+    from deepspeed_tpu.parallel import topology as topo
+
+    mesh = topo._GLOBAL_MESH
+    batch_axes = tuple(
+        a for a in ("dp", "fsdp", "ep")
+        if mesh is not None and mesh.shape.get(a, 1) > 1)
+    if not batch_axes:
+        out, stats = _dropless_core(x, router_w, expert_params, cfg,
+                                    activation)
+        out = constrain_activation(out, ("batch", "seq", "embed"))
+        return out, _aux_from_stats(stats, cfg)
+
+    from jax.sharding import PartitionSpec as P
+
+    def local_fn(x, router_w, experts):
+        out, stats = _dropless_core(x, router_w, experts, cfg, activation)
+        return out, jax.tree.map(lambda s: s[None], stats)  # lead shard dim
+
+    x_spec = P(batch_axes, None, None)
+    stat_spec = {k: P(batch_axes)
+                 for k in ("me", "ce", "zsq", "expert_load")}
+    out, stats_sh = jax.shard_map(
+        local_fn, mesh=mesh,
+        in_specs=(x_spec, P(), P()),
+        out_specs=(x_spec, stat_spec), check_vma=False,
+    )(x, router_w, expert_params)
+    stats = jax.tree.map(lambda s: jnp.mean(s, axis=0), stats_sh)
+    out = constrain_activation(out, ("batch", "seq", "embed"))
+    return out, _aux_from_stats(stats, cfg)

@@ -172,6 +172,15 @@ class Engine:
             # layout into the process-global dispatcher — clear it
             attn_ops.set_sparse_config(None)
 
+        # -- MoE expert execution engine selection (config.moe.impl) ------
+        mcfg = getattr(model, "config", None)
+        if (config.moe.impl != "auto" and mcfg is not None
+                and hasattr(mcfg, "moe_impl")
+                and mcfg.moe_impl != config.moe.impl):
+            import dataclasses as _dc
+
+            model.config = _dc.replace(mcfg, moe_impl=config.moe.impl)
+
         self.micro_batch_size = config.train_micro_batch_size_per_chip
         self.gradient_accumulation_steps = config.gradient_accumulation_steps
         self.train_batch_size = config.train_batch_size

@@ -1,0 +1,178 @@
+"""Grouped-GEMM dropless MoE tests (interpret mode on CPU).
+
+Reference analog: the grouped-GEMM expert execution engine behind AutoEP
+(deepspeed/moe/ep_experts.py:136 GroupedExperts) — parity against the
+capacity-padded einsum dispatch, gradient correctness, imbalanced
+routing, and the MoE model end-to-end through the grouped path.
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.ops.pallas.grouped_matmul import gmm, make_group_metadata
+from deepspeed_tpu.parallel.moe import (GateConfig, moe_ffn,
+                                        moe_ffn_dropless)
+
+
+def _ref_gmm(lhs, rhs, sizes):
+    """Same-precision reference: per-group jnp.dot slices."""
+    parts, off = [], 0
+    for e in range(rhs.shape[0]):
+        s = int(sizes[e])
+        parts.append(jnp.dot(lhs[off:off + s], rhs[e],
+                             preferred_element_type=jnp.float32))
+        off += s
+    return jnp.concatenate(parts).astype(lhs.dtype)
+
+
+@pytest.mark.parametrize("sizes", [
+    [128, 128],                 # tile-aligned
+    [100, 0, 128, 28],          # boundary mid-tile + empty group
+    [1, 254, 1],                # tiny groups both ends
+    [0, 0, 256, 0],             # single hot expert (max imbalance)
+])
+def test_gmm_forward(sizes):
+    rng = np.random.default_rng(0)
+    sizes = np.asarray(sizes, np.int32)
+    M, K, N = int(sizes.sum()), 64, 128
+    lhs = jnp.asarray(rng.standard_normal((M, K)), jnp.float32)
+    rhs = jnp.asarray(rng.standard_normal((len(sizes), K, N)), jnp.float32)
+    out = gmm(lhs, rhs, jnp.asarray(sizes), 128, 128, 64)
+    ref = _ref_gmm(lhs, rhs, sizes)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=1e-5, rtol=1e-5)
+
+
+def test_gmm_multi_tile_blocks():
+    """Groups spanning several m/n/k tiles."""
+    rng = np.random.default_rng(1)
+    sizes = np.asarray([300, 212, 0, 512], np.int32)
+    M, K, N = int(sizes.sum()), 256, 384
+    lhs = jnp.asarray(rng.standard_normal((M, K)), jnp.float32)
+    rhs = jnp.asarray(rng.standard_normal((len(sizes), K, N)), jnp.float32)
+    out = gmm(lhs, rhs, jnp.asarray(sizes), 128, 128, 128)
+    ref = _ref_gmm(lhs, rhs, sizes)
+    # k-blocked accumulation reorders the fp32 sums vs one long dot
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=1e-4, rtol=1e-4)
+
+
+def test_gmm_grad():
+    rng = np.random.default_rng(2)
+    sizes = np.asarray([100, 156], np.int32)
+    M, K, N = 256, 64, 128
+    lhs = jnp.asarray(rng.standard_normal((M, K)), jnp.float32)
+    rhs = jnp.asarray(rng.standard_normal((2, K, N)), jnp.float32)
+    gs = jnp.asarray(sizes)
+
+    g = jax.grad(lambda l, r: jnp.sum(gmm(l, r, gs, 128, 128, 64) ** 2),
+                 argnums=(0, 1))(lhs, rhs)
+    r = jax.grad(lambda l, r: jnp.sum(_ref_gmm(l, r, sizes) ** 2),
+                 argnums=(0, 1))(lhs, rhs)
+    for a, b in zip(g, r):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=1e-3, rtol=1e-3)
+
+
+def test_metadata_covers_rows_exactly_once():
+    """Every row of every nonempty group appears in exactly one work
+    item's (tile ∩ [row_start, row_end)) range."""
+    sizes = jnp.asarray([100, 0, 128, 28], jnp.int32)
+    m, bm = 256, 128
+    tiles, groups, rs, re = jax.tree.map(
+        np.asarray, make_group_metadata(sizes, m, bm))
+    covered = np.zeros(m, np.int32)
+    for t, g, s, e in zip(tiles, groups, rs, re):
+        lo, hi = t * bm, (t + 1) * bm
+        covered[max(lo, s):min(hi, e)] += 1
+    assert (covered == 1).all()
+
+
+@pytest.mark.parametrize("activation", ["swiglu", "gelu"])
+def test_dropless_matches_einsum(activation):
+    """With capacity big enough that the einsum path drops nothing, the
+    two dispatch engines are the same function."""
+    rng = jax.random.PRNGKey(0)
+    B, S, H, F, E, k = 2, 64, 32, 64, 4, 2
+    cfg = GateConfig(num_experts=E, top_k=k, capacity_factor=float(E),
+                     drop_tokens=True)
+    x = jax.random.normal(rng, (B, S, H), jnp.float32)
+    router = jax.random.normal(jax.random.fold_in(rng, 1), (H, E)) * 0.1
+    params = {
+        "wi": jax.random.normal(jax.random.fold_in(rng, 2), (E, H, F)) * 0.1,
+        "wo": jax.random.normal(jax.random.fold_in(rng, 3), (E, F, H)) * 0.1,
+        "wg": jax.random.normal(jax.random.fold_in(rng, 4), (E, H, F)) * 0.1,
+    }
+    out_e, aux_e = moe_ffn(x, router, params, cfg, activation=activation,
+                           impl="einsum")
+    out_g, aux_g = moe_ffn_dropless(x, router, params, cfg,
+                                    activation=activation)
+    np.testing.assert_allclose(np.asarray(out_g), np.asarray(out_e),
+                               atol=2e-5, rtol=2e-4)
+    np.testing.assert_allclose(float(aux_g["l_aux"]), float(aux_e["l_aux"]),
+                               rtol=1e-5)
+
+
+def test_dropless_imbalanced_routing_drops_nothing():
+    """Zipf-hot router: the capacity path drops tokens, the grouped path
+    routes all of them (the dropless selling point)."""
+    rng = jax.random.PRNGKey(5)
+    B, S, H, F, E, k = 2, 128, 32, 64, 8, 2
+    x = jax.random.normal(rng, (B, S, H), jnp.float32)
+    # bias the router hard toward expert 0
+    router = jnp.zeros((H, E)).at[:, 0].set(1.0)
+    params = {
+        "wi": jnp.ones((E, H, F)) * 0.05,
+        "wo": jnp.ones((E, F, H)) * 0.05,
+        "wg": jnp.ones((E, H, F)) * 0.05,
+    }
+    cfg = GateConfig(num_experts=E, top_k=k, capacity_factor=1.0)
+    out_cap, aux_cap = moe_ffn(x, router, params, cfg, impl="einsum")
+    out_grp, aux_grp = moe_ffn_dropless(x, router, params, cfg)
+    # capacity path: expert 0 overflows its C slots -> load clipped;
+    # grouped path records the true (hot) load and every token routed
+    assert float(aux_grp["expert_load"][0]) > float(aux_cap["expert_load"][0])
+    assert float(jnp.sum(aux_grp["expert_load"])) == pytest.approx(k, rel=1e-5)
+    # dropped tokens show up as rows the capacity path zeroed
+    cap_norms = jnp.linalg.norm(out_cap.reshape(-1, H), axis=-1)
+    grp_norms = jnp.linalg.norm(out_grp.reshape(-1, H), axis=-1)
+    assert int(jnp.sum(cap_norms < 1e-7)) > 0
+    assert int(jnp.sum(grp_norms < 1e-7)) == 0
+
+
+def test_moe_model_trains_through_grouped_path():
+    """End-to-end: MoE transformer with moe_impl='grouped' — two engine
+    steps, finite decreasing-ish loss, and parity at init vs einsum."""
+    import deepspeed_tpu as dstpu
+    from deepspeed_tpu.models.zoo import get_model
+
+    cfgs = {}
+    for impl in ("grouped", "einsum"):
+        model = get_model("tiny-moe", moe_impl=impl, max_seq_len=64)
+        config = {
+            "train_micro_batch_size_per_chip": 2,
+            "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+            "zero_optimization": {"stage": 0},
+            "steps_per_print": 1_000_000,
+        }
+        engine, _, _, _ = dstpu.initialize(model=model, config=config)
+        rng = np.random.default_rng(0)
+        B = engine.micro_batch_size * engine.dp_world_size
+        batch = {"input_ids": rng.integers(
+            0, model.config.vocab_size, (B, 65)).astype(np.int32)}
+
+        def it():
+            while True:
+                yield batch
+
+        losses = [float(engine.train_batch(it())) for _ in range(3)]
+        assert all(np.isfinite(losses)), losses
+        cfgs[impl] = losses
+    # same init, same data: first-step losses agree (capacity_factor of
+    # the tiny preset is large enough that nothing drops at S=64)
+    np.testing.assert_allclose(cfgs["grouped"][0], cfgs["einsum"][0],
+                               rtol=5e-3)
