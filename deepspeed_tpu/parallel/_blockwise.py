@@ -25,11 +25,14 @@ class BlockStats(NamedTuple):
 
 
 def block_attn_partial(q, k, v, q_pos, k_pos, causal: bool,
-                       s_valid: int) -> BlockStats:
+                       s_valid: int, seg_q=None, seg_k=None) -> BlockStats:
     """One Q-block × KV-block partial attention in fp32.
 
     q: [B,Sq,N,D]; k,v: [B,Sk,N,D]; q_pos/k_pos: global positions of the
     rows/keys; keys at positions >= s_valid (padding) are always masked.
+    seg_q/seg_k: optional [B,Sq]/[B,Sk] packed-sequence segment ids —
+    cross-segment pairs are masked (same contract as the flash kernel's
+    segment_ids).
     """
     d = q.shape[-1]
     scores = jnp.einsum("bqnd,bknd->bnqk", q, k).astype(jnp.float32)
@@ -39,7 +42,11 @@ def block_attn_partial(q, k, v, q_pos, k_pos, causal: bool,
         mask = mask & (k_pos[None, :] <= q_pos[:, None])
     else:
         mask = jnp.broadcast_to(mask, (q_pos.shape[0], k_pos.shape[0]))
-    scores = jnp.where(mask[None, None, :, :], scores, -jnp.inf)
+    mask = mask[None, None, :, :]
+    if seg_q is not None:
+        same = seg_q[:, None, :, None] == seg_k[:, None, None, :]  # [B,1,Sq,Sk]
+        mask = mask & same
+    scores = jnp.where(mask, scores, -jnp.inf)
     m = jnp.max(scores, axis=-1)
     valid = jnp.isfinite(m)
     m_safe = jnp.where(valid, m, 0.0)

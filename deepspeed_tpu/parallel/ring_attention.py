@@ -40,10 +40,13 @@ from deepspeed_tpu.utils.comms_logging import get_comms_logger
 BATCH = ("dp", "fsdp", "ep")
 
 
-def _ring_attn_local(q, k, v, *, axis: str, causal: bool, s_global: int):
+def _ring_attn_local(q, k, v, seg, *, axis: str, causal: bool,
+                     s_global: int):
     """Runs INSIDE shard_map: q,k,v are the local [B, S/p, N_loc, D]
-    blocks; rotates kv around ``axis`` accumulating exact softmax (shared
-    numerics in parallel/_blockwise.py)."""
+    blocks; rotates kv (and its segment-id block, for packed batches)
+    around ``axis`` accumulating exact softmax (shared numerics in
+    parallel/_blockwise.py). ``seg`` is the local [B, S/p] segment-id
+    block or a [B, 0] placeholder when the batch is unpacked."""
     from deepspeed_tpu.parallel._blockwise import (
         block_attn_partial, finalize, init_accumulators, online_merge)
 
@@ -51,6 +54,7 @@ def _ring_attn_local(q, k, v, *, axis: str, causal: bool, s_global: int):
     my_idx = lax.axis_index(axis)
     s_loc = q.shape[1]
     q_pos = my_idx * s_loc + jnp.arange(s_loc)
+    has_seg = seg.shape[1] > 0
 
     dt = q.dtype
     B, _, N, D = q.shape
@@ -61,23 +65,27 @@ def _ring_attn_local(q, k, v, *, axis: str, causal: bool, s_global: int):
     # [p, B, N, S/p, S/p] fp32, the O(S^2/p) memory blowup this path
     # exists to avoid (same leak class as fpdt's inner tile scan)
     ck_block = jax.checkpoint(
-        lambda q_, k_, v_, qp, kp: block_attn_partial(
-            q_, k_, v_, qp, kp, causal, s_global))
+        lambda q_, k_, v_, qp, kp, sq, sk: block_attn_partial(
+            q_, k_, v_, qp, kp, causal, s_global, seg_q=sq, seg_k=sk))
 
     def body(carry, step):
-        k_blk, v_blk, o_acc, m_acc, l_acc = carry
+        k_blk, v_blk, seg_blk, o_acc, m_acc, l_acc = carry
         kv_idx = (my_idx - step) % p_size
         k_pos = kv_idx * s_loc + jnp.arange(s_loc)
-        blk = ck_block(q, k_blk, v_blk, q_pos, k_pos)
+        blk = ck_block(q, k_blk, v_blk, q_pos, k_pos,
+                       seg if has_seg else None,
+                       seg_blk if has_seg else None)
         o_acc, m_acc, l_acc = online_merge(o_acc, m_acc, l_acc, blk)
         # rotate kv forward around the ring (device i -> i+1)
         perm = [(i, (i + 1) % p_size) for i in range(p_size)]
         k_blk = lax.ppermute(k_blk, axis, perm)
         v_blk = lax.ppermute(v_blk, axis, perm)
-        return (k_blk, v_blk, o_acc, m_acc, l_acc), None
+        if has_seg:
+            seg_blk = lax.ppermute(seg_blk, axis, perm)
+        return (k_blk, v_blk, seg_blk, o_acc, m_acc, l_acc), None
 
-    (k, v, o_acc, m_acc, l_acc), _ = lax.scan(
-        body, (k, v, o_acc, m_acc, l_acc), jnp.arange(p_size))
+    (k, v, seg, o_acc, m_acc, l_acc), _ = lax.scan(
+        body, (k, v, seg, o_acc, m_acc, l_acc), jnp.arange(p_size))
 
     return finalize(o_acc, l_acc, dt)  # [B,S/p,N,D]
 
@@ -88,8 +96,9 @@ def ring_attention(q, k, v, causal: bool = True, axis: str = "sp",
     the sequence dim is sharded over ``axis``.
 
     q,k,v: [B, S, N, D] global (kv heads already repeated for GQA, same
-    contract as ops/attention.py multi_head_attention). segment_ids are
-    not yet supported under the ring (packing + ring is follow-up work).
+    contract as ops/attention.py multi_head_attention). segment_ids
+    [B, S] mask cross-segment attention for packed batches — the id
+    block rotates around the ring with its KV block.
     """
     from deepspeed_tpu.ops.attention import multi_head_attention
 
@@ -97,8 +106,6 @@ def ring_attention(q, k, v, causal: bool = True, axis: str = "sp",
     if mesh is None or axis not in mesh.shape or mesh.shape[axis] == 1:
         return multi_head_attention(q, k, v, causal=causal,
                                     segment_ids=segment_ids)
-    if segment_ids is not None:
-        raise NotImplementedError("ring attention with segment_ids")
 
     logger = get_comms_logger()
     p_size = mesh.shape[axis]
@@ -114,12 +121,22 @@ def ring_attention(q, k, v, causal: bool = True, axis: str = "sp",
     if pad:
         widths = [(0, 0), (0, pad), (0, 0), (0, 0)]
         q, k, v = (jnp.pad(t, widths) for t in (q, k, v))
+    if segment_ids is None:
+        # zero-width placeholder: shard_map wants a concrete operand, the
+        # local body skips segment masking when it sees width 0
+        seg = jnp.zeros((q.shape[0], 0), jnp.int32)
+    else:
+        # padded keys are masked by position already; -1 also keeps them
+        # out of any real segment
+        seg = jnp.pad(segment_ids.astype(jnp.int32), [(0, 0), (0, pad)],
+                      constant_values=-1)
 
     batch_axes = tuple(a for a in BATCH if a in mesh.shape)
     spec = P(batch_axes, axis, "tp" if "tp" in mesh.shape else None, None)
+    seg_spec = P(batch_axes, None if seg.shape[1] == 0 else axis)
     fn = jax.shard_map(
         partial(_ring_attn_local, axis=axis, causal=causal, s_global=S),
-        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        mesh=mesh, in_specs=(spec, spec, spec, seg_spec), out_specs=spec,
         check_vma=False)
-    out = fn(q, k, v)
+    out = fn(q, k, v, seg)
     return out[:, :S] if pad else out
