@@ -1,0 +1,29 @@
+# Test entry points (VERDICT r2 #9: driver-observable tiers).
+#
+# Tiers (reference analog: modal CI's curated tests/unit/v1 subset vs the
+# full nightly matrix, .github/workflows/*):
+#   make smoke  — fast tier, target <15 min: excludes tests marked `slow`
+#   make test   — full suite
+#   make bench  — the headline bench.py JSON line (real TPU when present)
+#
+# XDIST workers default to auto; on single-core CI hosts xdist overhead
+# outweighs parallelism, so auto collapses to plain pytest there.
+
+NPROC := $(shell nproc)
+# xdist only when installed AND the host has spare cores
+XDIST ?= $(shell if [ $(NPROC) -gt 2 ] && python -c "import xdist" 2>/dev/null; then echo "-n $$(( $(NPROC) - 1 )) --dist loadfile"; fi)
+PYTEST ?= python -m pytest
+
+.PHONY: test smoke slow bench
+
+smoke:
+	$(PYTEST) tests/ -q -m "not slow" $(XDIST)
+
+test:
+	$(PYTEST) tests/ -q $(XDIST)
+
+slow:
+	$(PYTEST) tests/ -q -m "slow" $(XDIST)
+
+bench:
+	python bench.py
