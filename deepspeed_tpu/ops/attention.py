@@ -114,7 +114,10 @@ def multi_head_attention(q, k, v, causal: bool = True, impl: str = "auto",
     if want_flash:
         from deepspeed_tpu.ops.pallas.flash_attention import flash_attention
 
-        block = min(512, seq)  # 512x512 measured best on v5e MXU
+        # v5e measurements (docs/roofline.md): 512 best at short seq;
+        # 1024 wins from ~8K up (fewer grid steps amortize the packed
+        # triangle's per-step overhead — 128K fwd 124 vs 52 TF/s)
+        block = 1024 if seq >= 8192 else min(512, seq)
         return flash_attention(q, k, v, causal=causal,
                                segment_ids=segment_ids,
                                block_q=block, block_k=block)

@@ -28,6 +28,12 @@ from the model's [B, S, H, D]). fp32 accumulation on the MXU
 
 Blocks default to 128x128 (MXU-shaped); 512 measured best on v5e at
 seq >= 1024 (see ops/attention.py dispatch).
+
+Causal grids are *triangle-packed*: the kernels iterate a static work
+list of live (q-block, k-block) pairs via scalar prefetch instead of a
+dense nq x nk grid with a skip gate. A skipped grid step still costs
+its K/V block DMA and grid overhead — at long context that is ~2x
+wasted HBM bandwidth, which is exactly what bounds the kernel at D=128.
 """
 
 from __future__ import annotations
@@ -51,6 +57,51 @@ STAT_LANES = 8
 
 def _interpret() -> bool:
     return jax.default_backend() != "tpu"
+
+
+def _num_items(nq: int, nk: int, causal: bool) -> int:
+    """Work items in the (triangle-)packed grid. Causal requires
+    block_q == block_k, giving the exact lower triangle nq*(nq+1)/2."""
+    return nq * (nq + 1) // 2 if causal else nq * nk
+
+
+def _decompose_q(t, nq: int, nk: int, causal: bool):
+    """Work item t → (iq, ik), q-block-major (all k-blocks of one
+    q-block consecutive — the o/lse accumulation run). Causal packs the
+    lower triangle: t = iq(iq+1)/2 + ik. Closed form (fp32 sqrt + ±1
+    correction — exact for t < 2^23, i.e. any S the scalar core can
+    count): no SMEM work lists, so sequence length is unbounded."""
+    if not causal:
+        return t // nk, t % nk
+    tf = t.astype(jnp.float32)
+    iq = jnp.floor((jnp.sqrt(8.0 * tf + 1.0) - 1.0) * 0.5).astype(jnp.int32)
+    iq = jnp.where(iq * (iq + 1) // 2 > t, iq - 1, iq)
+    iq = jnp.where((iq + 1) * (iq + 2) // 2 <= t, iq + 1, iq)
+    ik = t - iq * (iq + 1) // 2
+    return iq, ik
+
+
+def _decompose_kv(t, nq: int, nk: int, causal: bool):
+    """k-block-major twin (the dk/dv accumulation run). Causal: for
+    k-block ik the q-blocks ik..nq-1 are live; cum(ik) = ik*nq -
+    ik(ik-1)/2 items precede it."""
+    if not causal:
+        return t % nq, t // nq
+    a = 2 * nq + 1
+    tf = t.astype(jnp.float32)
+    disc = jnp.maximum(a * a - 8.0 * tf, 0.0)
+    ik = jnp.floor((a - jnp.sqrt(disc)) * 0.5).astype(jnp.int32)
+    ik = jnp.clip(ik, 0, nq - 1)
+
+    def cum(i):
+        return i * nq - i * (i - 1) // 2
+
+    ik = jnp.where(cum(ik) > t, ik - 1, ik)
+    ik = jnp.where(cum(ik) > t, ik - 1, ik)
+    ik = jnp.where(cum(ik + 1) <= t, ik + 1, ik)
+    ik = jnp.where(cum(ik + 1) <= t, ik + 1, ik)
+    iq = ik + (t - cum(ik))
+    return iq, ik
 
 
 def _kv_row(b, hq: int, hkv: int):
@@ -80,55 +131,53 @@ def _mask(s, *, iq, ik, causal: bool, seg_q, seg_k,
 # ---------------------------------------------------------------------------
 
 
-def _fwd_kernel(*refs, scale: float, causal: bool, has_segments: bool,
-                block_q: int, block_k: int):
+def _fwd_kernel(*refs, scale: float, causal: bool,
+                has_segments: bool, block_q: int, block_k: int,
+                nq: int, nk: int):
     if has_segments:
         q_ref, k_ref, v_ref, sq_ref, sk_ref, o_ref, lse_ref, \
             acc_sc, m_sc, l_sc = refs
     else:
         q_ref, k_ref, v_ref, o_ref, lse_ref, acc_sc, m_sc, l_sc = refs
         sq_ref = sk_ref = None
-    iq, ik = pl.program_id(1), pl.program_id(2)
-    nk = pl.num_programs(2)
+    t = pl.program_id(1)
+    # triangle-packed grid: every step is live; q-major ordering means a
+    # q-block's run starts at its first k-block and ends at the diagonal
+    iq, ik = _decompose_q(t, nq, nk, causal)
+    first = ik == 0
+    last = (ik == iq) if causal else (ik == nk - 1)
 
-    @pl.when(ik == 0)
+    @pl.when(first)
     def _init():
         m_sc[:] = jnp.full_like(m_sc, NEG_INF)
         l_sc[:] = jnp.zeros_like(l_sc)
         acc_sc[:] = jnp.zeros_like(acc_sc)
 
-    # causal: skip key blocks strictly above the diagonal
-    run = True
-    if causal:
-        run = ik * block_k <= iq * block_q + block_q - 1
+    q = q_ref[0]  # [BQ, D]
+    k = k_ref[0]  # [BK, D]
+    v = v_ref[0]
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32) * scale  # [BQ, BK]
+    s = _mask(s, iq=iq, ik=ik, causal=causal,
+              seg_q=sq_ref[0] if has_segments else None,
+              seg_k=sk_ref[0] if has_segments else None,
+              block_q=block_q, block_k=block_k)
 
-    @pl.when(run)
-    def _compute():
-        q = q_ref[0]  # [BQ, D]
-        k = k_ref[0]  # [BK, D]
-        v = v_ref[0]
-        s = jax.lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32) * scale  # [BQ, BK]
-        s = _mask(s, iq=iq, ik=ik, causal=causal,
-                  seg_q=sq_ref[0] if has_segments else None,
-                  seg_k=sk_ref[0] if has_segments else None,
-                  block_q=block_q, block_k=block_k)
+    m_prev = m_sc[:, :1]  # [BQ, 1]
+    m_cur = jnp.max(s, axis=1, keepdims=True)
+    m_new = jnp.maximum(m_prev, m_cur)
+    alpha = jnp.exp(m_prev - m_new)  # [BQ, 1]
+    p = jnp.exp(s - m_new)  # [BQ, BK]
+    l_new = l_sc[:, :1] * alpha + jnp.sum(p, axis=1, keepdims=True)
+    pv = jax.lax.dot_general(
+        p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)  # [BQ, D]
+    acc_sc[:] = acc_sc[:] * alpha + pv
+    m_sc[:] = jnp.broadcast_to(m_new, m_sc.shape)
+    l_sc[:] = jnp.broadcast_to(l_new, l_sc.shape)
 
-        m_prev = m_sc[:, :1]  # [BQ, 1]
-        m_cur = jnp.max(s, axis=1, keepdims=True)
-        m_new = jnp.maximum(m_prev, m_cur)
-        alpha = jnp.exp(m_prev - m_new)  # [BQ, 1]
-        p = jnp.exp(s - m_new)  # [BQ, BK]
-        l_new = l_sc[:, :1] * alpha + jnp.sum(p, axis=1, keepdims=True)
-        pv = jax.lax.dot_general(
-            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)  # [BQ, D]
-        acc_sc[:] = acc_sc[:] * alpha + pv
-        m_sc[:] = jnp.broadcast_to(m_new, m_sc.shape)
-        l_sc[:] = jnp.broadcast_to(l_new, l_sc.shape)
-
-    @pl.when(ik == nk - 1)
+    @pl.when(last)
     def _finalize():
         l = l_sc[:, :1]
         l_safe = jnp.where(l == 0.0, 1.0, l)
@@ -150,38 +199,46 @@ def _flash_fwd(q, k, v, seg_q, seg_k, scale: float, causal: bool,
     def kv_row(b):
         return _kv_row(b, hq, hkv)
 
+    def d_q(t):
+        return _decompose_q(t, nq, nk, causal)
+
     in_specs = [
-        pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),
-        pl.BlockSpec((1, block_k, D), lambda b, i, j: (kv_row(b), j, 0)),
-        pl.BlockSpec((1, block_k, D), lambda b, i, j: (kv_row(b), j, 0)),
+        pl.BlockSpec((1, block_q, D), lambda b, t: (b, d_q(t)[0], 0)),
+        pl.BlockSpec((1, block_k, D),
+                     lambda b, t: (kv_row(b), d_q(t)[1], 0)),
+        pl.BlockSpec((1, block_k, D),
+                     lambda b, t: (kv_row(b), d_q(t)[1], 0)),
     ]
     args = [q, k, v]
     if has_segments:
         in_specs += [
-            pl.BlockSpec((1, block_q), lambda b, i, j: (b // hq, i)),
-            pl.BlockSpec((1, block_k), lambda b, i, j: (b // hq, j)),
+            pl.BlockSpec((1, block_q), lambda b, t: (b // hq, d_q(t)[0])),
+            pl.BlockSpec((1, block_k), lambda b, t: (b // hq, d_q(t)[1])),
         ]
         args += [seg_q, seg_k]
     kernel = functools.partial(
         _fwd_kernel, scale=scale, causal=causal, has_segments=has_segments,
-        block_q=block_q, block_k=block_k)
+        block_q=block_q, block_k=block_k, nq=nq, nk=nk)
     o, lse = pl.pallas_call(
         kernel,
-        grid=(BHq, nq, nk),
+        grid=(BHq, _num_items(nq, nk, causal)),
         in_specs=in_specs,
         out_specs=[
-            pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, block_q, STAT_LANES), lambda b, i, j: (b, i, 0)),
-        ],
-        out_shape=[
-            jax.ShapeDtypeStruct((BHq, S, D), q.dtype),
-            jax.ShapeDtypeStruct((BHq, S, STAT_LANES), jnp.float32),
+            pl.BlockSpec((1, block_q, D), lambda b, t: (b, d_q(t)[0], 0)),
+            pl.BlockSpec((1, block_q, STAT_LANES),
+                         lambda b, t: (b, d_q(t)[0], 0)),
         ],
         scratch_shapes=[
             pltpu.VMEM((block_q, D), jnp.float32),
             pltpu.VMEM((block_q, STAT_LANES), jnp.float32),
             pltpu.VMEM((block_q, STAT_LANES), jnp.float32),
         ],
+        out_shape=[
+            jax.ShapeDtypeStruct((BHq, S, D), q.dtype),
+            jax.ShapeDtypeStruct((BHq, S, STAT_LANES), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary")),
         interpret=_interpret(),
     )(*args)
     return o, lse
@@ -192,8 +249,9 @@ def _flash_fwd(q, k, v, seg_q, seg_k, scale: float, causal: bool,
 # ---------------------------------------------------------------------------
 
 
-def _bwd_dkdv_kernel(*refs, scale: float, causal: bool, has_segments: bool,
-                     nq: int, block_q: int, block_k: int):
+def _bwd_dkdv_kernel(*refs, scale: float, causal: bool,
+                     has_segments: bool, block_q: int, block_k: int,
+                     nq: int, nk: int):
     if has_segments:
         q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, sq_ref, sk_ref, \
             dk_ref, dv_ref, dk_sc, dv_sc = refs
@@ -201,58 +259,57 @@ def _bwd_dkdv_kernel(*refs, scale: float, causal: bool, has_segments: bool,
         q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, \
             dk_ref, dv_ref, dk_sc, dv_sc = refs
         sq_ref = sk_ref = None
-    # grid: (B*Hkv, nk, nq*group) — innermost folds q-blocks × q-head
-    # group so dk/dv accumulate over the whole GQA group in scratch.
-    ik, i = pl.program_id(1), pl.program_id(2)
-    ni = pl.num_programs(2)
-    iq = i % nq
+    # grid: (B*Hkv, T, group) — T iterates the k-block-major packed
+    # triangle, the inner dim the q-head group, so dk/dv accumulate over
+    # (GQA group x live q-blocks) in scratch per k-block run.
+    t, mem = pl.program_id(1), pl.program_id(2)
+    g = pl.num_programs(2)
+    iq, ik = _decompose_kv(t, nq, nk, causal)
+    run_start = ik if causal else 0
+    first = jnp.logical_and(mem == 0, iq == run_start)
+    last = jnp.logical_and(mem == g - 1, iq == nq - 1)
 
-    @pl.when(i == 0)
+    @pl.when(first)
     def _init():
         dk_sc[:] = jnp.zeros_like(dk_sc)
         dv_sc[:] = jnp.zeros_like(dv_sc)
 
-    run = True
-    if causal:
-        run = iq * block_q + block_q - 1 >= ik * block_k
+    q = q_ref[0]
+    k = k_ref[0]
+    v = v_ref[0]
+    do = do_ref[0]
+    lse = lse_ref[0][:, :1]  # [BQ, 1]
+    delta = delta_ref[0][:, :1]
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32) * scale  # [BQ, BK]
+    s = _mask(s, iq=iq, ik=ik, causal=causal,
+              seg_q=sq_ref[0] if has_segments else None,
+              seg_k=sk_ref[0] if has_segments else None,
+              block_q=block_q, block_k=block_k)
+    p = jnp.exp(s - lse)  # [BQ, BK]
+    # dv += p^T @ do
+    dv_sc[:] += jax.lax.dot_general(
+        p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    # dp = do @ v^T ; ds = p * (dp - delta)
+    dp = jax.lax.dot_general(
+        do, v, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    ds = p * (dp - delta)
+    dk_sc[:] += jax.lax.dot_general(
+        ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32) * scale
 
-    @pl.when(run)
-    def _compute():
-        q = q_ref[0]
-        k = k_ref[0]
-        v = v_ref[0]
-        do = do_ref[0]
-        lse = lse_ref[0][:, :1]  # [BQ, 1]
-        delta = delta_ref[0][:, :1]
-        s = jax.lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32) * scale  # [BQ, BK]
-        s = _mask(s, iq=iq, ik=ik, causal=causal,
-                  seg_q=sq_ref[0] if has_segments else None,
-                  seg_k=sk_ref[0] if has_segments else None,
-                  block_q=block_q, block_k=block_k)
-        p = jnp.exp(s - lse)  # [BQ, BK]
-        # dv += p^T @ do
-        dv_sc[:] += jax.lax.dot_general(
-            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
-        # dp = do @ v^T ; ds = p * (dp - delta)
-        dp = jax.lax.dot_general(
-            do, v, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32)
-        ds = p * (dp - delta)
-        dk_sc[:] += jax.lax.dot_general(
-            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32) * scale
-
-    @pl.when(i == ni - 1)
+    @pl.when(last)
     def _finalize():
         dk_ref[0] = dk_sc[:].astype(dk_ref.dtype)
         dv_ref[0] = dv_sc[:].astype(dv_ref.dtype)
 
 
-def _bwd_dq_kernel(*refs, scale: float, causal: bool, has_segments: bool,
-                   block_q: int, block_k: int):
+def _bwd_dq_kernel(*refs, scale: float, causal: bool,
+                   has_segments: bool, block_q: int, block_k: int,
+                   nq: int, nk: int):
     if has_segments:
         q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, sq_ref, sk_ref, \
             dq_ref, dq_sc = refs
@@ -260,42 +317,38 @@ def _bwd_dq_kernel(*refs, scale: float, causal: bool, has_segments: bool,
         q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, \
             dq_ref, dq_sc = refs
         sq_ref = sk_ref = None
-    iq, ik = pl.program_id(1), pl.program_id(2)
-    nk = pl.num_programs(2)
+    t = pl.program_id(1)
+    iq, ik = _decompose_q(t, nq, nk, causal)
+    first = ik == 0
+    last = (ik == iq) if causal else (ik == nk - 1)
 
-    @pl.when(ik == 0)
+    @pl.when(first)
     def _init():
         dq_sc[:] = jnp.zeros_like(dq_sc)
 
-    run = True
-    if causal:
-        run = ik * block_k <= iq * block_q + block_q - 1
+    q = q_ref[0]
+    k = k_ref[0]
+    v = v_ref[0]
+    do = do_ref[0]
+    lse = lse_ref[0][:, :1]
+    delta = delta_ref[0][:, :1]
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32) * scale
+    s = _mask(s, iq=iq, ik=ik, causal=causal,
+              seg_q=sq_ref[0] if has_segments else None,
+              seg_k=sk_ref[0] if has_segments else None,
+              block_q=block_q, block_k=block_k)
+    p = jnp.exp(s - lse)
+    dp = jax.lax.dot_general(
+        do, v, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    ds = p * (dp - delta)
+    dq_sc[:] += jax.lax.dot_general(
+        ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32) * scale
 
-    @pl.when(run)
-    def _compute():
-        q = q_ref[0]
-        k = k_ref[0]
-        v = v_ref[0]
-        do = do_ref[0]
-        lse = lse_ref[0][:, :1]
-        delta = delta_ref[0][:, :1]
-        s = jax.lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32) * scale
-        s = _mask(s, iq=iq, ik=ik, causal=causal,
-                  seg_q=sq_ref[0] if has_segments else None,
-                  seg_k=sk_ref[0] if has_segments else None,
-                  block_q=block_q, block_k=block_k)
-        p = jnp.exp(s - lse)
-        dp = jax.lax.dot_general(
-            do, v, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32)
-        ds = p * (dp - delta)
-        dq_sc[:] += jax.lax.dot_general(
-            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32) * scale
-
-    @pl.when(ik == nk - 1)
+    @pl.when(last)
     def _finalize():
         dq_ref[0] = dq_sc[:].astype(dq_ref.dtype)
 
@@ -312,45 +365,58 @@ def _flash_bwd(q, k, v, seg_q, seg_k, o, lse, do, scale, causal,
     nq, nk = S // block_q, S // block_k
     has_segments = seg_q is not None
 
+    def d_kv(t):
+        return _decompose_kv(t, nq, nk, causal)
+
     # --- dk/dv: one pass per kv head, accumulating over its q-head group
-    def q_row(b, i):
-        return (b // hkv) * hq + (b % hkv) * g + i // nq
+    def q_row(b, m):
+        return (b // hkv) * hq + (b % hkv) * g + m
 
     dkdv_in_specs = [
-        pl.BlockSpec((1, block_q, D), lambda b, j, i: (q_row(b, i), i % nq, 0)),
-        pl.BlockSpec((1, block_k, D), lambda b, j, i: (b, j, 0)),  # k
-        pl.BlockSpec((1, block_k, D), lambda b, j, i: (b, j, 0)),  # v
-        pl.BlockSpec((1, block_q, D), lambda b, j, i: (q_row(b, i), i % nq, 0)),
+        pl.BlockSpec((1, block_q, D),
+                     lambda b, t, m: (q_row(b, m), d_kv(t)[0], 0)),
+        pl.BlockSpec((1, block_k, D),
+                     lambda b, t, m: (b, d_kv(t)[1], 0)),  # k
+        pl.BlockSpec((1, block_k, D),
+                     lambda b, t, m: (b, d_kv(t)[1], 0)),  # v
+        pl.BlockSpec((1, block_q, D),
+                     lambda b, t, m: (q_row(b, m), d_kv(t)[0], 0)),
         pl.BlockSpec((1, block_q, STAT_LANES),
-                     lambda b, j, i: (q_row(b, i), i % nq, 0)),  # lse
+                     lambda b, t, m: (q_row(b, m), d_kv(t)[0], 0)),
         pl.BlockSpec((1, block_q, STAT_LANES),
-                     lambda b, j, i: (q_row(b, i), i % nq, 0)),  # delta
+                     lambda b, t, m: (q_row(b, m), d_kv(t)[0], 0)),
     ]
     dkdv_args = [q, k, v, do, lse, delta]
     if has_segments:
         dkdv_in_specs += [
-            pl.BlockSpec((1, block_q), lambda b, j, i: (b // hkv, i % nq)),
-            pl.BlockSpec((1, block_k), lambda b, j, i: (b // hkv, j)),
+            pl.BlockSpec((1, block_q),
+                         lambda b, t, m: (b // hkv, d_kv(t)[0])),
+            pl.BlockSpec((1, block_k),
+                         lambda b, t, m: (b // hkv, d_kv(t)[1])),
         ]
         dkdv_args += [seg_q, seg_k]
     dkdv = pl.pallas_call(
         functools.partial(_bwd_dkdv_kernel, scale=scale, causal=causal,
-                          has_segments=has_segments, nq=nq,
-                          block_q=block_q, block_k=block_k),
-        grid=(BHkv, nk, nq * g),
+                          has_segments=has_segments,
+                          block_q=block_q, block_k=block_k, nq=nq, nk=nk),
+        grid=(BHkv, _num_items(nq, nk, causal), g),
         in_specs=dkdv_in_specs,
         out_specs=[
-            pl.BlockSpec((1, block_k, D), lambda b, j, i: (b, j, 0)),
-            pl.BlockSpec((1, block_k, D), lambda b, j, i: (b, j, 0)),
-        ],
-        out_shape=[
-            jax.ShapeDtypeStruct((BHkv, S, D), k.dtype),
-            jax.ShapeDtypeStruct((BHkv, S, D), v.dtype),
+            pl.BlockSpec((1, block_k, D),
+                         lambda b, t, m: (b, d_kv(t)[1], 0)),
+            pl.BlockSpec((1, block_k, D),
+                         lambda b, t, m: (b, d_kv(t)[1], 0)),
         ],
         scratch_shapes=[
             pltpu.VMEM((block_k, D), jnp.float32),
             pltpu.VMEM((block_k, D), jnp.float32),
         ],
+        out_shape=[
+            jax.ShapeDtypeStruct((BHkv, S, D), k.dtype),
+            jax.ShapeDtypeStruct((BHkv, S, D), v.dtype),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary", "arbitrary")),
         interpret=_interpret(),
     )(*dkdv_args)
     dk, dv = dkdv
@@ -359,30 +425,40 @@ def _flash_bwd(q, k, v, seg_q, seg_k, o, lse, do, scale, causal,
     def kv_row(b):
         return _kv_row(b, hq, hkv)
 
+    def d_q(t):
+        return _decompose_q(t, nq, nk, causal)
+
     dq_in_specs = [
-        pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),
-        pl.BlockSpec((1, block_k, D), lambda b, i, j: (kv_row(b), j, 0)),
-        pl.BlockSpec((1, block_k, D), lambda b, i, j: (kv_row(b), j, 0)),
-        pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),
-        pl.BlockSpec((1, block_q, STAT_LANES), lambda b, i, j: (b, i, 0)),
-        pl.BlockSpec((1, block_q, STAT_LANES), lambda b, i, j: (b, i, 0)),
+        pl.BlockSpec((1, block_q, D), lambda b, t: (b, d_q(t)[0], 0)),
+        pl.BlockSpec((1, block_k, D),
+                     lambda b, t: (kv_row(b), d_q(t)[1], 0)),
+        pl.BlockSpec((1, block_k, D),
+                     lambda b, t: (kv_row(b), d_q(t)[1], 0)),
+        pl.BlockSpec((1, block_q, D), lambda b, t: (b, d_q(t)[0], 0)),
+        pl.BlockSpec((1, block_q, STAT_LANES),
+                     lambda b, t: (b, d_q(t)[0], 0)),
+        pl.BlockSpec((1, block_q, STAT_LANES),
+                     lambda b, t: (b, d_q(t)[0], 0)),
     ]
     dq_args = [q, k, v, do, lse, delta]
     if has_segments:
         dq_in_specs += [
-            pl.BlockSpec((1, block_q), lambda b, i, j: (b // hq, i)),
-            pl.BlockSpec((1, block_k), lambda b, i, j: (b // hq, j)),
+            pl.BlockSpec((1, block_q), lambda b, t: (b // hq, d_q(t)[0])),
+            pl.BlockSpec((1, block_k), lambda b, t: (b // hq, d_q(t)[1])),
         ]
         dq_args += [seg_q, seg_k]
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
                           has_segments=has_segments,
-                          block_q=block_q, block_k=block_k),
-        grid=(BHq, nq, nk),
+                          block_q=block_q, block_k=block_k, nq=nq, nk=nk),
+        grid=(BHq, _num_items(nq, nk, causal)),
         in_specs=dq_in_specs,
-        out_specs=pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),
-        out_shape=jax.ShapeDtypeStruct((BHq, S, D), q.dtype),
+        out_specs=pl.BlockSpec((1, block_q, D),
+                               lambda b, t: (b, d_q(t)[0], 0)),
         scratch_shapes=[pltpu.VMEM((block_q, D), jnp.float32)],
+        out_shape=jax.ShapeDtypeStruct((BHq, S, D), q.dtype),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary")),
         interpret=_interpret(),
     )(*dq_args)
     return dq, dk, dv
@@ -443,6 +519,10 @@ def flash_attention(q, k, v, causal: bool = True,
         raise ValueError(f"q heads ({Nq}) not a multiple of kv heads ({Nkv})")
     bq = min(block_q, _round_pow2(S))
     bk = min(block_k, _round_pow2(S))
+    if causal and bq != bk:
+        # the packed triangle grid's closed-form (iq, ik) decomposition
+        # assumes square blocks
+        bq = bk = min(bq, bk)
     Sp = -(-S // max(bq, bk)) * max(bq, bk)
 
     if segment_ids is None and not causal and Sp != S:

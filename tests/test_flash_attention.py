@@ -16,6 +16,31 @@ def _qkv(B=1, S=128, N=2, D=32, dtype=jnp.float32, seed=0):
                                    dtype) for i in range(3))
 
 
+def test_triangle_decomposition_exhaustive():
+    """The packed causal grid computes (iq, ik) from the flat work-item
+    index with fp32 sqrt + integer correction — must be exact for every
+    item at every grid size up to 1M-token scale."""
+    from deepspeed_tpu.ops.pallas.flash_attention import (_decompose_kv,
+                                                          _decompose_q,
+                                                          _num_items)
+
+    for nq in (1, 2, 3, 7, 64, 1024):
+        T = _num_items(nq, nq, True)
+        t = jnp.arange(T, dtype=jnp.int32)
+        iq, ik = jax.jit(lambda t: _decompose_q(t, nq, nq, True))(t)
+        iq, ik = np.asarray(iq), np.asarray(ik)
+        # q-major triangle: t = iq(iq+1)/2 + ik, 0 <= ik <= iq
+        assert (iq * (iq + 1) // 2 + ik == np.arange(T)).all(), nq
+        assert (ik <= iq).all() and (ik >= 0).all(), nq
+
+        iq2, ik2 = jax.jit(lambda t: _decompose_kv(t, nq, nq, True))(t)
+        iq2, ik2 = np.asarray(iq2), np.asarray(ik2)
+        # k-major triangle: cum(ik) = ik*nq - ik(ik-1)/2, ik <= iq < nq
+        cum = ik2 * nq - ik2 * (ik2 - 1) // 2
+        assert (cum + (iq2 - ik2) == np.arange(T)).all(), nq
+        assert (iq2 >= ik2).all() and (iq2 < nq).all(), nq
+
+
 def test_forward_matches_xla():
     q, k, v = _qkv(B=2, S=128, N=2, D=32)
     ref = xla_attention(q, k, v, causal=True)
