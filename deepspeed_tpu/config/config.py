@@ -264,6 +264,16 @@ class PipelineConfig(ConfigModel):
     # in-flight depth, reference pipe/schedule.py:189)
     microbatches: int = 0  # 0 = auto
     window: int = 0  # 0 = auto (2*stages)
+    # "waves": waves of `window` microbatches with per-wave remat —
+    #   activation memory O(window + stages) however large `microbatches`
+    #   grows, at the cost of one extra forward per wave (the reference
+    #   1F1B TrainSchedule's bounded depth, pipe/schedule.py:189).
+    # "save_boundaries": one un-rematted pass — the scan saves exactly
+    #   the per-step stage-boundary activations (O(microbatches+stages)
+    #   of them), no wave recompute: pipeline flops match the no-pp
+    #   model within the bubble. Scale batch via gradient accumulation
+    #   instead of microbatches in this mode.
+    schedule: str = "waves"
 
 
 @register_config_model

@@ -52,35 +52,38 @@ def pipeline_enabled(mesh: Optional[Mesh]) -> bool:
     return mesh is not None and mesh.shape.get("pp", 1) > 1
 
 
-# trace-scoped schedule defaults (config.pipeline.{microbatches,window}):
-# the engine enters this around its own model traces, so two engines in
-# one process cannot contaminate each other's pipeline schedule
+# trace-scoped schedule defaults (config.pipeline.{microbatches,window,
+# schedule}): the engine enters this around its own model traces, so two
+# engines in one process cannot contaminate each other's pipeline schedule
 _CONFIG_MICROBATCHES = 0
 _CONFIG_WINDOW = 0
+_CONFIG_SCHEDULE = "waves"
 
 
 class schedule_defaults:
-    """``with schedule_defaults(m, w): model.loss(...)`` — engine-config
-    defaults for pipelined_layers, scoped to the trace."""
+    """``with schedule_defaults(m, w, s): model.loss(...)`` —
+    engine-config defaults for pipelined_layers, scoped to the trace."""
 
-    def __init__(self, microbatches: int = 0, window: int = 0):
-        self._mw = (microbatches, window)
+    def __init__(self, microbatches: int = 0, window: int = 0,
+                 schedule: str = "waves"):
+        self._mws = (microbatches, window, schedule)
 
     def __enter__(self):
-        global _CONFIG_MICROBATCHES, _CONFIG_WINDOW
-        self._prev = (_CONFIG_MICROBATCHES, _CONFIG_WINDOW)
-        _CONFIG_MICROBATCHES, _CONFIG_WINDOW = self._mw
+        global _CONFIG_MICROBATCHES, _CONFIG_WINDOW, _CONFIG_SCHEDULE
+        self._prev = (_CONFIG_MICROBATCHES, _CONFIG_WINDOW, _CONFIG_SCHEDULE)
+        _CONFIG_MICROBATCHES, _CONFIG_WINDOW, _CONFIG_SCHEDULE = self._mws
 
     def __exit__(self, *a):
-        global _CONFIG_MICROBATCHES, _CONFIG_WINDOW
-        _CONFIG_MICROBATCHES, _CONFIG_WINDOW = self._prev
+        global _CONFIG_MICROBATCHES, _CONFIG_WINDOW, _CONFIG_SCHEDULE
+        _CONFIG_MICROBATCHES, _CONFIG_WINDOW, _CONFIG_SCHEDULE = self._prev
         return False
 
 
 def pipelined_layers(layer_fn: Callable, stacked_params: Any, x: jax.Array,
                      num_microbatches: Optional[int] = None,
                      window: Optional[int] = None,
-                     with_aux: bool = False):
+                     with_aux: bool = False,
+                     schedule: Optional[str] = None):
     """Run ``scan(layer_fn)`` over [L, ...]-stacked params as a pp-stage
     pipeline.
 
@@ -93,6 +96,13 @@ def pipelined_layers(layer_fn: Callable, stacked_params: Any, x: jax.Array,
     ``window`` caps in-flight microbatches per rematted wave (1F1B-depth
     memory; default 2*pp). Returns [B, S, H] replicated over pp (and the
     summed aux when ``with_aux``).
+
+    ``schedule``: "waves" remats each window-sized wave (memory
+    O(window+P) for any M, one extra forward per wave); "save_boundaries"
+    runs one un-rematted pass whose scan residuals are exactly the
+    per-step stage-boundary activations — zero recompute above the
+    per-stage remat, memory O(M+P) boundaries (config
+    pipeline.schedule).
     """
     mesh = topo.get_global_mesh()
     PP = mesh.shape["pp"]
@@ -102,10 +112,17 @@ def pipelined_layers(layer_fn: Callable, stacked_params: Any, x: jax.Array,
     while B % M != 0:
         M -= 1
     assert M >= 1
-    W = window or _CONFIG_WINDOW or 2 * PP
-    W = min(W, M)
-    while M % W != 0:
-        W -= 1
+    sched = schedule or _CONFIG_SCHEDULE or "waves"
+    if sched not in ("waves", "save_boundaries"):
+        raise ValueError(f"pipeline schedule must be 'waves' or "
+                         f"'save_boundaries', got {sched!r}")
+    if sched == "save_boundaries":
+        W = M  # single pass; the wave body is not rematted when W == M
+    else:
+        W = window or _CONFIG_WINDOW or 2 * PP
+        W = min(W, M)
+        while M % W != 0:
+            W -= 1
 
     L = jax.tree.leaves(stacked_params)[0].shape[0]
     assert L % PP == 0, f"num_layers {L} must divide pp {PP}"

@@ -561,7 +561,8 @@ class Engine:
         from deepspeed_tpu.parallel import pipeline as pipe_mod
 
         pp_defaults = pipe_mod.schedule_defaults(cfg.pipeline.microbatches,
-                                                 cfg.pipeline.window)
+                                                 cfg.pipeline.window,
+                                                 cfg.pipeline.schedule)
 
         def model_loss(params, batch):
             with shard_lib.qwz_context(qwz_bits), pp_defaults:
@@ -855,14 +856,12 @@ class Engine:
         """Config-driven ZenFlow (reference zenflow_stage_1_and_2.py:47
         enablement via the zero_optimization.zenflow block): replaces the
         blocking host step with top-k on-device updates + an overlapped
-        host pass. Single-process only (the importance split flattens
-        full leaves host-side); multi-host falls back with a warning."""
+        host pass. Multi-host: each process's host optimizer owns its
+        devices' shards (per-shard masters in runtime/zenflow.py); device
+        selection/updates are plain SPMD jits, so no full leaf is ever
+        flattened host-side."""
         zf = self.config.zero_optimization.zenflow
         if zf is None:
-            return None
-        if jax.process_count() > 1:
-            logger.warning("zenflow: multi-host not supported yet; "
-                           "falling back to the blocking offload step")
             return None
         if self.config.zero_optimization.offload_param is not None and \
                 self.config.zero_optimization.offload_param.device != "none":

@@ -167,6 +167,64 @@ def test_window_bounds_memory_as_microbatches_grow(devices):
     assert t32_nowin > t32, (t32_nowin, t32)
 
 
+def test_save_boundaries_schedule(devices):
+    """VERDICT r2 #7: a schedule without the wave-recompute tax.
+    save_boundaries runs one un-rematted pass whose residuals are the
+    per-step stage boundaries: same values/grads as waves, measurably
+    fewer flops (no wave replay), at pp=2 within 10% of the no-pp
+    model's compiled grad flops (the bubble is (P-1)/M)."""
+    mesh = topo.build_mesh({"dp": 4, "pp": 2})
+    topo.set_global_mesh(mesh)
+    L, M, mb, S, H = 4, 16, 1, 8, 64
+    B = M * mb
+    rng = jax.random.PRNGKey(0)
+    w = jax.random.normal(rng, (L, H, H), jnp.float32) * 0.1
+    x = jax.random.normal(jax.random.fold_in(rng, 1), (B, S, H))
+
+    def layer(c, wl):
+        return jnp.tanh(c @ wl) + c
+
+    def loss_fn(schedule, window=4):
+        return lambda w: jnp.sum(pipelined_layers(
+            layer, w, x, num_microbatches=M, window=window,
+            schedule=schedule) ** 2)
+
+    # parity with the waves schedule
+    g_sb = jax.jit(jax.grad(loss_fn("save_boundaries")))(w)
+    g_wv = jax.jit(jax.grad(loss_fn("waves")))(w)
+    np.testing.assert_allclose(np.asarray(g_sb), np.asarray(g_wv),
+                               atol=3e-4)
+
+    def compiled(f, *a):
+        return jax.jit(f).lower(*a).compile()
+
+    c_sb = compiled(jax.grad(loss_fn("save_boundaries")), w)
+    c_wv = compiled(jax.grad(loss_fn("waves")), w)
+
+    # no-pp baseline: the same rematted layer scan on the full batch
+    def base_loss(w):
+        def body(c, wl):
+            return jax.checkpoint(layer)(c, wl), None
+        y, _ = jax.lax.scan(body, x, w)
+        return jnp.sum(y ** 2)
+
+    c_base = compiled(jax.grad(base_loss), w)
+
+    flops = lambda c: c.cost_analysis()["flops"]
+    F_sb, F_wv, F_base = flops(c_sb), flops(c_wv), flops(c_base)
+    # wave remat replays the forward once more than save_boundaries
+    assert F_sb < 0.92 * F_wv, (F_sb, F_wv)
+    # per-device pp program = (M+P-1) stage passes of L/P layers; two
+    # stages together must land within 10% of the no-pp compiled grad
+    # (VERDICT done criterion; bubble (P-1)/M = 1/16 is inside the 10%)
+    assert 2 * F_sb < 1.10 * F_base, (2 * F_sb, F_base)
+
+    # the memory side of the tradeoff (waves bounds residuals at
+    # O(window+P) as M grows) is pinned at scale by
+    # test_window_bounds_memory_as_microbatches_grow; at this toy shape
+    # the wave machinery's fixed overhead dominates, so no assertion here
+
+
 @pytest.mark.parametrize("tied", [True, False])
 def test_pp_embedding_parity(devices, tied):
     """Tied and untied embeddings across pp: GSPMD inserts the tied-grad
