@@ -73,6 +73,7 @@ def main():
     # (the HBM ceiling for small-vocab-heavy models like GPT-2)
     remat = bool(int(os.environ.get("BENCH_REMAT", "1")))
     tiled = int(os.environ.get("BENCH_TILED_LOGITS", "8"))
+    tiled_mlp = int(os.environ.get("BENCH_TILED_MLP", "0"))
     attn = os.environ.get("BENCH_ATTN", "auto")
     # gpt2: full remat (save only the residual stream) measures fastest —
     # saved matmul outputs at micro=224 would cost ~10GB HBM.
@@ -82,7 +83,8 @@ def main():
         "BENCH_REMAT_POLICY",
         "save_attn_out" if llama_headline else "nothing_saveable")
     overrides = dict(max_seq_len=seq, remat=remat, tiled_logits=tiled,
-                     attn_impl=attn, remat_policy=policy)
+                     tiled_mlp=tiled_mlp, attn_impl=attn,
+                     remat_policy=policy)
     if llama_headline:
         # depth that fits one 16GB chip with full fp32 Adam resident;
         # vocab cut so layer matmuls dominate FLOPs like the 32L model

@@ -81,6 +81,11 @@ class TransformerConfig:
     # FPDT-style chunked attention (reference fpdt_layer.py): number of
     # query chunks scanned sequentially, 0/1 = off
     attn_chunks: int = 0
+    # FPDT host-KV streaming (reference _FPDTGPUOffloadingAttentionImpl_
+    # fpdt_layer.py:545): K/V tiles live in pinned host memory and stream
+    # to the chip per chunk — beyond-HBM sequence lengths on one chip.
+    # Uses attn_chunks (min 2) as the chunk count.
+    fpdt_host_kv: bool = False
     # Falcon-style parallel residual: x + attn(ln1(x)) + mlp(ln2(x)),
     # both branches reading the pre-attention residual
     parallel_block: bool = False
@@ -89,6 +94,13 @@ class TransformerConfig:
         if self.sp_mode not in ("ulysses", "ring"):
             raise ValueError(
                 f"sp_mode must be ulysses|ring, got {self.sp_mode!r}")
+        if self.fpdt_host_kv and self.sequence_parallel:
+            # silently running the full-S SP path would OOM at exactly
+            # the lengths the flag promises to enable
+            raise ValueError(
+                "fpdt_host_kv does not compose with sequence_parallel "
+                "yet; shard the sequence (sp) or stream host KV chunks, "
+                "not both")
 
     @property
     def kv_heads(self) -> int:
@@ -354,7 +366,7 @@ def _layer(cfg: TransformerConfig, x, layer_params, positions):
     from deepspeed_tpu.runtime.sharding import effective_dtype
 
     layer_params = _qwz_fetch_tree(cfg, layer_params)
-    ap, mp = layer_params["attn"], layer_params["mlp"]
+    ap = layer_params["attn"]
     dt = effective_dtype(cfg.dtype)
     x = x.astype(dt)
 
@@ -362,6 +374,23 @@ def _layer(cfg: TransformerConfig, x, layer_params, positions):
 
     # attention
     y = _norm(x, layer_params["ln1"], cfg.norm, cfg.norm_eps)
+    if cfg.fpdt_host_kv:
+        # host-KV streaming path: q/k/v/context never materialize at
+        # full S on the chip (parallel/fpdt.py fpdt_attention_block);
+        # fpdt_host_kv + sequence_parallel rejected at config time
+        from deepspeed_tpu.parallel.fpdt import fpdt_attention_block
+
+        attn = fpdt_attention_block(
+            y, ap, positions, num_heads=cfg.num_heads,
+            kv_heads=cfg.kv_heads, head_dim=cfg.head_dim,
+            rope_theta=cfg.rope_theta if cfg.pos_emb == "rope" else None,
+            q_chunks=max(cfg.attn_chunks, 2), causal=True,
+            use_biases=cfg.use_biases)
+        if cfg.use_biases:
+            attn = attn + ap["bo"].astype(dt)
+        attn = constrain_activation(
+            checkpoint_name(attn, "attn_out"), ("batch", "seq", "embed"))
+        return _layer_mlp(cfg, x, attn, layer_params)
     q = jnp.einsum("bsh,hnd->bsnd", y, ap["wq"].astype(dt))
     k = jnp.einsum("bsh,hnd->bsnd", y, ap["wk"].astype(dt))
     v = jnp.einsum("bsh,hnd->bsnd", y, ap["wv"].astype(dt))
@@ -390,6 +419,18 @@ def _layer(cfg: TransformerConfig, x, layer_params, positions):
         attn = attn + ap["bo"].astype(dt)
     attn = constrain_activation(
         checkpoint_name(attn, "attn_out"), ("batch", "seq", "embed"))
+    return _layer_mlp(cfg, x, attn, layer_params)
+
+
+def _layer_mlp(cfg: TransformerConfig, x, attn, layer_params):
+    """Residual-add + MLP half of the block (shared by the standard and
+    fpdt_host_kv attention paths)."""
+    from jax.ad_checkpoint import checkpoint_name
+
+    from deepspeed_tpu.runtime.sharding import effective_dtype
+
+    mp = layer_params["mlp"]
+    dt = effective_dtype(cfg.dtype)
 
     # mlp: sequential (x + attn first) or parallel (Falcon-style — both
     # branches read the pre-attention residual; the loader duplicates a

@@ -28,6 +28,7 @@ per-chip footprint of the resident S/p slice.
 
 from __future__ import annotations
 
+import functools
 from typing import Optional
 
 import jax
@@ -118,3 +119,269 @@ def chunked_attention(q, k, v, causal: bool = True, q_chunks: int = 4,
     _, out = lax.scan(chunk_body, None, (q_t, q_pos0s))
     out = jnp.moveaxis(out, 0, 1).reshape(B, Sp, N, D)
     return out[:, :S] if pad_q else out
+
+
+# ---------------------------------------------------------------------------
+# host-KV streaming attention block (beyond-HBM sequence lengths)
+# ---------------------------------------------------------------------------
+
+
+def _to_host(x):
+    """Move to pinned host memory inside jit (no-op placement on CPU)."""
+    return jax.device_put(x, jax.memory.Space.Host)
+
+
+def _to_device(x):
+    return jax.device_put(x, jax.memory.Space.Device)
+
+
+def _fetch_tile(stacked, t_idx):
+    """Stream one [B, kv_tile, Nkv, D] tile of a host-resident stack to
+    the device."""
+    return _to_device(lax.dynamic_index_in_dim(stacked, t_idx,
+                                               keepdims=False))
+
+
+def _masked_scores(q_c, k_rep, q_pos, k_pos, causal: bool, s_valid: int):
+    """Scaled masked scores [B, N, C, kv_tile] — must match the forward
+    numerics exactly (same einsum + mask as _blockwise)."""
+    d = q_c.shape[-1]
+    s = jnp.einsum("bqnd,bknd->bnqk", q_c, k_rep).astype(jnp.float32)
+    s = s / jnp.sqrt(jnp.asarray(d, jnp.float32))
+    mask = k_pos[None, :] < s_valid
+    if causal:
+        mask = mask & (k_pos[None, :] <= q_pos[:, None])
+    else:
+        mask = jnp.broadcast_to(mask, (q_pos.shape[0], k_pos.shape[0]))
+    return jnp.where(mask[None, None, :, :], s, -jnp.inf)
+
+
+def _repeat_tile(tile, g: int):
+    return jnp.repeat(tile, g, axis=2) if g > 1 else tile
+
+
+def _unrepeat_grad(grad_rep, g: int):
+    """[B, kv_tile, Nkv*g, D] cotangent → summed back to kv heads."""
+    if g == 1:
+        return grad_rep
+    B, T, NG, D = grad_rep.shape
+    return grad_rep.reshape(B, T, NG // g, g, D).sum(axis=3)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8))
+def _stream_attn(q_c, k_t, v_t, q_pos, n_tiles, g, s_valid, causal,
+                 kv_tile):
+    """One q-chunk against host-resident KV tiles, flash-style exact
+    softmax. The custom VJP recomputes per-tile probabilities from the
+    saved logsumexp instead of differentiating through the online-merge
+    scan — without it the scan's backward stacks every tile's fp32
+    (o, m, l) carry, an O(S * N * D) residual that is exactly the memory
+    this path exists to avoid (observed: 2x8GB at 512K)."""
+    ctx, _ = _stream_attn_fwd_impl(q_c, k_t, v_t, q_pos, n_tiles, g,
+                                   s_valid, causal, kv_tile)
+    return ctx
+
+
+def _stream_attn_fwd_impl(q_c, k_t, v_t, q_pos, n_tiles, g, s_valid,
+                          causal, kv_tile):
+    B, C, N, D = q_c.shape
+    T = k_t.shape[0]
+    o = jnp.zeros((B, N, C, D), jnp.float32)
+    m = jnp.full((B, N, C), -jnp.inf, jnp.float32)
+    l = jnp.zeros((B, N, C), jnp.float32)
+
+    def tile_body(carry, t_idx):
+        o, m, l = carry
+        k_rep = _repeat_tile(_fetch_tile(k_t, t_idx), g)
+        v_rep = _repeat_tile(_fetch_tile(v_t, t_idx), g)
+        k_pos = t_idx * kv_tile + jnp.arange(kv_tile)
+        s = _masked_scores(q_c, k_rep, q_pos, k_pos, causal, s_valid)
+        m_blk = jnp.max(s, axis=-1)
+        valid = jnp.isfinite(m_blk)
+        m_safe = jnp.where(valid, m_blk, 0.0)
+        p = jnp.exp(s - m_safe[..., None])
+        l_blk = jnp.where(valid, jnp.sum(p, axis=-1), 0.0)
+        o_blk = jnp.einsum("bnqk,bknd->bnqd", p,
+                           v_rep.astype(jnp.float32))
+        m_new = jnp.maximum(m, jnp.where(valid, m_blk, -jnp.inf))
+        m_new_safe = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
+        alpha = jnp.where(jnp.isfinite(m), jnp.exp(m - m_new_safe), 0.0)
+        beta = jnp.where(valid, jnp.exp(m_blk - m_new_safe), 0.0)
+        o = o * alpha[..., None] + o_blk * beta[..., None]
+        l = l * alpha + l_blk * beta
+        return (o, m_new, l), None
+
+    def guarded(carry, t_idx):
+        return lax.cond(t_idx < n_tiles,
+                        lambda c: tile_body(c, t_idx)[0],
+                        lambda c: c, carry), None
+
+    (o, m, l), _ = lax.scan(guarded, (o, m, l), jnp.arange(T))
+    l_safe = jnp.maximum(l, 1e-30)
+    ctx = jnp.transpose(o / l_safe[..., None], (0, 2, 1, 3)) \
+        .astype(q_c.dtype)                                   # [B,C,N,D]
+    lse = jnp.where(l > 0, jnp.where(jnp.isfinite(m), m, 0.0)
+                    + jnp.log(l_safe), 0.0)                  # [B,N,C]
+    return ctx, lse
+
+
+def _stream_attn_fwd(q_c, k_t, v_t, q_pos, n_tiles, g, s_valid, causal,
+                     kv_tile):
+    ctx, lse = _stream_attn_fwd_impl(q_c, k_t, v_t, q_pos, n_tiles, g,
+                                     s_valid, causal, kv_tile)
+    return ctx, (q_c, k_t, v_t, q_pos, n_tiles, ctx, lse)
+
+
+def _stream_attn_bwd(g, s_valid, causal, kv_tile, res, dctx):
+    import numpy as np
+
+    q_c, k_t, v_t, q_pos, n_tiles, ctx, lse = res
+    B, C, N, D = q_c.shape
+    T = k_t.shape[0]
+    scale = 1.0 / jnp.sqrt(jnp.asarray(D, jnp.float32))
+    dctx32 = jnp.transpose(dctx.astype(jnp.float32), (0, 2, 1, 3))
+    ctx32 = jnp.transpose(ctx.astype(jnp.float32), (0, 2, 1, 3))
+    delta = jnp.sum(dctx32 * ctx32, axis=-1)                 # [B,N,C]
+
+    dq = jnp.zeros((B, N, C, D), jnp.float32)
+    dk_t = jnp.zeros_like(k_t)
+    dv_t = jnp.zeros_like(v_t)
+
+    def tile_body(carry, t_idx):
+        dq, dk_t, dv_t = carry
+        k_tile = _fetch_tile(k_t, t_idx)
+        v_tile = _fetch_tile(v_t, t_idx)
+        k_rep = _repeat_tile(k_tile, g)
+        v_rep = _repeat_tile(v_tile, g)
+        k_pos = t_idx * kv_tile + jnp.arange(kv_tile)
+        s = _masked_scores(q_c, k_rep, q_pos, k_pos, causal, s_valid)
+        p = jnp.exp(s - lse[..., None])                      # [B,N,C,kt]
+        # dv[k] = sum_q p * dctx ; dp = dctx . v ; ds = p (dp - delta)
+        dv_rep = jnp.einsum("bnqk,bnqd->bknd", p, dctx32)
+        dp = jnp.einsum("bnqd,bknd->bnqk", dctx32,
+                        v_rep.astype(jnp.float32))
+        ds = p * (dp - delta[..., None])
+        dq = dq + jnp.einsum("bnqk,bknd->bnqd", ds,
+                             k_rep.astype(jnp.float32)) * scale
+        dk_rep = jnp.einsum("bnqk,bnqd->bknd", ds,
+                            q_c.astype(jnp.float32).transpose(0, 2, 1, 3)
+                            ) * scale
+        dk_tile = _unrepeat_grad(dk_rep, g).astype(k_t.dtype)
+        dv_tile = _unrepeat_grad(dv_rep, g).astype(v_t.dtype)
+        dk_t2 = lax.dynamic_update_index_in_dim(dk_t, dk_tile, t_idx, 0)
+        dv_t2 = lax.dynamic_update_index_in_dim(dv_t, dv_tile, t_idx, 0)
+        return (dq, dk_t2, dv_t2), None
+
+    def guarded(carry, t_idx):
+        return lax.cond(t_idx < n_tiles,
+                        lambda c: tile_body(c, t_idx)[0],
+                        lambda c: c, carry), None
+
+    (dq, dk_t, dv_t), _ = lax.scan(guarded, (dq, dk_t, dv_t),
+                                   jnp.arange(T))
+    dq_out = jnp.transpose(dq, (0, 2, 1, 3)).astype(q_c.dtype)
+    zero_pos = np.zeros(q_pos.shape, dtype=jax.dtypes.float0)
+    zero_nt = np.zeros((), dtype=jax.dtypes.float0)
+    return dq_out, dk_t, dv_t, zero_pos, zero_nt
+
+
+_stream_attn.defvjp(_stream_attn_fwd, _stream_attn_bwd)
+
+
+def fpdt_attention_block(y, ap, positions, *, num_heads: int,
+                         kv_heads: int, head_dim: int,
+                         rope_theta: Optional[float], q_chunks: int,
+                         kv_tile: Optional[int] = None, causal: bool = True,
+                         use_biases: bool = False) -> jax.Array:
+    """Full FPDT attention sub-layer with host-resident KV streaming —
+    the reference ``_FPDTGPUOffloadingAttentionImpl_``'s pinned
+    double-buffered sequence chunks (sequence/fpdt_layer.py:545,
+    ``SequenceChunk`` :497) as XLA memory-space movement.
+
+    y: [B, S, H] normed layer input (device). Returns the attention
+    branch output [B, S, H] (wo applied). Device never holds a full-S
+    [B, S, Nq, D] query/output tensor or repeated-KV tensor:
+
+      * K/V are projected once at kv_heads width (the GQA-narrow 1/g
+        footprint), rotated, tiled, and *moved to host memory*;
+      * the q-chunk scan projects each chunk's queries on the fly and
+        streams KV tiles back one at a time (``device_put`` to device
+        inside the rematted chunk body — XLA's scheduler overlaps the
+        H2D copy with the previous tile's compute, the role of the
+        reference's double buffering);
+      * each chunk's context immediately contracts with wo to [B, C, H].
+
+    The backward replays chunk bodies (remat), re-streaming tiles from
+    host, so residuals are O(B*S*H) rather than O(B*S*Nq*D).
+    """
+    B, S, H = y.shape
+    dt = y.dtype
+    g = num_heads // kv_heads
+    positions = jnp.broadcast_to(positions, (B, S))
+
+    pad_q = (-S) % q_chunks
+    Sp = S + pad_q
+    C = Sp // q_chunks
+    kv_tile = kv_tile or C
+    pad_kv = (-S) % kv_tile
+    Skv = S + pad_kv
+    T = Skv // kv_tile
+
+    def proj(w, b):
+        out = jnp.einsum("bsh,hnd->bsnd", y, w.astype(dt))
+        if use_biases:
+            out = out + b.astype(dt)
+        return out
+
+    # K/V at kv_heads width only — 1/g of the repeated footprint
+    k = proj(ap["wk"], ap.get("bk"))
+    v = proj(ap["wv"], ap.get("bv"))
+    if rope_theta:
+        k = _rope_chunk(k, positions, rope_theta)
+    if pad_kv:
+        k = jnp.pad(k, [(0, 0), (0, pad_kv), (0, 0), (0, 0)])
+        v = jnp.pad(v, [(0, 0), (0, pad_kv), (0, 0), (0, 0)])
+    k_t = _to_host(jnp.moveaxis(k.reshape(B, T, kv_tile, kv_heads, head_dim),
+                                1, 0))
+    v_t = _to_host(jnp.moveaxis(v.reshape(B, T, kv_tile, kv_heads, head_dim),
+                                1, 0))
+
+    y_p = jnp.pad(y, [(0, 0), (0, pad_q), (0, 0)]) if pad_q else y
+    y_c = jnp.moveaxis(y_p.reshape(B, q_chunks, C, H), 1, 0)  # [QC,B,C,H]
+    pos_p = jnp.pad(positions, [(0, 0), (0, pad_q)]) if pad_q else positions
+    pos_c = jnp.moveaxis(pos_p.reshape(B, q_chunks, C), 1, 0)
+
+    wo = ap["wo"].astype(dt)
+
+    def chunk(y_chunk, pos_chunk, chunk_idx):
+        q_c = jnp.einsum("bch,hnd->bcnd", y_chunk, ap["wq"].astype(dt))
+        if use_biases:
+            q_c = q_c + ap["bq"].astype(dt)
+        if rope_theta:
+            q_c = _rope_chunk(q_c, pos_chunk, rope_theta)
+        q_pos = chunk_idx * C + jnp.arange(C)
+
+        # causal: later tiles are fully masked for this chunk — skipped
+        # entirely inside _stream_attn (no H2D fetch, no compute)
+        n_tiles = (jnp.minimum(
+            ((chunk_idx + 1) * C + kv_tile - 1) // kv_tile, T)
+            if causal else jnp.asarray(T, jnp.int32))
+
+        ctx = _stream_attn(q_c, k_t, v_t, q_pos, n_tiles, g, S, causal,
+                           kv_tile)
+        return jnp.einsum("bcnd,ndh->bch", ctx, wo)
+
+    def chunk_body(_, xs):
+        y_chunk, p_chunk, idx = xs
+        return None, jax.checkpoint(chunk)(y_chunk, p_chunk, idx)
+
+    _, out = lax.scan(chunk_body, None,
+                      (y_c, pos_c, jnp.arange(q_chunks)))
+    out = jnp.moveaxis(out, 0, 1).reshape(B, Sp, H)
+    return out[:, :S] if pad_q else out
+
+
+def _rope_chunk(x, positions, theta: float):
+    from deepspeed_tpu.models.transformer import _rope
+
+    return _rope(x, positions, theta)

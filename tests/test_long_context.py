@@ -119,6 +119,85 @@ def test_chunked_attention_grads_match():
 
 
 # ---------------------------------------------------------------------------
+# FPDT host-KV streaming (beyond-HBM path)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("kv_heads", [4, 2])
+def test_fpdt_host_kv_block_matches_dense(kv_heads):
+    """fpdt_attention_block (K/V tiles in host memory, per-chunk q
+    projection + streaming) computes the same attention branch as the
+    dense path, incl. GQA and rope (VERDICT r2 #8)."""
+    from deepspeed_tpu.parallel.fpdt import fpdt_attention_block
+
+    B, S, H, N, D = 2, 48, 32, 4, 8
+    rng = jax.random.PRNGKey(0)
+    y = jax.random.normal(rng, (B, S, H), jnp.float32)
+    positions = jnp.arange(S)[None, :]
+    ap = {
+        "wq": jax.random.normal(jax.random.fold_in(rng, 1), (H, N, D)) * 0.1,
+        "wk": jax.random.normal(jax.random.fold_in(rng, 2),
+                                (H, kv_heads, D)) * 0.1,
+        "wv": jax.random.normal(jax.random.fold_in(rng, 3),
+                                (H, kv_heads, D)) * 0.1,
+        "wo": jax.random.normal(jax.random.fold_in(rng, 4), (N, D, H)) * 0.1,
+    }
+
+    out = jax.jit(lambda y: fpdt_attention_block(
+        y, ap, positions, num_heads=N, kv_heads=kv_heads, head_dim=D,
+        rope_theta=10000.0, q_chunks=4, causal=True))(y)
+
+    # dense reference
+    from deepspeed_tpu.models.transformer import _rope
+    from deepspeed_tpu.ops.attention import repeat_kv_heads
+
+    q = jnp.einsum("bsh,hnd->bsnd", y, ap["wq"])
+    k = jnp.einsum("bsh,hnd->bsnd", y, ap["wk"])
+    v = jnp.einsum("bsh,hnd->bsnd", y, ap["wv"])
+    q = _rope(q, positions, 10000.0)
+    k = _rope(k, positions, 10000.0)
+    k, v = repeat_kv_heads(q, k, v)
+    ref = xla_attention(q, k, v, causal=True)
+    ref = jnp.einsum("bsnd,ndh->bsh", ref, ap["wo"])
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=2e-4)
+
+
+def test_fpdt_host_kv_grads_and_training(devices):
+    """Gradients flow through the host round-trip; a tiny model trains
+    with fpdt_host_kv=True and matches the standard path's first loss."""
+    losses = {}
+    for host_kv in (False, True):
+        cfg = TransformerConfig(
+            vocab_size=64, hidden_size=32, num_layers=2, num_heads=4,
+            num_kv_heads=2, max_seq_len=64, pos_emb="rope", norm="rmsnorm",
+            activation="swiglu", tie_embeddings=True, remat=False,
+            attn_chunks=4, fpdt_host_kv=host_kv, attn_impl="xla")
+        ds_cfg = {
+            "train_micro_batch_size_per_chip": 1,
+            "optimizer": {"type": "adamw", "params": {"lr": 1e-2}},
+            "zero_optimization": {"stage": 0},
+            "steps_per_print": 100,
+        }
+        engine, *_ = dstpu.initialize(model=TransformerLM(cfg),
+                                      config=ds_cfg)
+        rng = np.random.default_rng(0)
+        batch = {"input_ids": rng.integers(
+            0, 64, (engine.micro_batch_size * engine.dp_world_size, 48))
+            .astype(np.int32)}
+
+        def it():
+            while True:
+                yield batch
+
+        stream = it()
+        losses[host_kv] = [float(engine.train_batch(stream))
+                           for _ in range(6)]
+        assert all(np.isfinite(losses[host_kv]))
+        assert losses[host_kv][-1] < losses[host_kv][0]
+    np.testing.assert_allclose(losses[True][0], losses[False][0], rtol=1e-4)
+
+
+# ---------------------------------------------------------------------------
 # end-to-end: all three in one model
 # ---------------------------------------------------------------------------
 
