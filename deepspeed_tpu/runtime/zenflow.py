@@ -25,6 +25,7 @@ pass uses the same vectorized native CPU Adam as the offload tier.
 from __future__ import annotations
 
 import dataclasses
+import functools
 import threading
 from typing import Any, Dict, List, Optional, Tuple
 
@@ -90,6 +91,41 @@ class _AsyncWorker:
         return self._result
 
 
+def _unique_local_shards(x):
+    """Yield (index, [devices], host_data) per DISTINCT addressable shard
+    slice of a jax.Array (plain arrays yield one full-shape shard)."""
+    shards = getattr(x, "addressable_shards", None)
+    if not shards:
+        yield (tuple(slice(None) for _ in np.shape(x)), [None],
+               np.asarray(x))
+        return
+    by_index: Dict[Any, Tuple[List, Any]] = {}
+    for s in shards:
+        key = tuple((sl.start, sl.stop, sl.step) for sl in s.index)
+        if key in by_index:
+            by_index[key][0].append(s.device)
+        else:
+            by_index[key] = ([s.device], s.data)
+    for key, (devs, data) in sorted(by_index.items()):
+        index = tuple(slice(*k) for k in key)
+        yield index, devs, data
+
+
+def _rebuild_global(shape, sharding, metas, flat_bufs):
+    """Per-shard host buffers → one global jax.Array with the leaf's
+    original sharding (offload.py's multi-host reassembly pattern)."""
+    if sharding is None or metas[0][1][0] is None:
+        return jnp.asarray(flat_bufs[0].reshape(shape))
+    arrays = []
+    for (index, devs), buf in zip(metas, flat_bufs):
+        shard_shape = tuple(
+            len(range(*sl.indices(dim))) for sl, dim in zip(index, shape))
+        piece = buf.reshape(shard_shape)
+        for d in devs:
+            arrays.append(jax.device_put(piece, d))
+    return jax.make_array_from_single_device_arrays(shape, sharding, arrays)
+
+
 class ZenFlowOptimizer:
     """Importance-split optimizer over a parameter pytree.
 
@@ -114,16 +150,30 @@ class ZenFlowOptimizer:
         self._sizes = [int(np.prod(s)) for s in self._shapes]
         self._ks = [max(1, int(np.ceil(self.cfg.topk_ratio * n)))
                     for n in self._sizes]
-        # host fp32 masters + native CPU Adam per leaf. Explicit copies:
-        # on CPU backends np.asarray(jax_array) can ALIAS the device
-        # buffer, and the host optimizer mutates masters in place — an
-        # aliased master would corrupt the caller's (immutable) params.
-        self._masters = [np.array(x, np.float32).reshape(-1)
-                         for x in leaves]
-        self._host_opts = [CPUAdam(n, lr=self.lr, betas=self.cfg.betas,
-                                   eps=self.cfg.eps,
-                                   weight_decay=self.cfg.weight_decay)
-                           for n in self._sizes]
+        # host fp32 masters + native CPU Adam PER LOCAL SHARD of each
+        # leaf: each process touches only the slices its devices hold, so
+        # multi-host never flattens a full leaf host-side (the reference
+        # SuperOffload worker owns its rank's partition the same way,
+        # superoffload_utils.py:165). Shards dedupe by index — replicated
+        # leaves run one host optimizer per distinct slice. Explicit
+        # copies: on CPU backends np.asarray(jax_array) can ALIAS the
+        # device buffer, and the host optimizer mutates masters in place.
+        self._shardings = [getattr(x, "sharding", None) for x in leaves]
+        self._shard_meta: List[List[Tuple]] = []  # per leaf: (index, devs)
+        self._masters: List[List[np.ndarray]] = []
+        self._host_opts: List[List[CPUAdam]] = []
+        for x in leaves:
+            metas, bufs, opts = [], [], []
+            for idx, devs, data in _unique_local_shards(x):
+                metas.append((idx, devs))
+                buf = np.array(data, np.float32).reshape(-1)
+                bufs.append(buf)
+                opts.append(CPUAdam(buf.size, lr=self.lr,
+                                    betas=self.cfg.betas, eps=self.cfg.eps,
+                                    weight_decay=self.cfg.weight_decay))
+            self._shard_meta.append(metas)
+            self._masters.append(bufs)
+            self._host_opts.append(opts)
         # device state: accumulators [n], selected idx [k], m/v [k]
         self._acc = [jnp.zeros(n, jnp.float32) for n in self._sizes]
         self._idx = [jnp.arange(k, dtype=jnp.int32) for k in self._ks]
@@ -146,24 +196,56 @@ class ZenFlowOptimizer:
             f"ZenFlow: {len(leaves)} tensors, topk={self.cfg.topk_ratio:.2%}"
             f", update_interval={self.cfg.update_interval}", ranks=[0])
 
-    # -- jitted pieces ---------------------------------------------------
+    # -- jitted pieces (explicit jit: eager ops on multi-host global
+    # arrays are not generally allowed, and every process runs these in
+    # the same order — plain SPMD) --------------------------------------
     @staticmethod
     @jax.jit
     def _accumulate(acc, g):
-        return acc + g
+        return acc + g.reshape(-1).astype(jnp.float32)
 
     @staticmethod
     @jax.jit
-    def _selective_adam(flat_param, g, idx, m, v, step, lr, b1, b2, eps):
+    def _selective_adam(p, g, idx, m, v, step, lr, b1, b2, eps):
         """Adam on the selected coordinates only (ZenFlowSelectiveAdamW)."""
-        sel_g = g[idx]
+        sel_g = g.reshape(-1).astype(jnp.float32)[idx]
         m = b1 * m + (1 - b1) * sel_g
         v = b2 * v + (1 - b2) * sel_g * sel_g
         mhat = m / (1 - b1 ** step)
         vhat = v / (1 - b2 ** step)
         upd = lr * mhat / (jnp.sqrt(vhat) + eps)
-        new = flat_param.astype(jnp.float32).at[idx].add(-upd)
-        return new.astype(flat_param.dtype), m, v
+        new = p.reshape(-1).astype(jnp.float32).at[idx].add(-upd)
+        return new.reshape(p.shape).astype(p.dtype), m, v
+
+    @staticmethod
+    @jax.jit
+    def _zero_at(acc, idx):
+        return acc.at[idx].set(0.0)
+
+    @staticmethod
+    @functools.partial(jax.jit, static_argnames=("shape",))
+    def _ship_acc(acc, idx, shape):
+        return acc.at[idx].set(0.0).reshape(shape)
+
+    @staticmethod
+    @jax.jit
+    def _cat(a, b):
+        return jnp.concatenate([a, b])
+
+    @staticmethod
+    @functools.partial(jax.jit, static_argnames=("k",))
+    def _topk_idx(acc, k):
+        _, idx = jax.lax.top_k(jnp.abs(acc), k)
+        return idx.astype(jnp.int32)
+
+    @staticmethod
+    @jax.jit
+    def _fold(master, p, keep):
+        """Masters own non-selected coords; device values survive for
+        ``keep`` (selected/protected since the last fold-in)."""
+        flat = master.reshape(-1)
+        dev = p.reshape(-1).astype(jnp.float32)
+        return flat.at[keep].set(dev[keep]).reshape(master.shape)
 
     # -- selection -------------------------------------------------------
     def _reselect(self, i: int, initial: bool = False):
@@ -175,7 +257,7 @@ class ZenFlowOptimizer:
         k = self._ks[i]
         if not initial:
             old = self._idx[i]
-            self._acc[i] = self._acc[i].at[old].set(0.0)
+            self._acc[i] = self._zero_at(self._acc[i], old)
             if self._updated_since_foldin[i]:
                 # masters lack the device updates applied to ``old`` since
                 # the last fold-in — protect them until the next fold-in
@@ -183,36 +265,45 @@ class ZenFlowOptimizer:
                 # already equal the device values and protection would
                 # wrongly revert the host's later updates.)
                 self._protected[i] = (old if self._protected[i] is None
-                                      else jnp.concatenate(
-                                          [self._protected[i], old]))
-        _, idx = jax.lax.top_k(jnp.abs(self._acc[i]), k)
-        self._idx[i] = idx.astype(jnp.int32)
+                                      else self._cat(self._protected[i],
+                                                     old))
+        self._idx[i] = self._topk_idx(self._acc[i], k)
         self._m[i] = jnp.zeros(k, jnp.float32)
         self._v[i] = jnp.zeros(k, jnp.float32)
         self._sel_step[i] = 0
 
     # -- host pass -------------------------------------------------------
-    def _host_pass(self, host_grads: List[np.ndarray], lr: float,
-                   denom: float) -> List[np.ndarray]:
-        """One host optimizer pass over all leaves. With workers > 1 the
-        leaves split across a thread pool (SuperOffload's N-worker host
-        optimizer, superoffload_utils.py:165 — worker *threads* here:
-        the native CPUAdam releases the GIL, so threads scale across
-        cores without the reference's process plumbing)."""
-        def one(i, hg):
-            self._host_opts[i].step(self._masters[i], hg / denom, lr=lr)
-            return self._masters[i].copy()
+    def _host_pass(self, host_grads: List[List[np.ndarray]], lr: float,
+                   denom: float) -> List[List[np.ndarray]]:
+        """One host optimizer pass over every (leaf, local shard). With
+        workers > 1 the shards split across a thread pool (SuperOffload's
+        N-worker host optimizer, superoffload_utils.py:165 — worker
+        *threads* here: the native CPUAdam releases the GIL, so threads
+        scale across cores without the reference's process plumbing).
+        Each process steps only its local shards — multi-host splits the
+        host work the way the reference splits it across ranks."""
+        def one(pair):
+            i, s = pair
+            self._host_opts[i][s].step(self._masters[i][s],
+                                       host_grads[i][s] / denom, lr=lr)
+            return self._masters[i][s].copy()
 
-        if self.cfg.workers <= 1 or len(host_grads) <= 1:
-            return [one(i, hg) for i, hg in enumerate(host_grads)]
-        if self._host_pool is None:  # one pool for the whole run
-            import concurrent.futures as _fut
+        pairs = [(i, s) for i in range(len(host_grads))
+                 for s in range(len(host_grads[i]))]
+        if self.cfg.workers <= 1 or len(pairs) <= 1:
+            flat = [one(p) for p in pairs]
+        else:
+            if self._host_pool is None:  # one pool for the whole run
+                import concurrent.futures as _fut
 
-            self._host_pool = _fut.ThreadPoolExecutor(
-                max_workers=self.cfg.workers,
-                thread_name_prefix="zenflow-host")
-        return list(self._host_pool.map(one, range(len(host_grads)),
-                                        host_grads))
+                self._host_pool = _fut.ThreadPoolExecutor(
+                    max_workers=self.cfg.workers,
+                    thread_name_prefix="zenflow-host")
+            flat = list(self._host_pool.map(one, pairs))
+        out: List[List[np.ndarray]] = [[] for _ in host_grads]
+        for (i, _), buf in zip(pairs, flat):
+            out[i].append(buf)
+        return out
 
     # -- main ------------------------------------------------------------
     def step(self, grads, params, lr: Optional[float] = None):
@@ -227,51 +318,75 @@ class ZenFlowOptimizer:
         # Fold-in only runs with the worker idle (a running pass reads the
         # master arrays), and a newer snapshot supersedes a deferred one —
         # masters mutate cumulatively, so the latest copy is complete.
-        done = self._worker.collect(block=not cfg.overlap_step)
-        if done is None and not self._worker.busy and \
-                self._pending_upload is not None:
-            done = self._pending_upload
+        # Multi-host: the fold-in runs jitted SPMD collectives, so WHEN it
+        # happens must be step-deterministic, not host-thread-timing-
+        # dependent — fold only at update-interval boundaries with a
+        # blocking collect (the host pass still overlaps the interior
+        # steps; a timing-based fold would let processes enter different
+        # program sequences and hang the collectives).
+        if jax.process_count() > 1:
+            done = None
+            if self.steps % cfg.update_interval == 0:
+                done = self._worker.collect(block=True)
+                if done is None:
+                    done = self._pending_upload
+        else:
+            done = self._worker.collect(block=not cfg.overlap_step)
+            if done is None and not self._worker.busy and \
+                    self._pending_upload is not None:
+                done = self._pending_upload
         if done is not None:
             self._pending_upload = None  # fresh result supersedes deferred
             new_leaves = []
-            for i, (pl_, master) in enumerate(zip(p_leaves, done)):
-                flat = jnp.asarray(master)
+            for i, (pl_, shard_bufs) in enumerate(zip(p_leaves, done)):
+                master_g = _rebuild_global(
+                    self._shapes[i], self._shardings[i],
+                    self._shard_meta[i], shard_bufs)
                 # device values survive for every coordinate selected
                 # since the last fold-in (masters never saw their grads)
                 keep = self._idx[i]
                 if self._protected[i] is not None:
-                    keep = jnp.concatenate([keep, self._protected[i]])
-                dev_flat = pl_.reshape(-1).astype(jnp.float32)
-                flat = flat.at[keep].set(dev_flat[keep])
-                self._masters[i] = np.array(flat)  # copy: host opt mutates
+                    keep = self._cat(keep, self._protected[i])
+                master_new = self._fold(master_g, pl_, keep)
+                if self._shardings[i] is not None:
+                    master_new = jax.device_put(master_new,
+                                                self._shardings[i])
+                # refresh per-shard masters (copies: host opt mutates)
+                self._masters[i] = [
+                    np.array(data, np.float32).reshape(-1)
+                    for _, _, data in _unique_local_shards(master_new)]
                 self._protected[i] = None
                 self._updated_since_foldin[i] = False
-                new_leaves.append(
-                    flat.reshape(self._shapes[i]).astype(self._dtypes[i]))
+                new_leaves.append(master_new.astype(self._dtypes[i]))
             p_leaves = new_leaves
 
         new_p = []
         for i, (pl_, gl) in enumerate(zip(p_leaves, g_leaves)):
-            g_flat = gl.reshape(-1).astype(jnp.float32)
-            self._acc[i] = self._accumulate(self._acc[i], g_flat)
+            self._acc[i] = self._accumulate(self._acc[i], gl)
             if (self.steps - 1) % cfg.select_interval == 0:
                 self._reselect(i, initial=self.steps == 1)
             self._sel_step[i] += 1
-            flat, self._m[i], self._v[i] = self._selective_adam(
-                pl_.reshape(-1), g_flat, self._idx[i], self._m[i],
+            new_pl, self._m[i], self._v[i] = self._selective_adam(
+                pl_, gl, self._idx[i], self._m[i],
                 self._v[i], jnp.asarray(self._sel_step[i], jnp.float32),
                 jnp.asarray(lr, jnp.float32), cfg.betas[0], cfg.betas[1],
                 cfg.eps)
             self._updated_since_foldin[i] = True
-            new_p.append(flat.reshape(self._shapes[i]))
+            new_p.append(new_pl)
 
         if self.steps % cfg.update_interval == 0:
             # ship accumulated (averaged) grads to the host optimizer,
-            # zeroing the selected coords (already applied on device)
+            # zeroing the selected coords (already applied on device);
+            # each process extracts only its local shards
             host_grads = []
             for i in range(len(new_p)):
-                acc = self._acc[i].at[self._idx[i]].set(0.0)
-                host_grads.append(np.asarray(acc))
+                acc = self._ship_acc(self._acc[i], self._idx[i],
+                                     self._shapes[i])
+                if self._shardings[i] is not None:
+                    acc = jax.device_put(acc, self._shardings[i])
+                host_grads.append([
+                    np.asarray(data, np.float32).reshape(-1)
+                    for _, _, data in _unique_local_shards(acc)])
                 self._acc[i] = jnp.zeros_like(self._acc[i])
             if self._worker.busy:  # previous pass still running: wait
                 self._pending_upload = self._worker.collect(block=True)
@@ -314,11 +429,21 @@ class ZenFlowOptimizer:
 
         return {
             "steps": self.steps,
-            "masters": [m.copy() for m in self._masters],
+            # per-(leaf, local shard) with the shard's slice recorded as
+            # (start, stop) pairs (slice objects don't serialize), so a
+            # restore under a different shard layout can reslice
+            "masters": [[m.copy() for m in ms] for ms in self._masters],
+            "shard_index": [
+                [tuple((sl.start or 0,
+                        sl.stop if sl.stop is not None else dim)
+                       for sl, dim in zip(idx, self._shapes[i]))
+                 for idx, _ in self._shard_meta[i]]
+                for i in range(len(self._shard_meta))],
             # deep-copy moments: CPUAdam.state_dict returns live buffers
             # the next step mutates in place (a torn async serialization
             # would pair step-N masters with step-N+k moments)
-            "host_opt": [copy_opt(o.state_dict()) for o in self._host_opts],
+            "host_opt": [[copy_opt(o.state_dict()) for o in os_]
+                         for os_ in self._host_opts],
             "idx": [np.asarray(i) for i in self._idx],
             "m": [np.asarray(m) for m in self._m],
             "v": [np.asarray(v) for v in self._v],
@@ -331,9 +456,75 @@ class ZenFlowOptimizer:
 
     def load_state_dict(self, sd: Dict[str, Any]):
         self.steps = int(sd["steps"])
-        self._masters = [np.array(m, np.float32) for m in sd["masters"]]
-        for o, os_ in zip(self._host_opts, sd["host_opt"]):
-            o.load_state_dict(os_)
+        if sd["masters"] and isinstance(sd["masters"][0], np.ndarray):
+            # legacy (single-process) checkpoint: one flat master per
+            # leaf — reslice to this run's local shards
+            for i, flat in enumerate(sd["masters"]):
+                full = np.asarray(flat, np.float32).reshape(self._shapes[i])
+                self._masters[i] = [full[idx].reshape(-1).copy()
+                                    for idx, _ in self._shard_meta[i]]
+            for i, os_ in enumerate(sd["host_opt"]):
+                full_m = np.asarray(os_["exp_avg"]).reshape(self._shapes[i])
+                full_v = np.asarray(
+                    os_["exp_avg_sq"]).reshape(self._shapes[i])
+                for s, (idx, _) in enumerate(self._shard_meta[i]):
+                    shard_sd = dict(os_)
+                    shard_sd["exp_avg"] = full_m[idx].reshape(-1).copy()
+                    shard_sd["exp_avg_sq"] = full_v[idx].reshape(-1).copy()
+                    self._host_opts[i][s].load_state_dict(shard_sd)
+        else:
+            for i, (ms, os_) in enumerate(zip(sd["masters"],
+                                              sd["host_opt"])):
+                cur_idx = [
+                    tuple((sl.start or 0,
+                           sl.stop if sl.stop is not None else dim)
+                          for sl, dim in zip(idx, self._shapes[i]))
+                    for idx, _ in self._shard_meta[i]]
+                saved_all = sd.get("shard_index")
+                saved_idx = (cur_idx if saved_all is None else
+                             [tuple(tuple(int(x) for x in p) for p in e)
+                              for e in saved_all[i]])
+                cur_idx = [tuple(tuple(int(x) for x in p) for p in e)
+                           for e in cur_idx]
+                if saved_idx == cur_idx:
+                    self._masters[i] = [np.array(m, np.float32) for m in ms]
+                    for s, shard_sd in enumerate(os_):
+                        self._host_opts[i][s].load_state_dict(shard_sd)
+                    continue
+                # layout changed (different process count / sharding):
+                # reassemble the full leaf from the saved shards, reslice.
+                # Requires the saved shards to cover the leaf — a
+                # per-process partial checkpoint can't restore here.
+                full_m = np.zeros(self._shapes[i], np.float32)
+                full_ea = np.zeros(self._shapes[i], np.float32)
+                full_es = np.zeros(self._shapes[i], np.float32)
+                covered = np.zeros(self._shapes[i], bool)
+                step_count = 0
+                for e, buf, shard_sd in zip(saved_idx, ms, os_):
+                    sl = tuple(slice(a, b) for a, b in e)
+                    shp = tuple(b - a for a, b in e)
+                    full_m[sl] = np.asarray(buf).reshape(shp)
+                    full_ea[sl] = np.asarray(
+                        shard_sd["exp_avg"]).reshape(shp)
+                    full_es[sl] = np.asarray(
+                        shard_sd["exp_avg_sq"]).reshape(shp)
+                    covered[sl] = True
+                    step_count = int(shard_sd["step"])
+                if not covered.all():
+                    raise ValueError(
+                        "zenflow restore: saved shards do not cover leaf "
+                        f"{i} — a per-process partial checkpoint cannot "
+                        "restore under a different shard layout; save a "
+                        "full checkpoint (all processes) or restore with "
+                        "the original topology")
+                self._masters[i] = []
+                for s, (idx, _) in enumerate(self._shard_meta[i]):
+                    piece = full_m[idx].reshape(-1).copy()
+                    self._masters[i].append(piece)
+                    self._host_opts[i][s].load_state_dict({
+                        "exp_avg": full_ea[idx].reshape(-1).copy(),
+                        "exp_avg_sq": full_es[idx].reshape(-1).copy(),
+                        "step": step_count})
         self._idx = [jnp.asarray(i) for i in sd["idx"]]
         self._m = [jnp.asarray(m) for m in sd["m"]]
         self._v = [jnp.asarray(v) for v in sd["v"]]

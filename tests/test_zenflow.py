@@ -257,3 +257,45 @@ def test_host_pass_workers_match_serial(devices):
     for k in params:
         np.testing.assert_allclose(np.asarray(p3[k]), np.asarray(p1[k]),
                                    rtol=1e-6)
+
+
+@pytest.mark.slow
+def test_multihost_two_process_matches_single():
+    """VERDICT r2 #6: ZenFlow on 2 jax.distributed processes x 4 devices
+    (per-process per-shard host masters, gloo collectives) produces the
+    same loss stream as the single-process 8-device run."""
+    import json
+    import os
+    import socket
+    import subprocess
+    import sys
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    worker = os.path.join(here, "zenflow_worker.py")
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("XLA_FLAGS", "JAX_PLATFORMS",
+                        "_DSTPU_AFFINITY_REEXEC", "LD_PRELOAD")}
+
+    def run_single():
+        out = subprocess.run([sys.executable, worker, "single"],
+                             capture_output=True, text=True, timeout=2400,
+                             env=env)
+        assert out.returncode == 0, out.stderr[-2000:]
+        return json.loads(out.stdout.strip().splitlines()[-1])["losses"]
+
+    def run_multi():
+        with socket.socket() as s:  # free rendezvous port
+            s.bind(("127.0.0.1", 0))
+            env["ZF_PORT"] = str(s.getsockname()[1])
+        procs = [subprocess.Popen(
+            [sys.executable, worker, "multi", str(pid)],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+            env=env) for pid in (0, 1)]
+        outs = [p.communicate(timeout=2400) for p in procs]
+        for p, (so, se) in zip(procs, outs):
+            assert p.returncode == 0, se[-2000:]
+        return json.loads(outs[0][0].strip().splitlines()[-1])["losses"]
+
+    single = run_single()
+    multi = run_multi()
+    np.testing.assert_allclose(multi, single, rtol=2e-4)
