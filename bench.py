@@ -65,6 +65,7 @@ def main():
     #  - gpt2-125m: micro=224 with flash block-512 → ~75k tok/s, MFU 0.33.
     micro_default = 8 if llama_headline else 224
     micro = int(os.environ.get("BENCH_MICRO", micro_default if on_tpu else 1))
+    gas = int(os.environ.get("BENCH_GAS", 1))
     steps = int(os.environ.get("BENCH_STEPS", 20 if on_tpu else 3))
     warmup = 3 if on_tpu else 1
 
@@ -146,18 +147,23 @@ def main():
 
     config = {
         "train_micro_batch_size_per_chip": micro,
-        "gradient_accumulation_steps": 1,
+        "gradient_accumulation_steps": gas,
         "optimizer": {"type": "adamw",
                       "params": {"lr": 1e-4, "weight_decay": 0.1}},
         "zero_optimization": {"stage": zero_stage},
         "bf16": {"enabled": True},
         "steps_per_print": 1_000_000,
     }
-    if int(os.environ.get("BENCH_OFFLOAD", "0")):
+    offload = int(os.environ.get("BENCH_OFFLOAD", "0"))
+    if offload:
         # ZeRO-Offload mode: fp32 master + Adam state live in host RAM,
         # the chip keeps bf16 params only (capacity benchmark — the
         # reference's "13B on one GPU" claim class)
         config["zero_optimization"]["offload_optimizer"] = {"device": "cpu"}
+    if offload >= 2:
+        # ZeRO-Infinity pairing: layer params stream from pinned host
+        # memory one layer at a time (offload_param)
+        config["zero_optimization"]["offload_param"] = {"device": "cpu"}
     engine, _, _, _ = dstpu.initialize(model=model, config=config,
                                        topology=topology)
 
@@ -181,7 +187,7 @@ def main():
     jax.block_until_ready(loss)
     dt = time.perf_counter() - t0
 
-    tokens = B * seq * steps
+    tokens = B * seq * steps * gas  # train_batch runs gas microbatches
     tok_per_sec_chip = tokens / dt / n_chips
     flops_per_token = model.flops_per_token()
     peak = detect_peak_tflops(jax.devices()[0])

@@ -5,6 +5,13 @@ from deepspeed_tpu.compression.compress import (  # noqa: F401
     init_compression,
     redundancy_clean,
 )
+from deepspeed_tpu.compression.distillation import (  # noqa: F401
+    DistillationConfig,
+    StudentTeacherModel,
+    init_distillation,
+    kd_loss,
+    student_from_teacher,
+)
 from deepspeed_tpu.compression.quantization import (  # noqa: F401
     fake_quantize,
     quantize_activation,

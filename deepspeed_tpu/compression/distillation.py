@@ -1,0 +1,164 @@
+"""Knowledge distillation for structural compression.
+
+Reference: ``deepspeed/compression/compress.py:100`` — ``init_compression
+(model, config, teacher_model=...)`` pairs layer-reduction students with
+a teacher, and the compression examples train the student on a soft
+KL term against the teacher's logits plus the hard-label CE (the
+DistilBERT/TinyBERT recipe the reference's layer_reduction tutorial
+follows).
+
+TPU-native shape: the teacher forward runs inside the same jitted loss
+under ``stop_gradient`` (no separate serving pass, XLA overlaps both
+networks), and the student is born from the teacher by slicing the
+stacked layer axis — ``layer_reduction.keep_layers`` indexes [L, ...]
+arrays directly instead of rewriting a module graph.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from deepspeed_tpu.utils.logging import log_dist
+
+
+@dataclasses.dataclass(frozen=True)
+class DistillationConfig:
+    """Reference knobs (compression examples' kd settings)."""
+
+    temperature: float = 2.0
+    alpha_kd: float = 0.5      # soft-target KL weight
+    alpha_ce: float = 0.5      # hard-label CE weight
+    alpha_hidden: float = 0.0  # optional last-hidden MSE weight
+
+
+def kd_loss(student_logits: jax.Array, teacher_logits: jax.Array,
+            temperature: float) -> jax.Array:
+    """Temperature-scaled KL(teacher || student), mean over tokens,
+    scaled by T^2 (gradient magnitude invariant in T — Hinton et al.)."""
+    t = jax.nn.log_softmax(teacher_logits.astype(jnp.float32) / temperature)
+    s = jax.nn.log_softmax(student_logits.astype(jnp.float32) / temperature)
+    return (jnp.exp(t) * (t - s)).sum(-1).mean() * temperature ** 2
+
+
+def student_from_teacher(teacher_model, teacher_params,
+                         keep_layers: Sequence[int]):
+    """Build a layer-reduced student initialized from the teacher
+    (reference layer_reduction: student layer i <- teacher layer
+    keep_layers[i]; embeddings/final norm copied).
+
+    Returns (student_model, student_params).
+    """
+    import dataclasses as _dc
+
+    keep = [int(i) for i in keep_layers]
+    cfg = teacher_model.config
+    if any(i < 0 or i >= cfg.num_layers for i in keep):
+        raise ValueError(f"keep_layers {keep} out of range for "
+                         f"{cfg.num_layers}-layer teacher")
+    student_cfg = _dc.replace(cfg, num_layers=len(keep))
+    student_model = type(teacher_model)(student_cfg)
+
+    idx = jnp.asarray(keep)
+    sp = {k: v for k, v in teacher_params.items()}
+    sp["layers"] = jax.tree.map(lambda a: a[idx], teacher_params["layers"])
+    log_dist(f"distillation: student keeps teacher layers {keep}",
+             ranks=[0])
+    return student_model, sp
+
+
+class StudentTeacherModel:
+    """Model-protocol wrapper: trains the student against hard labels +
+    the teacher's soft targets. The teacher's params live on the object
+    (never part of the optimized tree) and its forward runs under
+    stop_gradient inside the same compiled step."""
+
+    def __init__(self, student, teacher, teacher_params,
+                 config: Optional[DistillationConfig] = None):
+        self.student = student
+        self.teacher = teacher
+        self.teacher_params = teacher_params
+        self.kd = config or DistillationConfig()
+        self.config = student.config  # engine reads model.config
+
+    def init(self, rng):
+        return self.student.init(rng)
+
+    def logical_axes(self):
+        return self.student.logical_axes()
+
+    def apply(self, params, tokens, positions=None):
+        return self.student.apply(params, tokens, positions)
+
+    def loss(self, params, batch) -> Any:
+        kd = self.kd
+        tokens = batch["input_ids"]
+        inputs, labels = tokens[:, :-1], tokens[:, 1:]
+        s_logits = self.student.apply(params, inputs)
+        t_logits = lax.stop_gradient(
+            self.teacher.apply(self.teacher_params, inputs))
+
+        logz = jax.nn.logsumexp(s_logits, axis=-1)
+        gold = jnp.take_along_axis(s_logits, labels[..., None],
+                                   axis=-1)[..., 0]
+        ce = (logz - gold).mean()
+        soft = kd_loss(s_logits, t_logits, kd.temperature)
+        total = kd.alpha_ce * ce + kd.alpha_kd * soft
+        aux: Dict[str, jax.Array] = {
+            "lm_loss": ce, "kd_loss": soft,
+            "ntokens": jnp.asarray(labels.size, jnp.float32)}
+        if kd.alpha_hidden:
+            # last-hidden MSE needs matching widths (same hidden_size)
+            from deepspeed_tpu.models import transformer as tfm
+
+            sh = tfm.apply_hidden(self.student.config, params, inputs)
+            th = lax.stop_gradient(tfm.apply_hidden(
+                self.teacher.config, self.teacher_params, inputs))
+            hid = jnp.mean((sh.astype(jnp.float32)
+                            - th.astype(jnp.float32)) ** 2)
+            total = total + kd.alpha_hidden * hid
+            aux["hidden_loss"] = hid
+        aux["loss"] = total
+        return total, aux
+
+    def flops_per_token(self):
+        # student + teacher forward both run per step
+        return (self.student.flops_per_token()
+                + self.teacher.flops_per_token() / 3)
+
+    def num_params(self):
+        return self.student.num_params()
+
+
+def init_distillation(teacher_model, teacher_params,
+                      compression_config: Dict[str, Any],
+                      kd_config: Optional[DistillationConfig] = None):
+    """Reference-parity entry: layer_reduction block + teacher →
+    (StudentTeacherModel, student_params) ready for dstpu.initialize
+    (the reference's init_compression(model, cfg, teacher_model=...)).
+    """
+    cfg = compression_config.get("compression_training",
+                                 compression_config) or {}
+    lr = cfg.get("layer_reduction", {})
+    if not lr.get("enabled", False):
+        raise ValueError("init_distillation needs an enabled "
+                         "layer_reduction block (keep_layers or "
+                         "keep_number_layer)")
+    keep = lr.get("keep_layers")
+    if keep is None:
+        import numpy as np
+
+        n = int(lr["keep_number_layer"])
+        total = int(lr.get("total_layers",
+                           teacher_model.config.num_layers))
+        keep = sorted(set(np.linspace(0, total - 1, n).astype(int)
+                          .tolist()))
+    student, sparams = student_from_teacher(teacher_model, teacher_params,
+                                            keep)
+    wrapper = StudentTeacherModel(student, teacher_model, teacher_params,
+                                  kd_config)
+    return wrapper, sparams

@@ -475,16 +475,30 @@ class Engine:
                     init_fn, out_shardings=out_sh)(self._rng)
             self.opt_state = None
         elif self._offload_device in ("cpu", "nvme"):
-            # fp32 init sharded like optimizer state, pulled host-side into
-            # the native offload optimizer; device keeps compute dtype only
-            # (reference: stage_1_and_2.py cpu_offload / stage3.py
-            # offload_optimizer paths).
+            # fp32 init sharded like optimizer state and written STRAIGHT
+            # to pinned host memory (out_shardings memory kind): the full
+            # fp32 model never resides in HBM, so multi-B-param offload
+            # configs initialize on one 16GB chip (zero.Init analog for
+            # the offload tier; reference stage_1_and_2.py cpu_offload /
+            # stage3.py offload_optimizer paths).
             def init32(rng):
                 p32 = self.model.init(rng)
                 return _constrain_tree(p32, opt_sh)
 
+            # the CPU simulator can't lower in-jit host placement
+            # ("side-effect ops cannot be replicated"); there the fp32
+            # tree is small — init on device and move below
+            host_init = jax.default_backend() == "tpu"
+            out_sh = (jax.tree.map(
+                lambda s: s.with_memory_kind("pinned_host"), opt_sh)
+                if host_init else opt_sh)
             with jax.set_mesh(mesh) if hasattr(jax, "set_mesh") else _nullctx():
-                p32 = jax.jit(init32)(self._rng)
+                p32 = jax.jit(init32, out_shardings=out_sh)(self._rng)
+            if not host_init:
+                p32 = jax.tree.map(
+                    lambda a: jax.device_put(
+                        a, a.sharding.with_memory_kind("pinned_host")),
+                    p32)
             from deepspeed_tpu.runtime.offload import HostOffloadOptimizer
 
             ocfg = self.config.optimizer
@@ -501,26 +515,32 @@ class Engine:
                 nvme_path=(off.nvme_path
                            if self._offload_device == "nvme" else None),
                 host_memory_leaf_prefixes=host_prefixes)
-            host_layers = None
-            if host_prefixes and isinstance(p32, dict) and "layers" in p32:
-                # pin the TRUE fp32 masters host-side before the compute-
-                # dtype cast (casting first would store bf16-rounded
-                # values relabeled fp32, and waste a d2h round trip)
-                host_layers = jax.tree.map(
-                    lambda a: jax.device_put(
-                        a, a.sharding.with_memory_kind("pinned_host")),
-                    p32["layers"])
-            cast = jax.jit(
-                lambda t: _constrain_tree(
-                    jax.tree.map(lambda m: m.astype(cdt), t), param_sh),
-                donate_argnums=(0,))
-            # ZenFlow masters must come from the TRUE fp32 init (cast()
-            # below donates p32 and yields bf16-rounded leaves)
+            # ZenFlow masters come from the TRUE fp32 init
             self._zenflow = self._maybe_build_zenflow(p32)
-            self.params = cast(p32)
-            if host_layers is not None:
+            # the compute-dtype params must land back in DEVICE memory —
+            # XLA would otherwise propagate the staged inputs' host space
+            # into the outputs. TPU: out_shardings memory kind; CPU sim:
+            # explicit device_put (in-jit placement doesn't lower there).
+            if host_init:
+                cast = jax.jit(
+                    lambda t: jax.tree.map(lambda m: m.astype(cdt), t),
+                    out_shardings=jax.tree.map(
+                        lambda s: s.with_memory_kind("device"), param_sh))
+                self.params = cast(p32)
+            else:
+                cast = jax.jit(
+                    lambda t: _constrain_tree(
+                        jax.tree.map(lambda m: m.astype(cdt), t), param_sh))
+                self.params = jax.tree.map(
+                    lambda a: jax.device_put(
+                        a, a.sharding.with_memory_kind("device")),
+                    cast(p32))
+            if host_prefixes and isinstance(p32, dict) and "layers" in p32:
+                # layer params stay the pinned fp32 masters (the compiled
+                # step streams one layer at a time); drop the device bf16
+                # copies the cast produced
                 self.params = dict(self.params)
-                self.params["layers"] = host_layers
+                self.params["layers"] = p32["layers"]
             self.opt_state = None
         else:
             def init_fn(rng):

@@ -158,7 +158,17 @@ class ZenFlowOptimizer:
         # leaves run one host optimizer per distinct slice. Explicit
         # copies: on CPU backends np.asarray(jax_array) can ALIAS the
         # device buffer, and the host optimizer mutates masters in place.
-        self._shardings = [getattr(x, "sharding", None) for x in leaves]
+        # normalize to device memory kind: the offload tier may hand us
+        # pinned-host fp32 masters (engine host-side init), but fold-ins
+        # rebuild/consume masters as device arrays
+        def _dev_sharding(x):
+            s = getattr(x, "sharding", None)
+            if s is not None and getattr(s, "memory_kind", None) not in (
+                    None, "device"):
+                s = s.with_memory_kind("device")
+            return s
+
+        self._shardings = [_dev_sharding(x) for x in leaves]
         self._shard_meta: List[List[Tuple]] = []  # per leaf: (index, devs)
         self._masters: List[List[np.ndarray]] = []
         self._host_opts: List[List[CPUAdam]] = []

@@ -272,9 +272,11 @@ def test_multihost_two_process_matches_single():
 
     here = os.path.dirname(os.path.abspath(__file__))
     worker = os.path.join(here, "zenflow_worker.py")
+    # keep LD_PRELOAD: the conftest affinity shim prevents the XLA-CPU
+    # collective-rendezvous race in the workers too (see conftest)
     env = {k: v for k, v in os.environ.items()
            if k not in ("XLA_FLAGS", "JAX_PLATFORMS",
-                        "_DSTPU_AFFINITY_REEXEC", "LD_PRELOAD")}
+                        "_DSTPU_AFFINITY_REEXEC")}
 
     def run_single():
         out = subprocess.run([sys.executable, worker, "single"],
@@ -283,7 +285,7 @@ def test_multihost_two_process_matches_single():
         assert out.returncode == 0, out.stderr[-2000:]
         return json.loads(out.stdout.strip().splitlines()[-1])["losses"]
 
-    def run_multi():
+    def run_multi(attempt):
         with socket.socket() as s:  # free rendezvous port
             s.bind(("127.0.0.1", 0))
             env["ZF_PORT"] = str(s.getsockname()[1])
@@ -293,9 +295,20 @@ def test_multihost_two_process_matches_single():
             env=env) for pid in (0, 1)]
         outs = [p.communicate(timeout=2400) for p in procs]
         for p, (so, se) in zip(procs, outs):
-            assert p.returncode == 0, se[-2000:]
+            if p.returncode != 0:
+                # first-run compile drift can outlive gloo's ~30s pair
+                # timeout on single-core hosts; the persistent compile
+                # cache (ZF_CACHE) makes the retry near-instant
+                if attempt == 0 and "Gloo" in se:
+                    return None
+                assert p.returncode == 0, se[-2000:]
         return json.loads(outs[0][0].strip().splitlines()[-1])["losses"]
 
+    import tempfile
+
+    env["ZF_CACHE"] = tempfile.mkdtemp(prefix="zf_cache_")
     single = run_single()
-    multi = run_multi()
+    multi = run_multi(0)
+    if multi is None:
+        multi = run_multi(1)
     np.testing.assert_allclose(multi, single, rtol=2e-4)

@@ -31,6 +31,14 @@ import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_num_cpu_devices", ndev)
+if os.environ.get("ZF_CACHE"):
+    # persistent compile cache: on single-core CI hosts the two
+    # processes' first-run compiles drift by minutes while gloo's pair
+    # timeout is ~30s; a warm cache collapses the drift (the test
+    # retries once after populating it)
+    jax.config.update("jax_compilation_cache_dir", os.environ["ZF_CACHE"])
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
 if mode == "multi":
     jax.config.update("jax_cpu_collectives_implementation", "gloo")
     port = os.environ.get("ZF_PORT", "29751")
