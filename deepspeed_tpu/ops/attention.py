@@ -111,6 +111,14 @@ def multi_head_attention(q, k, v, causal: bool = True, impl: str = "auto",
         impl == "flash"
         or (impl == "auto" and _flash_available() and seq >= FLASH_MIN_SEQ)
     )
+    if (impl == "auto" and seq >= FLASH_MIN_SEQ and not want_flash
+            and jax.default_backend() == "tpu"):
+        # the flash kernel should have dispatched here but can't load —
+        # the O(S^2)-memory XLA path is a real perf downgrade on TPU
+        from deepspeed_tpu.utils import telemetry
+
+        telemetry.count("attention.flash_to_xla_fallback",
+                        "pallas flash kernel unavailable on tpu backend")
     if want_flash:
         from deepspeed_tpu.ops.pallas.flash_attention import flash_attention
 

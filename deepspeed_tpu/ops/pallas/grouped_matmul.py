@@ -63,14 +63,21 @@ def make_group_metadata(group_sizes: jax.Array, m: int, block_m: int
     all visits to one group are consecutive (for the tgmm accumulator).
     Padding items repeat the last real (tile, group) with an empty row
     range.
+
+    Contract guard (sum(group_sizes) must equal m): sizes are clamped so
+    cumulative ends never exceed ``m`` (over-sum can't index tiles out of
+    range), and when sum < m the padding items are re-aimed at the
+    uncovered trailing m-tiles with empty row ranges — those output
+    blocks come back zero-filled instead of as uninitialized memory.
     """
     num_groups = group_sizes.shape[0]
     m_tiles = m // block_m
     t_total = m_tiles + num_groups - 1
 
     sizes = group_sizes.astype(jnp.int32)
-    ends = jnp.cumsum(sizes)
-    starts = ends - sizes
+    ends = jnp.minimum(jnp.cumsum(sizes), m)    # clamp: over-sum stays in range
+    starts = jnp.concatenate([jnp.zeros((1,), jnp.int32), ends[:-1]])
+    sizes = ends - starts
     first_tile = starts // block_m
     last_tile = jnp.where(sizes > 0, (ends - 1) // block_m, first_tile)
     items = jnp.where(sizes > 0, last_tile - first_tile + 1, 0)  # [E]
@@ -85,7 +92,15 @@ def make_group_metadata(group_sizes: jax.Array, m: int, block_m: int
 
     valid = w < total
     last = jnp.maximum(total - 1, 0)
-    tile = jnp.where(valid, tile, tile[last]).astype(jnp.int32)
+    # padding items: aim at any m-tiles left uncovered by an under-sum
+    # (one each, empty row range → zero-filled output); once tiles are
+    # exhausted, repeat the last real item (a benign re-visit)
+    first_uncovered = (ends[-1] + block_m - 1) // block_m
+    pad_tile = first_uncovered + (w - total)
+    use_pad_tile = jnp.logical_and(~valid, pad_tile < m_tiles)
+    tile = jnp.where(valid, tile,
+                     jnp.where(use_pad_tile, pad_tile, tile[last]))
+    tile = jnp.clip(tile, 0, max(m_tiles - 1, 0)).astype(jnp.int32)
     group = jnp.where(valid, gid, gid[last]).astype(jnp.int32)
     row_start = jnp.where(valid, starts[gid], 0).astype(jnp.int32)
     row_end = jnp.where(valid, ends[gid], 0).astype(jnp.int32)
@@ -251,8 +266,12 @@ def _tgmm_call(lhs: jax.Array, dout: jax.Array, group_sizes: jax.Array,
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=_interpret(),
     )(*meta, lhs, dout)
-    # groups with zero rows are never visited — their blocks are undefined
-    return jnp.where((group_sizes > 0)[:, None, None], out, 0)
+    # groups with zero rows are never visited — their blocks are
+    # undefined. Mask with the same clamped sizes the metadata uses, so
+    # a group zeroed by the over-sum guard is zero-filled too.
+    ends = jnp.minimum(jnp.cumsum(group_sizes.astype(jnp.int32)), m)
+    starts = jnp.concatenate([jnp.zeros((1,), jnp.int32), ends[:-1]])
+    return jnp.where((ends > starts)[:, None, None], out, 0)
 
 
 # ---------------------------------------------------------------------------

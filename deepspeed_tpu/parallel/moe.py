@@ -109,19 +109,26 @@ def top_k_gating(logits: jax.Array, cfg: GateConfig, capacity: int
     return combine, dispatch, aux
 
 
-def _grouped_ok() -> bool:
-    """Dropless grouped-GEMM path composes with dp/fsdp batch sharding
-    (a shard_map over the batch axes — each shard routes its own tokens,
-    expert weights gather whole per shard, the ZeRO-3 fetch semantic)
-    but not yet with expert/tensor/sequence model sharding — those fall
-    back to the capacity einsum dispatch whose all-to-alls GSPMD
-    partitions."""
+def _grouped_unsupported_reason(cfg: GateConfig) -> Optional[str]:
+    """Why the grouped path can't run on the current mesh (None = it can).
+
+    The grouped engine composes with dp/fsdp (token-parallel shards), ep
+    (experts partitioned per shard, tokens routed by two all-to-alls),
+    sp (another token axis) and tp (FFN dim split + deferred psum). The
+    remaining exclusions: pp (the pipeline stage body pre-slices layer
+    stacks outside this module's shard_map) and expert counts that don't
+    divide over ep."""
     from deepspeed_tpu.parallel import topology as topo
 
     mesh = topo._GLOBAL_MESH
     if mesh is None:
-        return True
-    return all(mesh.shape.get(a, 1) == 1 for a in ("ep", "tp", "sp", "pp"))
+        return None
+    if mesh.shape.get("pp", 1) > 1:
+        return "pp>1: grouped dispatch not yet wired through pipeline stages"
+    ep = mesh.shape.get("ep", 1)
+    if ep > 1 and cfg.num_experts % ep:
+        return f"num_experts={cfg.num_experts} not divisible by ep={ep}"
+    return None
 
 
 def moe_ffn(x: jax.Array, router_w: jax.Array, expert_params: Dict[str, jax.Array],
@@ -135,14 +142,19 @@ def moe_ffn(x: jax.Array, router_w: jax.Array, expert_params: Dict[str, jax.Arra
     impl: "einsum" = capacity-padded GShard dispatch (drops overflow
     tokens, pads underflow — fixed E*C flops); "grouped" = dropless
     grouped-GEMM execution (reference GroupedExperts, ep_experts.py:136 —
-    exact top-k flops regardless of imbalance); "auto" picks grouped
-    whenever the mesh doesn't shard experts/tp/sp.
+    exact top-k flops regardless of imbalance), expert-parallel over ep
+    with two all-to-alls and tp-split FFNs (see moe_ffn_dropless).
+    "auto"/"grouped" take the grouped path on every mesh except pp>1 or
+    E % ep != 0 — those fall back to einsum with a telemetry count
+    ("moe.grouped_fallback") and a one-time warning.
     """
-    if impl == "auto":
-        impl = "grouped" if _grouped_ok() else "einsum"
-    if impl == "grouped":
-        return moe_ffn_dropless(x, router_w, expert_params, cfg,
-                                activation=activation, train=train)
+    if impl in ("auto", "grouped"):
+        reason = _grouped_unsupported_reason(cfg)
+        if reason is None:
+            return moe_ffn_dropless(x, router_w, expert_params, cfg,
+                                    activation=activation, train=train)
+        from deepspeed_tpu.utils import telemetry
+        telemetry.count("moe.grouped_fallback", reason)
     B, S, H = x.shape
     dt = x.dtype
     logits = jnp.einsum("bsh,he->bse", x, router_w.astype(dt))
@@ -173,50 +185,11 @@ def moe_ffn(x: jax.Array, router_w: jax.Array, expert_params: Dict[str, jax.Arra
     return out, aux
 
 
-def _dropless_core(x: jax.Array, router_w: jax.Array,
-                   expert_params: Dict[str, jax.Array], cfg: GateConfig,
-                   activation: str) -> Tuple[jax.Array, Dict[str, jax.Array]]:
-    """Single-shard dropless dispatch. Returns (out, per-shard stats);
-    stats are shaped so that an unweighted mean over equal-sized shards
-    reproduces the global statistic exactly (me/ce/zsq/expert_load are
-    all means over local tokens)."""
+def _expert_ffn(sorted_x: jax.Array, group_sizes: jax.Array,
+                expert_params: Dict[str, jax.Array], activation: str,
+                dt) -> jax.Array:
+    """Grouped-GEMM expert FFN over rows sorted by (local) expert."""
     from deepspeed_tpu.ops.pallas.grouped_matmul import gmm
-
-    B, S, H = x.shape
-    E, k = cfg.num_experts, cfg.top_k
-    dt = x.dtype
-    logits = jnp.einsum("bsh,he->bse", x, router_w.astype(dt))
-    gates = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
-    top_vals, top_idx = lax.top_k(gates, k)
-    weights = top_vals / jnp.maximum(
-        jnp.sum(top_vals, axis=-1, keepdims=True), 1e-9)
-
-    tokens = B * S
-    flat_x = x.reshape(tokens, H)
-    flat_expert = top_idx.reshape(-1)                       # [tokens*k]
-    flat_w = weights.reshape(-1)
-    token_idx = jnp.repeat(jnp.arange(tokens, dtype=jnp.int32), k)
-
-    # pad the row count to the 128-row MXU tile; padding rows carry zero
-    # combine weight and point at token 0, so they can run through any
-    # expert (assign E-1: real rows already sum to group_sizes, padding
-    # lands in the last group)
-    m0 = tokens * k
-    m = ((m0 + 127) // 128) * 128
-    pad = m - m0
-    if pad:
-        flat_expert = jnp.concatenate(
-            [flat_expert, jnp.full((pad,), E - 1, flat_expert.dtype)])
-        flat_w = jnp.concatenate([flat_w, jnp.zeros((pad,), flat_w.dtype)])
-        token_idx = jnp.concatenate(
-            [token_idx, jnp.zeros((pad,), token_idx.dtype)])
-
-    order = jnp.argsort(flat_expert, stable=True)           # [M]
-    sorted_token = token_idx[order]
-    sorted_w = flat_w[order]
-    group_sizes = jnp.bincount(flat_expert, length=E).astype(jnp.int32)
-
-    sorted_x = flat_x[sorted_token]                         # [M, H] gather
 
     wi, wo = expert_params["wi"].astype(dt), expert_params["wo"].astype(dt)
     if activation == "swiglu":
@@ -225,11 +198,80 @@ def _dropless_core(x: jax.Array, router_w: jax.Array,
             * gmm(sorted_x, wi, group_sizes)
     else:
         hidden = jax.nn.gelu(gmm(sorted_x, wi, group_sizes))
-    expert_out = gmm(hidden, wo, group_sizes)               # [M, H]
+    return gmm(hidden, wo, group_sizes)                     # [M, H-or-H_tp]
 
-    contrib = expert_out * sorted_w[:, None].astype(dt)
-    out = jnp.zeros((tokens, H), dt).at[sorted_token].add(contrib)
-    out = out.reshape(B, S, H)
+
+def _ep_capacity(m0: int, ep: int, cfg: GateConfig, train: bool) -> int:
+    """Static per-(src,dst) row budget for the expert all-to-all.
+
+    drop_tokens=False → the true worst case (every local row to one
+    owner shard): genuinely dropless, at ep× the balanced buffer. With
+    drop_tokens, capacity pools at *shard* level (an owner's hot expert
+    borrows headroom from its cold co-residents — strictly fewer drops
+    than the reference's per-expert capacity at the same factor,
+    sharded_moe.py:91)."""
+    if cfg.drop_tokens:
+        factor = cfg.capacity_factor if train else cfg.eval_capacity_factor
+        cap = int(-(-factor * m0 // ep))                    # ceil
+        cap = max(cap, cfg.min_capacity)
+        cap = min(cap, m0)
+    else:
+        cap = m0
+    return ((cap + 127) // 128) * 128                       # MXU row tile
+
+
+def _dropless_shard_core(x: jax.Array, router_w: jax.Array,
+                         expert_params: Dict[str, jax.Array],
+                         cfg: GateConfig, activation: str, *,
+                         ep_axis: Optional[str] = None, ep: int = 1,
+                         tp_axis: Optional[str] = None, tp: int = 1,
+                         train: bool = True
+                         ) -> Tuple[jax.Array, Dict[str, jax.Array]]:
+    """Per-shard dropless dispatch (runs inside shard_map, or bare when
+    there is no mesh).
+
+    ep == 1: rows sort by expert locally and run through the whole
+    (locally resident) expert stack — the original single-shard engine.
+
+    ep > 1: *expert parallelism*. ``expert_params`` hold only this
+    shard's E/ep experts; each row's owner shard is ``expert // e_loc``
+    and rows travel by two all-to-alls over ``ep_axis`` (the reference's
+    dispatch/combine pair, sharded_moe.py:589-685) with a static
+    per-(src,dst) row budget (:func:`_ep_capacity`). Overflow rows are
+    dropped at the sender with zero combine weight and counted in
+    ``stats['ep_dropped_frac']``.
+
+    tp > 1: ``expert_params`` additionally hold only this shard's F/tp
+    slice of every expert; the combine output is a partial sum and is
+    psum'd over ``tp_axis`` at the end (deferred past the return
+    all-to-all — [tokens,H] is top_k× smaller than the row buffer). A
+    routing digest cross-checks that all tp peers dispatched
+    identically (reference TP-consistency digests, ep_tp_dispatch.py:99).
+
+    Stats are shaped so an unweighted mean over equal-sized token shards
+    reproduces the global statistic exactly.
+    """
+    B, S, H = x.shape
+    E, k = cfg.num_experts, cfg.top_k
+    e_loc = E // ep
+    # the expert-parallel guarantee, enforced at trace time: a shard only
+    # ever holds E/ep experts (no whole-stack gather can have happened)
+    assert expert_params["wi"].shape[0] == e_loc, (
+        f"expected {e_loc} experts per ep shard, got "
+        f"{expert_params['wi'].shape[0]}")
+    dt = x.dtype
+    logits = jnp.einsum("bsh,he->bse", x, router_w.astype(dt))
+    gates = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+    top_vals, top_idx = lax.top_k(gates, k)
+    weights = top_vals / jnp.maximum(
+        jnp.sum(top_vals, axis=-1, keepdims=True), 1e-9)
+
+    tokens = B * S
+    m0 = tokens * k
+    flat_x = x.reshape(tokens, H)
+    flat_expert = top_idx.reshape(-1).astype(jnp.int32)     # [m0]
+    flat_w = weights.reshape(-1)                            # fp32
+    token_idx = jnp.repeat(jnp.arange(tokens, dtype=jnp.int32), k)
 
     stats = {
         "me": jnp.mean(gates, axis=(0, 1)),                          # [E]
@@ -237,9 +279,92 @@ def _dropless_core(x: jax.Array, router_w: jax.Array,
                                       dtype=jnp.float32), axis=(0, 1)),
         "zsq": jnp.mean(jax.nn.logsumexp(
             logits.astype(jnp.float32), axis=-1) ** 2)[None],
-        "expert_load": (jnp.bincount(top_idx.reshape(-1), length=E)
+        "expert_load": (jnp.bincount(flat_expert, length=E)
                         .astype(jnp.float32) / max(tokens, 1)),
+        "ep_dropped_frac": jnp.zeros((1,), jnp.float32),
+        "dispatch_digest_mismatch": jnp.zeros((1,), jnp.float32),
     }
+    if tp > 1:
+        # dispatch digest: order-sensitive checksum of the routing
+        # decision; pmax==pmin over tp ⇔ every tp peer will slice the
+        # same rows to the same experts (they see replicated x, so any
+        # mismatch means nondeterminism that would corrupt the deferred
+        # psum row alignment)
+        dig = jnp.sum(flat_expert.astype(jnp.uint32)
+                      * (jnp.arange(m0, dtype=jnp.uint32)
+                         * jnp.uint32(2654435761) + jnp.uint32(12345)))
+        mismatch = lax.pmax(dig, tp_axis) != lax.pmin(dig, tp_axis)
+        stats["dispatch_digest_mismatch"] = \
+            mismatch.astype(jnp.float32)[None]
+
+    if ep > 1:
+        dest = flat_expert // e_loc                         # owner shard
+        cap = _ep_capacity(m0, ep, cfg, train)
+        # position of row j within its (src→dest) budget: rows fill
+        # slots in row order
+        oh = (dest[:, None] == jnp.arange(ep, dtype=jnp.int32)[None, :]
+              ).astype(jnp.int32)
+        pos = jnp.take_along_axis(jnp.cumsum(oh, axis=0) - oh,
+                                  dest[:, None], axis=1)[:, 0]
+        keep = pos < cap
+        pos_c = jnp.minimum(pos, cap - 1)
+        kf = keep.astype(dt)
+        rows_x = flat_x[token_idx]                          # [m0, H]
+        # packed send buffers: [ep*cap, H] rows + [ep*cap] local-expert
+        # tags (0 = padding slot); kept slots are unique so scatter-add
+        # is exact, dropped rows add zeros into the clamped last slot
+        send_x = jnp.zeros((ep * cap, H), dt).at[
+            dest * cap + pos_c].add(rows_x * kf[:, None])
+        tag = (flat_expert % e_loc + 1) * keep
+        send_tag = jnp.zeros((ep * cap,), jnp.int32).at[
+            dest * cap + pos_c].add(tag)
+        # all-to-all #1 (dispatch): block d of mine → shard d; block s
+        # of the result ← shard s's rows for my experts
+        recv_x = lax.all_to_all(send_x, ep_axis, 0, 0, tiled=True)
+        recv_tag = lax.all_to_all(send_tag, ep_axis, 0, 0, tiled=True)
+
+        m_rows = ep * cap
+        valid = recv_tag > 0
+        local_e = jnp.where(valid, recv_tag - 1, e_loc - 1)
+        order = jnp.argsort(local_e, stable=True)
+        sorted_x = recv_x[order]
+        group_sizes = jnp.bincount(local_e, length=e_loc).astype(jnp.int32)
+        expert_out = _expert_ffn(sorted_x, group_sizes, expert_params,
+                                 activation, dt)            # [m_rows, H]
+        unsorted = jnp.zeros((m_rows, H), dt).at[order].set(expert_out)
+        # all-to-all #2 (combine): results return to their source shard
+        back = lax.all_to_all(unsorted, ep_axis, 0, 0, tiled=True)
+        out_rows = back[dest * cap + pos_c] * kf[:, None]   # [m0, H]
+        contrib = out_rows.astype(jnp.float32) * flat_w[:, None]
+        stats["ep_dropped_frac"] = (
+            jnp.sum(~keep).astype(jnp.float32) / max(m0, 1))[None]
+        row_token = token_idx
+    else:
+        # local sort path: pad rows to the MXU tile; padding rows carry
+        # zero combine weight and land in the last group
+        m = ((m0 + 127) // 128) * 128
+        pad = m - m0
+        if pad:
+            flat_expert = jnp.concatenate(
+                [flat_expert, jnp.full((pad,), E - 1, flat_expert.dtype)])
+            flat_w = jnp.concatenate([flat_w, jnp.zeros((pad,), flat_w.dtype)])
+            token_idx = jnp.concatenate(
+                [token_idx, jnp.zeros((pad,), token_idx.dtype)])
+        order = jnp.argsort(flat_expert, stable=True)       # [M]
+        row_token = token_idx[order]
+        flat_w = flat_w[order]
+        group_sizes = jnp.bincount(flat_expert, length=E).astype(jnp.int32)
+        sorted_x = flat_x[row_token]                        # [M, H] gather
+        expert_out = _expert_ffn(sorted_x, group_sizes, expert_params,
+                                 activation, dt)
+        contrib = expert_out.astype(jnp.float32) * flat_w[:, None]
+
+    # combine accumulates in fp32 (bf16 scatter-add would stack rounding
+    # per top-k contribution); one cast back at the end
+    out = jnp.zeros((tokens, H), jnp.float32).at[row_token].add(contrib)
+    if tp > 1:
+        out = lax.psum(out, tp_axis)                        # F/tp partials
+    out = out.astype(dt).reshape(B, S, H)
     return out, stats
 
 
@@ -252,7 +377,14 @@ def _aux_from_stats(stats: Dict[str, jax.Array], cfg: GateConfig
            "expert_load": stats["expert_load"]}
     if cfg.z_loss_weight:
         aux["l_zloss"] = stats["zsq"][0]
+    for key in ("ep_dropped_frac", "dispatch_digest_mismatch"):
+        if key in stats:
+            aux[key] = stats[key][0]
     return aux
+
+
+_STAT_KEYS = ("me", "ce", "zsq", "expert_load", "ep_dropped_frac",
+              "dispatch_digest_mismatch")
 
 
 def moe_ffn_dropless(x: jax.Array, router_w: jax.Array,
@@ -260,48 +392,92 @@ def moe_ffn_dropless(x: jax.Array, router_w: jax.Array,
                      activation: str = "swiglu", train: bool = True
                      ) -> Tuple[jax.Array, Dict[str, jax.Array]]:
     """Dropless MoE FFN via grouped GEMMs (reference GroupedExperts,
-    moe/ep_experts.py:136).
+    moe/ep_experts.py:136, executed through the two-all-to-all structure
+    of MOELayer.forward, sharded_moe.py:589-685).
 
-    Tokens sort by chosen expert (stable argsort keeps static shapes:
-    M = B*S*top_k rows always), experts execute as one grouped matmul per
-    projection (ops/pallas/grouped_matmul.py), and outputs scatter-add
-    back weighted by the gate. Exactly top_k expert-FFNs per token —
-    no capacity padding, no token dropping, flops independent of routing
-    imbalance.
+    Tokens sort by chosen expert (stable argsort keeps static shapes),
+    experts execute as one grouped matmul per projection
+    (ops/pallas/grouped_matmul.py), and outputs scatter-add back weighted
+    by the gate — exactly top_k expert-FFNs per token with no capacity
+    padding, flops independent of routing imbalance.
 
-    On a mesh with dp/fsdp/ep batch sharding the dispatch runs inside a
-    shard_map over those axes (a Pallas call can't be GSPMD-partitioned):
-    each shard sorts and executes its local tokens against the whole
-    expert stack (gathered per shard — the ZeRO-3 fetch semantic), and
-    routing statistics average across shards so the aux losses equal the
-    global-batch formulas exactly.
+    Mesh composition (a Pallas call can't be GSPMD-partitioned, so the
+    whole dispatch runs inside one shard_map):
+
+      dp/fsdp/sp  token axes — each shard routes its own tokens.
+      ep          experts *partition* over the axis (in_spec P('ep') on
+                  the stacked expert dim: a shard only ever sees E/ep
+                  experts — no whole-stack gather); tokens travel to
+                  their owner shard and back via two all-to-alls.
+      tp          every expert's FFN dim splits over tp (in_spec on the
+                  mlp dim); the combine is psum'd over tp, and routing
+                  digests assert tp peers dispatched identically.
+      fsdp        the ZeRO-3 param fetch: the expert in_spec leaves the
+                  embed dim unsharded, so GSPMD all-gathers it over fsdp
+                  on use (stage-3 semantics, never over ep).
     """
-    from functools import partial
-
     from deepspeed_tpu.parallel import topology as topo
 
     mesh = topo._GLOBAL_MESH
-    batch_axes = tuple(
-        a for a in ("dp", "fsdp", "ep")
-        if mesh is not None and mesh.shape.get(a, 1) > 1)
-    if not batch_axes:
-        out, stats = _dropless_core(x, router_w, expert_params, cfg,
-                                    activation)
+    sizes = dict(mesh.shape) if mesh is not None else {}
+    ep, tp = sizes.get("ep", 1), sizes.get("tp", 1)
+    B_in, S_in = x.shape[0], x.shape[1]
+    # token axes only shard what divides: a serve-time batch of 2 on a
+    # dp=2×ep=2 mesh shards over dp and *replicates* over ep — the ep
+    # dispatch still partitions experts and routes correctly (each source
+    # gets its own copies back), it just computes redundantly across the
+    # unused token axis
+    batch_axes, prod = [], 1
+    for a in ("dp", "fsdp", "ep"):
+        sz = sizes.get(a, 1)
+        if sz > 1 and B_in % (prod * sz) == 0:
+            batch_axes.append(a)
+            prod *= sz
+    batch_axes = tuple(batch_axes)
+    sp = sizes.get("sp", 1) if S_in % max(sizes.get("sp", 1), 1) == 0 else 1
+    if mesh is not None and (
+            len(batch_axes) < sum(1 for a in ("dp", "fsdp", "ep")
+                                  if sizes.get(a, 1) > 1)
+            or sp != sizes.get("sp", 1)):
+        from deepspeed_tpu.utils import telemetry
+        telemetry.count(
+            "moe.grouped_replicated_tokens",
+            f"batch {B_in}x{S_in} not shardable over all token axes "
+            f"{ {a: sizes.get(a, 1) for a in ('dp', 'fsdp', 'ep', 'sp')} }")
+    if mesh is None or (not batch_axes and tp == 1 and sp == 1 and ep == 1):
+        out, stats = _dropless_shard_core(x, router_w, expert_params, cfg,
+                                          activation, train=train)
         out = constrain_activation(out, ("batch", "seq", "embed"))
         return out, _aux_from_stats(stats, cfg)
 
+    if ep > 1 and cfg.num_experts % ep:
+        raise ValueError(
+            f"moe_ffn_dropless: num_experts={cfg.num_experts} must divide "
+            f"over ep={ep}")
+
     from jax.sharding import PartitionSpec as P
 
+    ep_ax = "ep" if ep > 1 else None
+    tp_ax = "tp" if tp > 1 else None
+    sp_ax = "sp" if sp > 1 else None
+    token_axes = batch_axes + ((sp_ax,) if sp_ax else ())
+
     def local_fn(x, router_w, experts):
-        out, stats = _dropless_core(x, router_w, experts, cfg, activation)
+        out, stats = _dropless_shard_core(
+            x, router_w, experts, cfg, activation,
+            ep_axis=ep_ax, ep=ep, tp_axis=tp_ax, tp=tp, train=train)
         return out, jax.tree.map(lambda s: s[None], stats)  # lead shard dim
 
-    x_spec = P(batch_axes, None, None)
-    stat_spec = {k: P(batch_axes)
-                 for k in ("me", "ce", "zsq", "expert_load")}
+    x_spec = P(batch_axes or None, sp_ax, None)
+    # stacked experts: expert dim stays on ep, mlp dim on tp, embed dim
+    # gathered (the ZeRO-3 fetch — over fsdp only)
+    exp_specs = {"wi": P(ep_ax, None, tp_ax), "wo": P(ep_ax, tp_ax, None)}
+    if "wg" in expert_params:
+        exp_specs["wg"] = P(ep_ax, None, tp_ax)
+    stat_spec = {k: P(token_axes or None) for k in _STAT_KEYS}
     out, stats_sh = jax.shard_map(
         local_fn, mesh=mesh,
-        in_specs=(x_spec, P(), P()),
+        in_specs=(x_spec, P(), exp_specs),
         out_specs=(x_spec, stat_spec), check_vma=False,
     )(x, router_w, expert_params)
     stats = jax.tree.map(lambda s: jnp.mean(s, axis=0), stats_sh)

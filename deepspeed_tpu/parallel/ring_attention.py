@@ -104,6 +104,11 @@ def ring_attention(q, k, v, causal: bool = True, axis: str = "sp",
 
     mesh = topology._GLOBAL_MESH
     if mesh is None or axis not in mesh.shape or mesh.shape[axis] == 1:
+        from deepspeed_tpu.utils import telemetry
+
+        telemetry.count(
+            "ring_attention.dense_fallback",
+            f"no mesh axis '{axis}' > 1 — running dense attention")
         return multi_head_attention(q, k, v, causal=causal,
                                     segment_ids=segment_ids)
 

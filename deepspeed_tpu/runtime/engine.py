@@ -237,7 +237,9 @@ class Engine:
         # -- ZeRO++ quantized-collective step (runtime/zeropp.py) ---------
         self._zeropp = self._zeropp_applicable(config) and not self._onebit
         self._zeropp_state = None
-        self._zeropp_lr_override = None  # set_lr under the compiled step
+        # set_lr under a compiled runtime-lr step (zeropp/onebit): the lr
+        # rides as an operand, NaN = use the traced schedule
+        self._lr_override = None
         zq = config.zero_optimization
         # stage-3 qwZ: int8 parameter all-gather in the GSPMD fetch path
         # (reference partition_parameters.py:1446). Composes with tp/sp/
@@ -247,11 +249,16 @@ class Engine:
                             and not config.moe.enabled
                             and self.mesh.shape.get("pp", 1) <= 1)
         if (zq.stage == 3 and zq.zero_quantized_weights
-                and self.mesh.shape.get("pp", 1) > 1):
+                and not self._qwz_stage3):
+            from deepspeed_tpu.utils import telemetry
+
+            telemetry.count(
+                "zeropp.qwz_disabled",
+                "pp>1" if self.mesh.shape.get("pp", 1) > 1 else "moe")
             logger.warning(
-                "ZeRO++ qwZ stage-3 is inert under pipeline parallelism "
-                "(the pp stage body traces with sharding constraints "
-                "disabled) — layer gathers stay full-width bf16")
+                "ZeRO++ qwZ stage-3 is inert under this config "
+                "(pp stage bodies / MoE) — layer gathers stay "
+                "full-width bf16")
         if self._qwz_stage3:
             log_dist("ZeRO++ qwZ: stage-3 int8 quantized parameter "
                      "all-gather enabled (fsdp axis)", ranks=[0])
@@ -274,6 +281,10 @@ class Engine:
                    if self.mesh.shape.get("dp", 1) > 1 else "") + ")",
                 ranks=[0])
         elif zq.stage == 3 and zq.zero_quantized_gradients:
+            from deepspeed_tpu.utils import telemetry
+
+            telemetry.count("zeropp.qgz_disabled",
+                            "config outside qgZ support matrix")
             logger.warning(
                 "ZeRO++ qgZ at stage 3 requires a dense model (no MoE), "
                 "no optimizer offload, no pp/sp/ep axes, and fsdp > 1 — "
@@ -291,6 +302,11 @@ class Engine:
                 "is disabled and the standard step runs")
 
         # -- state init (sharded; zero.Init analog is in abstract init) ---
+        # streamed-param subtrees (offload_param): the host_param_paths
+        # protocol (runtime/param_stream.py) or TransformerLM's "layers"
+        _proto = getattr(model, "host_param_paths", None)
+        self._host_param_paths = (tuple(_proto) if _proto is not None
+                                  else ("layers",))
         self._rng = jax.random.PRNGKey(seed if seed is not None else config.seed)
         self._axes = model.logical_axes()
         self._build_state()
@@ -504,8 +520,9 @@ class Engine:
             ocfg = self.config.optimizer
             off = self.config.zero_optimization.offload_optimizer
             poff = self.config.zero_optimization.offload_param
-            host_prefixes = (("['layers']",) if poff is not None
-                             and poff.device != "none" else ())
+            host_prefixes = (
+                tuple(f"['{k}']" for k in self._host_param_paths)
+                if poff is not None and poff.device != "none" else ())
             self._offload = HostOffloadOptimizer(
                 p32,
                 optimizer_name=(ocfg.type if ocfg else "adamw") or "adamw",
@@ -535,12 +552,14 @@ class Engine:
                     lambda a: jax.device_put(
                         a, a.sharding.with_memory_kind("device")),
                     cast(p32))
-            if host_prefixes and isinstance(p32, dict) and "layers" in p32:
-                # layer params stay the pinned fp32 masters (the compiled
-                # step streams one layer at a time); drop the device bf16
-                # copies the cast produced
+            if host_prefixes and isinstance(p32, dict):
+                # streamed params stay the pinned fp32 masters (the
+                # compiled step fetches one layer at a time); drop the
+                # device bf16 copies the cast produced
                 self.params = dict(self.params)
-                self.params["layers"] = p32["layers"]
+                for key in getattr(self, "_host_param_paths", ("layers",)):
+                    if key in p32:
+                        self.params[key] = p32[key]
             self.opt_state = None
         else:
             def init_fn(rng):
@@ -717,18 +736,21 @@ class Engine:
         # compiled by XLA over ICI; and grad-acc → optimizer sharding.
         self._jit_reshard_to_params = jax.jit(lambda t: t,
                                               out_shardings=param_sh)
-        if getattr(self, "_param_host_offload", False) and \
-                isinstance(param_sh, dict) and "layers" in param_sh:
-            # updated layer params land straight in pinned host memory —
-            # the full stack must never materialize in HBM (the point of
-            # offload_param). XLA rejects host-kind out_shardings on
+        stream_paths = [
+            k for k in getattr(self, "_host_param_paths", ("layers",))
+            if isinstance(param_sh, dict) and k in param_sh]
+        if getattr(self, "_param_host_offload", False) and stream_paths:
+            # updated streamed params land straight in pinned host memory
+            # — the full stack must never materialize in HBM (the point
+            # of offload_param). XLA rejects host-kind out_shardings on
             # replicated leaves inside jit ("side-effect ops cannot be
             # replicated"), so this reshard runs as an out-of-jit
             # device_put over a sharding tree instead.
             host_sh = dict(param_sh)
-            host_sh["layers"] = jax.tree.map(
-                lambda s: s.with_memory_kind("pinned_host"),
-                param_sh["layers"])
+            for key in stream_paths:
+                host_sh[key] = jax.tree.map(
+                    lambda s: s.with_memory_kind("pinned_host"),
+                    param_sh[key])
             self._jit_reshard_to_params = lambda t: jax.device_put(
                 t, host_sh)
         self._jit_to_opt_sharding = jax.jit(
@@ -789,15 +811,14 @@ class Engine:
         return metrics["loss"]
 
     def _dispatch_train_step(self, batches):
+        lr_over = jnp.asarray(
+            self._lr_override if self._lr_override is not None
+            else float("nan"), jnp.float32)
         if self._onebit:
             self.params, self._onebit_state, metrics = self._jit_onebit(
-                self.params, self._onebit_state, batches)
+                self.params, self._onebit_state, batches, lr_over)
             self.step_count = self._onebit_state.step
         elif self._zeropp:
-            lr_over = jnp.asarray(
-                self._zeropp_lr_override
-                if self._zeropp_lr_override is not None else float("nan"),
-                jnp.float32)
             self.params, self._zeropp_state, metrics = self._jit_zeropp(
                 self.params, self._zeropp_state, batches, lr_over)
             self.step_count = self._zeropp_state.step
@@ -935,23 +956,33 @@ class Engine:
             raise ValueError("offload_param does not compose with the "
                              "pipeline-parallel layer path yet")
         mcfg = getattr(self.model, "config", None)
-        if mcfg is None or not hasattr(mcfg, "param_host_offload"):
-            raise ValueError("offload_param needs a model whose config "
-                             "supports param_host_offload (TransformerLM)")
-        updates = {}
-        if not mcfg.param_host_offload:
-            updates["param_host_offload"] = True
-        if not getattr(mcfg, "remat", True):
-            # without remat every fetched layer is saved as a backward
-            # residual and the full stack materializes in HBM anyway —
-            # force the streaming-compatible mode on
-            logger.warning("offload_param requires per-layer remat to "
-                           "keep the stack out of HBM; enabling remat")
-            updates["remat"] = True
-        if updates:
-            import dataclasses as _dc
+        if getattr(self.model, "host_param_paths", None) is not None:
+            # model-agnostic protocol (runtime/param_stream.py): the
+            # model declares which top-level stacked subtrees stream
+            # (self._host_param_paths, set at init) and consults
+            # model.param_host_offload in its apply
+            self.model.param_host_offload = True
+        elif mcfg is not None and hasattr(mcfg, "param_host_offload"):
+            updates = {}
+            if not mcfg.param_host_offload:
+                updates["param_host_offload"] = True
+            if not getattr(mcfg, "remat", True):
+                # without remat every fetched layer is saved as a backward
+                # residual and the full stack materializes in HBM anyway —
+                # force the streaming-compatible mode on
+                logger.warning("offload_param requires per-layer remat to "
+                               "keep the stack out of HBM; enabling remat")
+                updates["remat"] = True
+            if updates:
+                import dataclasses as _dc
 
-            self.model.config = _dc.replace(mcfg, **updates)
+                self.model.config = _dc.replace(mcfg, **updates)
+        else:
+            raise ValueError(
+                "offload_param needs a model that supports streaming: "
+                "either config.param_host_offload (TransformerLM family) "
+                "or the host_param_paths protocol "
+                "(runtime/param_stream.py)")
         self.params = self._place_layer_params_on_host(self.params)
         log_dist("offload_param: layer params pinned to host memory; "
                  "the compiled step streams one layer at a time", ranks=[0])
@@ -961,19 +992,15 @@ class Engine:
         # is not supported by current TPU runtimes, and fp32 is the master
         # precision anyway (the layer body casts to compute dtype right
         # after the fetch, so HBM holds one fp32 layer transiently)
-        if not isinstance(params, dict) or "layers" not in params:
+        from deepspeed_tpu.runtime.param_stream import pin_to_host
+
+        paths = getattr(self, "_host_param_paths", ("layers",))
+        if not isinstance(params, dict):
             return params
-
-        def pin(a):
-            if getattr(a.sharding, "memory_kind", None) == "pinned_host" \
-                    and a.dtype == jnp.float32:
-                return a  # already staged (init pins the fp32 masters)
-            return jax.device_put(
-                a.astype(jnp.float32),
-                a.sharding.with_memory_kind("pinned_host"))
-
         out = dict(params)
-        out["layers"] = jax.tree.map(pin, params["layers"])
+        for key in paths:
+            if key in out:
+                out[key] = pin_to_host(out[key])
         return out
 
     def _offload_apply(self, grads, loss):
@@ -1123,20 +1150,16 @@ class Engine:
         step bakes the lr closure at trace time, so this rebuilds the
         step functions — recompilation happens on the next call (cheap
         relative to how rarely clients poke lr mid-run)."""
-        if self._zeropp:
-            # the ZeRO++ step takes lr as a runtime operand (NaN = use
-            # the traced schedule), so no rebuild is needed
-            self._zeropp_lr_override = float(lr)
+        if self._zeropp or getattr(self, "_onebit", False):
+            # the ZeRO++ and 1-bit steps take lr as a runtime operand
+            # (NaN = use the traced schedule), so no rebuild is needed
+            self._lr_override = float(lr)
             self._base_lr = float(lr)
             if self.lr_schedule is not None:
                 logger.warning("set_lr override disables the configured "
-                               "lr schedule for the ZeRO++ step")
+                               "lr schedule for the runtime-lr step")
                 self.lr_schedule = None
             return
-        if getattr(self, "_onebit", False):
-            raise NotImplementedError(
-                "set_lr: 1-bit steps bake lr into their compiled "
-                "collective step; configure lr up front")
         if self._client_optimizer_present:
             raise NotImplementedError(
                 "set_lr: the engine cannot re-point a client-supplied "
@@ -1210,13 +1233,14 @@ class Engine:
                 and a.sharding.memory_kind == "pinned_host" else a, tree)
 
         if getattr(self, "_param_host_offload", False):
-            # layer params live on host by design; restore the rest only
-            layers = self.params.get("layers") if isinstance(
-                self.params, dict) else None
+            # streamed params live on host by design; restore the rest
+            paths = getattr(self, "_host_param_paths", ("layers",))
+            kept = {k: self.params[k] for k in paths
+                    if isinstance(self.params, dict) and k in self.params}
             self.params = to_device(self.params)
-            if layers is not None:
+            if kept:
                 self.params = dict(self.params)
-                self.params["layers"] = layers
+                self.params.update(kept)
         else:
             self.params = to_device(self.params)
         if self.opt_state is not None:

@@ -472,8 +472,12 @@ class HostOffloadOptimizer:
             gshape, sharding = self._leaf_layout[path]
             to_host = any(path.startswith(p)
                           for p in self.host_memory_leaf_prefixes)
-            if to_host:
-                sharding = sharding.with_memory_kind("pinned_host")
+            # the recorded layout is the MASTERS' placement (often fully
+            # pinned); the rebuilt compute tree must be pinned only for
+            # streamed prefixes and device elsewhere, and the buffer
+            # placement below must match the sharding exactly
+            sharding = sharding.with_memory_kind(
+                "pinned_host" if to_host else "device")
             bufs = []
             idx_map = sharding.addressable_devices_indices_map(gshape)
             for device, index in idx_map.items():

@@ -162,7 +162,10 @@ def build_onebit_step(model, mesh, cfg, opt: Dict, param_shardings,
     batch_spec = P(None, "dp")
     rep = P()
 
-    def step_fn(params, state: OneBitState, batches):
+    def step_fn(params, state: OneBitState, batches, lr_override=None):
+        """lr_override: fp32 scalar operand; NaN = use the traced
+        schedule (the engine's set_lr without a rebuild — same runtime-lr
+        technique as the ZeRO++ step, runtime/zeropp.py)."""
         step = state.step
         err_specs = jax.tree.map(lambda _: P("dp"), state.error)
         batch_specs = jax.tree.map(lambda _: batch_spec, batches)
@@ -198,6 +201,8 @@ def build_onebit_step(model, mesh, cfg, opt: Dict, param_shardings,
 
         lr = (lr_schedule(step) if lr_schedule is not None
               else jnp.asarray(base_lr, jnp.float32))
+        if lr_override is not None:
+            lr = jnp.where(jnp.isnan(lr_override), lr, lr_override)
 
         bc1 = 1 - beta1 ** (step.astype(jnp.float32) + 1)
         bc2 = 1 - beta2 ** (step.astype(jnp.float32) + 1)

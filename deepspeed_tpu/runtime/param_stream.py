@@ -1,0 +1,96 @@
+"""ZeRO-Infinity parameter streaming — the model-agnostic protocol.
+
+The reference's ``offload_param`` works on any module tree: the param
+swapper intercepts each submodule's parameters on use
+(deepspeed/runtime/zero/partitioned_param_swapper.py,
+partition_parameters.py:1188 fetch on pre-forward). The XLA analog
+cannot hook arbitrary Python modules — the compiled program must contain
+the host→device copies — so the contract is a *protocol* instead:
+
+  * the engine pins a model's declared stacked-parameter subtrees to
+    pinned host memory (``Engine._setup_param_host_offload``), and
+  * the model's ``apply`` runs those stacks through
+    :func:`scan_streamed` (or fetches slices with :func:`fetch_slice`),
+    so one layer's params occupy HBM at a time and the remat replay
+    re-fetches them for the backward (the cotangent of the fetch is a
+    device→host copy, landing gradients host-side).
+
+A model opts in one of two ways:
+
+  1. TransformerLM family: ``config.param_host_offload`` (the engine
+     flips it on and the model's own scan streams — models/
+     transformer.py:505).
+  2. Any other model: expose ``host_param_paths`` — an iterable of
+     top-level parameter-tree keys whose leaves are ``[L, ...]`` stacks.
+     The engine pins those subtrees and sets
+     ``model.param_host_offload = True``; the model consults that flag
+     in ``apply`` and wraps its layer scan in :func:`scan_streamed`.
+
+See tests/test_offload.py::test_offload_param_protocol_custom_model for
+a complete non-TransformerLM example.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def fetch_slice(stacked_tree: Any, i) -> Any:
+    """Fetch layer ``i`` of a host-pinned ``[L, ...]`` stacked tree to
+    device memory. Usable inside jit/scan bodies; under remat the
+    backward replay re-issues the copy instead of saving the layer."""
+    return jax.tree.map(
+        lambda a: jax.device_put(
+            lax.dynamic_index_in_dim(a, i, keepdims=False),
+            jax.memory.Space.Device),
+        stacked_tree)
+
+
+def scan_streamed(body: Callable[[Any, Any], Any], carry: Any,
+                  stacked_tree: Any, *, length: Optional[int] = None,
+                  remat: bool = True,
+                  remat_policy: Optional[str] = None) -> Any:
+    """``lax.scan`` over a host-pinned layer stack, fetching one slice
+    per step inside the (optionally rematerialized) body.
+
+    body(carry, layer_params) -> carry. Returns the final carry.
+    ``remat=True`` is required for the memory win: without it every
+    fetched layer would be saved as a backward residual and the full
+    stack would materialize in HBM anyway.
+    """
+    if length is None:
+        length = jax.tree.leaves(stacked_tree)[0].shape[0]
+
+    def fetched(carry, i):
+        return body(carry, fetch_slice(stacked_tree, i))
+
+    if remat:
+        from deepspeed_tpu.runtime.activation_checkpointing import \
+            checkpoint_wrapper
+
+        fetched = checkpoint_wrapper(fetched, policy=remat_policy)
+
+    def scan_body(carry, i):
+        return fetched(carry, i), None
+
+    carry, _ = lax.scan(scan_body, carry, jnp.arange(length))
+    return carry
+
+
+def pin_to_host(tree: Any) -> Any:
+    """Place a parameter subtree in pinned host memory, staged fp32
+    (sub-32-bit host→device streaming is unsupported on current TPU
+    runtimes; fp32 is the master precision anyway)."""
+    def pin(a):
+        if getattr(a.sharding, "memory_kind", None) == "pinned_host" \
+                and a.dtype == jnp.float32:
+            return a  # already staged (init pins the fp32 masters)
+        return jax.device_put(
+            a.astype(jnp.float32),
+            a.sharding.with_memory_kind("pinned_host"))
+
+    return jax.tree.map(pin, tree)

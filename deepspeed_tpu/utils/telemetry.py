@@ -1,0 +1,72 @@
+"""Capability-downgrade telemetry: no silent fallbacks.
+
+The reference surfaces engine downgrades through logs and counters (e.g.
+the FastGen scheduler stats, inference/v2/ragged); round-2/3 reviews
+flagged our own silent downgrades (grouped MoE -> capacity einsum, flash
+-> XLA attention, ring -> dense) as the one anti-pattern the serve-path
+telemetry in inference/engine_v2.py:89 had already solved locally. This
+module is the process-wide version of that pattern: every capability
+fallback calls :func:`count` with a stable counter name and a reason;
+tests and users query :func:`get`/:func:`snapshot`.
+
+Counters are plain Python ints incremented at *trace/dispatch* time (all
+fallback decisions in this codebase are static — mesh shapes, dtypes,
+geometry — so they happen outside jit-compiled code).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict
+
+from deepspeed_tpu.utils.logging import logger
+
+_LOCK = threading.Lock()
+_COUNTERS: Dict[str, int] = {}
+_REASONS: Dict[str, Dict[str, int]] = {}
+_LOGGED: set = set()
+
+
+def count(name: str, reason: str = "") -> None:
+    """Record one occurrence of the named fallback/downgrade.
+
+    Logs a warning the first time each (name, reason) pair fires so the
+    downgrade is visible exactly once per process, then keeps counting
+    silently (queryable via :func:`get`).
+    """
+    with _LOCK:
+        _COUNTERS[name] = _COUNTERS.get(name, 0) + 1
+        if reason:
+            per = _REASONS.setdefault(name, {})
+            per[reason] = per.get(reason, 0) + 1
+        key = (name, reason)
+        if key not in _LOGGED:
+            _LOGGED.add(key)
+            logger.warning(
+                f"capability fallback '{name}'"
+                + (f": {reason}" if reason else "")
+                + " (telemetry.get(%r) counts occurrences)" % name)
+
+
+def get(name: str) -> int:
+    """Occurrences of the named fallback since process start / reset."""
+    with _LOCK:
+        return _COUNTERS.get(name, 0)
+
+
+def reasons(name: str) -> Dict[str, int]:
+    with _LOCK:
+        return dict(_REASONS.get(name, {}))
+
+
+def snapshot() -> Dict[str, int]:
+    with _LOCK:
+        return dict(_COUNTERS)
+
+
+def reset() -> None:
+    """Zero all counters (tests)."""
+    with _LOCK:
+        _COUNTERS.clear()
+        _REASONS.clear()
+        _LOGGED.clear()
