@@ -480,8 +480,16 @@ def _layer_mlp(cfg: TransformerConfig, x, attn, layer_params):
 
 def apply_hidden(cfg: TransformerConfig, params: Dict[str, Any],
                  tokens: jax.Array,
-                 positions: Optional[jax.Array] = None) -> jax.Array:
-    """Forward pass up to the final norm: tokens [B,S] → hidden [B,S,H]."""
+                 positions: Optional[jax.Array] = None,
+                 final_norm: bool = True) -> jax.Array:
+    """Forward pass up to (and including, unless ``final_norm=False``)
+    the final norm: tokens [B,S] → hidden [B,S,H].
+
+    ``final_norm=False`` lets the tiled-logits path fuse the norm into
+    its per-tile pass — at long context the full-sequence norm's fp32
+    intermediate ([B,S,H] fp32 = 2x the bf16 residual) is one of the
+    peak-memory terms (the reference chunks final-norm+logits through
+    the same tiles, fpdt_layer.py:1207)."""
     B, S = tokens.shape
     dt = cfg.dtype
     if positions is None:
@@ -544,6 +552,8 @@ def apply_hidden(cfg: TransformerConfig, params: Dict[str, Any],
 
         x, _ = lax.scan(scan_body, x, params["layers"])
 
+    if not final_norm:
+        return x
     return _norm(x, params["final_norm"], cfg.norm, cfg.norm_eps)
 
 
@@ -581,10 +591,14 @@ def loss_fn(cfg: TransformerConfig, params, batch) -> Tuple[jax.Array, Dict]:
             mask = mask[:, 1:]
 
     if cfg.tiled_logits > 1:
-        # fused unembed+loss per sequence tile: [B,S,V] never materializes
+        # fused final-norm+unembed+loss per sequence tile: neither the
+        # [B,S,V] logits nor the [B,S,H] fp32 normed hidden materialize
         from deepspeed_tpu.parallel.tiled_compute import tiled_logits_loss
 
-        hidden = apply_hidden(cfg, params, inputs)
+        hidden = apply_hidden(cfg, params, inputs, final_norm=False)
+
+        def fnorm_tile(h):
+            return _norm(h, params["final_norm"], cfg.norm, cfg.norm_eps)
         if cfg.tie_embeddings:
             # the table also feeds the token lookup; its gather stays
             # exact (quantizing it would noise embeddings, not just wire)
@@ -602,7 +616,7 @@ def loss_fn(cfg: TransformerConfig, params, batch) -> Tuple[jax.Array, Dict]:
             transpose = False
         nll_sum, total = tiled_logits_loss(
             hidden, unembed, labels, mask, cfg.tiled_logits,
-            transpose_unembed=transpose)
+            transpose_unembed=transpose, tile_transform=fnorm_tile)
         total = jnp.maximum(total, 1.0)
         loss = nll_sum / total
         return loss, {"loss": loss, "ntokens": total}

@@ -61,8 +61,18 @@ def _interpret() -> bool:
 
 def _num_items(nq: int, nk: int, causal: bool) -> int:
     """Work items in the (triangle-)packed grid. Causal requires
-    block_q == block_k, giving the exact lower triangle nq*(nq+1)/2."""
-    return nq * (nq + 1) // 2 if causal else nq * nk
+    block_q == block_k, giving the exact lower triangle nq*(nq+1)/2.
+
+    Guard: the packed decomposition runs in int32 with an fp32 sqrt
+    seed + double ±1 correction (_decompose_q/_decompose_kv) — exact
+    while the item count fits int32. nq = 2^15 (S = 32M at block 1024)
+    is still ~5e8 items; anything larger must raise, not corrupt."""
+    t_total = nq * (nq + 1) // 2 if causal else nq * nk
+    if t_total >= 2 ** 31:
+        raise ValueError(
+            f"flash grid item count {t_total} overflows the int32 packed "
+            f"decomposition (nq={nq}, nk={nk}); use a larger block size")
+    return t_total
 
 
 def _decompose_q(t, nq: int, nk: int, causal: bool):
@@ -75,7 +85,12 @@ def _decompose_q(t, nq: int, nk: int, causal: bool):
         return t // nk, t % nk
     tf = t.astype(jnp.float32)
     iq = jnp.floor((jnp.sqrt(8.0 * tf + 1.0) - 1.0) * 0.5).astype(jnp.int32)
+    # two ±1 corrections each way (matching _decompose_kv): one fp32 ulp
+    # at large t can put the closed form two integers off; a silently
+    # wrong (iq, ik) would corrupt attention with no error
     iq = jnp.where(iq * (iq + 1) // 2 > t, iq - 1, iq)
+    iq = jnp.where(iq * (iq + 1) // 2 > t, iq - 1, iq)
+    iq = jnp.where((iq + 1) * (iq + 2) // 2 <= t, iq + 1, iq)
     iq = jnp.where((iq + 1) * (iq + 2) // 2 <= t, iq + 1, iq)
     ik = t - iq * (iq + 1) // 2
     return iq, ik

@@ -188,7 +188,7 @@ def pipelined_layers(layer_fn: Callable, stacked_params: Any, x: jax.Array,
         aux_total = lax.psum(jnp.where(stage == PP - 1, aux_total, 0.0), "pp")
         return ys, aux_total
 
-    from deepspeed_tpu.runtime.sharding import disable_constraints, force_f32
+    from deepspeed_tpu.runtime.sharding import force_f32, manual_axes
 
     # XLA's CPU backend crashes ("Invalid binary instruction opcode copy")
     # on bf16 inside a partial-manual shard_map; upcast the pipeline region
@@ -206,7 +206,12 @@ def pipelined_layers(layer_fn: Callable, stacked_params: Any, x: jax.Array,
 
     param_specs = jax.tree.map(lambda _: P("pp"), stacked_params)
     ctx2 = force_f32() if cast_f32 else nullcontext()
-    with disable_constraints(), ctx2:
+    # the region is manual over pp ONLY: activation constraints and the
+    # qwZ int8 fetch stay live inside the stage body with the pp axis
+    # stripped from their specs (sharding.manual_axes — same construction
+    # as the ZeRO++ dp region, runtime/zeropp.py:116), so fsdp/tp/sp
+    # sharding and quantized gathers compose with pipeline stages
+    with manual_axes({"pp"}), ctx2:
         out, aux = jax.shard_map(
             per_stage,
             mesh=mesh,

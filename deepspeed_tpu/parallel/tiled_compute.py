@@ -72,14 +72,18 @@ def tiled_mlp(mlp_fn: Callable, x: jax.Array, n_tiles: int,
 
 def tiled_logits_loss(hidden: jax.Array, unembed: jax.Array,
                       labels: jax.Array, mask: Optional[jax.Array],
-                      n_tiles: int, transpose_unembed: bool = False
-                      ) -> Tuple[jax.Array, jax.Array]:
+                      n_tiles: int, transpose_unembed: bool = False,
+                      tile_transform=None) -> Tuple[jax.Array, jax.Array]:
     """Fused unembed + causal-LM cross-entropy without materializing
     [B, S, V] logits (reference TiledFusedLogitsLoss ulysses_sp.py:943).
 
     hidden: [B, S, H]; unembed: [V, H] (tied embedding) or [H, V] with
     ``transpose_unembed=False``; labels: [B, S] int; mask: [B, S] or None.
-    Returns (masked_nll_sum, mask_total) — caller divides.
+    ``tile_transform`` (e.g. the model's final norm) applies to each
+    hidden tile inside the rematted tile body, so its fp32 intermediates
+    stay tile-sized (reference chunks final-norm+logits the same way,
+    fpdt_layer.py:1207). Returns (masked_nll_sum, mask_total) — caller
+    divides.
     """
     B, S, H = hidden.shape
     if mask is None:
@@ -92,6 +96,8 @@ def tiled_logits_loss(hidden: jax.Array, unembed: jax.Array,
     m_tiles, _ = _split_tiles(mask, n_tiles, axis=1)
 
     def tile_nll(h, lbl, m):
+        if tile_transform is not None:
+            h = tile_transform(h)
         if transpose_unembed:
             logits = jnp.einsum("bsh,vh->bsv", h, unembed)
         else:

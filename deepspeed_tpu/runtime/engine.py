@@ -245,32 +245,46 @@ class Engine:
         # (reference partition_parameters.py:1446). Composes with tp/sp/
         # hpZ/MiCS since it is just a constraint pair around the gather;
         # armed per-engine via the sharding module switch.
+        # pp composes since round 4: the pipeline region is manual over
+        # pp only, so the int8 fetch constraints stay live in stage
+        # bodies (parallel/pipeline.py manual_axes). Known exception:
+        # pp×fsdp×tp together trips an XLA SPMD-partitioner grouping
+        # CHECK (spmd_partitioner_util.cc:495) on the fetch's constraint
+        # pair — that one combination falls back to full-width gathers.
+        _pp_fsdp_tp = (self.mesh.shape.get("pp", 1) > 1
+                       and self.mesh.shape.get("fsdp", 1) > 1
+                       and self.mesh.shape.get("tp", 1) > 1)
         self._qwz_stage3 = (zq.stage == 3 and zq.zero_quantized_weights
-                            and not config.moe.enabled
-                            and self.mesh.shape.get("pp", 1) <= 1)
+                            and not config.moe.enabled and not _pp_fsdp_tp)
         if (zq.stage == 3 and zq.zero_quantized_weights
                 and not self._qwz_stage3):
             from deepspeed_tpu.utils import telemetry
 
-            telemetry.count(
-                "zeropp.qwz_disabled",
-                "pp>1" if self.mesh.shape.get("pp", 1) > 1 else "moe")
+            reason = ("pp*fsdp*tp XLA partitioner limitation"
+                      if _pp_fsdp_tp else "moe")
+            telemetry.count("zeropp.qwz_disabled", reason)
             logger.warning(
-                "ZeRO++ qwZ stage-3 is inert under this config "
-                "(pp stage bodies / MoE) — layer gathers stay "
-                "full-width bf16")
+                f"ZeRO++ qwZ stage-3 is inert for this config ({reason}) "
+                "— layer gathers stay full-width bf16")
         if self._qwz_stage3:
             log_dist("ZeRO++ qwZ: stage-3 int8 quantized parameter "
                      "all-gather enabled (fsdp axis)", ranks=[0])
-        # stage-3 qgZ: per-group grads (vmap over batch shards) + explicit
-        # int8[/int4 hierarchical] all-to-all reduction (runtime/qgz.py;
-        # reference coalesced_collectives.py:31 all_to_all_quant_reduce)
+        # qgZ for the GSPMD path (stages 2-3): per-group grads (vmap over
+        # batch shards) + explicit int8[/int4 hierarchical] all-to-all
+        # reduction (runtime/qgz.py; reference coalesced_collectives.py:31
+        # all_to_all_quant_reduce). Composes with tp and sp (sp grads
+        # reduce full-width inside each group's backward — intra-slice
+        # ICI; the fsdp/dp reduction, the DCN-bound wire, is quantized)
+        # and with optimizer offload/zenflow (the wire quantizes before
+        # the host grad copy — grad_step runs the same construction).
+        # Stage 2 with fsdp>1 routes here too, retiring the legacy
+        # manual-dp step's fsdp rejection (runtime/zeropp.py:74).
+        # Remaining exclusions: MoE/ep (expert grads are ep-sharded — the
+        # group axis would collide with the expert dim) and pp.
         self._qgz_stage3 = (
-            zq.stage == 3 and zq.zero_quantized_gradients
+            zq.stage >= 2 and zq.zero_quantized_gradients
             and not config.moe.enabled
-            and self._offload_device == "none"  # offload takes grad_step
             and self.mesh.shape.get("pp", 1) <= 1
-            and self.mesh.shape.get("sp", 1) <= 1
             and self.mesh.shape.get("ep", 1) <= 1
             and self.mesh.shape.get("fsdp", 1) > 1)
         if self._qgz_stage3:
@@ -399,6 +413,15 @@ class Engine:
                 and config.pipeline.stages == 1
                 and z.zero_hpz_partition_size <= 1
                 and z.mics_shard_size <= 0
+                # fsdp/sp/ep/pp meshes route to the per-group qgZ
+                # construction instead (build_zeropp_step is manual over
+                # dp only and would reject them, zeropp.py:74). During
+                # default-mesh selection (self.mesh not set yet) the
+                # mesh WILL be dp-only if this returns True, so the
+                # axes check passes vacuously.
+                and all(m.shape.get(a, 1) == 1
+                        for a in ("fsdp", "sp", "ep", "pp")
+                        for m in [getattr(self, "mesh", None)] if m)
                 and opt in ("adam", "adamw", "fusedadam", "fusedadamw"))
 
     def _default_mesh(self, topology) -> Mesh:
@@ -644,7 +667,23 @@ class Engine:
 
             n_groups = int(np.prod([self.mesh.shape.get(a, 1)
                                     for a in topo.BATCH_AXES]))
-            group_sh = NamedSharding(self.mesh, P(None, topo.BATCH_AXES))
+            sp_n = self.mesh.shape.get("sp", 1)
+
+            def _group_batches(batches):
+                """[gas, B, ...] leaves → [gas, G, B/G, ...] with the
+                group dim on the batch axes. The sequence dim is left
+                unconstrained — the model's own activation constraints
+                re-pin it to sp inside each group's trace, and a "sp"
+                entry here trips an XLA SPMD-partitioner grouped-sharding
+                CHECK (num_groups mismatch) when combined with the
+                vmapped group axis."""
+                def reshape(x):
+                    return lax.with_sharding_constraint(
+                        x.reshape(x.shape[0], n_groups,
+                                  x.shape[1] // n_groups, *x.shape[2:]),
+                        NamedSharding(self.mesh, P(None, topo.BATCH_AXES)))
+
+                return jax.tree.map(reshape, batches)
 
         def train_step(params, opt_state, ls_state, step, batches):
             """Fused GAS boundary: grads of a scan over microbatches —
@@ -671,15 +710,16 @@ class Engine:
                         body, jnp.asarray(0.0, jnp.float32), mbs)
                     return total, (losses, ntoks)
 
-                grouped = jax.tree.map(
-                    lambda x: lax.with_sharding_constraint(
-                        x.reshape(x.shape[0], n_groups,
-                                  x.shape[1] // n_groups, *x.shape[2:]),
-                        group_sh),
-                    batches)
-                (_, (losses_g, ntoks_g)), g_groups = jax.vmap(
-                    jax.value_and_grad(per_group, has_aux=True),
-                    in_axes=(None, 1))(params, grouped)
+                from deepspeed_tpu.runtime import sharding as shard_lib
+
+                grouped = _group_batches(batches)
+                # the group dim carries the batch axes; activation
+                # constraints inside the mapped trace must not re-pin
+                # them (sharding.vmapped_axes)
+                with shard_lib.vmapped_axes(topo.BATCH_AXES):
+                    (_, (losses_g, ntoks_g)), g_groups = jax.vmap(
+                        jax.value_and_grad(per_group, has_aux=True),
+                        in_axes=(None, 1))(params, grouped)
                 g_groups = jax.tree.map(
                     lambda g: g.astype(jnp.float32), g_groups)
                 grads = qgz_reduce_tree(g_groups, grad_sh, self.mesh)
@@ -704,7 +744,11 @@ class Engine:
             """Offload path: (loss-scaled) grads only — the update happens
             host-side in the native CPU optimizer (runtime/offload.py),
             which unscales by grad_scale. grad_transfer_dtype=bf16 halves
-            device->host volume and feeds the native bf16-grad kernel."""
+            device->host volume and feeds the native bf16-grad kernel.
+            Under qgZ the cross-shard reduction is the quantized-wire
+            construction (the wire quantizes BEFORE the host grad copy —
+            reference applies all_to_all_quant_reduce in offload configs
+            too, coalesced_collectives.py:31)."""
 
             def total_loss(params):
                 def body(carry, mb):
@@ -715,8 +759,30 @@ class Engine:
                                          batches)
                 return total, losses
 
-            (_, losses), grads = jax.value_and_grad(
-                total_loss, has_aux=True)(params)
+            if qgz:
+                def per_group(p, mbs):
+                    def body(carry, mb):
+                        loss, aux = model_loss(p, mb)
+                        return carry + loss * scale / gas, loss
+
+                    total, losses = lax.scan(
+                        body, jnp.asarray(0.0, jnp.float32), mbs)
+                    return total, losses
+
+                from deepspeed_tpu.runtime import sharding as shard_lib
+
+                grouped = _group_batches(batches)
+                with shard_lib.vmapped_axes(topo.BATCH_AXES):
+                    (_, losses_g), g_groups = jax.vmap(
+                        jax.value_and_grad(per_group, has_aux=True),
+                        in_axes=(None, 1))(params, grouped)
+                g_groups = jax.tree.map(
+                    lambda g: g.astype(jnp.float32), g_groups)
+                grads = qgz_reduce_tree(g_groups, grad_sh, self.mesh)
+                losses = jnp.mean(losses_g, axis=0)
+            else:
+                (_, losses), grads = jax.value_and_grad(
+                    total_loss, has_aux=True)(params)
             xfer = jnp.bfloat16 if grad_xfer_bf16 else jnp.float32
             grads = jax.tree.map(lambda g: g.astype(xfer), grads)
             grads = _constrain_tree(grads, opt_sh)

@@ -301,6 +301,34 @@ class manual_axes:
         return False
 
 
+_VMAPPED_AXES: frozenset = frozenset()
+
+
+class vmapped_axes:
+    """Trace-scoped marker for explicit per-shard-group vmaps (the qgZ
+    per-group gradient construction, engine.py): the named mesh axes are
+    carried by the vmapped group dimension, so activation constraints
+    inside the mapped trace must not re-pin body dims to them — the
+    conflicting pair trips XLA's SPMD grouped-sharding CHECK
+    (spmd_partitioner_util.cc num_groups mismatch) once another axis
+    (sp) is in play. Unlike manual_axes this strips ONLY activation
+    constraints; the qwZ parameter-fetch constraints keep fsdp (params
+    are not vmapped)."""
+
+    def __init__(self, axes):
+        self._axes = frozenset(axes)
+
+    def __enter__(self):
+        global _VMAPPED_AXES
+        self._prev = _VMAPPED_AXES
+        _VMAPPED_AXES = _VMAPPED_AXES | self._axes
+
+    def __exit__(self, *a):
+        global _VMAPPED_AXES
+        _VMAPPED_AXES = self._prev
+        return False
+
+
 def _strip_axes_spec(spec, axes) -> PartitionSpec:
     out = []
     for e in spec:
@@ -437,6 +465,10 @@ def quantized_param_fetch(x, logical_axes: Sequence[Optional[str]],
         return x
     rules = TP_RULES + EP_RULES + PP_RULES + FSDP_RULES  # stage-3 params
     spec = z3_leaf_spec(path, spec_from_logical(logical_axes, rules))
+    if _MANUAL_AXES:
+        # inside a partial-manual region (pipeline stages: pp) the fetch
+        # constraints may only name auto axes
+        spec = _strip_axes_spec(spec, _MANUAL_AXES)
     entries = list(spec) + [None] * (len(x.shape) - len(spec))
     if not any(_has_fsdp(e) for e in entries):
         return x  # not fsdp-partitioned: nothing to win
@@ -584,6 +616,6 @@ def constrain_activation(x, logical_axes: Sequence[Optional[str]]):
     if mesh is None or all(s == 1 for s in mesh.shape.values()):
         return x
     spec = spec_from_logical(logical_axes, ACT_RULES + TP_RULES)
-    if _MANUAL_AXES:
-        spec = _strip_axes_spec(spec, _MANUAL_AXES)
+    if _MANUAL_AXES or _VMAPPED_AXES:
+        spec = _strip_axes_spec(spec, _MANUAL_AXES | _VMAPPED_AXES)
     return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, spec))

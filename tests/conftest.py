@@ -116,9 +116,24 @@ def pytest_collection_modifyitems(config, items):
                     listed.add(line)
     except OSError:
         return
+    matched = set()
     for item in items:
         nodeid = item.nodeid.replace("\\", "/")
         if not nodeid.startswith("tests/"):
             nodeid = "tests/" + nodeid
         if nodeid in listed:
+            matched.add(nodeid)
             item.add_marker(pytest.mark.slow)
+    # a renamed test or changed parametrize id would silently fall out
+    # of the slow set and back into the smoke tier — warn so the list
+    # can't drift stale (full-collection runs only; -k/path selections
+    # legitimately collect a subset)
+    stale = listed - matched
+    if stale and not (config.getoption("keyword", "")
+                      or config.args not in ([], ["tests"], ["tests/"])):
+        import warnings
+
+        warnings.warn(
+            f"tests/slow_tests.txt has {len(stale)} entries matching no "
+            f"collected test (stale after a rename?): "
+            f"{sorted(stale)[:3]}...", stacklevel=1)

