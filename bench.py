@@ -67,7 +67,7 @@ def main():
     micro = int(os.environ.get("BENCH_MICRO", micro_default if on_tpu else 1))
     gas = int(os.environ.get("BENCH_GAS", 1))
     steps = int(os.environ.get("BENCH_STEPS", 20 if on_tpu else 3))
-    warmup = 3 if on_tpu else 1
+    warmup = int(os.environ.get("BENCH_WARMUP", 3 if on_tpu else 1))
 
     # long-context mode (driver-capturable 128K+ claim, VERDICT r3 #2):
     # BENCH_SEQ >= 32768 flips the measured long-seq defaults — depth 1,

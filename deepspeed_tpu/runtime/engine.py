@@ -534,10 +534,20 @@ class Engine:
             with jax.set_mesh(mesh) if hasattr(jax, "set_mesh") else _nullctx():
                 p32 = jax.jit(init32, out_shardings=out_sh)(self._rng)
             if not host_init:
-                p32 = jax.tree.map(
-                    lambda a: jax.device_put(
-                        a, a.sharding.with_memory_kind("pinned_host")),
-                    p32)
+                def _pin(a):
+                    try:
+                        return jax.device_put(
+                            a, a.sharding.with_memory_kind("pinned_host"))
+                    except Exception:
+                        # multi-process CPU sim: jax routes this
+                        # device_put through a jit reshard (device order
+                        # differs across processes) and the CPU backend
+                        # rejects in-jit host placement ("side-effect
+                        # ops cannot be replicated"). Memory kind is
+                        # simulation-moot there — keep device placement.
+                        return a
+
+                p32 = jax.tree.map(_pin, p32)
             from deepspeed_tpu.runtime.offload import HostOffloadOptimizer
 
             ocfg = self.config.optimizer

@@ -37,6 +37,8 @@ import subprocess
 import sys
 import time
 
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
 LAYERS = int(os.environ.get("PROBE_LAYERS", "6"))
 MICRO = int(os.environ.get("PROBE_MICRO", "4"))
 SEQ = int(os.environ.get("PROBE_SEQ", "2048"))
