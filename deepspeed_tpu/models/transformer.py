@@ -373,24 +373,55 @@ def _layer(cfg: TransformerConfig, x, layer_params, positions):
     from jax.ad_checkpoint import checkpoint_name
 
     # attention
-    y = _norm(x, layer_params["ln1"], cfg.norm, cfg.norm_eps)
     if cfg.fpdt_host_kv:
         # host-KV streaming path: q/k/v/context never materialize at
-        # full S on the chip (parallel/fpdt.py fpdt_attention_block);
+        # full S on the chip, ln1/ln2 apply per chunk inside the scans,
+        # and (for the sequential-block default) the residual add + MLP
+        # fuse into the same chunk — the whole layer emits one full-S
+        # buffer (parallel/fpdt.py fpdt_attention_block);
         # fpdt_host_kv + sequence_parallel rejected at config time
         from deepspeed_tpu.parallel.fpdt import fpdt_attention_block
 
-        attn = fpdt_attention_block(
-            y, ap, positions, num_heads=cfg.num_heads,
+        mp = layer_params.get("mlp")
+        fuse_mlp = (not cfg.parallel_block) and mp is not None
+
+        def post_fn(x_chunk, attn_chunk):
+            if cfg.use_biases:
+                attn_chunk = attn_chunk + ap["bo"].astype(dt)
+            xc = x_chunk + attn_chunk
+            yc = _norm(xc, layer_params["ln2"], cfg.norm, cfg.norm_eps)
+            if cfg.activation == "swiglu":
+                gt = jnp.einsum("bch,hf->bcf", yc, mp["wg"].astype(dt))
+                ut = jnp.einsum("bch,hf->bcf", yc, mp["wi"].astype(dt))
+                zt = jax.nn.silu(gt) * ut
+            else:
+                pre = jnp.einsum("bch,hf->bcf", yc, mp["wi"].astype(dt))
+                if cfg.use_biases:
+                    pre = pre + mp["bi"].astype(dt)
+                zt = act_fn(cfg.activation)(pre)
+            out = jnp.einsum("bcf,fh->bch", zt, mp["wo"].astype(dt))
+            if cfg.use_biases:
+                out = out + mp["bo"].astype(dt)
+            return xc + out
+
+        res = fpdt_attention_block(
+            x, ap, positions, num_heads=cfg.num_heads,
             kv_heads=cfg.kv_heads, head_dim=cfg.head_dim,
             rope_theta=cfg.rope_theta if cfg.pos_emb == "rope" else None,
             q_chunks=max(cfg.attn_chunks, 2), causal=True,
-            use_biases=cfg.use_biases)
+            use_biases=cfg.use_biases,
+            norm_fn=lambda t: _norm(t, layer_params["ln1"], cfg.norm,
+                                    cfg.norm_eps),
+            post_fn=post_fn if fuse_mlp else None)
+        if fuse_mlp:
+            return constrain_activation(res, ("batch", "seq", "embed"))
+        attn = res
         if cfg.use_biases:
             attn = attn + ap["bo"].astype(dt)
         attn = constrain_activation(
             checkpoint_name(attn, "attn_out"), ("batch", "seq", "embed"))
         return _layer_mlp(cfg, x, attn, layer_params)
+    y = _norm(x, layer_params["ln1"], cfg.norm, cfg.norm_eps)
     q = jnp.einsum("bsh,hnd->bsnd", y, ap["wq"].astype(dt))
     k = jnp.einsum("bsh,hnd->bsnd", y, ap["wk"].astype(dt))
     v = jnp.einsum("bsh,hnd->bsnd", y, ap["wv"].astype(dt))
@@ -435,11 +466,8 @@ def _layer_mlp(cfg: TransformerConfig, x, attn, layer_params):
     # mlp: sequential (x + attn first) or parallel (Falcon-style — both
     # branches read the pre-attention residual; the loader duplicates a
     # single input_layernorm into ln1/ln2 when the arch has one)
-    if cfg.parallel_block:
-        y = _norm(x, layer_params["ln2"], cfg.norm, cfg.norm_eps)
-    else:
+    if not cfg.parallel_block:
         x = x + attn
-        y = _norm(x, layer_params["ln2"], cfg.norm, cfg.norm_eps)
 
     def mlp_fn(y):
         if cfg.activation == "swiglu":
@@ -461,11 +489,19 @@ def _layer_mlp(cfg: TransformerConfig, x, attn, layer_params):
 
     if cfg.tiled_mlp > 1:
         # position-wise → chunk the sequence (ALST TiledMLP analog):
-        # peak MLP activation drops to one tile's worth
+        # peak MLP activation drops to one tile's worth. ln2 is
+        # position-wise too — normalizing inside the tile body keeps
+        # its fp32 intermediate (and the normed y) tile-sized instead
+        # of full-sequence (a full-S term at 512K context)
         from deepspeed_tpu.parallel.tiled_compute import tiled_mlp
 
-        z = tiled_mlp(mlp_fn, y, cfg.tiled_mlp)
+        def norm_mlp_tile(x_tile):
+            return mlp_fn(_norm(x_tile, layer_params["ln2"], cfg.norm,
+                                cfg.norm_eps))
+
+        z = tiled_mlp(norm_mlp_tile, x, cfg.tiled_mlp)
     else:
+        y = _norm(x, layer_params["ln2"], cfg.norm, cfg.norm_eps)
         z = mlp_fn(y)
     z = constrain_activation(z, ("batch", "seq", "embed"))
     if cfg.parallel_block:
@@ -519,27 +555,61 @@ def apply_hidden(cfg: TransformerConfig, params: Dict[str, Any],
         # fetch is a device→host transfer, landing grads host-side
         # (reference: swap_tensor/partitioned_param_swapper.py semantics,
         # compiled by XLA instead of hand-scheduled copies).
-        def fetch_layer(i):
-            return jax.tree.map(
-                lambda a: jax.device_put(
-                    lax.dynamic_index_in_dim(a, i, keepdims=False),
-                    jax.memory.Space.Device),
-                params["layers"])
+        import os as _os
 
-        def fetched_layer_fn(carry, i):
-            return layer_fn(carry, fetch_layer(i), positions)
+        # default: the double-buffered prefetch streamer
+        # (runtime/param_stream.py streamed_layers_prefetch — fetch of
+        # layer i+1 overlaps layer i's compute; measured 2026-07-31 on
+        # v5e-1 that XLA's default schedule overlaps these host fetches
+        # not at all, docs/latency_hiding.md). Its custom VJP implies
+        # per-layer full recompute (nothing_saveable). DSTPU_PREFETCH=0
+        # falls back to the plain scan; DSTPU_SERIALIZE_FETCH=1
+        # additionally chains each fetch on the previous layer's output
+        # (the probe's no-overlap control).
+        _prefetch = bool(int(_os.environ.get("DSTPU_PREFETCH", "1")))
+        _serialize_fetch = bool(int(_os.environ.get(
+            "DSTPU_SERIALIZE_FETCH", "0")))
 
-        if cfg.remat:
-            from deepspeed_tpu.runtime.activation_checkpointing import \
-                checkpoint_wrapper
+        if _prefetch and not _serialize_fetch:
+            from deepspeed_tpu.runtime.param_stream import \
+                streamed_layers_prefetch
 
-            fetched_layer_fn = checkpoint_wrapper(fetched_layer_fn,
-                                                  policy=cfg.remat_policy)
+            if cfg.remat and cfg.remat_policy not in (
+                    None, "nothing_saveable"):
+                from deepspeed_tpu.utils.logging import warning_once
 
-        def host_scan_body(carry, i):
-            return fetched_layer_fn(carry, i), None
+                warning_once(
+                    "offload_param prefetch streaming remats per layer "
+                    f"(nothing_saveable); remat_policy="
+                    f"{cfg.remat_policy!r} does not apply to the "
+                    "streamed stack")
+            x = streamed_layers_prefetch(
+                layer_fn, params["layers"], x, length=cfg.num_layers,
+                extra=(positions,))
+        else:
+            def fetch_layer(i):
+                return jax.tree.map(
+                    lambda a: jax.device_put(
+                        lax.dynamic_index_in_dim(a, i, keepdims=False),
+                        jax.memory.Space.Device),
+                    params["layers"])
 
-        x, _ = lax.scan(host_scan_body, x, jnp.arange(cfg.num_layers))
+            def fetched_layer_fn(carry, i):
+                if _serialize_fetch:
+                    carry, i = lax.optimization_barrier((carry, i))
+                return layer_fn(carry, fetch_layer(i), positions)
+
+            if cfg.remat:
+                from deepspeed_tpu.runtime.activation_checkpointing import \
+                    checkpoint_wrapper
+
+                fetched_layer_fn = checkpoint_wrapper(
+                    fetched_layer_fn, policy=cfg.remat_policy)
+
+            def host_scan_body(carry, i):
+                return fetched_layer_fn(carry, i), None
+
+            x, _ = lax.scan(host_scan_body, x, jnp.arange(cfg.num_layers))
     else:
         if cfg.remat:
             from deepspeed_tpu.runtime.activation_checkpointing import \

@@ -186,14 +186,20 @@ def _stream_attn_fwd_impl(q_c, k_t, v_t, q_pos, n_tiles, g, s_valid,
                           causal, kv_tile):
     B, C, N, D = q_c.shape
     T = k_t.shape[0]
+
+    def _untile(flat):
+        # host stacks are [T, B*kv_tile*Nkv*D] (2-D dodges an XLA
+        # async-copy layout bug on 5-D host moves)
+        return flat.reshape(B, kv_tile, N // g, D)
+
     o = jnp.zeros((B, N, C, D), jnp.float32)
     m = jnp.full((B, N, C), -jnp.inf, jnp.float32)
     l = jnp.zeros((B, N, C), jnp.float32)
 
     def tile_body(carry, t_idx):
         o, m, l = carry
-        k_rep = _repeat_tile(_fetch_tile(k_t, t_idx), g)
-        v_rep = _repeat_tile(_fetch_tile(v_t, t_idx), g)
+        k_rep = _repeat_tile(_untile(_fetch_tile(k_t, t_idx)), g)
+        v_rep = _repeat_tile(_untile(_fetch_tile(v_t, t_idx)), g)
         k_pos = t_idx * kv_tile + jnp.arange(kv_tile)
         s = _masked_scores(q_c, k_rep, q_pos, k_pos, causal, s_valid)
         m_blk = jnp.max(s, axis=-1)
@@ -238,6 +244,10 @@ def _stream_attn_bwd(g, s_valid, causal, kv_tile, res, dctx):
     q_c, k_t, v_t, q_pos, n_tiles, ctx, lse = res
     B, C, N, D = q_c.shape
     T = k_t.shape[0]
+
+    def _untile(flat):
+        return flat.reshape(B, kv_tile, N // g, D)
+
     scale = 1.0 / jnp.sqrt(jnp.asarray(D, jnp.float32))
     dctx32 = jnp.transpose(dctx.astype(jnp.float32), (0, 2, 1, 3))
     ctx32 = jnp.transpose(ctx.astype(jnp.float32), (0, 2, 1, 3))
@@ -249,8 +259,8 @@ def _stream_attn_bwd(g, s_valid, causal, kv_tile, res, dctx):
 
     def tile_body(carry, t_idx):
         dq, dk_t, dv_t = carry
-        k_tile = _fetch_tile(k_t, t_idx)
-        v_tile = _fetch_tile(v_t, t_idx)
+        k_tile = _untile(_fetch_tile(k_t, t_idx))
+        v_tile = _untile(_fetch_tile(v_t, t_idx))
         k_rep = _repeat_tile(k_tile, g)
         v_rep = _repeat_tile(v_tile, g)
         k_pos = t_idx * kv_tile + jnp.arange(kv_tile)
@@ -268,8 +278,10 @@ def _stream_attn_bwd(g, s_valid, causal, kv_tile, res, dctx):
                             ) * scale
         dk_tile = _unrepeat_grad(dk_rep, g).astype(k_t.dtype)
         dv_tile = _unrepeat_grad(dv_rep, g).astype(v_t.dtype)
-        dk_t2 = lax.dynamic_update_index_in_dim(dk_t, dk_tile, t_idx, 0)
-        dv_t2 = lax.dynamic_update_index_in_dim(dv_t, dv_tile, t_idx, 0)
+        dk_t2 = lax.dynamic_update_index_in_dim(
+            dk_t, dk_tile.reshape(dk_t.shape[1:]), t_idx, 0)
+        dv_t2 = lax.dynamic_update_index_in_dim(
+            dv_t, dv_tile.reshape(dv_t.shape[1:]), t_idx, 0)
         return (dq, dk_t2, dv_t2), None
 
     def guarded(carry, t_idx):
@@ -292,27 +304,31 @@ def fpdt_attention_block(y, ap, positions, *, num_heads: int,
                          kv_heads: int, head_dim: int,
                          rope_theta: Optional[float], q_chunks: int,
                          kv_tile: Optional[int] = None, causal: bool = True,
-                         use_biases: bool = False) -> jax.Array:
+                         use_biases: bool = False,
+                         norm_fn: Optional[callable] = None,
+                         post_fn: Optional[callable] = None) -> jax.Array:
     """Full FPDT attention sub-layer with host-resident KV streaming —
     the reference ``_FPDTGPUOffloadingAttentionImpl_``'s pinned
     double-buffered sequence chunks (sequence/fpdt_layer.py:545,
     ``SequenceChunk`` :497) as XLA memory-space movement.
 
-    y: [B, S, H] normed layer input (device). Returns the attention
-    branch output [B, S, H] (wo applied). Device never holds a full-S
-    [B, S, Nq, D] query/output tensor or repeated-KV tensor:
+    y: [B, S, H] layer input (device) — pre-norm when ``norm_fn`` is
+    given (the norm then applies per chunk inside the scans, so neither
+    the normed full-S activation nor its fp32 intermediate ever
+    materializes — the reference chunks the whole layer pass the same
+    way, fpdt_layer.py:1126). Returns the attention branch output
+    [B, S, H] (wo applied). Device never holds a full-S [B, S, Nq, D]
+    query/output tensor or repeated-KV tensor:
 
-      * K/V are projected once at kv_heads width (the GQA-narrow 1/g
-        footprint), rotated, tiled, and *moved to host memory*;
+      * K/V build scans sequence tiles: per tile (norm→) project at
+        kv_heads width (the GQA-narrow 1/g footprint), rotate, and
+        write into pinned-host stacks;
       * the q-chunk scan projects each chunk's queries on the fly and
-        streams KV tiles back one at a time (``device_put`` to device
-        inside the rematted chunk body — XLA's scheduler overlaps the
-        H2D copy with the previous tile's compute, the role of the
-        reference's double buffering);
-      * each chunk's context immediately contracts with wo to [B, C, H].
-
-    The backward replays chunk bodies (remat), re-streaming tiles from
-    host, so residuals are O(B*S*H) rather than O(B*S*Nq*D).
+        streams KV tiles back one at a time, accumulating each chunk's
+        wo-contracted output into a carried [B, Sp, H] buffer (scan
+        in-places the carry — no stacked-ys + reshape double buffer);
+      * the backward replays chunk bodies (remat), re-streaming tiles
+        from host, so residuals are O(B*S*H) rather than O(B*S*Nq*D).
     """
     B, S, H = y.shape
     dt = y.dtype
@@ -327,33 +343,60 @@ def fpdt_attention_block(y, ap, positions, *, num_heads: int,
     Skv = S + pad_kv
     T = Skv // kv_tile
 
-    def proj(w, b):
-        out = jnp.einsum("bsh,hnd->bsnd", y, w.astype(dt))
+    # one padded view serves both the q chunks and the kv tiles
+    P = max(Sp, Skv)
+    y_p = jnp.pad(y, [(0, 0), (0, P - S), (0, 0)]) if P > S else y
+    pos_p = (jnp.pad(positions, [(0, 0), (0, P - S)]) if P > S
+             else positions)
+
+    def maybe_norm(t):
+        return norm_fn(t) if norm_fn is not None else t
+
+    def proj_tile(yt, w, b):
+        out = jnp.einsum("bch,hnd->bcnd", yt, w.astype(dt))
         if use_biases:
             out = out + b.astype(dt)
         return out
 
-    # K/V at kv_heads width only — 1/g of the repeated footprint
-    k = proj(ap["wk"], ap.get("bk"))
-    v = proj(ap["wv"], ap.get("bv"))
-    if rope_theta:
-        k = _rope_chunk(k, positions, rope_theta)
-    if pad_kv:
-        k = jnp.pad(k, [(0, 0), (0, pad_kv), (0, 0), (0, 0)])
-        v = jnp.pad(v, [(0, 0), (0, pad_kv), (0, 0), (0, 0)])
-    k_t = _to_host(jnp.moveaxis(k.reshape(B, T, kv_tile, kv_heads, head_dim),
-                                1, 0))
-    v_t = _to_host(jnp.moveaxis(v.reshape(B, T, kv_tile, kv_heads, head_dim),
-                                1, 0))
+    # K/V build: scan tiles — per tile (norm→) project+rotate — stacking
+    # on device at kv_heads width (1/g of the repeated footprint; ~2GB
+    # at 512K vs 4.3GB for one full-S hidden), then one move to host.
+    # Pad tiles carry norm-of-zero garbage; _masked_scores' k_pos <
+    # s_valid mask keeps them out of every softmax. (Stacks can't build
+    # directly into host buffers: autodiff of a host-carried
+    # dynamic_update scan makes mixed-memory-space cotangents.)
+    def kv_tile_fn(t):
+        x_tile = lax.dynamic_slice_in_dim(y_p, t * kv_tile, kv_tile, 1)
+        p_tile = lax.dynamic_slice_in_dim(pos_p, t * kv_tile, kv_tile, 1)
+        yt = maybe_norm(x_tile)
+        kt = proj_tile(yt, ap["wk"], ap.get("bk"))
+        vt = proj_tile(yt, ap["wv"], ap.get("bv"))
+        if rope_theta:
+            kt = _rope_chunk(kt, p_tile, rope_theta)
+        # [rows, head_dim] keeps the lane dim: fully flat 1-D tiles trip
+        # the TPU async dynamic-index emitter's sublane alignment CHECK
+        return (kt.reshape(-1, head_dim), vt.reshape(-1, head_dim))
 
-    y_p = jnp.pad(y, [(0, 0), (0, pad_q), (0, 0)]) if pad_q else y
-    y_c = jnp.moveaxis(y_p.reshape(B, q_chunks, C, H), 1, 0)  # [QC,B,C,H]
-    pos_p = jnp.pad(positions, [(0, 0), (0, pad_q)]) if pad_q else positions
-    pos_c = jnp.moveaxis(pos_p.reshape(B, q_chunks, C), 1, 0)
+    # remat per tile: without it the scan's backward saves every tile's
+    # norm fp32 intermediates — stacked [T, ...] f32, exactly the full-S
+    # footprint this path removes. The host move stays OUTSIDE the
+    # rematted region (its replay would mix memory spaces), and happens
+    # per flattened tile: the stacked host result is [T, tile_elems]
+    # built from 1-D per-step copies (bulk D2H of a multi-dim stack
+    # trips an XLA async-copy layout-assignment mismatch on TPU);
+    # _stream_attn re-shapes per fetched tile.
+    kv_tile_fn = jax.checkpoint(kv_tile_fn)
+
+    def kv_body(_, t):
+        kt, vt = kv_tile_fn(t)
+        return None, (_to_host(kt), _to_host(vt))
+
+    _, (k_t, v_t) = lax.scan(kv_body, None, jnp.arange(T))
 
     wo = ap["wo"].astype(dt)
 
-    def chunk(y_chunk, pos_chunk, chunk_idx):
+    def chunk(x_chunk, pos_chunk, chunk_idx):
+        y_chunk = maybe_norm(x_chunk)
         q_c = jnp.einsum("bch,hnd->bcnd", y_chunk, ap["wq"].astype(dt))
         if use_biases:
             q_c = q_c + ap["bq"].astype(dt)
@@ -369,15 +412,29 @@ def fpdt_attention_block(y, ap, positions, *, num_heads: int,
 
         ctx = _stream_attn(q_c, k_t, v_t, q_pos, n_tiles, g, S, causal,
                            kv_tile)
-        return jnp.einsum("bcnd,ndh->bch", ctx, wo)
+        attn_c = jnp.einsum("bcnd,ndh->bch", ctx, wo)
+        if post_fn is not None:
+            # fuse the rest of the transformer block into the same
+            # chunk (residual add + ln2 + MLP — all position-wise): the
+            # layer emits ONE full-S buffer instead of separate
+            # attention-out and MLP-out full-S intermediates (reference
+            # chunks the whole layer pass, fpdt_layer.py:1126)
+            return post_fn(x_chunk, attn_c)
+        return attn_c
 
-    def chunk_body(_, xs):
-        y_chunk, p_chunk, idx = xs
-        return None, jax.checkpoint(chunk)(y_chunk, p_chunk, idx)
+    def chunk_body(buf, idx):
+        # slice the chunk in-body (a pre-split [q_chunks, B, C, H] copy
+        # would be a second full-sequence buffer) and write the result
+        # into the carried output buffer (scan in-places the carry — a
+        # stacked-ys + moveaxis/reshape epilogue would transiently hold
+        # two full-sequence copies)
+        x_chunk = lax.dynamic_slice_in_dim(y_p, idx * C, C, axis=1)
+        p_chunk = lax.dynamic_slice_in_dim(pos_p, idx * C, C, axis=1)
+        res = jax.checkpoint(chunk)(x_chunk, p_chunk, idx)
+        return lax.dynamic_update_slice_in_dim(buf, res, idx * C, 1), None
 
-    _, out = lax.scan(chunk_body, None,
-                      (y_c, pos_c, jnp.arange(q_chunks)))
-    out = jnp.moveaxis(out, 0, 1).reshape(B, Sp, H)
+    out, _ = lax.scan(chunk_body, jnp.zeros((B, Sp, H), dt),
+                      jnp.arange(q_chunks))
     return out[:, :S] if pad_q else out
 
 
