@@ -16,6 +16,8 @@ from __future__ import annotations
 
 import functools
 import json
+import os, sys
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 import time
 
 import jax
