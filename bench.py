@@ -3,6 +3,14 @@
 Prints ONE JSON line:
   {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
 
+``value`` is the median of k measured windows (default 3), with a
+host-load sentinel: windows that started while the 1-minute loadavg
+exceeded BENCH_LOAD_MAX are dropped when cleaner windows exist, and the
+run resamples (up to BENCH_MAX_WINDOWS) while the kept spread exceeds
+BENCH_SPREAD_TARGET. Per-window throughput + loadavg ship in the JSON
+(``windows``/``load_avg``/``spread_pct``/``contended``) so a contended
+capture is diagnosable from the artifact alone.
+
 Default configuration is BASELINE.json's north-star class: Llama-3-8B
 layer geometry (h=4096, ffn=14336, 32q/8kv GQA, RoPE, swiglu, RMSNorm)
 under ZeRO-3 — depth cut to the 3 layers that fit one 16GB chip with
@@ -13,13 +21,17 @@ in BASELINE.json's ``published`` dict.
 Env knobs: BENCH_MODEL (zoo name; "gpt2-125m" restores the round-1
 config), BENCH_SEQ, BENCH_MICRO, BENCH_STEPS, BENCH_LAYERS, BENCH_VOCAB,
 BENCH_ZERO_STAGE, BENCH_REMAT_POLICY, BENCH_PEAK_TFLOPS (defaults to the
-detected chip's bf16 peak).
+detected chip's bf16 peak), BENCH_WINDOWS / BENCH_MAX_WINDOWS /
+BENCH_LOAD_MAX / BENCH_SPREAD_TARGET (measurement-window controls;
+BENCH_WINDOWS=1 restores the single-sample behavior for slow capacity
+probes).
 """
 
 from __future__ import annotations
 
 import json
 import os
+import statistics
 import time
 
 
@@ -212,14 +224,80 @@ def main():
         loss = engine.train_batch(data)
     jax.block_until_ready(loss)
 
-    t0 = time.perf_counter()
-    for _ in range(steps):
-        loss = engine.train_batch(data)
-    jax.block_until_ready(loss)
-    dt = time.perf_counter() - t0
+    # Median-of-k measurement with a host-contention sentinel. This repo
+    # benches on a 1-core host the driver shares with other work; a single
+    # 20-step sample has been observed 28% low purely from host load
+    # (BENCH_r04 vs a fresh run at the same commit). Defense: measure k
+    # independent windows, record the 1-minute loadavg at each window
+    # start, drop windows that began under heavy load when clean ones
+    # exist, resample while the spread is wide, and report the median
+    # plus the full per-window evidence so an outlier is visible in the
+    # artifact instead of silently becoming the headline.
+    tokens_per_window = B * seq * steps * gas  # train_batch runs gas microbatches
 
-    tokens = B * seq * steps * gas  # train_batch runs gas microbatches
-    tok_per_sec_chip = tokens / dt / n_chips
+    def loadavg():
+        try:
+            return os.getloadavg()[0]
+        except OSError:
+            return -1.0
+
+    def measure_window():
+        # loadavg is a 1-minute EMA, so the run's own compile/warmup burst
+        # lingers into the first windows; min(start, end) reads through
+        # that decaying tail, while genuine external contention persists
+        # across the window and keeps both samples high
+        load0 = loadavg()
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            loss = engine.train_batch(data)
+        jax.block_until_ready(loss)
+        dt = time.perf_counter() - t0
+        load = min(load0, loadavg()) if load0 >= 0 else load0
+        return tokens_per_window / dt / n_chips, load, loss
+
+    # capacity-probe runs (BENCH_STEPS=1 on host-optimizer shapes where a
+    # step takes minutes) default to one window; normal runs take three
+    n_windows = max(1, int(os.environ.get(
+        "BENCH_WINDOWS", 3 if (on_tpu and steps > 1) else 1)))
+    max_windows = int(os.environ.get("BENCH_MAX_WINDOWS",
+                                     max(n_windows + 2, 5)))
+    load_max = float(os.environ.get("BENCH_LOAD_MAX", "2.0"))
+    spread_target = float(os.environ.get("BENCH_SPREAD_TARGET", "0.05"))
+
+    windows = []  # (tok/s/chip, loadavg)
+    for _ in range(n_windows):
+        tps, load, loss = measure_window()
+        windows.append((tps, load))
+    # resample while spread is wide and budget remains — one contended
+    # window out of three still skews the median less than it skews a
+    # single-sample mean, and extra clean windows dilute it further.
+    # With >=4 kept windows the single slowest value is trimmed before
+    # the spread check: contention noise on this host is one-sided (it
+    # only slows windows down), so the slowest window is the suspect one
+    # and the fastest is never discarded. Without a trim, max-min never
+    # shrinks and resampling could not converge.
+    def kept_and_spread():
+        clean = [w for w in windows if 0.0 <= w[1] <= load_max]
+        kept = clean if clean else windows
+        vals = sorted(w[0] for w in kept)
+        trimmed = 0
+        if len(vals) >= 4:
+            vals = vals[1:]
+            trimmed = 1
+        med = statistics.median(vals)
+        spread = (max(vals) - min(vals)) / med if med > 0 else 0.0
+        return kept, med, spread, trimmed
+
+    kept, med, spread, trimmed = kept_and_spread()
+    while (len(windows) < max_windows
+           and (spread > spread_target or len(kept) < min(3, n_windows))):
+        tps, load, loss = measure_window()
+        windows.append((tps, load))
+        kept, med, spread, trimmed = kept_and_spread()
+
+    tok_per_sec_chip = med
+    contended = len(kept) < len(windows) or any(
+        w[1] > load_max for w in windows)
     flops_per_token = model.flops_per_token()
     peak = detect_peak_tflops(jax.devices()[0])
     mfu = tok_per_sec_chip * flops_per_token / (peak * 1e12)
@@ -244,6 +322,13 @@ def main():
         "unit": "tokens/s/chip",
         "vs_baseline": round(vs_baseline, 3),
         "mfu": round(mfu, 4),
+        "spread_pct": round(100.0 * spread, 2),
+        "windows": [round(w[0], 1) for w in windows],
+        "load_avg": [round(w[1], 2) for w in windows],
+        "windows_kept": len(kept),
+        "windows_used": len(kept) - trimmed,
+        "trimmed_low": trimmed,
+        "contended": contended,
         "config_source": config_source,
         "remat_policy": overrides.get("remat_policy", policy),
         "loss": round(float(loss), 4),
