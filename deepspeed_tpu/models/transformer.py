@@ -89,8 +89,22 @@ class TransformerConfig:
     # Falcon-style parallel residual: x + attn(ln1(x)) + mlp(ln2(x)),
     # both branches reading the pre-attention residual
     parallel_block: bool = False
+    # offload_param streamed-stack A/B knobs. None resolves from
+    # DSTPU_PREFETCH / DSTPU_SERIALIZE_FETCH at *config construction* so
+    # the choice participates in the jit trace-cache key — flipping the
+    # env after the first compile changes the next config built, never a
+    # stale cached executable.
+    prefetch_stream: Optional[bool] = None
+    serialize_fetch: Optional[bool] = None
 
     def __post_init__(self):
+        import os as _os
+        if self.prefetch_stream is None:
+            object.__setattr__(self, "prefetch_stream", bool(int(
+                _os.environ.get("DSTPU_PREFETCH", "1"))))
+        if self.serialize_fetch is None:
+            object.__setattr__(self, "serialize_fetch", bool(int(
+                _os.environ.get("DSTPU_SERIALIZE_FETCH", "0"))))
         if self.sp_mode not in ("ulysses", "ring"):
             raise ValueError(
                 f"sp_mode must be ulysses|ring, got {self.sp_mode!r}")
@@ -555,20 +569,18 @@ def apply_hidden(cfg: TransformerConfig, params: Dict[str, Any],
         # fetch is a device→host transfer, landing grads host-side
         # (reference: swap_tensor/partitioned_param_swapper.py semantics,
         # compiled by XLA instead of hand-scheduled copies).
-        import os as _os
-
         # default: the double-buffered prefetch streamer
         # (runtime/param_stream.py streamed_layers_prefetch — fetch of
         # layer i+1 overlaps layer i's compute; measured 2026-07-31 on
         # v5e-1 that XLA's default schedule overlaps these host fetches
         # not at all, docs/latency_hiding.md). Its custom VJP implies
-        # per-layer full recompute (nothing_saveable). DSTPU_PREFETCH=0
-        # falls back to the plain scan; DSTPU_SERIALIZE_FETCH=1
+        # per-layer full recompute (nothing_saveable). prefetch_stream
+        # False falls back to the plain scan; serialize_fetch True
         # additionally chains each fetch on the previous layer's output
-        # (the probe's no-overlap control).
-        _prefetch = bool(int(_os.environ.get("DSTPU_PREFETCH", "1")))
-        _serialize_fetch = bool(int(_os.environ.get(
-            "DSTPU_SERIALIZE_FETCH", "0")))
+        # (the probe's no-overlap control). Both resolve from env at
+        # config construction (see TransformerConfig).
+        _prefetch = cfg.prefetch_stream
+        _serialize_fetch = cfg.serialize_fetch
 
         if _prefetch and not _serialize_fetch:
             from deepspeed_tpu.runtime.param_stream import \

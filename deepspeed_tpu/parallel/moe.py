@@ -114,20 +114,28 @@ def _grouped_unsupported_reason(cfg: GateConfig) -> Optional[str]:
 
     The grouped engine composes with dp/fsdp (token-parallel shards), ep
     (experts partitioned per shard, tokens routed by two all-to-alls),
-    sp (another token axis) and tp (FFN dim split + deferred psum). The
-    remaining exclusions: pp (the pipeline stage body pre-slices layer
-    stacks outside this module's shard_map) and expert counts that don't
-    divide over ep."""
+    sp (another token axis), tp (FFN dim split + deferred psum) and pp
+    (the dispatch shard_map nests inside the pipeline's manual-pp stage
+    body over the remaining auto axes). The one exclusion left: expert
+    counts that don't divide over ep."""
     from deepspeed_tpu.parallel import topology as topo
+
+    from deepspeed_tpu.runtime import sharding as _sharding
 
     mesh = topo._GLOBAL_MESH
     if mesh is None:
         return None
-    if mesh.shape.get("pp", 1) > 1:
-        return "pp>1: grouped dispatch not yet wired through pipeline stages"
     ep = mesh.shape.get("ep", 1)
     if ep > 1 and cfg.num_experts % ep:
         return f"num_experts={cfg.num_experts} not divisible by ep={ep}"
+    # the dispatch shard_map must manualize ep/tp itself (its collectives
+    # and specs reference them); an enclosing region that already
+    # manualized them (none in-tree does) can't host the grouped path
+    pre_manual = sorted(a for a in ("ep", "tp")
+                        if a in _sharding._MANUAL_AXES
+                        and mesh.shape.get(a, 1) > 1)
+    if pre_manual:
+        return f"axes {pre_manual} already manual in the enclosing region"
     return None
 
 
@@ -144,15 +152,24 @@ def moe_ffn(x: jax.Array, router_w: jax.Array, expert_params: Dict[str, jax.Arra
     grouped-GEMM execution (reference GroupedExperts, ep_experts.py:136 —
     exact top-k flops regardless of imbalance), expert-parallel over ep
     with two all-to-alls and tp-split FFNs (see moe_ffn_dropless).
-    "auto"/"grouped" take the grouped path on every mesh except pp>1 or
-    E % ep != 0 — those fall back to einsum with a telemetry count
-    ("moe.grouped_fallback") and a one-time warning.
+    "auto"/"grouped" take the grouped path on every mesh (under pp the
+    dispatch nests inside the pipeline stage body) except E % ep != 0 —
+    "auto" falls back to einsum there with a telemetry count
+    ("moe.grouped_fallback") and a one-time warning; an explicit
+    "grouped" raises instead (a silent numeric change is worse than an
+    error).
     """
     if impl in ("auto", "grouped"):
         reason = _grouped_unsupported_reason(cfg)
         if reason is None:
             return moe_ffn_dropless(x, router_w, expert_params, cfg,
                                     activation=activation, train=train)
+        if impl == "grouped":
+            # an explicit request must not silently change numerics (the
+            # einsum path drops tokens differently); only "auto" degrades
+            raise ValueError(
+                f"moe_ffn: impl='grouped' is unsupported on this mesh: "
+                f"{reason} (use impl='auto' to allow the einsum fallback)")
         from deepspeed_tpu.utils import telemetry
         telemetry.count("moe.grouped_fallback", reason)
     B, S, H = x.shape
@@ -309,6 +326,15 @@ def _dropless_shard_core(x: jax.Array, router_w: jax.Array,
         keep = pos < cap
         pos_c = jnp.minimum(pos, cap - 1)
         kf = keep.astype(dt)
+        # renormalize combine weights over *kept* gates per token (the
+        # einsum path and reference topkgating normalize over kept top-k
+        # probs; without this a token whose row overflowed the budget
+        # would lose that weight mass entirely instead of redistributing
+        # it to its surviving experts)
+        keep_f = keep.astype(jnp.float32)
+        kept_mass = jnp.zeros((tokens,), jnp.float32).at[token_idx].add(
+            flat_w * keep_f)
+        flat_w = flat_w * keep_f / jnp.maximum(kept_mass[token_idx], 1e-9)
         rows_x = flat_x[token_idx]                          # [m0, H]
         # packed send buffers: [ep*cap, H] rows + [ep*cap] local-expert
         # tags (0 = padding slot); kept slots are unique so scatter-add
@@ -415,10 +441,19 @@ def moe_ffn_dropless(x: jax.Array, router_w: jax.Array,
       fsdp        the ZeRO-3 param fetch: the expert in_spec leaves the
                   embed dim unsharded, so GSPMD all-gathers it over fsdp
                   on use (stage-3 semantics, never over ep).
+      pp          when called inside the pipeline's manual-pp stage body
+                  (runtime/sharding.manual_axes tracks it), the dispatch
+                  shard_map nests: it takes the context *abstract* mesh
+                  and manualizes only the still-auto axes, so the two
+                  all-to-alls and the tp psum run per pipeline stage —
+                  the reference's MoE-inside-pipe composition
+                  (sharded_moe.py:589 under runtime/pipe/engine.py:60).
     """
     from deepspeed_tpu.parallel import topology as topo
+    from deepspeed_tpu.runtime import sharding as _sharding
 
     mesh = topo._GLOBAL_MESH
+    manual = _sharding._MANUAL_AXES
     sizes = dict(mesh.shape) if mesh is not None else {}
     ep, tp = sizes.get("ep", 1), sizes.get("tp", 1)
     B_in, S_in = x.shape[0], x.shape[1]
@@ -426,19 +461,25 @@ def moe_ffn_dropless(x: jax.Array, router_w: jax.Array,
     # dp=2×ep=2 mesh shards over dp and *replicates* over ep — the ep
     # dispatch still partitions experts and routes correctly (each source
     # gets its own copies back), it just computes redundantly across the
-    # unused token axis
+    # unused token axis. Axes already manual in an enclosing region (the
+    # pipeline's pp, the ZeRO++ dp region) can't be re-manualized or
+    # referenced in this shard_map's specs — they drop out of the token
+    # axes (the enclosing region already localized them).
+    def _auto(a: str) -> int:
+        return 1 if a in manual else sizes.get(a, 1)
+
     batch_axes, prod = [], 1
     for a in ("dp", "fsdp", "ep"):
-        sz = sizes.get(a, 1)
+        sz = _auto(a)
         if sz > 1 and B_in % (prod * sz) == 0:
             batch_axes.append(a)
             prod *= sz
     batch_axes = tuple(batch_axes)
-    sp = sizes.get("sp", 1) if S_in % max(sizes.get("sp", 1), 1) == 0 else 1
+    sp = _auto("sp") if S_in % max(_auto("sp"), 1) == 0 else 1
     if mesh is not None and (
             len(batch_axes) < sum(1 for a in ("dp", "fsdp", "ep")
-                                  if sizes.get(a, 1) > 1)
-            or sp != sizes.get("sp", 1)):
+                                  if _auto(a) > 1)
+            or sp != _auto("sp")):
         from deepspeed_tpu.utils import telemetry
         telemetry.count(
             "moe.grouped_replicated_tokens",
@@ -475,10 +516,18 @@ def moe_ffn_dropless(x: jax.Array, router_w: jax.Array,
     if "wg" in expert_params:
         exp_specs["wg"] = P(ep_ax, None, tp_ax)
     stat_spec = {k: P(token_axes or None) for k in _STAT_KEYS}
+    if manual:
+        # nested inside a partial-manual region (the pipeline stage body
+        # is manual over pp): shard_map must take the context abstract
+        # mesh and may only manualize the axes still under GSPMD
+        sm_mesh = jax.sharding.get_abstract_mesh()
+    else:
+        sm_mesh = mesh
+    names = frozenset(a for a in mesh.axis_names if a not in manual)
     out, stats_sh = jax.shard_map(
-        local_fn, mesh=mesh,
+        local_fn, mesh=sm_mesh,
         in_specs=(x_spec, P(), exp_specs),
-        out_specs=(x_spec, stat_spec), check_vma=False,
+        out_specs=(x_spec, stat_spec), axis_names=names, check_vma=False,
     )(x, router_w, expert_params)
     stats = jax.tree.map(lambda s: jnp.mean(s, axis=0), stats_sh)
     out = constrain_activation(out, ("batch", "seq", "embed"))

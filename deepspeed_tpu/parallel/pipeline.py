@@ -94,8 +94,10 @@ def pipelined_layers(layer_fn: Callable, stacked_params: Any, x: jax.Array,
     engine's loss reduction, pipe/engine.py:592).
     x: [B, S, H]; B must divide into num_microbatches (default 2*pp).
     ``window`` caps in-flight microbatches per rematted wave (1F1B-depth
-    memory; default 2*pp). Returns [B, S, H] replicated over pp (and the
-    summed aux when ``with_aux``).
+    memory; default 2*pp). Returns [B, S, H] replicated over pp (and,
+    when ``with_aux``, the aux *averaged over microbatches* — the same
+    mean reduction the reference's pipe engine applies to losses, so the
+    aux-loss scale is invariant to the pipeline's microbatch count).
 
     ``schedule``: "waves" remats each window-sized wave (memory
     O(window+P) for any M, one extra forward per wave); "save_boundaries"
@@ -224,5 +226,5 @@ def pipelined_layers(layer_fn: Callable, stacked_params: Any, x: jax.Array,
     if cast_f32:
         out = out.astype(orig_dtype)
     if with_aux:
-        return out, aux
+        return out, aux / M
     return out

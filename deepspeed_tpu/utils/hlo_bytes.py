@@ -58,20 +58,34 @@ def collective_wire_bytes(hlo_text: str) -> Dict[Tuple[str, str], float]:
         rhs = rhs.strip()
         # HLO line shape: `name = TYPE opcode(operands), attrs`; TYPE is
         # a tensor type or a tuple of them, between '=' and the opcode
-        kind, op_pos = None, -1
+        kind, op_pos, started = None, -1, False
         for c in _COLLECTIVES:
-            m = re.search(rf"(?:^|\s){c}(?:-start)?\(", rhs[:400])
+            m = re.search(rf"(?:^|\s){c}(-start)?\(", rhs[:400])
             if m and (op_pos == -1 or m.start() < op_pos):
-                kind, op_pos = c, m.start()
+                kind, op_pos, started = c, m.start(), bool(m.group(1))
         if kind is None:
             continue
         if re.search(r"-done\(", rhs[:400]):
             continue
         type_decl = rhs[:op_pos]
-        for dtype, dims in _TENSOR_RE.findall(type_decl):
-            if dtype in _DTYPE_BITS:
-                key = (kind, dtype)
-                out[key] = out.get(key, 0.0) + _tensor_bytes(dtype, dims)
+        tensors = [(d, dims) for d, dims in _TENSOR_RE.findall(type_decl)
+                   if d in _DTYPE_BITS]
+        if started:
+            # async `-start` declares a tuple (operands..., results...,
+            # u32 context...); summing all entries would double-count the
+            # payload ~2x vs the sync form. Context tensors are scalar
+            # u32[] — drop those (a genuinely scalar u32 *payload*, e.g.
+            # a digest psum, is miscounted by 4 bytes; acceptable), then
+            # keep the result half (operands and results pair up, so the
+            # last half of the remaining entries — handles coalesced
+            # variadic forms with N>1 operand/result pairs; a bare
+            # non-tuple result, length 1, is kept whole).
+            non_ctx = [(d, dims) for d, dims in tensors
+                       if not (d == "u32" and not dims)]
+            tensors = non_ctx[len(non_ctx) // 2:]
+        for dtype, dims in tensors:
+            key = (kind, dtype)
+            out[key] = out.get(key, 0.0) + _tensor_bytes(dtype, dims)
     return out
 
 

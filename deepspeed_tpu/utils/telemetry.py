@@ -11,7 +11,13 @@ tests and users query :func:`get`/:func:`snapshot`.
 
 Counters are plain Python ints incremented at *trace/dispatch* time (all
 fallback decisions in this codebase are static — mesh shapes, dtypes,
-geometry — so they happen outside jit-compiled code).
+geometry — so they happen outside jit-compiled code). Consequence: a
+count() reached from inside a jit-traced function fires once per
+*compilation* (distinct compiled configuration), not once per executed
+step — during steady-state training the counter stays flat because jit
+replays the cached executable. Read counters as "how many distinct
+downgraded configs were built", and don't assert exact values in tests
+that may retrace.
 """
 
 from __future__ import annotations
@@ -32,7 +38,9 @@ def count(name: str, reason: str = "") -> None:
 
     Logs a warning the first time each (name, reason) pair fires so the
     downgrade is visible exactly once per process, then keeps counting
-    silently (queryable via :func:`get`).
+    silently (queryable via :func:`get`). When called during jit
+    tracing, "occurrence" means one per compiled configuration, not one
+    per step (see module docstring).
     """
     with _LOCK:
         _COUNTERS[name] = _COUNTERS.get(name, 0) + 1
