@@ -108,8 +108,13 @@ def get_model(name: str, **overrides) -> TransformerLM:
     if name not in CONFIGS:
         raise ValueError(f"unknown model '{name}'; known: {sorted(CONFIGS)}")
     cfg = CONFIGS[name]
-    if overrides:
-        cfg = dataclasses.replace(cfg, **overrides)
+    # env-derived fields resolve at __post_init__; presets were built at
+    # import, so re-resolve here (set to None → replace re-runs
+    # __post_init__) or a later DSTPU_PREFETCH/DSTPU_SERIALIZE_FETCH
+    # flip would be silently ignored for zoo models
+    env_fields = {f: None for f in ("prefetch_stream", "serialize_fetch")
+                  if f not in overrides}
+    cfg = dataclasses.replace(cfg, **env_fields, **overrides)
     from deepspeed_tpu.models.moe_transformer import (
         MoETransformerConfig, MoETransformerLM)
 

@@ -136,6 +136,18 @@ def _grouped_unsupported_reason(cfg: GateConfig) -> Optional[str]:
                         and mesh.shape.get(a, 1) > 1)
     if pre_manual:
         return f"axes {pre_manual} already manual in the enclosing region"
+    # under qgZ's per-group gradient vmap the token axes are mapped, not
+    # mesh-sharded — a shard_map can't map a vmapped dim, so the einsum
+    # dispatch (plain GSPMD ops, vmappable) carries MoE there. This is an
+    # engine-internal trace mode, not a user mesh limit: soft (see
+    # moe_ffn — even an explicit impl="grouped" degrades here instead of
+    # raising, since the same config trains fine outside the qgZ vmap)
+    vmapped = sorted(a for a in ("dp", "fsdp", "ep", "sp")
+                     if a in getattr(_sharding, "_VMAPPED_AXES", frozenset())
+                     and mesh.shape.get(a, 1) > 1)
+    if vmapped:
+        return (f"token axes {vmapped} are vmapped (qgZ per-group grads): "
+                "grouped dispatch uses the einsum path [soft]")
     return None
 
 
@@ -164,9 +176,13 @@ def moe_ffn(x: jax.Array, router_w: jax.Array, expert_params: Dict[str, jax.Arra
         if reason is None:
             return moe_ffn_dropless(x, router_w, expert_params, cfg,
                                     activation=activation, train=train)
-        if impl == "grouped":
+        if impl == "grouped" and "[soft]" not in reason:
             # an explicit request must not silently change numerics (the
-            # einsum path drops tokens differently); only "auto" degrades
+            # einsum path drops tokens differently); only "auto" degrades.
+            # Exception: [soft] reasons are engine-internal trace modes
+            # (the qgZ per-group vmap) — raising would make a valid user
+            # config crash only when qgZ arms, so those degrade with
+            # telemetry for explicit "grouped" too.
             raise ValueError(
                 f"moe_ffn: impl='grouped' is unsupported on this mesh: "
                 f"{reason} (use impl='auto' to allow the einsum fallback)")

@@ -247,21 +247,17 @@ class Engine:
         # armed per-engine via the sharding module switch.
         # pp composes since round 4: the pipeline region is manual over
         # pp only, so the int8 fetch constraints stay live in stage
-        # bodies (parallel/pipeline.py manual_axes). Known exception:
-        # pp×fsdp×tp together trips an XLA SPMD-partitioner grouping
-        # CHECK (spmd_partitioner_util.cc:495) on the fetch's constraint
-        # pair — that one combination falls back to full-width gathers.
-        _pp_fsdp_tp = (self.mesh.shape.get("pp", 1) > 1
-                       and self.mesh.shape.get("fsdp", 1) > 1
-                       and self.mesh.shape.get("tp", 1) > 1)
+        # bodies (parallel/pipeline.py manual_axes). pp×fsdp×tp composes
+        # since round 5: the partitioner CHECK that used to kill that
+        # mesh class was the vocab-parallel lookup's gather (see
+        # sharding.py vocab_parallel_lookup), not the qwZ fetch pair.
         self._qwz_stage3 = (zq.stage == 3 and zq.zero_quantized_weights
-                            and not config.moe.enabled and not _pp_fsdp_tp)
+                            and not config.moe.enabled)
         if (zq.stage == 3 and zq.zero_quantized_weights
                 and not self._qwz_stage3):
             from deepspeed_tpu.utils import telemetry
 
-            reason = ("pp*fsdp*tp XLA partitioner limitation"
-                      if _pp_fsdp_tp else "moe")
+            reason = "moe"
             telemetry.count("zeropp.qwz_disabled", reason)
             logger.warning(
                 f"ZeRO++ qwZ stage-3 is inert for this config ({reason}) "
@@ -279,18 +275,22 @@ class Engine:
         # the host grad copy — grad_step runs the same construction).
         # Stage 2 with fsdp>1 routes here too, retiring the legacy
         # manual-dp step's fsdp rejection (runtime/zeropp.py:74).
-        # Remaining exclusions: MoE/ep (expert grads are ep-sharded — the
-        # group axis would collide with the expert dim) and pp.
+        # MoE/ep composes since round 5: the ep token-group axis reduces
+        # expert grads onto the expert-stacked dim with int8 wire
+        # (expert-dim-aware grouping, runtime/qgz.py level 2); the
+        # grouped MoE dispatch falls back to the einsum path under the
+        # per-group vmap (parallel/moe.py — a shard_map can't map a
+        # vmapped token axis). Remaining exclusion: pp.
         self._qgz_stage3 = (
             zq.stage >= 2 and zq.zero_quantized_gradients
-            and not config.moe.enabled
             and self.mesh.shape.get("pp", 1) <= 1
-            and self.mesh.shape.get("ep", 1) <= 1
             and self.mesh.shape.get("fsdp", 1) > 1)
         if self._qgz_stage3:
             log_dist(
                 "ZeRO++ qgZ: stage-3 quantized gradient reduction enabled "
                 f"(int8 over fsdp={self.mesh.shape['fsdp']}"
+                + (f", int8 expert-grads over ep={self.mesh.shape['ep']}"
+                   if self.mesh.shape.get("ep", 1) > 1 else "")
                 + (f", int4 over dp={self.mesh.shape['dp']}"
                    if self.mesh.shape.get("dp", 1) > 1 else "") + ")",
                 ranks=[0])
@@ -300,9 +300,9 @@ class Engine:
             telemetry.count("zeropp.qgz_disabled",
                             "config outside qgZ support matrix")
             logger.warning(
-                "ZeRO++ qgZ at stage 3 requires a dense model (no MoE), "
-                "no optimizer offload, no pp/sp/ep axes, and fsdp > 1 — "
-                "this config fails that, so gradients reduce at full width")
+                "ZeRO++ qgZ at stage 3 requires no optimizer offload, "
+                "no pp axis, and fsdp > 1 — this config fails that, so "
+                "gradients reduce at full width")
         if (zq.zero_quantized_weights or zq.zero_quantized_gradients) \
                 and not self._zeropp and not self._qwz_stage3 \
                 and not self._qgz_stage3:

@@ -93,20 +93,41 @@ def _blocked_spec(entries, block_axis: int):
 
 
 def _reduce_leaf(g, out_sharding: NamedSharding, mesh, dp: int, fsdp: int,
-                 bits1: int, bits2: Optional[int]):
-    """g: [G, *shape] fp32 per-group grads, G = dp*fsdp (dp-major).
-    Returns the reduced grad [*shape] constrained to ``out_sharding``."""
-    G = dp * fsdp
+                 ep: int, bits1: int, bits2: Optional[int]):
+    """g: [G, *shape] fp32 per-group grads, G = dp*fsdp*ep
+    (BATCH_AXES-major: dp, fsdp, ep). Returns the reduced grad [*shape]
+    constrained to ``out_sharding``.
+
+    Levels, in order (each moves one token-group axis onto the payload
+    dim that axis shards in ``out_sharding`` — GSPMD lowers the sharding
+    transpose to an all-to-all, so the wire is the quantized payload):
+
+      1. fsdp @ bits1 (int8) → the fsdp-partitioned dim.
+      2. ep   @ bits1 (int8) → the expert-stacked dim, when the leaf has
+         one (expert-dim-aware grouping: each ep shard receives exactly
+         its own experts' gradient slices — the expert-grad counterpart
+         of the reference's all_to_all_quant_reduce,
+         coalesced_collectives.py:31). Dense leaves on an ep mesh have
+         no ep dim; their ep group folds into the replica level below.
+      3. remaining replica axes (dp, and ep for dense leaves) @ bits2
+         (int4 by default — the reference's inter-node precision).
+    """
+    G = dp * fsdp * ep
     shape = g.shape[1:]
-    out_entries = list(out_sharding.spec) + [None] * (len(shape)
+    nd = len(shape)
+    out_entries = list(out_sharding.spec) + [None] * (nd
                                                       - len(out_sharding.spec))
 
     # the dim the engine partitions grads over (fsdp from FSDP_RULES)
     part_dim = next((i for i, e in enumerate(out_entries)
                      if "fsdp" in _axes_of(e)), None)
+    # the expert-stacked dim (EP_RULES) — target of the ep group level
+    exp_dim = next((i for i, e in enumerate(out_entries)
+                    if "ep" in _axes_of(e)), None)
 
-    # non-fsdp residual sharding of the grad dims (tp/pp on other dims)
-    pre_entries = [_without(e, "fsdp") for e in out_entries]
+    # residual sharding of the payload dims while group levels run
+    # (fsdp/ep re-land on their dims level by level; tp/pp stay put)
+    payload = [_without(_without(e, "fsdp"), "ep") for e in out_entries]
 
     # block along the last dim; blocks must tile within every sharding
     # layout the payload passes through
@@ -117,46 +138,62 @@ def _reduce_leaf(g, out_sharding: NamedSharding, mesh, dp: int, fsdp: int,
     block = math.gcd(last // div, QGZ_BLOCK) if last % max(div, 1) == 0 else 1
     exact = part_dim is None or block <= 1
 
-    g = g.reshape(dp, fsdp, *shape)
-    pre = P("dp", "fsdp", *pre_entries)
-    g = lax.with_sharding_constraint(g, NamedSharding(mesh, pre))
+    g = g.reshape(dp, fsdp, ep, *shape)
+    g = lax.with_sharding_constraint(
+        g, NamedSharding(mesh, P("dp", "fsdp", "ep", *payload)))
 
     if exact:
         # nothing to win (unpartitioned or unblockable leaf — 1-D norm
         # scales and friends): exact f32 reduction, tiny bytes
-        red = jnp.sum(g, axis=(0, 1)) / G
+        red = jnp.sum(g, axis=(0, 1, 2)) / G
         return lax.with_sharding_constraint(red, out_sharding)
 
-    block_axis = 2 + len(shape) - 1  # last dim, after the (dp, fsdp) dims
+    groups = ["dp", "fsdp", "ep"]  # leading group dims of g
+
+    def move_level(g, names, target_dim, bits):
+        """Quantize, a2a the named group dims onto ``target_dim``, and
+        sum them out. g: [*groups, *shape]; returns [*groups', *shape]."""
+        ng = len(groups)
+        kept_sizes = tuple(g.shape[i] for i, a in enumerate(groups)
+                           if a not in names)
+        block_axis = ng + nd - 1
+        q, s, _ = _quant(g, block_axis, block, bits)
+        from_spec = _blocked_spec(list(groups) + payload, block_axis)
+        to_groups = [None if a in names else a for a in groups]
+        to_payload = list(payload)
+        ent = payload[target_dim]
+        for a in names:
+            ent = _with(ent, a)
+        to_payload[target_dim] = ent
+        to_spec = _blocked_spec(to_groups + to_payload, block_axis)
+        q = lax.with_sharding_constraint(q, NamedSharding(mesh, P(*from_spec)))
+        s = lax.with_sharding_constraint(s, NamedSharding(mesh, P(*from_spec)))
+        q = lax.with_sharding_constraint(q, NamedSharding(mesh, P(*to_spec)))
+        s = lax.with_sharding_constraint(s, NamedSharding(mesh, P(*to_spec)))
+        idxs = tuple(i for i, a in enumerate(groups) if a in names)
+        out = (q.astype(jnp.float32) * s).sum(axis=idxs)
+        payload[target_dim] = to_payload[target_dim]
+        for a in names:
+            groups.remove(a)
+        return out.reshape(kept_sizes + shape)
 
     # ---- level 1: int8 all-to-all over fsdp ---------------------------
-    q, s, _ = _quant(g, block_axis, block, bits1)
-    from_spec = _blocked_spec(["dp", "fsdp"] + pre_entries, block_axis)
-    to_entries = ["dp", None] + pre_entries
-    to_entries[2 + part_dim] = _with(pre_entries[part_dim], "fsdp")
-    to_spec = _blocked_spec(to_entries, block_axis)
-    q = lax.with_sharding_constraint(q, NamedSharding(mesh, P(*from_spec)))
-    s = lax.with_sharding_constraint(s, NamedSharding(mesh, P(*from_spec)))
-    q = lax.with_sharding_constraint(q, NamedSharding(mesh, P(*to_spec)))
-    s = lax.with_sharding_constraint(s, NamedSharding(mesh, P(*to_spec)))
-    g1 = (q.astype(jnp.float32) * s).sum(axis=1)  # [dp, *blocked slice]
-
-    if dp > 1 and bits2:
-        # ---- level 2: int4 (default) all-to-all over dp ---------------
-        q2, s2, _ = _quant(
-            g1.reshape((dp,) + shape), block_axis - 1, block, bits2)
-        ent1 = ["dp"] + [to_entries[i] for i in range(2, 2 + len(shape))]
-        ent2 = [None] + list(ent1[1:])
-        ent2[1 + part_dim] = _with(ent1[1 + part_dim], "dp")
-        sp1 = _blocked_spec(ent1, block_axis - 1)
-        sp2 = _blocked_spec(ent2, block_axis - 1)
-        q2 = lax.with_sharding_constraint(q2, NamedSharding(mesh, P(*sp1)))
-        s2 = lax.with_sharding_constraint(s2, NamedSharding(mesh, P(*sp1)))
-        q2 = lax.with_sharding_constraint(q2, NamedSharding(mesh, P(*sp2)))
-        s2 = lax.with_sharding_constraint(s2, NamedSharding(mesh, P(*sp2)))
-        red = (q2.astype(jnp.float32) * s2).sum(axis=0).reshape(shape) / G
+    if fsdp > 1:
+        g = move_level(g, ["fsdp"], part_dim, bits1)
     else:
-        red = g1.sum(axis=0).reshape(shape) / G
+        g = g.sum(axis=groups.index("fsdp")).reshape(
+            (dp, ep) + shape)
+        groups.remove("fsdp")
+
+    # ---- level 2: int8 all-to-all over ep → the expert dim ------------
+    if ep > 1 and exp_dim is not None:
+        g = move_level(g, ["ep"], exp_dim, bits1)
+
+    # ---- level 3: remaining replica axes at bits2 ---------------------
+    rem = [a for a in list(groups) if mesh.shape.get(a, 1) > 1]
+    if rem and bits2:
+        g = move_level(g, rem, part_dim, bits2)
+    red = g.sum(axis=tuple(range(len(groups)))).reshape(shape) / G
 
     return lax.with_sharding_constraint(red, out_sharding)
 
@@ -168,6 +205,7 @@ def qgz_reduce_tree(g_groups, grad_shardings, mesh, bits1: int = 8,
     (the engine's grad plan)."""
     dp = mesh.shape.get("dp", 1)
     fsdp = mesh.shape.get("fsdp", 1)
+    ep = mesh.shape.get("ep", 1)
     return jax.tree.map(
-        lambda g, sh: _reduce_leaf(g, sh, mesh, dp, fsdp, bits1, bits2),
+        lambda g, sh: _reduce_leaf(g, sh, mesh, dp, fsdp, ep, bits1, bits2),
         g_groups, grad_shardings)

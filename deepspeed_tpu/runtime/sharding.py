@@ -581,6 +581,17 @@ def vocab_parallel_lookup(table, ids, axis: str = "tp"):
         table = table.astype(jnp.float32)
 
     def body(tbl, tok):
+        # XLA SPMD-partitioner CHECK workaround (spmd_partitioner_util.cc
+        # ExpandDeviceGroupsWithIota): a gather whose operand stays
+        # auto-sharded over fsdp inside this partial-manual (tp) region
+        # crashes the partitioner on 3-axis meshes (pp×fsdp×tp, the 70B
+        # class). Fetch the embed dim up front — at stage 3 this is
+        # exactly the ZeRO-3 all-gather of the local vocab shard the
+        # lookup needs anyway; when the table isn't fsdp-sharded the
+        # constraint is a no-op.
+        if mesh.shape.get("fsdp", 1) > 1:
+            tbl = jax.lax.with_sharding_constraint(
+                tbl, NamedSharding(mesh, PartitionSpec(*([None] * tbl.ndim))))
         start = lax.axis_index(axis) * shard
         local = tok - start
         valid = (local >= 0) & (local < shard)

@@ -1,0 +1,146 @@
+"""Bring-your-own-model (VERDICT r4 #8): onboard a real external model.
+
+The model here is transformers' ``FlaxGPT2LMHeadModel`` — an
+architecture implementation that lives entirely outside this repo — and
+the test onboards it the way a user would, through the documented
+protocol (``init``/``loss``/``logical_axes``, runtime/engine.py:69),
+with the logical axes *inferred* by AutoTP's name-policy classifier
+rather than hand-annotated. Reference bar: the wrapper-framework story —
+``deepspeed.initialize`` + AutoTP work on arbitrary user nn.Modules
+(module_inject/auto_tp.py:194 tp_parser scans any module graph).
+
+Covers: ZeRO-2 training on a dp×fsdp×tp mesh (loss decreases),
+AutoTP-sharded serving (``tp_model_init`` on the trained tree, greedy
+decode parity vs a replicated-params decode), and the inferred
+classification itself (column/row/embed counts are sane for GPT-2).
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import deepspeed_tpu as dstpu
+from deepspeed_tpu.module_inject.auto_tp import SEP, AutoTP
+
+transformers = pytest.importorskip("transformers")
+
+
+def _tiny_foreign_gpt2():
+    cfg = transformers.GPT2Config(
+        vocab_size=128, n_positions=64, n_embd=64, n_layer=2, n_head=4,
+        resid_pdrop=0.0, embd_pdrop=0.0, attn_pdrop=0.0)
+    return transformers.FlaxGPT2LMHeadModel(cfg, seed=0)
+
+
+class ForeignLM:
+    """The ~40 lines a user writes to onboard an external Flax model:
+    the engine needs init/loss/logical_axes; AutoTP supplies the axes
+    from parameter names alone (no per-architecture code)."""
+
+    #: AutoTP kind → logical axes for the trailing two dims ([in, out]
+    #: jax matmul layout). The engine's rule tables map mlp→tp,
+    #: vocab→tp, embed→fsdp (runtime/sharding.py TP_RULES/FSDP_RULES).
+    _KIND_AXES = {
+        "column": ("embed", "mlp"),
+        "row": ("mlp", "embed"),
+        "embed": ("vocab", "embed"),
+    }
+
+    def __init__(self, flax_model):
+        self.m = flax_model
+        self._atp = AutoTP()
+
+    def init(self, rng):
+        return jax.tree.map(lambda x: x, self.m.params)  # plain copy
+
+    def loss(self, params, batch):
+        ids = jnp.asarray(batch["input_ids"])
+        logits = self.m(input_ids=ids, params=params, train=False).logits
+        lp = jax.nn.log_softmax(logits[:, :-1].astype(jnp.float32), axis=-1)
+        tgt = ids[:, 1:]
+        nll = -jnp.take_along_axis(lp, tgt[..., None], axis=-1)[..., 0]
+        return nll.mean(), {"ntokens": jnp.asarray(nll.size, jnp.float32)}
+
+    def logical_axes(self):
+        def walk(tree, prefix=""):
+            if isinstance(tree, dict):
+                return {k: walk(v, f"{prefix}{SEP}{k}" if prefix else k)
+                        for k, v in tree.items()}
+            shape = tuple(tree.shape)
+            kind = self._atp.classify(prefix, shape)
+            if kind in self._KIND_AXES and len(shape) >= 2:
+                lead = (None,) * (len(shape) - 2)
+                return lead + self._KIND_AXES[kind]
+            # replicated weights/biases: first dim rides fsdp when it
+            # divides (the engine's unannotated-tree fallback)
+            return ("embed",) + (None,) * (len(shape) - 1) if shape else ()
+
+        return walk(self.m.params)
+
+
+def test_auto_tp_classifies_foreign_tree(devices):
+    model = _tiny_foreign_gpt2()
+    counts = AutoTP().summary(model.params)
+    # GPT-2: per layer c_attn+c_fc column, c_proj x2 row; wte/wpe embed
+    assert counts["column"] == 4 and counts["row"] == 4, counts
+    assert counts["embed"] == 2, counts
+
+
+def test_foreign_model_trains_and_serves(devices):
+    from deepspeed_tpu.parallel import topology as topo
+
+    foreign = ForeignLM(_tiny_foreign_gpt2())
+    cfg = {
+        "train_micro_batch_size_per_chip": 2,
+        "optimizer": {"type": "adamw", "params": {"lr": 3e-3}},
+        "zero_optimization": {"stage": 2},
+        "steps_per_print": 1000,
+    }
+    engine, *_ = dstpu.initialize(
+        model=foreign, config=cfg,
+        topology={"dp": 2, "fsdp": 2, "tp": 2})
+
+    rng = np.random.default_rng(0)
+    gb = engine.micro_batch_size * engine.dp_world_size
+    fixed = [{"input_ids": rng.integers(0, 128, (gb, 24)).astype(np.int32)}
+             for _ in range(2)]
+
+    def it():
+        i = 0
+        while True:
+            yield fixed[i % 2]
+            i += 1
+
+    stream = it()
+    losses = [float(engine.train_batch(stream)) for _ in range(10)]
+    assert losses[-1] < losses[0] - 0.3, losses
+
+    # -- serve: AutoTP-inferred tp sharding of the trained tree ---------
+    trained = jax.device_get(engine.params)  # host copy, original layout
+    mesh = topo.build_mesh({"dp": 4, "tp": 2})
+    topo.set_global_mesh(mesh)
+    sharded, specs = dstpu.tp_model_init(trained, mesh=mesh)
+    # the inference layout must actually be tensor-parallel: some kernel
+    # carries "tp" in its spec
+    flat_specs = jax.tree.leaves(
+        jax.tree.map(lambda s: "tp" in str(s), specs,
+                     is_leaf=lambda x: not isinstance(x, (dict, list, tuple))))
+    assert any(flat_specs)
+
+    prompt = jnp.asarray(fixed[0]["input_ids"][:1, :4])
+
+    def greedy(params, steps=6):
+        toks = prompt
+        for _ in range(steps):
+            logits = foreign.m(input_ids=toks, params=params,
+                               train=False).logits
+            nxt = jnp.argmax(logits[:, -1], axis=-1)[:, None]
+            toks = jnp.concatenate([toks, nxt], axis=1)
+        return np.asarray(toks[0, 4:])
+
+    with mesh:
+        served = greedy(sharded)
+    replicated = greedy(trained)
+    np.testing.assert_array_equal(served, replicated)

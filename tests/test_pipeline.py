@@ -286,6 +286,45 @@ def test_pp_qwz_int8_gather_and_permute_in_hlo(devices):
     assert np.isfinite(losses).all()
 
 
+def test_pp_fsdp_tp_qwz_int8_gather_in_hlo(devices):
+    """VERDICT r4 #6: qwZ on the pp×fsdp×tp (70B-class 3D) mesh. Through
+    round 4 this mesh class tripped an XLA SPMD-partitioner CHECK
+    (spmd_partitioner_util.cc ExpandDeviceGroupsWithIota) and qwZ gated
+    itself off with telemetry. The CHECK's real trigger was the
+    vocab-parallel lookup's gather keeping an auto-fsdp operand inside
+    the tp-manual region (fixed in sharding.py vocab_parallel_lookup);
+    qwZ must now arm, emit int8 parameter all-gathers, keep the
+    telemetry counter at zero, and train."""
+    from deepspeed_tpu.utils import telemetry
+
+    telemetry.reset()
+    cfg = {
+        "train_micro_batch_size_per_chip": 2,
+        "optimizer": {"type": "adamw", "params": {"lr": 1e-2}},
+        "zero_optimization": {"stage": 3, "zero_quantized_weights": True},
+        "steps_per_print": 1000,
+    }
+    engine, *_ = dstpu.initialize(
+        model=TransformerLM(TINY4), config=cfg,
+        topology={"pp": 2, "fsdp": 2, "tp": 2})
+    assert engine._qwz_stage3
+    assert telemetry.get("zeropp.qwz_disabled") == 0
+    it = data_iter(engine.micro_batch_size * engine.dp_world_size)
+    batches = engine._next_microbatches(
+        it, engine.gradient_accumulation_steps)
+    hlo = engine._jit_train_step.lower(
+        engine.params, engine.opt_state, engine.loss_scale_state,
+        engine.step_count, batches).compile().as_text()
+    lines = hlo.splitlines()
+    assert any("collective-permute" in l for l in lines), \
+        "no stage-boundary collective-permute in pp HLO"
+    s8_gather = [l for l in lines if "all-gather" in l and "s8[" in l]
+    assert s8_gather, "no int8 parameter all-gather on pp*fsdp*tp"
+    losses = [float(engine.train_batch(it)) for _ in range(4)]
+    assert np.isfinite(losses).all()
+    telemetry.reset()
+
+
 def test_pp_dryrun_b_mesh_collectives(devices):
     """The driver's config-B mesh shape (pp×ep×tp, MoE): stage-boundary
     collective-permutes present in the compiled step (HLO-level evidence
