@@ -57,6 +57,19 @@ def detect_peak_tflops(device) -> float:
 
 
 def main():
+    if os.environ.get("BENCH_MODE") == "serve":
+        # serving throughput instead of the training headline: v2 ragged
+        # continuous batching + multi-step decode vs the naive v1 dense
+        # path (tools/serve_bench.py; SERVE_* env knobs)
+        import sys
+
+        sys.path.insert(0, os.path.join(os.path.dirname(
+            os.path.abspath(__file__)), "tools"))
+        import serve_bench
+
+        print(json.dumps(serve_bench.run()))
+        return
+
     import jax
     import numpy as np
 
@@ -122,6 +135,9 @@ def main():
         overrides["fpdt_host_kv"] = True
         overrides["attn_chunks"] = int(os.environ.get("BENCH_ATTN_CHUNKS",
                                                       "8"))
+        if int(os.environ.get("BENCH_FPDT_RESIDUAL", "0")):
+            # residual stream hosted too: no full-S device buffer at all
+            overrides["fpdt_host_residual"] = True
     if not on_tpu:  # CPU smoke: shrink the model
         overrides.update(num_layers=2, hidden_size=256, num_heads=8,
                          vocab_size=2048)

@@ -39,7 +39,8 @@ class InferenceEngineV2:
                  kv_blocks: int = 256, kv_block_size: int = 16,
                  max_tokens_per_step: int = 128, max_seqs_per_step: int = 16,
                  max_blocks_per_seq: int = 32, dtype=jnp.bfloat16, seed: int = 0,
-                 quantize_weights: Optional[str] = None):
+                 quantize_weights: Optional[str] = None,
+                 decode_steps: int = 8):
         from deepspeed_tpu.inference.engine import InferenceEngine
 
         # reuse v1's TP placement logic for params/mesh
@@ -99,6 +100,22 @@ class InferenceEngineV2:
         self._prefill_fn = jax.jit(partial(
             model_runner.ragged_prefill_forward, self.cfg,
             mesh=kernel_mesh))
+        # device-side token pick: the step fetches only sampled ids (or
+        # the consumed rows when temperature > 0), never the full [T, V]
+        # logits buffer (see step())
+        self._pick_greedy = jax.jit(lambda lg, idx: jnp.argmax(
+            lg.reshape(-1, lg.shape[-1])[idx].astype(jnp.float32),
+            axis=-1).astype(jnp.int32))
+        self._take_rows = jax.jit(
+            lambda lg, idx: lg.reshape(-1, lg.shape[-1])[idx])
+        # multi-step greedy decode: one device program per `decode_steps`
+        # tokens when every live sequence is in steady decode
+        # (model_runner.ragged_multi_decode; decode_steps=1 restores
+        # strict per-token SplitFuse admission)
+        self.decode_steps = max(1, int(decode_steps))
+        self._multi_decode_fn = jax.jit(partial(
+            model_runner.ragged_multi_decode, self.cfg, mesh=kernel_mesh),
+            static_argnames=("steps",))
         log_dist(
             f"InferenceEngineV2: kv_blocks={kv_blocks}x{kv_block_size} "
             f"budget={max_tokens_per_step}tok/{max_seqs_per_step}seq",
@@ -193,27 +210,47 @@ class InferenceEngineV2:
                     jnp.asarray(batch.num_tokens, jnp.int32))
         self.kv_cache.data = new_kv
 
-        logits_np = np.asarray(logits)  # [T, V] fp32 (or [S, V] decode)
-        emitted: Dict[int, int] = {}
+        # Sample ON DEVICE and fetch only token ids (greedy) or just the
+        # consumed rows (stochastic). Materializing the full [T, V]
+        # logits host-side (131 MB/step at a 256-token budget x 128k
+        # vocab) dominated step latency ~20:1 on a tunnel-attached host;
+        # the ids are 4 bytes/sequence.
+        stride = logits.shape[1] if logits.ndim == 3 else 1
+        flat_idx = np.zeros(self.max_seqs, np.int32)
+        consumers = []
         for slot, (seq, new_tokens, start_pos) in enumerate(scheduled):
             n = len(new_tokens)
             seq.seen_tokens = start_pos + n
-            completed_prompt = seq.seen_tokens >= len(seq.input_tokens)
-            if not completed_prompt:
+            if seq.seen_tokens < len(seq.input_tokens):
                 continue  # mid-prefill: no logits consumed
             if seg_plan is not None:
-                row = logits_np[slot, n - 1]
+                flat_idx[slot] = slot * stride + (n - 1)
             elif decode_only:
-                row = logits_np[slot]
+                flat_idx[slot] = slot
             else:
-                row = logits_np[batch.last_token_index[slot]]
-            tok = _sample_np(row, temperature, seed + slot + seq.seen_tokens)
-            seq.generated.append(int(tok))
-            emitted[seq.uid] = int(tok)
-            if eos_token_id is not None and tok == eos_token_id:
-                seq.done = True
-            if len(seq.generated) >= seq.max_new_tokens:
-                seq.done = True
+                flat_idx[slot] = batch.last_token_index[slot]
+            consumers.append((slot, seq))
+
+        emitted: Dict[int, int] = {}
+        if consumers:
+            idx_dev = jnp.asarray(flat_idx)
+            with self.mesh:
+                if temperature == 0.0:
+                    toks_np = np.asarray(self._pick_greedy(logits, idx_dev))
+                else:
+                    rows_np = np.asarray(self._take_rows(logits, idx_dev))
+            for slot, seq in consumers:
+                if temperature == 0.0:
+                    tok = int(toks_np[slot])
+                else:
+                    tok = int(_sample_np(rows_np[slot], temperature,
+                                         seed + slot + seq.seen_tokens))
+                seq.generated.append(tok)
+                emitted[seq.uid] = tok
+                if eos_token_id is not None and tok == eos_token_id:
+                    seq.done = True
+                if len(seq.generated) >= seq.max_new_tokens:
+                    seq.done = True
         self._release_finished()
         return emitted
 
@@ -257,15 +294,96 @@ class InferenceEngineV2:
         for uid in [s.uid for s in self.state.seqs.values() if s.done]:
             self.state.release(uid)
 
+    def _try_decode_burst(self, eos_token_id: Optional[int]
+                          ) -> Optional[Dict[int, List[int]]]:
+        """Run ``decode_steps`` greedy tokens in one device round trip.
+
+        Applies only in steady state: every live sequence mid-decode, no
+        prefill pending, and KV capacity for the whole burst (the block
+        tables are frozen for its duration). Returns None when a single
+        SplitFuse step should run instead."""
+        live = [s for s in self.state.seqs.values() if not s.done]
+        if (self.decode_steps <= 1 or not live or len(live) > self.max_seqs
+                or any((not s.in_decode) or s.pending_prefill for s in live)):
+            return None
+        # clamp the burst to the shortest remaining budget: probing
+        # capacity K tokens past a sequence that only needs 1 more would
+        # trip ensure_capacity's per-seq-cap kill and truncate output
+        # that per-token stepping would have finished
+        K = min(self.decode_steps,
+                max(1, min(s.max_new_tokens - len(s.generated)
+                           for s in live)))
+        if K <= 1:
+            return None
+        # side-effect-free capacity probe first: per-seq cap, then total
+        # pool demand (a partial speculative grab would strand blocks
+        # and push the fallback step into victim preemption)
+        need_total = 0
+        for s in live:
+            blocks = self.kv_cache.blocks_needed(s.seen_tokens + K)
+            if (self.state.max_blocks_per_seq is not None
+                    and blocks > self.state.max_blocks_per_seq):
+                return None  # near the per-seq cap: per-token tail
+            need_total += max(0, blocks - len(s.kv_blocks))
+        if need_total > self.kv_cache.free_blocks:
+            return None
+        for s in live:
+            ok = self.state.ensure_capacity(s, s.seen_tokens + K)
+            assert ok, "capacity probe said yes but allocation failed"
+        S = self.max_seqs
+        d_tok = np.zeros(S, np.int32)
+        d_pos = np.zeros(S, np.int32)
+        ctx = np.zeros(S, np.int32)
+        bt = np.zeros((S, self.max_blocks_per_seq), np.int32)
+        for i, s in enumerate(live):
+            d_tok[i] = (s.generated[-1] if s.generated
+                        else int(s.input_tokens[-1]))
+            d_pos[i] = s.seen_tokens
+            ctx[i] = s.seen_tokens + 1
+            bt[i, :len(s.kv_blocks)] = s.kv_blocks
+        with self.mesh:
+            toks, new_kv = self._multi_decode_fn(
+                self.params, self.kv_cache.data, jnp.asarray(d_tok),
+                jnp.asarray(d_pos), jnp.asarray(bt), jnp.asarray(ctx),
+                steps=K)
+            toks_np = np.asarray(toks)  # [K, S] — one fetch per K tokens
+        self.kv_cache.data = new_kv
+        self.stats["decode_kernel_steps"] += K
+        self.stats["burst_steps"] = self.stats.get("burst_steps", 0) + 1
+        emitted: Dict[int, List[int]] = {}
+        for i, s in enumerate(live):
+            accepted = []
+            for k in range(K):
+                tok = int(toks_np[k, i])
+                accepted.append(tok)
+                if eos_token_id is not None and tok == eos_token_id:
+                    s.done = True
+                    break
+                if len(s.generated) + len(accepted) >= s.max_new_tokens:
+                    s.done = True
+                    break
+            s.generated.extend(accepted)
+            s.seen_tokens += len(accepted)
+            emitted[s.uid] = accepted
+        self._release_finished()
+        return emitted
+
     def generate_all(self, temperature: float = 0.0, seed: int = 0,
                      eos_token_id: Optional[int] = None,
                      max_steps: int = 10_000) -> Dict[int, List[int]]:
         """Drive steps until every admitted sequence finishes; returns
-        {uid: generated tokens}."""
+        {uid: generated tokens}. In steady greedy decode, bursts
+        ``decode_steps`` tokens per device round trip."""
         results: Dict[int, List[int]] = {}
         for _ in range(max_steps):
             if not self.state.seqs:
                 break
+            if temperature == 0.0:
+                burst = self._try_decode_burst(eos_token_id)
+                if burst is not None:
+                    for uid, toks in burst.items():
+                        results.setdefault(uid, []).extend(toks)
+                    continue
             # every step makes progress: emits tokens, advances a prefill,
             # or preempts a starved sequence — so this loop terminates
             emitted = self.step(temperature, seed, eos_token_id)

@@ -135,6 +135,28 @@ class TestInferenceEngineV2:
                               max_new_tokens=4)[0, len(prompt):]
             assert results[uid] == ref.tolist(), f"uid {uid}"
 
+    def test_decode_burst_matches_per_token(self, tiny):
+        """Multi-step decode (one device program per decode_steps tokens,
+        model_runner.ragged_multi_decode) must be token-exact vs strict
+        per-token stepping, including eos landing mid-burst and
+        max_new_tokens overshoot trimming."""
+        prompts = {1: [5, 9, 2, 14, 7], 2: [3, 1, 4], 3: [2] * 11}
+
+        def run(decode_steps, eos=None, n=9):
+            v2 = self._make(tiny, decode_steps=decode_steps)
+            v2.put(list(prompts), [np.asarray(p) for p in prompts.values()],
+                   max_new_tokens=n)
+            return v2.generate_all(eos_token_id=eos)
+
+        base = run(1)
+        burst = run(4)
+        assert base == burst, (base, burst)
+        assert run(4, n=7) == run(1, n=7)  # 7 % 4 != 0: trim inside burst
+        # eos: pick a token the greedy stream actually emits so the burst
+        # must stop a sequence mid-program
+        eos_tok = base[1][2]
+        assert run(4, eos=eos_tok) == run(1, eos=eos_tok)
+
     def test_splitfuse_chunked_prefill(self, tiny):
         """A prompt longer than the token budget is prefilled over several
         steps and still generates correctly."""

@@ -1,0 +1,129 @@
+"""Serving throughput: v2 ragged continuous batching vs v1 dense decode.
+
+VERDICT r4 #9 asked for a serving performance number against the
+reference's FastGen claim (2.3x vs vLLM, blogs/deepspeed-fastgen/
+README.md:28 — the win comes from continuous batching + SplitFuse
+keeping the chip at a constant token budget while the naive engine
+decodes lock-step with the slowest sequence).
+
+This benchmark serves the same workload through both engines on the
+current backend and prints ONE JSON line:
+
+  {"metric": "serve tokens/s (v2 ragged)", "value": ..., "v1_value": ...,
+   "speedup_vs_v1": ...}
+
+Workload: N prompts of mixed length, G new tokens each, greedy. The v2
+engine admits continuously under a token budget; v1 decodes the whole
+batch dense and synchronous (its per-step work scales with max prompt
+length padding + every sequence decoding until the last finishes).
+
+Env knobs: SERVE_MODEL (zoo name, default llama3-8b geometry cut to
+SERVE_LAYERS=3), SERVE_SEQS (default 24), SERVE_PROMPT (default 128),
+SERVE_GEN (default 128), SERVE_BUDGET (v2 max_tokens_per_step, 256).
+
+Driver capture: ``BENCH_MODE=serve python bench.py`` routes here
+(bench.py), so the serving number is recordable by the same harness as
+the training headline.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+
+def run() -> dict:
+    import jax
+    import numpy as np
+
+    from deepspeed_tpu.inference.engine import InferenceEngine
+    from deepspeed_tpu.inference.engine_v2 import InferenceEngineV2
+    from deepspeed_tpu.models.zoo import get_model
+
+    on_tpu = jax.default_backend() == "tpu"
+    model_name = os.environ.get("SERVE_MODEL", "llama3-8b")
+    layers = int(os.environ.get("SERVE_LAYERS", 3))
+    n_seqs = int(os.environ.get("SERVE_SEQS", 24 if on_tpu else 4))
+    prompt_len = int(os.environ.get("SERVE_PROMPT", 128 if on_tpu else 16))
+    gen = int(os.environ.get("SERVE_GEN", 128 if on_tpu else 8))
+    budget = int(os.environ.get("SERVE_BUDGET", 256 if on_tpu else 32))
+    decode_steps = int(os.environ.get("SERVE_DECODE_STEPS", 8))
+    max_seq_len = 1 << (prompt_len + gen + 1).bit_length()
+
+    model = get_model(model_name, num_layers=layers, max_seq_len=max_seq_len,
+                      remat=False)
+    cfg = model.config
+    rng = np.random.default_rng(0)
+    import jax.numpy as jnp
+
+    params = jax.jit(model.init)(jax.random.PRNGKey(0))
+    params = jax.tree.map(lambda x: x.astype(jnp.bfloat16), params)
+
+    # mixed prompt lengths: half full, quarter 3/4, quarter 1/2 — the
+    # ragged engine's reason to exist
+    lens = [prompt_len, prompt_len * 3 // 4, prompt_len // 2,
+            prompt_len] * (n_seqs // 4 + 1)
+    lens = [max(4, l) for l in lens[:n_seqs]]
+    prompts = [rng.integers(0, cfg.vocab_size, (l,)).astype(np.int32)
+               for l in lens]
+
+    # -- v1: dense synchronous decode -----------------------------------
+    v1 = InferenceEngine(model, params=params, max_batch=n_seqs,
+                         max_seq_len=max_seq_len)
+    pad = max(lens)
+    batch = np.zeros((n_seqs, pad), np.int32)
+    for i, p in enumerate(prompts):
+        batch[i, :len(p)] = p  # right-pad; v1 decodes from the padded end
+
+    def v1_run():
+        return v1.generate(batch, max_new_tokens=gen)
+
+    v1_run()  # compile
+    t0 = time.perf_counter()
+    v1_run()
+    t1 = time.perf_counter()
+    v1_toks = n_seqs * gen / (t1 - t0)
+
+    # -- v2: ragged continuous batching ---------------------------------
+    block = 16
+    blocks_per_seq = (max(lens) + gen) // block + 2
+    kv_blocks = blocks_per_seq * n_seqs + 2
+
+    def make_v2():
+        return InferenceEngineV2(
+            model, params=params, kv_blocks=kv_blocks, kv_block_size=block,
+            max_tokens_per_step=budget,
+            max_seqs_per_step=min(n_seqs, budget),
+            max_blocks_per_seq=blocks_per_seq, decode_steps=decode_steps)
+
+    def v2_run(engine):
+        engine.put(list(range(n_seqs)), prompts, max_new_tokens=gen)
+        out = engine.generate_all()
+        total = sum(len(v) for v in out.values())
+        assert total >= n_seqs * (gen - 1), (total, n_seqs * gen)
+        return total
+
+    engine = make_v2()
+    v2_run(engine)  # compile pass; generate_all drains the KV pool
+    t0 = time.perf_counter()
+    total = v2_run(engine)
+    t1 = time.perf_counter()
+    v2_toks = total / (t1 - t0)
+
+    return {
+        "metric": f"{model_name}-geometry({layers}L) serve tokens/s "
+                  f"(v2 ragged, {n_seqs} seqs, prompt~{prompt_len}, "
+                  f"gen {gen}, {'tpu' if on_tpu else 'cpu'})",
+        "value": round(v2_toks, 1),
+        "unit": "tokens/s",
+        "v1_value": round(v1_toks, 1),
+        "speedup_vs_v1": round(v2_toks / max(v1_toks, 1e-9), 3),
+        "kernel_steps": (engine.stats.get("decode_kernel_steps", 0)
+                         + engine.stats.get("prefill_kernel_steps", 0)),
+        "fallback_steps": engine.stats.get("prefill_gather_fallbacks", 0),
+    }
+
+
+if __name__ == "__main__":
+    print(json.dumps(run()))
