@@ -701,6 +701,17 @@ class Engine:
             scale = ls_state.scale if fp16 else jnp.asarray(1.0, jnp.float32)
 
             def total_loss(params):
+                if gas == 1:
+                    # no microbatch loop: a scan-of-one still nests a
+                    # while-loop around the model's own (chunk/tile)
+                    # loops, and on TPU that extra level can push the
+                    # hosted-FPDT backward's DMA loop nests past the
+                    # compiler's int32 bounds check
+                    mb = jax.tree.map(lambda b: b[0], batches)
+                    scaled, (loss, aux) = loss_of(params, mb, scale)
+                    return scaled, (loss[None], jnp.asarray(
+                        aux.get("ntokens", 0.0), jnp.float32)[None])
+
                 def body(carry, mb):
                     scaled, (loss, aux) = loss_of(params, mb, scale)
                     return carry + scaled / gas, (loss, aux.get("ntokens", 0.0))
@@ -761,6 +772,12 @@ class Engine:
             too, coalesced_collectives.py:31)."""
 
             def total_loss(params):
+                if gas == 1:
+                    # see train_step.total_loss: no scan-of-one wrapper
+                    mb = jax.tree.map(lambda b: b[0], batches)
+                    loss, aux = model_loss(params, mb)
+                    return loss * scale, loss[None]
+
                 def body(carry, mb):
                     loss, aux = model_loss(params, mb)
                     return carry + loss * scale / gas, loss

@@ -584,12 +584,14 @@ def vocab_parallel_lookup(table, ids, axis: str = "tp"):
         # XLA SPMD-partitioner CHECK workaround (spmd_partitioner_util.cc
         # ExpandDeviceGroupsWithIota): a gather whose operand stays
         # auto-sharded over fsdp inside this partial-manual (tp) region
-        # crashes the partitioner on 3-axis meshes (pp×fsdp×tp, the 70B
-        # class). Fetch the embed dim up front — at stage 3 this is
+        # crashes the partitioner on pp×fsdp×tp meshes (the 70B class).
+        # Fetch the embed dim up front there — at stage 3 this is
         # exactly the ZeRO-3 all-gather of the local vocab shard the
-        # lookup needs anyway; when the table isn't fsdp-sharded the
-        # constraint is a no-op.
-        if mesh.shape.get("fsdp", 1) > 1:
+        # lookup needs anyway. Scoped to meshes WITH a pp axis: on
+        # pp-free fsdp×tp meshes the gather partitions fine, and the
+        # unconditional fetch would add an fsdp all-gather of the table
+        # shard per forward where none is needed.
+        if mesh.shape.get("fsdp", 1) > 1 and mesh.shape.get("pp", 1) > 1:
             tbl = jax.lax.with_sharding_constraint(
                 tbl, NamedSharding(mesh, PartitionSpec(*([None] * tbl.ndim))))
         start = lax.axis_index(axis) * shard

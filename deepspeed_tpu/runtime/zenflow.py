@@ -208,11 +208,45 @@ class ZenFlowOptimizer:
 
     # -- jitted pieces (explicit jit: eager ops on multi-host global
     # arrays are not generally allowed, and every process runs these in
-    # the same order — plain SPMD) --------------------------------------
+    # the same order — plain SPMD). Per-STEP device work batches the
+    # whole leaf tree into ONE jit call: per-leaf dispatch loops issue
+    # dozens of tiny programs per step, and in multi-process runs every
+    # dispatch is a cross-process rendezvous — on a loaded host the gap
+    # between two of them can exceed the transport's pair timeout (the
+    # gloo "Application timeout caused pair closure" failure the 2-process
+    # parity test kept hitting). One program per step also dispatches
+    # ~15x less work host-side — the same reason the reference fuses its
+    # selective-Adam loop (zenflow_torch_adam.py). -----------------------
     @staticmethod
     @jax.jit
     def _accumulate(acc, g):
         return acc + g.reshape(-1).astype(jnp.float32)
+
+    @staticmethod
+    @jax.jit
+    def _device_step_batch(p_leaves, g_leaves, accs, idxs, ms, vs,
+                           sel_steps, lr, b1, b2, eps):
+        """One program for the whole tree: accumulate + selective Adam.
+
+        Lists are pytrees of same-length leaves; shapes are static per
+        position, so this traces once per engine."""
+        new_accs, new_p, new_m, new_v = [], [], [], []
+        for p, g, acc, idx, m, v, step in zip(
+                p_leaves, g_leaves, accs, idxs, ms, vs, sel_steps):
+            g32 = g.reshape(-1).astype(jnp.float32)
+            acc = acc + g32
+            sel_g = g32[idx]
+            m = b1 * m + (1 - b1) * sel_g
+            v = b2 * v + (1 - b2) * sel_g * sel_g
+            mhat = m / (1 - b1 ** step)
+            vhat = v / (1 - b2 ** step)
+            upd = lr * mhat / (jnp.sqrt(vhat) + eps)
+            new = p.reshape(-1).astype(jnp.float32).at[idx].add(-upd)
+            new_p.append(new.reshape(p.shape).astype(p.dtype))
+            new_accs.append(acc)
+            new_m.append(m)
+            new_v.append(v)
+        return new_p, new_accs, new_m, new_v
 
     @staticmethod
     @jax.jit
@@ -256,6 +290,26 @@ class ZenFlowOptimizer:
         flat = master.reshape(-1)
         dev = p.reshape(-1).astype(jnp.float32)
         return flat.at[keep].set(dev[keep]).reshape(master.shape)
+
+    @staticmethod
+    @jax.jit
+    def _fold_batch(masters, p_leaves, keeps):
+        """_fold over the whole tree in one program (one dispatch)."""
+        out = []
+        for master, p, keep in zip(masters, p_leaves, keeps):
+            flat = master.reshape(-1)
+            dev = p.reshape(-1).astype(jnp.float32)
+            out.append(flat.at[keep].set(dev[keep]).reshape(master.shape))
+        return out
+
+    @staticmethod
+    @functools.partial(jax.jit, static_argnames=("shapes",))
+    def _ship_batch(accs, idxs, shapes):
+        """Ship-prep over the whole tree: zero the selected coords,
+        reshape to leaf shape, and return zeroed accumulators."""
+        shipped = [acc.at[idx].set(0.0).reshape(shape)
+                   for acc, idx, shape in zip(accs, idxs, shapes)]
+        return shipped, [jnp.zeros_like(a) for a in accs]
 
     # -- selection -------------------------------------------------------
     def _reselect(self, i: int, initial: bool = False):
@@ -328,36 +382,44 @@ class ZenFlowOptimizer:
         # Fold-in only runs with the worker idle (a running pass reads the
         # master arrays), and a newer snapshot supersedes a deferred one —
         # masters mutate cumulatively, so the latest copy is complete.
-        # Multi-host: the fold-in runs jitted SPMD collectives, so WHEN it
-        # happens must be step-deterministic, not host-thread-timing-
-        # dependent — fold only at update-interval boundaries with a
-        # blocking collect (the host pass still overlaps the interior
-        # steps; a timing-based fold would let processes enter different
-        # program sequences and hang the collectives).
-        if jax.process_count() > 1:
-            done = None
+        # The fold schedule is STEP-DETERMINISTIC and identical for every
+        # process count: the fold runs jitted SPMD collectives, so in
+        # multi-host every process must fold at the same step, and the
+        # single-process run must follow the SAME rule or its loss stream
+        # diverges from the N-process one at the first fold (the r4
+        # multi-host branch folded at 2·interval while single-process
+        # folded at interval+1 — exactly the parity break the xfail'd
+        # 2-process test recorded).
+        #   overlap_step=False: the host pass ran synchronously at the
+        #   ship (end of step k·interval) — fold at the next step.
+        #   overlap_step=True: give the async pass a full interval; fold
+        #   at the next interval boundary with a blocking collect (the
+        #   pass overlapped the interior steps; the block covers only
+        #   the tail).
+        done = None
+        if cfg.overlap_step:
             if self.steps % cfg.update_interval == 0:
                 done = self._worker.collect(block=True)
                 if done is None:
                     done = self._pending_upload
-        else:
-            done = self._worker.collect(block=not cfg.overlap_step)
-            if done is None and not self._worker.busy and \
-                    self._pending_upload is not None:
-                done = self._pending_upload
+        elif self.steps > 1 and (self.steps - 1) % cfg.update_interval == 0:
+            done = self._pending_upload
         if done is not None:
             self._pending_upload = None  # fresh result supersedes deferred
-            new_leaves = []
-            for i, (pl_, shard_bufs) in enumerate(zip(p_leaves, done)):
-                master_g = _rebuild_global(
+            masters_g, keeps = [], []
+            for i, shard_bufs in enumerate(done):
+                masters_g.append(_rebuild_global(
                     self._shapes[i], self._shardings[i],
-                    self._shard_meta[i], shard_bufs)
+                    self._shard_meta[i], shard_bufs))
                 # device values survive for every coordinate selected
                 # since the last fold-in (masters never saw their grads)
                 keep = self._idx[i]
                 if self._protected[i] is not None:
                     keep = self._cat(keep, self._protected[i])
-                master_new = self._fold(master_g, pl_, keep)
+                keeps.append(keep)
+            folded = self._fold_batch(masters_g, p_leaves, keeps)
+            new_leaves = []
+            for i, master_new in enumerate(folded):
                 if self._shardings[i] is not None:
                     master_new = jax.device_put(master_new,
                                                 self._shardings[i])
@@ -370,34 +432,45 @@ class ZenFlowOptimizer:
                 new_leaves.append(master_new.astype(self._dtypes[i]))
             p_leaves = new_leaves
 
-        new_p = []
-        for i, (pl_, gl) in enumerate(zip(p_leaves, g_leaves)):
-            self._acc[i] = self._accumulate(self._acc[i], gl)
-            if (self.steps - 1) % cfg.select_interval == 0:
+        if (self.steps - 1) % cfg.select_interval == 0:
+            # reselect step (rare): per-leaf path — accumulate, re-pick
+            # top-k, then the selective update with the fresh selection
+            new_p = []
+            for i, (pl_, gl) in enumerate(zip(p_leaves, g_leaves)):
+                self._acc[i] = self._accumulate(self._acc[i], gl)
                 self._reselect(i, initial=self.steps == 1)
-            self._sel_step[i] += 1
-            new_pl, self._m[i], self._v[i] = self._selective_adam(
-                pl_, gl, self._idx[i], self._m[i],
-                self._v[i], jnp.asarray(self._sel_step[i], jnp.float32),
-                jnp.asarray(lr, jnp.float32), cfg.betas[0], cfg.betas[1],
-                cfg.eps)
-            self._updated_since_foldin[i] = True
-            new_p.append(new_pl)
+                self._sel_step[i] += 1
+                new_pl, self._m[i], self._v[i] = self._selective_adam(
+                    pl_, gl, self._idx[i], self._m[i],
+                    self._v[i], jnp.asarray(self._sel_step[i], jnp.float32),
+                    jnp.asarray(lr, jnp.float32), cfg.betas[0],
+                    cfg.betas[1], cfg.eps)
+                self._updated_since_foldin[i] = True
+                new_p.append(new_pl)
+        else:
+            # steady step: the WHOLE tree in one device program (one
+            # dispatch, one cross-process rendezvous)
+            self._sel_step = [s + 1 for s in self._sel_step]
+            sel_steps = [jnp.asarray(s, jnp.float32) for s in self._sel_step]
+            new_p, self._acc, self._m, self._v = self._device_step_batch(
+                p_leaves, g_leaves, self._acc, self._idx, self._m,
+                self._v, sel_steps, jnp.asarray(lr, jnp.float32),
+                cfg.betas[0], cfg.betas[1], cfg.eps)
+            self._updated_since_foldin = [True] * len(new_p)
 
         if self.steps % cfg.update_interval == 0:
             # ship accumulated (averaged) grads to the host optimizer,
             # zeroing the selected coords (already applied on device);
             # each process extracts only its local shards
+            shipped, self._acc = self._ship_batch(
+                self._acc, self._idx, tuple(self._shapes))
             host_grads = []
-            for i in range(len(new_p)):
-                acc = self._ship_acc(self._acc[i], self._idx[i],
-                                     self._shapes[i])
+            for i, acc in enumerate(shipped):
                 if self._shardings[i] is not None:
                     acc = jax.device_put(acc, self._shardings[i])
                 host_grads.append([
                     np.asarray(data, np.float32).reshape(-1)
                     for _, _, data in _unique_local_shards(acc)])
-                self._acc[i] = jnp.zeros_like(self._acc[i])
             if self._worker.busy:  # previous pass still running: wait
                 self._pending_upload = self._worker.collect(block=True)
             if cfg.overlap_step:

@@ -305,3 +305,56 @@ def test_chunked_attention_grad_memory_bounded(devices):
     # rematted — the threshold sits between with margin on both sides
     stacked = N * S * S // CH * 4  # 32MB: the leaked residual tensor
     assert temp < 2 * stacked, (temp, stacked)
+
+
+def test_fpdt_host_residual_matches_standard(devices):
+    """fpdt_host_residual (VERDICT r4 #5): the residual stream lives as
+    a host chunk stack between layers; embedding, every layer chunk, and
+    the fused final-norm+logits+loss fetch/emit host chunks. Loss and
+    gradients must match the device-residual fpdt path (bf16
+    summation-order noise only), and a tiny model must train."""
+    import jax
+    import jax.numpy as jnp
+
+    base = dict(vocab_size=64, hidden_size=32, num_layers=2, num_heads=4,
+                num_kv_heads=2, max_seq_len=48, pos_emb="rope",
+                norm="rmsnorm", activation="swiglu", tie_embeddings=False,
+                remat=False, attn_chunks=4, fpdt_host_kv=True,
+                attn_impl="xla")
+    m_std = TransformerLM(TransformerConfig(**base))
+    m_host = TransformerLM(TransformerConfig(**base,
+                                             fpdt_host_residual=True))
+    params = m_std.init(jax.random.PRNGKey(0))
+    rng = np.random.default_rng(0)
+    # S=45 does not divide the 4-chunk grid: exercises padding+masking
+    batch = {"input_ids": rng.integers(0, 64, (2, 45)).astype(np.int32)}
+    l_std, _ = jax.jit(lambda p, b: m_std.loss(p, b))(params, batch)
+    l_host, _ = jax.jit(lambda p, b: m_host.loss(p, b))(params, batch)
+    assert abs(float(l_std) - float(l_host)) < 2e-5, (l_std, l_host)
+    g_std = jax.jit(jax.grad(lambda p: m_std.loss(p, batch)[0]))(params)
+    g_host = jax.jit(jax.grad(lambda p: m_host.loss(p, batch)[0]))(params)
+    err = max(jax.tree.leaves(jax.tree.map(
+        lambda a, b: float(jnp.max(jnp.abs(a - b))), g_std, g_host)))
+    assert err < 2e-3, err
+
+    # trains end-to-end through the engine
+    cfg = TransformerConfig(**base, fpdt_host_residual=True)
+    ds_cfg = {
+        "train_micro_batch_size_per_chip": 1,
+        "optimizer": {"type": "adamw", "params": {"lr": 1e-2}},
+        "zero_optimization": {"stage": 0},
+        "steps_per_print": 100,
+    }
+    engine, *_ = dstpu.initialize(model=TransformerLM(cfg), config=ds_cfg)
+    fixed = {"input_ids": rng.integers(
+        0, 64, (engine.micro_batch_size * engine.dp_world_size, 48))
+        .astype(np.int32)}
+
+    def it():
+        while True:
+            yield fixed
+
+    stream = it()
+    losses = [float(engine.train_batch(stream)) for _ in range(6)]
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0] - 0.2, losses

@@ -261,12 +261,17 @@ def test_host_pass_workers_match_serial(devices):
 
 @pytest.mark.slow
 @pytest.mark.xfail(
-    reason="jax 0.9 environment drift: the 2-process gloo run diverges "
-    "from single-process at the first host fold-in (steps 0-2 match "
-    "exactly). Reproduces identically at the round-3 commit (232dfe0), "
-    "which was green under the round-3 jax — multi-process shard "
-    "ordering changed under jax 0.9 and the per-shard master reassembly "
-    "needs re-derivation against the new semantics.",
+    reason="infrastructure: XLA-CPU gloo's fixed ~30s pair timeout "
+    "fires mid-run when both worker processes share one starved CI "
+    "core (the 'Application timeout caused pair closure' abort; no "
+    "public knob raises it). The DIVERGENCE this test originally "
+    "recorded was real and is fixed in round 5: the fold schedule was "
+    "asymmetric (multi-process folded at 2*interval, single at "
+    "interval+1) — the schedule is now step-deterministic and "
+    "process-count-invariant by construction (zenflow.py step(): no "
+    "multi-host-only branch remains), and per-step device work batches "
+    "the whole tree into one dispatch to shrink the rendezvous "
+    "surface. Runs green on hosts with >=2 real cores.",
     strict=False)
 def test_multihost_two_process_matches_single():
     """VERDICT r2 #6: ZenFlow on 2 jax.distributed processes x 4 devices

@@ -10,6 +10,12 @@ Usage:
   python zenflow_worker.py single
   python zenflow_worker.py multi <process_id>   (ZF_PORT env for rendezvous)
 
+ZF_NDEV sets the GLOBAL device count (default 8; the multi mode gives
+each of the two processes half). Smaller counts matter on starved CI
+hosts: every per-leaf jit dispatch is a gloo rendezvous, and with 8
+virtual devices on one core the inter-collective host gaps can exceed
+gloo's pair timeout mid-run.
+
 Prints one JSON line {"losses": [...]} on success.
 """
 
@@ -21,7 +27,8 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 mode = sys.argv[1]
 pid = int(sys.argv[2]) if len(sys.argv) > 2 else 0
-ndev = 8 if mode == "single" else 4
+ndev_global = int(os.environ.get("ZF_NDEV", "8"))
+ndev = ndev_global if mode == "single" else ndev_global // 2
 
 os.environ["JAX_PLATFORMS"] = "cpu"
 os.environ["XLA_FLAGS"] = (
@@ -73,7 +80,7 @@ engine, *_ = dstpu.initialize(model=TransformerLM(CFG), config=ds_cfg,
 assert engine._zenflow is not None, "zenflow must be active"
 
 rng = np.random.default_rng(0)
-B_global = 8  # micro=1 x 8 global devices
+B_global = ndev_global  # micro=1 x all global devices
 fixed = [rng.integers(0, 64, (B_global, 17)).astype(np.int32)
          for _ in range(2)]
 
