@@ -35,25 +35,12 @@ import statistics
 import time
 
 
-# per-chip dense bf16 peak TFLOPS by TPU generation
-PEAK_TFLOPS = {
-    "v4": 275.0,
-    "v5 lite": 197.0,  # v5e
-    "v5e": 197.0,
-    "v5p": 459.0,
-    "v6 lite": 918.0,  # v6e (Trillium)
-    "v6e": 918.0,
-}
-
-
-def detect_peak_tflops(device) -> float:
-    if "BENCH_PEAK_TFLOPS" in os.environ:
-        return float(os.environ["BENCH_PEAK_TFLOPS"])
-    kind = getattr(device, "device_kind", "").lower()
-    for key, val in PEAK_TFLOPS.items():
-        if key in kind:
-            return val
-    return 197.0
+# peak tables + detection live in the observability package now, so the
+# engine's per-step MFU and this benchmark's headline MFU come from one
+# table and one formula (tools/device_step_bench.py imports them from
+# here — keep the re-export)
+from deepspeed_tpu.observability.roofline import (  # noqa: E402,F401
+    PEAK_TFLOPS, detect_peak_tflops)
 
 
 def main():
@@ -269,7 +256,12 @@ def main():
         jax.block_until_ready(loss)
         dt = time.perf_counter() - t0
         load = min(load0, loadavg()) if load0 >= 0 else load0
-        return tokens_per_window / dt / n_chips, load, loss
+        # the engine's own per-step MFU over exactly this window's steps
+        # (observability hub StepTrace rows) — same formula + peak table,
+        # timed per step instead of per window
+        emfu = (engine.hub.window_mfu(last_n=steps)
+                if getattr(engine, "hub", None) is not None else None)
+        return tokens_per_window / dt / n_chips, load, loss, emfu
 
     # capacity-probe runs (BENCH_STEPS=1 on host-optimizer shapes where a
     # step takes minutes) default to one window; normal runs take three
@@ -280,10 +272,10 @@ def main():
     load_max = float(os.environ.get("BENCH_LOAD_MAX", "2.0"))
     spread_target = float(os.environ.get("BENCH_SPREAD_TARGET", "0.05"))
 
-    windows = []  # (tok/s/chip, loadavg)
+    windows = []  # (tok/s/chip, loadavg, engine-window-mfu)
     for _ in range(n_windows):
-        tps, load, loss = measure_window()
-        windows.append((tps, load))
+        tps, load, loss, emfu = measure_window()
+        windows.append((tps, load, emfu))
     # resample while spread is wide and budget remains — one contended
     # window out of three still skews the median less than it skews a
     # single-sample mean, and extra clean windows dilute it further.
@@ -295,21 +287,26 @@ def main():
     def kept_and_spread():
         clean = [w for w in windows if 0.0 <= w[1] <= load_max]
         kept = clean if clean else windows
-        vals = sorted(w[0] for w in kept)
+        ordered = sorted(kept, key=lambda w: w[0])
         trimmed = 0
-        if len(vals) >= 4:
-            vals = vals[1:]
+        if len(ordered) >= 4:
+            ordered = ordered[1:]
             trimmed = 1
+        vals = [w[0] for w in ordered]
         med = statistics.median(vals)
         spread = (max(vals) - min(vals)) / med if med > 0 else 0.0
-        return kept, med, spread, trimmed
+        # engine MFU through the SAME window selection, so a contended
+        # window dropped from the throughput median is dropped here too
+        emfus = [w[2] for w in ordered if w[2] is not None]
+        emfu_med = statistics.median(emfus) if emfus else None
+        return kept, med, spread, trimmed, emfu_med
 
-    kept, med, spread, trimmed = kept_and_spread()
+    kept, med, spread, trimmed, engine_mfu = kept_and_spread()
     while (len(windows) < max_windows
            and (spread > spread_target or len(kept) < min(3, n_windows))):
-        tps, load, loss = measure_window()
-        windows.append((tps, load))
-        kept, med, spread, trimmed = kept_and_spread()
+        tps, load, loss, emfu = measure_window()
+        windows.append((tps, load, emfu))
+        kept, med, spread, trimmed, engine_mfu = kept_and_spread()
 
     tok_per_sec_chip = med
     contended = len(kept) < len(windows) or any(
@@ -338,6 +335,8 @@ def main():
         "unit": "tokens/s/chip",
         "vs_baseline": round(vs_baseline, 3),
         "mfu": round(mfu, 4),
+        "engine_mfu": (round(engine_mfu, 4)
+                       if engine_mfu is not None else None),
         "spread_pct": round(100.0 * spread, 2),
         "windows": [round(w[0], 1) for w in windows],
         "load_avg": [round(w[1], 2) for w in windows],

@@ -327,6 +327,7 @@ class MonitorConfig(ConfigModel):
     csv_monitor: MonitorBackendConfig = field(default_factory=MonitorBackendConfig)
     wandb: MonitorBackendConfig = field(default_factory=MonitorBackendConfig)
     comet: MonitorBackendConfig = field(default_factory=MonitorBackendConfig)
+    jsonl: MonitorBackendConfig = field(default_factory=MonitorBackendConfig)
 
 
 @register_config_model
@@ -341,6 +342,40 @@ class FlopsProfilerConfig(ConfigModel):
     top_modules: int = 1
     detailed: bool = True
     output_file: Optional[str] = None
+
+
+@register_config_model
+@dataclass
+class WatchdogConfig(ConfigModel):
+    """Stall watchdog (observability/watchdog.py): a step exceeding
+    ``max(factor * rolling_mean_step_time, min_seconds)`` triggers a
+    report with Python stacks and device memory stats. Env overrides:
+    DSTPU_WATCHDOG=0, DSTPU_WATCHDOG_FACTOR, DSTPU_WATCHDOG_MIN_S."""
+
+    enabled: bool = True
+    factor: float = 8.0
+    min_seconds: float = 30.0
+
+
+@register_config_model
+@dataclass
+class ObservabilityConfig(ConfigModel):
+    """Unified observability hub (observability/hub.py). Per-step
+    StepTrace rows (wall time, loss, tokens/s, MFU, comm deltas,
+    compile events) flow to the in-process hub always; ``jsonl_path`` /
+    ``prometheus_path`` additionally stream them to disk
+    (DSTPU_METRICS_JSONL / DSTPU_METRICS_PROM env override).
+    ``xla_cost_analysis`` opts into the lazily-computed roofline from
+    the compiled step's cost analysis (env: DSTPU_ROOFLINE=1) — it
+    costs one extra lower+compile, so it is off by default."""
+
+    enabled: bool = True
+    jsonl_path: Optional[str] = None
+    prometheus_path: Optional[str] = None
+    prometheus_every_steps: int = 10
+    step_history: int = 512
+    xla_cost_analysis: bool = False
+    watchdog: WatchdogConfig = field(default_factory=WatchdogConfig)
 
 
 @register_config_model
@@ -457,6 +492,7 @@ class Config(ConfigModel):
     comms_logger: CommsLoggerConfig = field(default_factory=CommsLoggerConfig)
     monitor: MonitorConfig = field(default_factory=MonitorConfig)
     flops_profiler: FlopsProfilerConfig = field(default_factory=FlopsProfilerConfig)
+    observability: ObservabilityConfig = field(default_factory=ObservabilityConfig)
     sparse_attention: Optional[SparseAttentionConfig] = None
     checkpoint: CheckpointConfig = field(default_factory=CheckpointConfig)
     compile: CompileConfig = field(default_factory=CompileConfig)
@@ -477,6 +513,7 @@ class Config(ConfigModel):
             "pipeline": PipelineConfig, "monitor": MonitorConfig,
             "activation_checkpointing": ActivationCheckpointingConfig,
             "comms_logger": CommsLoggerConfig, "flops_profiler": FlopsProfilerConfig,
+            "observability": ObservabilityConfig,
             "checkpoint": CheckpointConfig, "compile": CompileConfig,
             "data_efficiency": DataEfficiencyConfig,
         }

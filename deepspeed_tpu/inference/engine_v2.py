@@ -15,6 +15,7 @@ serves the whole workload.
 
 from __future__ import annotations
 
+import time
 from functools import partial
 from typing import Any, Dict, List, Optional, Tuple
 
@@ -93,6 +94,22 @@ class InferenceEngineV2:
         self.stats = {"decode_kernel_steps": 0, "prefill_kernel_steps": 0,
                       "prefill_gather_fallbacks": 0,
                       "fallback_reasons": {"vmem": 0, "padding": 0}}
+        # request-latency observability (docs/observability.md): TTFT is
+        # put()->first emitted token; decode latency is the gap between
+        # consecutive emitted tokens of one sequence (a burst spreads its
+        # round-trip evenly over the tokens it produced). Histograms live
+        # in the process-wide hub so serving percentiles land on the same
+        # Prometheus page as training metrics.
+        from deepspeed_tpu.observability import get_hub
+
+        self._hub = get_hub()
+        self._ttft_hist = self._hub.histogram("serve.ttft_seconds")
+        self._decode_hist = self._hub.histogram("serve.decode_token_seconds")
+        self._step_hist = self._hub.histogram("serve.step_seconds")
+        self._admit_time: Dict[int, float] = {}
+        self._last_emit_time: Dict[int, float] = {}
+        self._burst_tokens = 0
+        self._burst_capacity = 0
         kernel_mesh = None if single else self.mesh
         self._decode_fn = jax.jit(partial(
             model_runner.ragged_decode_forward, self.cfg,
@@ -134,16 +151,20 @@ class InferenceEngineV2:
     def put(self, uids: List[int], tokens_list: List[np.ndarray],
             max_new_tokens: int = 64) -> None:
         """Admit new sequences (uid -> prompt tokens)."""
+        now = time.perf_counter()
         for uid, toks in zip(uids, tokens_list):
             toks = np.asarray(toks, np.int32).ravel()
             if not self.can_schedule(len(toks)):
                 raise RuntimeError(f"cannot schedule uid={uid}: KV pool full")
             self.state.get_or_create(uid, toks, max_new_tokens)
+            self._admit_time[uid] = now
+            self._hub.counter_add("serve.requests")
 
     def step(self, temperature: float = 0.0, seed: int = 0,
              eos_token_id: Optional[int] = None) -> Dict[int, int]:
         """Run one SplitFuse step. Returns {uid: new_token} for sequences
         that produced a token this step."""
+        t0 = time.perf_counter()
         scheduled = self.scheduler.schedule()
         self._release_finished()
         if not scheduled:
@@ -251,6 +272,11 @@ class InferenceEngineV2:
                     seq.done = True
                 if len(seq.generated) >= seq.max_new_tokens:
                     seq.done = True
+        now = time.perf_counter()
+        self._step_hist.observe(now - t0)
+        for uid in emitted:
+            self._note_emitted(uid, 1, now)
+        self._update_serve_gauges()
         self._release_finished()
         return emitted
 
@@ -293,6 +319,42 @@ class InferenceEngineV2:
     def _release_finished(self) -> None:
         for uid in [s.uid for s in self.state.seqs.values() if s.done]:
             self.state.release(uid)
+            self._admit_time.pop(uid, None)
+            self._last_emit_time.pop(uid, None)
+
+    def _note_emitted(self, uid: int, n_tokens: int, now: float) -> None:
+        """Fold ``n_tokens`` just-emitted tokens of ``uid`` into the
+        latency histograms: the first token of a request is its TTFT;
+        later tokens record the gap since the previous emission (a burst
+        spreads one device round trip evenly over its tokens)."""
+        self._hub.counter_add("serve.tokens_emitted", n_tokens)
+        admit = self._admit_time.pop(uid, None)
+        last = self._last_emit_time.get(uid)
+        if admit is not None:
+            self._ttft_hist.observe(now - admit)
+            n_tokens -= 1
+            last = now
+        if last is not None and n_tokens > 0:
+            per_tok = (now - last) / n_tokens
+            for _ in range(n_tokens):
+                self._decode_hist.observe(per_tok)
+        self._last_emit_time[uid] = now
+
+    def _update_serve_gauges(self) -> None:
+        live = [s for s in self.state.seqs.values() if not s.done]
+        self._hub.gauge("serve.queue_depth", len(live))
+        self._hub.gauge("serve.pending_prefill_tokens",
+                        sum(s.pending_prefill for s in live))
+        self._hub.gauge("serve.kv_free_blocks", self.kv_cache.free_blocks)
+        self._hub.gauge("serve.batch_seq_occupancy",
+                        self.scheduler.last_scheduled_seqs
+                        / max(1, self.max_seqs))
+        self._hub.gauge("serve.batch_token_occupancy",
+                        self.scheduler.last_scheduled_tokens
+                        / max(1, self.max_tokens))
+        if self._burst_capacity > 0:
+            self._hub.gauge("serve.burst_efficiency",
+                            self._burst_tokens / self._burst_capacity)
 
     def _try_decode_burst(self, eos_token_id: Optional[int]
                           ) -> Optional[Dict[int, List[int]]]:
@@ -330,6 +392,7 @@ class InferenceEngineV2:
         for s in live:
             ok = self.state.ensure_capacity(s, s.seen_tokens + K)
             assert ok, "capacity probe said yes but allocation failed"
+        t0 = time.perf_counter()
         S = self.max_seqs
         d_tok = np.zeros(S, np.int32)
         d_pos = np.zeros(S, np.int32)
@@ -365,6 +428,16 @@ class InferenceEngineV2:
             s.generated.extend(accepted)
             s.seen_tokens += len(accepted)
             emitted[s.uid] = accepted
+        now = time.perf_counter()
+        self._step_hist.observe(now - t0)
+        # burst efficiency: accepted tokens vs the K*len(live) the device
+        # program computed (early-EOS/max-token exits waste the tail)
+        self._burst_tokens += sum(len(v) for v in emitted.values())
+        self._burst_capacity += K * len(live)
+        for uid, toks in emitted.items():
+            if toks:
+                self._note_emitted(uid, len(toks), now)
+        self._update_serve_gauges()
         self._release_finished()
         return emitted
 
@@ -406,6 +479,33 @@ class InferenceEngineV2:
         s["fallback_reasons"] = dict(self.stats["fallback_reasons"])
         log_dist(f"InferenceEngineV2 summary: {s}", ranks=[0])
         return s
+
+    def snapshot(self) -> Dict[str, Any]:
+        """Serving observability snapshot: request-latency percentiles
+        (TTFT + per-decode-token, p50/p95/p99), queue/occupancy gauges
+        and the kernel/fallback counters. The same histograms render on
+        the hub's Prometheus page (docs/observability.md)."""
+        live = [s for s in self.state.seqs.values() if not s.done]
+        out: Dict[str, Any] = {
+            "ttft": self._ttft_hist.snapshot(),
+            "decode_token_latency": self._decode_hist.snapshot(),
+            "step_latency": self._step_hist.snapshot(),
+            "queue_depth": len(live),
+            "pending_prefill_tokens": sum(s.pending_prefill for s in live),
+            "kv_free_blocks": self.kv_cache.free_blocks,
+            "batch_seq_occupancy": (self.scheduler.last_scheduled_seqs
+                                    / max(1, self.max_seqs)),
+            "batch_token_occupancy": (self.scheduler.last_scheduled_tokens
+                                      / max(1, self.max_tokens)),
+            "scheduler": dict(self.scheduler.stats),
+            "stats": dict(self.stats,
+                          fallback_reasons=dict(
+                              self.stats["fallback_reasons"])),
+        }
+        if self._burst_capacity > 0:
+            out["burst_efficiency"] = (self._burst_tokens
+                                       / self._burst_capacity)
+        return out
 
 
 def _sample_np(logits_row: np.ndarray, temperature: float, seed: int) -> int:

@@ -24,6 +24,12 @@ class SplitFuseScheduler:
         self.state = state
         self.max_tokens = max_tokens_per_step
         self.max_seqs = max_seqs_per_step
+        # scheduling observability: cumulative token mix plus the last
+        # step's occupancy (exported by InferenceEngineV2.snapshot())
+        self.stats = {"steps": 0, "decode_tokens": 0, "prefill_tokens": 0,
+                      "kv_starved_skips": 0}
+        self.last_scheduled_seqs = 0
+        self.last_scheduled_tokens = 0
 
     def schedule(self) -> List[Tuple[SequenceDescriptor, np.ndarray, int]]:
         """Pick (seq, new_tokens, start_pos) chunks for the next step.
@@ -42,10 +48,12 @@ class SplitFuseScheduler:
             if not seq.in_decode or seq.done:
                 continue
             if not self.state.ensure_capacity(seq, seq.seen_tokens + 1):
+                self.stats["kv_starved_skips"] += 1
                 continue  # KV OOM: leave for a later step
             tok = (seq.generated[-1] if seq.generated
                    else int(seq.input_tokens[-1]))
             out.append((seq, np.asarray([tok], np.int32), seq.seen_tokens))
+            self.stats["decode_tokens"] += 1
             budget -= 1
             slots -= 1
 
@@ -59,9 +67,14 @@ class SplitFuseScheduler:
                 continue
             chunk = min(pending, budget)
             if not self.state.ensure_capacity(seq, seq.seen_tokens + chunk):
+                self.stats["kv_starved_skips"] += 1
                 continue
             toks = seq.input_tokens[seq.seen_tokens:seq.seen_tokens + chunk]
             out.append((seq, toks.astype(np.int32), seq.seen_tokens))
+            self.stats["prefill_tokens"] += chunk
             budget -= chunk
             slots -= 1
+        self.stats["steps"] += 1
+        self.last_scheduled_seqs = len(out)
+        self.last_scheduled_tokens = self.max_tokens - budget
         return out

@@ -116,6 +116,29 @@ class CometMonitor(_Backend):
             self._exp.log_metric(label, value, step=step)
 
 
+class JSONLMonitor(_Backend):
+    """Append-only JSON-lines event stream (observability hub sink
+    reused as a monitor backend: one `{"label", "value", "step"}` row
+    per event, greppable and pandas-loadable without a TB install)."""
+
+    def __init__(self, cfg):
+        self.enabled = False
+        if not cfg.enabled:
+            return
+        from deepspeed_tpu.observability.sinks import JSONLSink
+
+        path = cfg.output_path or "./monitor_events.jsonl"
+        if os.path.isdir(path) or path.endswith(os.sep):
+            path = os.path.join(path, cfg.job_name + ".jsonl")
+        self._sink = JSONLSink(path)
+        self.enabled = True
+
+    def write_events(self, events: List[Event]):
+        for label, value, step in events:
+            self._sink.write({"kind": "monitor_event", "label": label,
+                              "value": value, "step": step})
+
+
 class MonitorMaster:
     """Fan-out writer (reference monitor/monitor.py:30)."""
 
@@ -127,7 +150,10 @@ class MonitorMaster:
                 (CSVMonitor, monitor_config.csv_monitor),
                 (WandbMonitor, monitor_config.wandb),
                 (CometMonitor, monitor_config.comet),
+                (JSONLMonitor, getattr(monitor_config, "jsonl", None)),
             ):
+                if cfg is None:
+                    continue
                 b = backend_cls(cfg)
                 if b.enabled:
                     self.backends.append(b)

@@ -1,0 +1,45 @@
+"""Unified observability hub.
+
+One process-wide registry (``get_hub()``) collecting per-step training
+traces (MFU/roofline included), serving latency histograms, stall
+watchdog reports and capability-fallback counters, exported through the
+existing monitor backends plus JSON-lines and Prometheus text sinks.
+See docs/observability.md.
+"""
+
+from deepspeed_tpu.observability.histogram import Histogram
+from deepspeed_tpu.observability.hub import (MetricsHub, compile_stats,
+                                             get_hub, reset_hub)
+from deepspeed_tpu.observability.profile_trace import (TraceCapture,
+                                                       parse_trace_steps)
+from deepspeed_tpu.observability.roofline import (HBM_GBPS, PEAK_TFLOPS,
+                                                  detect_hbm_gbps,
+                                                  detect_peak_tflops, mfu,
+                                                  roofline_summary)
+from deepspeed_tpu.observability.sinks import (JSONLSink, PrometheusTextSink,
+                                               prometheus_name,
+                                               render_prometheus)
+from deepspeed_tpu.observability.step_trace import StepTrace
+from deepspeed_tpu.observability.watchdog import StallWatchdog
+
+__all__ = [
+    "Histogram",
+    "MetricsHub",
+    "get_hub",
+    "reset_hub",
+    "compile_stats",
+    "TraceCapture",
+    "parse_trace_steps",
+    "PEAK_TFLOPS",
+    "HBM_GBPS",
+    "detect_peak_tflops",
+    "detect_hbm_gbps",
+    "mfu",
+    "roofline_summary",
+    "JSONLSink",
+    "PrometheusTextSink",
+    "prometheus_name",
+    "render_prometheus",
+    "StepTrace",
+    "StallWatchdog",
+]

@@ -1,0 +1,282 @@
+"""Process-wide metrics hub.
+
+Every signal the runtime already produces — step timing, loss/grad-norm,
+traced collective volume (utils/comms_logging), capability-fallback
+counters (utils/telemetry), serving latencies (inference/engine_v2) —
+flows through one registry with three export paths:
+
+* ``record_step`` keeps a bounded in-memory history of ``StepTrace``
+  rows and mirrors the headline numbers into gauges;
+* a JSON-lines sink streams every row to disk as it happens;
+* a Prometheus text snapshot is rewritten (atomically) on a cadence for
+  textfile-collector scraping.
+
+The hub is a singleton (``get_hub``): training engine, serving engine
+and user code in one process share the registry, so one Prometheus page
+shows the whole picture. Sinks attach via :meth:`configure` (config
+block or ``DSTPU_METRICS_JSONL`` / ``DSTPU_METRICS_PROM`` env vars).
+
+Compile/retrace visibility: jax.monitoring event listeners (registered
+once, best-effort — older jax may lack the API) count XLA compilations
+and their wall time; ``StepTrace.compile_events`` > 0 on a mid-run step
+is the classic silent-retrace regression signature.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from collections import deque
+from typing import Any, Dict, Optional
+
+from deepspeed_tpu.observability.histogram import Histogram
+from deepspeed_tpu.observability.sinks import (JSONLSink, PrometheusTextSink,
+                                               render_prometheus)
+from deepspeed_tpu.observability.step_trace import StepTrace
+from deepspeed_tpu.utils.logging import logger
+
+# process-global compile accounting: jax.monitoring listeners cannot be
+# unregistered, so they feed module state rather than a hub instance
+# (reset_hub() would otherwise leak dead hubs into the listener)
+_COMPILE_LOCK = threading.Lock()
+_COMPILE_EVENTS = 0
+_COMPILE_SECS = 0.0
+_LISTENERS_REGISTERED = False
+
+
+def _on_compile_duration(event: str, duration: float, **kw) -> None:
+    global _COMPILE_EVENTS, _COMPILE_SECS
+    if "compil" not in event:
+        return
+    with _COMPILE_LOCK:
+        _COMPILE_EVENTS += 1
+        _COMPILE_SECS += float(duration)
+
+
+def _register_compile_listeners() -> None:
+    global _LISTENERS_REGISTERED
+    if _LISTENERS_REGISTERED:
+        return
+    _LISTENERS_REGISTERED = True
+    try:
+        from jax import monitoring
+
+        monitoring.register_event_duration_secs_listener(
+            _on_compile_duration)
+    except Exception as e:  # jax.monitoring API varies across versions
+        logger.debug(f"compile-event listener unavailable: {e}")
+
+
+def compile_stats() -> Dict[str, float]:
+    with _COMPILE_LOCK:
+        return {"events": _COMPILE_EVENTS, "secs": _COMPILE_SECS}
+
+
+class MetricsHub:
+    def __init__(self, step_history: int = 512):
+        self._lock = threading.Lock()
+        self.gauges: Dict[str, float] = {}
+        self.counters: Dict[str, float] = {}
+        self.histograms: Dict[str, Histogram] = {}
+        self.step_history: deque = deque(maxlen=step_history)
+        self._jsonl: Optional[JSONLSink] = None
+        self._prom: Optional[PrometheusTextSink] = None
+        self._prom_every = 10  # steps between Prometheus snapshot rewrites
+        self._last_comm_totals: Dict[str, float] = {}
+        self._last_compile = compile_stats()
+        _register_compile_listeners()
+
+    # -- configuration -------------------------------------------------
+    def configure(self, obs_config=None) -> None:
+        """Attach sinks from the config block and/or env vars. Safe to
+        call more than once (a second engine in the process reuses the
+        already-attached sinks)."""
+        jsonl = os.environ.get("DSTPU_METRICS_JSONL") or getattr(
+            obs_config, "jsonl_path", None)
+        prom = os.environ.get("DSTPU_METRICS_PROM") or getattr(
+            obs_config, "prometheus_path", None)
+        hist = int(getattr(obs_config, "step_history", 0) or 0)
+        every = int(getattr(obs_config, "prometheus_every_steps", 0) or 0)
+        with self._lock:
+            if jsonl and (self._jsonl is None or self._jsonl.path != jsonl):
+                self._jsonl = JSONLSink(jsonl)
+            if prom and (self._prom is None or self._prom.path != prom):
+                self._prom = PrometheusTextSink(prom)
+            if every > 0:
+                self._prom_every = every
+            if hist > 0 and hist != self.step_history.maxlen:
+                self.step_history = deque(self.step_history, maxlen=hist)
+
+    # -- primitive metrics ---------------------------------------------
+    def gauge(self, name: str, value: float) -> None:
+        with self._lock:
+            self.gauges[name] = float(value)
+
+    def counter_add(self, name: str, n: float = 1.0) -> None:
+        with self._lock:
+            self.counters[name] = self.counters.get(name, 0.0) + n
+
+    def histogram(self, name: str, **kw) -> Histogram:
+        with self._lock:
+            h = self.histograms.get(name)
+            if h is None:
+                h = self.histograms[name] = Histogram(name, **kw)
+            return h
+
+    # -- step traces -----------------------------------------------------
+    def comm_deltas(self) -> (dict, dict):
+        """(cumulative, delta-since-last-call) traced collective bytes
+        by op — empty when the comms logger is disabled."""
+        try:
+            from deepspeed_tpu.utils.comms_logging import get_comms_logger
+
+            totals = get_comms_logger().totals()
+        except Exception:
+            totals = {}
+        delta = {k: v - self._last_comm_totals.get(k, 0.0)
+                 for k, v in totals.items()
+                 if v != self._last_comm_totals.get(k, 0.0)}
+        self._last_comm_totals = dict(totals)
+        return totals, delta
+
+    def compile_delta(self) -> Dict[str, float]:
+        now = compile_stats()
+        delta = {"events": now["events"] - self._last_compile["events"],
+                 "secs": now["secs"] - self._last_compile["secs"]}
+        self._last_compile = now
+        return delta
+
+    def record_step(self, trace: StepTrace) -> None:
+        with self._lock:
+            self.step_history.append(trace)
+            self.gauges["train.step"] = trace.step
+            self.gauges["train.step_seconds"] = trace.wall_ms / 1000.0
+            for name, val in (("train.loss", trace.loss),
+                              ("train.grad_norm", trace.grad_norm),
+                              ("train.lr", trace.lr),
+                              ("train.tokens_per_sec", trace.tokens_per_sec),
+                              ("train.tokens_per_sec_per_chip",
+                               trace.tokens_per_sec_per_chip),
+                              ("train.mfu", trace.mfu)):
+                if val is not None:
+                    self.gauges[name] = float(val)
+            self.counters["train.steps"] = \
+                self.counters.get("train.steps", 0.0) + 1.0
+            if trace.tokens:
+                self.counters["train.tokens"] = \
+                    self.counters.get("train.tokens", 0.0) + trace.tokens
+            if trace.overflow:
+                self.counters["train.overflow_steps"] = \
+                    self.counters.get("train.overflow_steps", 0.0) + 1.0
+            if trace.compile_events:
+                self.counters["jit.compile_events"] = \
+                    self.counters.get("jit.compile_events", 0.0) \
+                    + trace.compile_events
+        self.histogram("train.step_seconds").observe(trace.wall_ms / 1000.0)
+        if self._jsonl is not None:
+            self._jsonl.write(trace.to_dict())
+        if self._prom is not None and \
+                trace.step % max(1, self._prom_every) == 0:
+            self.write_prometheus()
+
+    def record_event(self, kind: str, **fields) -> None:
+        """Free-form JSONL row (watchdog reports, trace markers, ...)."""
+        if self._jsonl is not None:
+            self._jsonl.write(dict(fields, kind=kind))
+
+    # -- export ----------------------------------------------------------
+    def mean_mfu(self, last_n: int = 0) -> Optional[float]:
+        """Mean MFU over the most recent ``last_n`` traced steps (all
+        history when 0); None when no step carried an MFU."""
+        with self._lock:
+            rows = list(self.step_history)
+        if last_n > 0:
+            rows = rows[-last_n:]
+        vals = [t.mfu for t in rows if t.mfu is not None]
+        if not vals:
+            return None
+        return sum(vals) / len(vals)
+
+    def window_mfu(self, last_n: int = 0) -> Optional[float]:
+        """MFU of the most recent ``last_n`` traced steps computed the
+        way bench.py computes its window: total tokens over total wall
+        time (token-weighted — a mean of per-step rates would overweight
+        fast steps). None when the window carries no MFU inputs."""
+        with self._lock:
+            rows = list(self.step_history)
+        if last_n > 0:
+            rows = rows[-last_n:]
+        rows = [t for t in rows
+                if t.mfu is not None and t.wall_ms > 0 and t.tokens]
+        if not rows:
+            return None
+        total_tokens = sum(t.tokens for t in rows)
+        total_s = sum(t.wall_ms for t in rows) / 1000.0
+        last = rows[-1]
+        from deepspeed_tpu.observability.roofline import mfu as _mfu
+
+        return _mfu(total_tokens / total_s / max(1, last.n_chips),
+                    last.flops_per_token, last.peak_tflops)
+
+    def snapshot(self) -> Dict[str, Any]:
+        from deepspeed_tpu.utils import telemetry
+
+        with self._lock:
+            out: Dict[str, Any] = {
+                "gauges": dict(self.gauges),
+                "counters": dict(self.counters),
+                "histograms": {n: h.snapshot()
+                               for n, h in self.histograms.items()},
+                "fallbacks": telemetry.snapshot(),
+            }
+            last = self.step_history[-1] if self.step_history else None
+        if last is not None:
+            out["last_step"] = last.to_dict()
+        return out
+
+    def to_prometheus(self) -> str:
+        from deepspeed_tpu.utils import telemetry
+
+        with self._lock:
+            gauges = dict(self.gauges)
+            counters = dict(self.counters)
+            hists = dict(self.histograms)
+        return render_prometheus(
+            gauges, counters, hists,
+            labeled_counters={"capability_fallback":
+                              {k: float(v)
+                               for k, v in telemetry.snapshot().items()}})
+
+    def write_prometheus(self) -> None:
+        if self._prom is not None:
+            self._prom.write_text(self.to_prometheus())
+
+    def close(self) -> None:
+        if self._jsonl is not None:
+            self._jsonl.close()
+        self.write_prometheus()
+
+
+_HUB: Optional[MetricsHub] = None
+_HUB_LOCK = threading.Lock()
+
+
+def get_hub() -> MetricsHub:
+    global _HUB
+    with _HUB_LOCK:
+        if _HUB is None:
+            _HUB = MetricsHub()
+        return _HUB
+
+
+def reset_hub() -> None:
+    """Drop the singleton (tests). Sinks on the old hub are closed."""
+    global _HUB
+    with _HUB_LOCK:
+        if _HUB is not None:
+            try:
+                if _HUB._jsonl is not None:
+                    _HUB._jsonl.close()
+            except Exception:
+                pass
+        _HUB = None

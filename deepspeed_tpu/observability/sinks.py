@@ -1,0 +1,141 @@
+"""Metrics sinks: JSON-lines stream + Prometheus text exposition.
+
+Two export shapes the existing monitor backends (CSV/TensorBoard/W&B,
+deepspeed_tpu/monitor) don't cover:
+
+* ``JSONLSink`` — one JSON object per line, appended and flushed per
+  record, so a crashed or stalled run leaves a machine-readable trail up
+  to its last completed step (the post-mortem artifact the stall
+  watchdog points at).
+* ``PrometheusTextSink`` — node-exporter *textfile collector* format:
+  the full current snapshot is rewritten atomically (tmp + rename) so a
+  scraper never reads a torn file. There is no HTTP server on TPU pod
+  workers; the textfile handoff is the standard pattern there.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import tempfile
+import threading
+import time
+from typing import Any, Dict, Optional
+
+from deepspeed_tpu.utils.logging import logger
+
+_NAME_RE = re.compile(r"[^a-zA-Z0-9_:]")
+
+
+def prometheus_name(name: str, prefix: str = "dstpu") -> str:
+    """'serve.ttft_seconds' -> 'dstpu_serve_ttft_seconds'."""
+    clean = _NAME_RE.sub("_", name.replace(".", "_").replace("/", "_"))
+    return f"{prefix}_{clean}" if prefix else clean
+
+
+class JSONLSink:
+    """Append-and-flush JSON-lines writer (one record per call)."""
+
+    def __init__(self, path: str):
+        self.path = path
+        d = os.path.dirname(os.path.abspath(path))
+        os.makedirs(d, exist_ok=True)
+        self._lock = threading.Lock()
+        self._fh = open(path, "a", buffering=1)
+        self._failed = False
+
+    def write(self, record: Dict[str, Any]) -> None:
+        if self._failed:
+            return
+        try:
+            line = json.dumps(record, default=_json_default)
+            with self._lock:
+                self._fh.write(line + "\n")
+                self._fh.flush()
+        except Exception as e:  # a full disk must not kill training
+            self._failed = True
+            logger.warning(f"JSONL metrics sink disabled after error: {e}")
+
+    def close(self) -> None:
+        with self._lock:
+            try:
+                self._fh.close()
+            except Exception:
+                pass
+
+
+def _json_default(obj):
+    # numpy / jax scalars → python numbers; anything else → str
+    for attr in ("item",):
+        if hasattr(obj, attr):
+            try:
+                return obj.item()
+            except Exception:
+                pass
+    return str(obj)
+
+
+def render_prometheus(gauges: Dict[str, float], counters: Dict[str, float],
+                      histograms: Dict[str, Any],
+                      labeled_counters: Optional[
+                          Dict[str, Dict[str, float]]] = None) -> str:
+    """Render a metrics snapshot in Prometheus text exposition format.
+
+    ``histograms`` maps name -> Histogram (duck-typed: needs
+    ``prometheus_lines``). ``labeled_counters`` maps a metric name to
+    ``{label_value: count}`` rendered with a ``name`` label (used for
+    the capability-fallback telemetry counters).
+    """
+    lines = [f"# dstpu metrics snapshot ts={time.time():.3f}"]
+    for name in sorted(gauges):
+        m = prometheus_name(name)
+        lines.append(f"# TYPE {m} gauge")
+        lines.append(f"{m} {gauges[name]:.6g}")
+    for name in sorted(counters):
+        m = prometheus_name(name)
+        if not m.endswith("_total"):
+            m += "_total"
+        lines.append(f"# TYPE {m} counter")
+        lines.append(f"{m} {counters[name]:.6g}")
+    for name, per_label in sorted((labeled_counters or {}).items()):
+        m = prometheus_name(name)
+        if not m.endswith("_total"):
+            m += "_total"
+        lines.append(f"# TYPE {m} counter")
+        for label, v in sorted(per_label.items()):
+            safe = label.replace("\\", "\\\\").replace('"', '\\"')
+            lines.append(f'{m}{{name="{safe}"}} {v:.6g}')
+    for name, hist in sorted(histograms.items()):
+        lines.extend(hist.prometheus_lines(prometheus_name(name)))
+    return "\n".join(lines) + "\n"
+
+
+class PrometheusTextSink:
+    """Atomic whole-file snapshot writer (textfile-collector handoff)."""
+
+    def __init__(self, path: str):
+        self.path = path
+        d = os.path.dirname(os.path.abspath(path))
+        os.makedirs(d, exist_ok=True)
+        self._lock = threading.Lock()
+        self._failed = False
+
+    def write_text(self, text: str) -> None:
+        if self._failed:
+            return
+        try:
+            with self._lock:
+                d = os.path.dirname(os.path.abspath(self.path))
+                fd, tmp = tempfile.mkstemp(dir=d, suffix=".prom.tmp")
+                try:
+                    with os.fdopen(fd, "w") as f:
+                        f.write(text)
+                    os.replace(tmp, self.path)
+                finally:
+                    if os.path.exists(tmp):
+                        os.unlink(tmp)
+        except Exception as e:
+            self._failed = True
+            logger.warning(f"Prometheus metrics sink disabled after "
+                           f"error: {e}")

@@ -29,11 +29,36 @@ per-chip footprint of the resident S/p slice.
 from __future__ import annotations
 
 import functools
+import os
 from typing import Optional
 
 import jax
 import jax.numpy as jnp
 from jax import lax
+
+
+def _read_bisect() -> str:
+    """DSTPU_FPDT_BISECT debug modes (noctx/outonly/novjp/devout/
+    dummybwd) amputate parts of the hosted-layer computation to bisect
+    TPU host-offloading failures — gradients (and for some modes the
+    outputs) are WRONG. Shout once and count, so a bisect var leaking
+    into a real run cannot pass silently."""
+    mode = os.environ.get("DSTPU_FPDT_BISECT", "")
+    if mode:
+        from deepspeed_tpu.utils import telemetry
+        from deepspeed_tpu.utils.logging import logger
+
+        telemetry.count("fpdt.bisect_active", mode)
+        if ("fpdt.bisect", mode) not in _BISECT_WARNED:
+            _BISECT_WARNED.add(("fpdt.bisect", mode))
+            logger.warning(
+                f"DSTPU_FPDT_BISECT={mode!r} is ACTIVE: this is a debug "
+                "bisection mode — fpdt numerics/gradients are "
+                "intentionally wrong. Unset it for real runs.")
+    return mode
+
+
+_BISECT_WARNED: set = set()
 
 
 def _chunk_vs_kv_tiles(q, k_tiles, v_tiles, q_pos0, causal: bool,
@@ -342,6 +367,11 @@ def fpdt_attention_block(y, ap, positions, *, num_heads: int,
     onto the chunk grid so both scans fetch the same host tiles.
     """
     if hosted:
+        if seq_len is None:
+            raise ValueError(
+                "hosted fpdt requires seq_len (the host stack is padded "
+                "on the chunk grid, so the real sequence length cannot "
+                "be recovered from y.shape)")
         T_res, BC, H = y.shape
         if q_chunks != T_res:
             raise ValueError(
@@ -641,8 +671,7 @@ def fpdt_hosted_layer(x_t, layer_params, pos_p, *, seq_len: int,
 
         f = jax.checkpoint(f)
 
-        import os as _os
-        _bisect = _os.environ.get("DSTPU_FPDT_BISECT", "")
+        _bisect = _read_bisect()
 
         def body_noctx(_, idx):
             out_c, ctx, lse = f(idx)
@@ -793,8 +822,7 @@ def fpdt_hosted_layer(x_t, layer_params, pos_p, *, seq_len: int,
         d_pos = np.zeros(np.shape(pos_p), jax.dtypes.float0)
         return dx_t, dparams, d_pos
 
-    import os as _os
-    _bis = _os.environ.get("DSTPU_FPDT_BISECT", "")
+    _bis = _read_bisect()
     if "novjp" in _bis:
         return _forward(x_t, layer_params)[0]
     if "devout" in _bis:
@@ -819,7 +847,7 @@ def fpdt_hosted_layer(x_t, layer_params, pos_p, *, seq_len: int,
 
         run_d.defvjp(run_d_fwd, run_d_bwd)
         return _to_host(run_d(x_t, layer_params, pos_p))
-    if "dummybwd" in _os.environ.get("DSTPU_FPDT_BISECT", ""):
+    if "dummybwd" in _bis:
         def run_bwd_dummy(res, d_out_t):
             import numpy as np
             x_t, params, *_ = res

@@ -332,6 +332,29 @@ class Engine:
             batch_size=self.train_batch_size,
             steps_per_output=config.steps_per_print)
         self.monitor = self._build_monitor()
+        # unified observability hub: per-step StepTrace rows, stall
+        # watchdog, on-demand profiler capture (docs/observability.md)
+        self.hub = None
+        self.watchdog = None
+        self._trace_capture = None
+        self._obs_cfg = getattr(config, "observability", None)
+        if self._obs_cfg is None or self._obs_cfg.enabled:
+            try:
+                from deepspeed_tpu.observability import (StallWatchdog,
+                                                         TraceCapture,
+                                                         get_hub)
+
+                self.hub = get_hub()
+                self.hub.configure(self._obs_cfg)
+                self.watchdog = StallWatchdog.from_config(
+                    getattr(self._obs_cfg, "watchdog", None),
+                    report_fn=self._on_stall_report)
+                self._trace_capture = TraceCapture.from_env()
+            except Exception as e:
+                logger.warning(f"observability hub disabled: {e}")
+        self._flops_per_token = None   # cached model.flops_per_token()
+        self._last_batches_struct = None  # abstract batch for roofline()
+        self._roofline_cost = None     # cached XLA cost analysis
         self.global_steps = 0
         self.global_samples = 0
         self.micro_steps = 0
@@ -897,10 +920,25 @@ class Engine:
         self.tput_timer.start()
         batches = self._next_microbatches(data_iter,
                                           self.gradient_accumulation_steps)
+        step_no = self.global_steps + 1
+        if self._trace_capture is not None:
+            self._trace_capture.on_step_begin(step_no)
+        if self.watchdog is not None:
+            # armed until the step's results are blocked on below: a
+            # wedged collective fires a stack/memory report
+            self.watchdog.arm(step_no)
         with topo.use_mesh(self.mesh):
             metrics = self._dispatch_train_step(batches)
         self._after_step(metrics)
         self.timers(TRAIN_BATCH_TIMER).stop(block=metrics["loss"])
+        if self._trace_capture is not None:
+            self._trace_capture.on_step_end(step_no)
+        wall_ms = self._last_step_wall_ms()
+        if self.watchdog is not None:
+            self.watchdog.disarm()
+            self.watchdog.observe(wall_ms / 1000.0, step_no)
+        if self.hub is not None:
+            self._emit_step_trace(step_no, metrics, batches, wall_ms)
         return metrics["loss"]
 
     def _dispatch_train_step(self, batches):
@@ -1224,6 +1262,158 @@ class Engine:
         except Exception as e:
             logger.debug(f"monitor disabled: {e}")
             return None
+
+    # ------------------------------------------------------------------
+    # observability (docs/observability.md)
+    # ------------------------------------------------------------------
+    def _last_step_wall_ms(self) -> float:
+        records = self.timers(TRAIN_BATCH_TIMER).records
+        return records[-1] if records else 0.0
+
+    def _on_stall_report(self, report: str) -> None:
+        if self.hub is not None:
+            self.hub.counter_add("train.stalls")
+            self.hub.record_event("stall_report", step=self.global_steps,
+                                  report=report)
+
+    def _batch_tokens(self, batches):
+        """Trained tokens in one train_batch: gas * B * S with input_ids
+        [gas, B, S+1] (next-token objective trains S positions per
+        sequence — the same count bench.py divides by)."""
+        try:
+            ids = batches.get("input_ids") if hasattr(batches, "get") \
+                else None
+            if ids is None:
+                leaves = jax.tree.leaves(batches)
+                ids = leaves[0] if leaves else None
+            if ids is None or ids.ndim < 2 or ids.shape[-1] < 2:
+                return None
+            return int(np.prod(ids.shape[:-1])) * (ids.shape[-1] - 1)
+        except Exception:
+            return None
+
+    def _model_flops_per_token(self):
+        if self._flops_per_token is None:
+            fn = getattr(self.model, "flops_per_token", None)
+            try:
+                self._flops_per_token = float(fn()) if callable(fn) else 0.0
+            except Exception:
+                self._flops_per_token = 0.0
+        return self._flops_per_token or None
+
+    def _emit_step_trace(self, step_no, metrics, batches, wall_ms) -> None:
+        try:
+            from deepspeed_tpu.observability import StepTrace
+            from deepspeed_tpu.observability import roofline as _rl
+            from deepspeed_tpu.utils.memory import device_memory_stats
+
+            self._last_batches_struct = jax.tree.map(
+                lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype), batches)
+            dt = wall_ms / 1000.0
+            tokens = self._batch_tokens(batches)
+            n_chips = max(1, len(jax.devices()))
+            tps = tokens / dt if (tokens and dt > 0) else None
+            tps_chip = tps / n_chips if tps else None
+            mfu_val = fpt = peak = None
+            if tps_chip:
+                fpt = self._model_flops_per_token()
+                if fpt:
+                    peak = _rl.detect_peak_tflops(jax.devices()[0])
+                    mfu_val = _rl.mfu(tps_chip, fpt, peak)
+
+            def _f(key):
+                v = metrics.get(key)
+                try:
+                    return None if v is None else float(v)
+                except Exception:
+                    return None
+
+            comm_total, comm_delta = self.hub.comm_deltas()
+            compile_d = self.hub.compile_delta()
+            trace = StepTrace(
+                step=step_no, wall_ms=wall_ms, tokens=tokens,
+                tokens_per_sec=tps, tokens_per_sec_per_chip=tps_chip,
+                n_chips=n_chips, loss=_f("loss"),
+                grad_norm=_f("grad_norm"), lr=_f("lr"),
+                loss_scale=_f("loss_scale"),
+                overflow=bool(metrics.get("overflow", False)),
+                skipped_steps=self.skipped_steps,
+                mfu=mfu_val, mfu_source="model" if mfu_val else None,
+                flops_per_token=fpt, peak_tflops=peak,
+                compile_events=int(compile_d["events"]),
+                compile_secs=compile_d["secs"],
+                comm_bytes_total=comm_total or None,
+                comm_bytes_delta=comm_delta or None,
+                device_mem=device_memory_stats())
+            self.hub.record_step(trace)
+            if self.monitor is not None and self.monitor.enabled and \
+                    step_no % self.config.steps_per_print == 0:
+                events = [("Train/Samples/step_seconds", dt,
+                           self.global_samples)]
+                if tps is not None:
+                    events.append(("Train/Samples/tokens_per_sec", tps,
+                                   self.global_samples))
+                if mfu_val is not None:
+                    events.append(("Train/Samples/mfu", mfu_val,
+                                   self.global_samples))
+                self.monitor.write_events(events)
+            if self._roofline_cost is None and step_no >= 2 and (
+                    os.environ.get("DSTPU_ROOFLINE", "") == "1"
+                    or getattr(self._obs_cfg, "xla_cost_analysis", False)):
+                self.roofline()
+        except Exception as e:  # observability must never fail the step
+            logger.warning(f"step trace emission failed: {e}")
+
+    def roofline(self, step_seconds=None):
+        """Classify the compiled train step against the chip roofline.
+
+        Lowers + compiles the active step function once more (XLA's
+        ``cost_analysis`` lives on the compiled executable) and caches
+        the cost — expensive for big models, hence opt-in via
+        ``observability.xla_cost_analysis`` or ``DSTPU_ROOFLINE=1``
+        (then it runs once, after step 2). Needs one prior
+        ``train_batch`` for the batch shapes."""
+        from deepspeed_tpu.observability import roofline as _rl
+        from deepspeed_tpu.utils.hlo_bytes import program_costs
+
+        if self._roofline_cost is None:
+            if self._last_batches_struct is None:
+                raise RuntimeError(
+                    "roofline() needs one prior train_batch() (the batch "
+                    "shapes come from it)")
+            b = self._last_batches_struct
+            lr_over = jnp.asarray(float("nan"), jnp.float32)
+            with topo.use_mesh(self.mesh):
+                if self._onebit:
+                    lowered = self._jit_onebit.lower(
+                        self.params, self._onebit_state, b, lr_over)
+                elif self._zeropp:
+                    lowered = self._jit_zeropp.lower(
+                        self.params, self._zeropp_state, b, lr_over)
+                elif self._offload is not None:
+                    lowered = self._jit_grad_step.lower(
+                        self.params, b, jnp.asarray(1.0, jnp.float32))
+                else:
+                    lowered = self._jit_train_step.lower(
+                        self.params, self.opt_state, self.loss_scale_state,
+                        self.step_count, b)
+            self._roofline_cost = program_costs(lowered.compile())
+        if step_seconds is None:
+            wall = self._last_step_wall_ms()
+            step_seconds = wall / 1000.0 if wall > 0 else None
+        dev = jax.devices()[0]
+        summary = _rl.roofline_summary(
+            self._roofline_cost, _rl.detect_peak_tflops(dev),
+            _rl.detect_hbm_gbps(dev), step_seconds=step_seconds)
+        if self.hub is not None:
+            self.hub.record_event("roofline", step=self.global_steps,
+                                  **summary)
+            self.hub.gauge("train.arithmetic_intensity",
+                           summary["arithmetic_intensity"])
+            if "hw_flops_utilization" in summary:
+                self.hub.gauge("train.hw_flops_utilization",
+                               summary["hw_flops_utilization"])
+        return summary
 
     # ------------------------------------------------------------------
     # optimizer view + state accessors

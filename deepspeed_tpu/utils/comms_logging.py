@@ -79,6 +79,16 @@ class CommsLogger:
     def reset(self) -> None:
         self.comms_dict.clear()
 
+    def totals(self) -> Dict[str, int]:
+        """Cumulative traced bytes per op, summed over axes — the shape
+        the observability hub snapshots each step to compute per-step
+        communication deltas. Remember these are trace-time volumes: a
+        re-executed compiled step adds nothing here."""
+        out: Dict[str, int] = {}
+        for op_name, per_axis in self.comms_dict.items():
+            out[op_name] = sum(rec.total_bytes for rec in per_axis.values())
+        return out
+
     def log_summary(self) -> str:
         """Per-op traced communication volume (per compiled step)."""
         lines = [f"{'Comm op':<28}{'Axis':<22}{'Count':<8}{'Total traced':<16}{'Max msg':<12}"]

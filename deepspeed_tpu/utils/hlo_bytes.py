@@ -94,6 +94,34 @@ def total_bytes(acct: Dict[Tuple[str, str], float],
     return sum(v for (k, _), v in acct.items() if k in kinds)
 
 
+def program_costs(compiled) -> Dict[str, float]:
+    """Full cost picture of a compiled executable.
+
+    Combines XLA's cost analysis (flops / bytes accessed /
+    transcendentals — the roofline inputs) with this module's
+    collective wire-byte accounting over the compiled HLO text. Any
+    piece that a given jax version can't produce is reported as 0.0
+    rather than raising, so callers can always roofline what they have.
+    """
+    out = {"flops": 0.0, "bytes_accessed": 0.0, "transcendentals": 0.0,
+           "collective_bytes": 0.0}
+    try:
+        cost = compiled.cost_analysis()
+        if isinstance(cost, (list, tuple)):  # older jax wraps in a list
+            cost = cost[0] if cost else {}
+        out["flops"] = float(cost.get("flops", 0.0))
+        out["bytes_accessed"] = float(cost.get("bytes accessed", 0.0))
+        out["transcendentals"] = float(cost.get("transcendentals", 0.0))
+    except Exception:
+        pass
+    try:
+        out["collective_bytes"] = total_bytes(
+            collective_wire_bytes(compiled.as_text()))
+    except Exception:
+        pass
+    return out
+
+
 def quantized_fraction(acct: Dict[Tuple[str, str], float]) -> float:
     """Fraction of collective bytes moved at <=8-bit element width."""
     tot = total_bytes(acct)

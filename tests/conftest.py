@@ -55,7 +55,13 @@ import jax  # noqa: E402
 # jax may already be imported by the interpreter's sitecustomize with the
 # real-TPU platform selected; override before any backend is initialized.
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 8)
+try:
+    jax.config.update("jax_num_cpu_devices", 8)
+except AttributeError:
+    # older jax (<0.5) has no jax_num_cpu_devices option; the
+    # XLA_FLAGS --xla_force_host_platform_device_count=8 set above
+    # provides the 8 simulated devices there
+    pass
 
 import pytest  # noqa: E402
 

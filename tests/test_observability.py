@@ -1,0 +1,452 @@
+"""Unified observability hub (deepspeed_tpu/observability/):
+histogram percentile math, sinks, StepTrace emission from the training
+engine, MFU agreement with bench.py's formula, the stall watchdog, and
+the serving latency snapshot (docs/observability.md)."""
+
+import json
+import time
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import deepspeed_tpu as dstpu
+from deepspeed_tpu.observability import (Histogram, StallWatchdog, StepTrace,
+                                         get_hub, parse_trace_steps,
+                                         reset_hub)
+from deepspeed_tpu.observability.roofline import (detect_peak_tflops, mfu,
+                                                  roofline_summary)
+from deepspeed_tpu.observability.sinks import (JSONLSink, PrometheusTextSink,
+                                               prometheus_name,
+                                               render_prometheus)
+
+
+@pytest.fixture(autouse=True)
+def _fresh_hub():
+    reset_hub()
+    yield
+    reset_hub()
+
+
+# ---------------------------------------------------------------------------
+# histogram percentile math
+# ---------------------------------------------------------------------------
+
+class TestHistogram:
+    def test_percentiles_uniform(self):
+        h = Histogram("t")
+        for v in np.linspace(0.01, 1.0, 1000):
+            h.observe(float(v))
+        # geometric buckets: interpolation is approximate but bounded by
+        # the bucket growth factor (15%)
+        assert h.percentile(50) == pytest.approx(0.5, rel=0.15)
+        assert h.percentile(95) == pytest.approx(0.95, rel=0.15)
+        assert h.percentile(99) == pytest.approx(0.99, rel=0.15)
+
+    def test_single_value_degenerates_to_it(self):
+        h = Histogram("t")
+        h.observe(0.25)
+        for p in (50, 95, 99):
+            assert h.percentile(p) == pytest.approx(0.25, rel=1e-6)
+
+    def test_min_max_tighten_percentiles(self):
+        h = Histogram("t")
+        for v in (0.30, 0.31, 0.32):
+            h.observe(v)
+        # all three fall near one bucket; observed min/max clamp the
+        # interpolation so p99 can't exceed the true max
+        assert h.percentile(99) <= 0.32 + 1e-9
+        assert h.percentile(1) >= 0.30 - 1e-9
+
+    def test_snapshot_fields(self):
+        h = Histogram("t")
+        for v in (1.0, 2.0, 3.0):
+            h.observe(v)
+        s = h.snapshot()
+        assert s["count"] == 3
+        assert s["sum"] == pytest.approx(6.0)
+        assert s["mean"] == pytest.approx(2.0)
+        assert s["min"] == 1.0 and s["max"] == 3.0
+        assert set(s) >= {"p50", "p95", "p99"}
+
+    def test_ignores_junk(self):
+        h = Histogram("t")
+        h.observe(float("nan"))
+        h.observe(float("inf"))
+        h.observe(-1.0)
+        assert h.snapshot()["count"] == 0
+
+    def test_prometheus_lines_cumulative(self):
+        h = Histogram("t")
+        for v in (0.01, 0.1, 1.0):
+            h.observe(v)
+        lines = h.prometheus_lines("x_seconds")
+        inf_line = [l for l in lines if 'le="+Inf"' in l]
+        assert inf_line and inf_line[0].endswith(" 3")
+        counts = [int(l.rsplit(" ", 1)[1]) for l in lines if "_bucket" in l]
+        assert counts == sorted(counts)  # cumulative
+
+
+# ---------------------------------------------------------------------------
+# sinks
+# ---------------------------------------------------------------------------
+
+class TestSinks:
+    def test_jsonl_roundtrip(self, tmp_path):
+        p = str(tmp_path / "m.jsonl")
+        s = JSONLSink(p)
+        s.write({"kind": "x", "v": 1.5, "arr": np.float32(2.5)})
+        s.write({"kind": "y"})
+        rows = [json.loads(l) for l in open(p)]
+        assert rows[0] == {"kind": "x", "v": 1.5, "arr": 2.5}
+        assert rows[1]["kind"] == "y"
+
+    def test_prometheus_text_sink_atomic(self, tmp_path):
+        p = str(tmp_path / "m.prom")
+        PrometheusTextSink(p).write_text("a 1\n")
+        assert open(p).read() == "a 1\n"
+
+    def test_prometheus_name_sanitization(self):
+        assert prometheus_name("train.step_seconds") == \
+            "dstpu_train_step_seconds"
+        assert prometheus_name("serve.p99-weird name") == \
+            "dstpu_serve_p99_weird_name"
+
+    def test_render_prometheus(self):
+        h = Histogram("lat")
+        h.observe(0.5)
+        text = render_prometheus({"g.x": 1.0}, {"c.y": 2.0}, {"lat": h},
+                                 {"fb": {"reason a": 3.0}})
+        assert "dstpu_g_x 1" in text
+        assert "dstpu_c_y_total 2" in text
+        assert 'dstpu_fb_total{name="reason a"} 3' in text
+        assert "dstpu_lat_bucket" in text and "dstpu_lat_count 1" in text
+
+    def test_parse_trace_steps(self):
+        assert parse_trace_steps("5:8") == (5, 8)
+        assert parse_trace_steps("12") == (12, 12)
+        assert parse_trace_steps("") is None
+        assert parse_trace_steps("8:5") is None
+        assert parse_trace_steps("abc") is None
+
+
+# ---------------------------------------------------------------------------
+# hub + engine StepTrace emission
+# ---------------------------------------------------------------------------
+
+TINY_CFG = {
+    "train_micro_batch_size_per_chip": 2,
+    "gradient_accumulation_steps": 1,
+    "optimizer": {"type": "adamw", "params": {"lr": 1e-2}},
+    "zero_optimization": {"stage": 1},
+    "steps_per_print": 1000,
+}
+
+
+def _tiny_engine(extra=None, **kw):
+    from deepspeed_tpu.models.transformer import (TransformerConfig,
+                                                  TransformerLM)
+
+    cfg = dict(TINY_CFG)
+    if extra:
+        cfg.update(extra)
+    model = TransformerLM(TransformerConfig(
+        vocab_size=64, hidden_size=32, num_layers=2, num_heads=4,
+        max_seq_len=32, pos_emb="learned", norm="layernorm",
+        activation="gelu", tie_embeddings=True, remat=False))
+    engine, *_ = dstpu.initialize(model=model, config=cfg, **kw)
+    return engine
+
+
+def _data_iter(batch, seq=16, vocab=64):
+    rng = np.random.default_rng(0)
+    fixed = {"input_ids": rng.integers(0, vocab,
+                                       (batch, seq + 1)).astype(np.int32)}
+    while True:
+        yield fixed
+
+
+class TestStepTraceEmission:
+    def test_engine_emits_step_traces(self, devices, tmp_path):
+        import os
+
+        jsonl = str(tmp_path / "steps.jsonl")
+        engine = _tiny_engine(extra={"observability": {
+            "jsonl_path": jsonl,
+            "prometheus_path": str(tmp_path / "m.prom"),
+            "prometheus_every_steps": 2}})
+        it = _data_iter(engine.micro_batch_size * engine.dp_world_size)
+        for _ in range(4):
+            engine.train_batch(it)
+
+        hub = get_hub()
+        assert len(hub.step_history) == 4
+        last = hub.step_history[-1]
+        assert last.step == 4
+        assert last.wall_ms > 0
+        assert last.loss is not None and last.loss > 0
+        assert last.tokens == engine.train_batch_size * 16
+        assert last.tokens_per_sec > 0
+        assert last.mfu is not None and last.mfu > 0
+        assert last.mfu_source == "model"
+        snap = hub.snapshot()
+        assert snap["gauges"]["train.step"] == 4
+        assert snap["counters"]["train.steps"] == 4.0
+        # JSONL sink got one row per step
+        rows = [json.loads(l) for l in open(jsonl)]
+        steps = [r["step"] for r in rows if r["kind"] == "step_trace"]
+        assert steps == [1, 2, 3, 4]
+        # Prometheus snapshot was rewritten on the cadence
+        prom = open(str(tmp_path / "m.prom")).read()
+        assert "dstpu_train_step_seconds" in prom
+        assert "dstpu_train_steps_total 4" in prom
+        assert os.path.exists(jsonl)
+
+    def test_mfu_agrees_with_bench_formula(self, devices, monkeypatch):
+        """The engine's per-step MFU must agree with bench.py's
+        window-level computation (same formula, same peak table) within
+        2% when both measure the same steady steps."""
+        monkeypatch.setenv("BENCH_PEAK_TFLOPS", "1.0")
+        # bigger-than-tiny steps: the residual between the two measures
+        # is a fixed per-step slice of host time outside the step timer,
+        # so longer steps amortize it under the 2% bar
+        engine = _tiny_engine(extra={"train_micro_batch_size_per_chip": 8})
+        seq = 31
+        it = _data_iter(engine.micro_batch_size * engine.dp_world_size,
+                        seq=seq)
+        # two warmup steps: the first compiles; the second retraces once
+        # (step_count weak-type settles) — bench.py's warmup absorbs the
+        # same thing
+        engine.train_batch(it)
+        engine.train_batch(it)
+
+        steps = 6
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            loss = engine.train_batch(it)
+        jax.block_until_ready(loss)
+        dt = time.perf_counter() - t0
+
+        # bench.py's computation over the same window
+        n_chips = len(jax.devices())
+        tokens_per_window = engine.train_batch_size * seq * steps
+        tok_per_sec_chip = tokens_per_window / dt / n_chips
+        peak = detect_peak_tflops(jax.devices()[0])
+        bench_mfu = mfu(tok_per_sec_chip,
+                        engine.model.flops_per_token(), peak)
+
+        engine_mfu = engine.hub.window_mfu(last_n=steps)
+        assert engine_mfu is not None
+        # identical formula + peak table; the residual is only the
+        # between-step host time that falls outside the step timers
+        assert engine_mfu == pytest.approx(bench_mfu, rel=0.02), \
+            (engine_mfu, bench_mfu)
+
+    def test_comm_deltas_and_roofline(self, devices):
+        engine = _tiny_engine()
+        it = _data_iter(engine.micro_batch_size * engine.dp_world_size)
+        engine.train_batch(it)
+        summary = engine.roofline()
+        assert summary["flops"] > 0
+        assert summary["bytes_accessed"] > 0
+        assert summary["bound"] in ("compute", "memory")
+        assert summary["arithmetic_intensity"] > 0
+        # second call reuses the cached cost analysis
+        assert engine.roofline()["flops"] == summary["flops"]
+
+
+# ---------------------------------------------------------------------------
+# stall watchdog
+# ---------------------------------------------------------------------------
+
+class TestWatchdog:
+    def test_slow_step_flagged_and_baseline_unpoisoned(self):
+        wd = StallWatchdog(factor=3.0, min_seconds=0.0, warmup_steps=3,
+                           enabled=True)
+        for _ in range(5):
+            assert not wd.observe(0.1)
+        assert wd.observe(1.0)  # 10x the mean
+        assert wd.slow_steps == 1
+        # the flagged step must not enter the rolling mean
+        assert wd.rolling_mean() == pytest.approx(0.1)
+
+    def test_stall_fires_report_with_stacks(self):
+        reports = []
+        wd = StallWatchdog(factor=1.0, min_seconds=0.05, warmup_steps=2,
+                           enabled=True, report_fn=reports.append)
+        for _ in range(3):
+            wd.observe(0.01)
+        wd.arm(step=7)
+        deadline = time.time() + 5.0
+        while wd.stalls == 0 and time.time() < deadline:
+            time.sleep(0.01)
+        wd.disarm()
+        wd.stop()
+        assert wd.stalls == 1
+        assert len(reports) == 1
+        assert "STALL WATCHDOG" in reports[0]
+        assert "python stacks:" in reports[0]
+        assert "step 7" in reports[0]
+
+    def test_disarm_prevents_report(self):
+        wd = StallWatchdog(factor=1.0, min_seconds=0.05, warmup_steps=2,
+                           enabled=True)
+        for _ in range(3):
+            wd.observe(0.01)
+        wd.arm(step=1)
+        wd.disarm()
+        time.sleep(0.2)
+        wd.stop()
+        assert wd.stalls == 0
+
+    def test_env_disable(self, monkeypatch):
+        monkeypatch.setenv("DSTPU_WATCHDOG", "0")
+        wd = StallWatchdog.from_config(None)
+        assert not wd.enabled
+        assert not wd.observe(100.0)
+
+    def test_no_trigger_before_warmup(self):
+        wd = StallWatchdog(factor=2.0, min_seconds=0.0, warmup_steps=5)
+        assert wd.threshold() is None
+        assert not wd.observe(99.0)  # no baseline yet -> not flagged
+
+
+# ---------------------------------------------------------------------------
+# roofline math
+# ---------------------------------------------------------------------------
+
+class TestRoofline:
+    def test_bound_classification(self):
+        # intensity 2000 >> any ridge -> compute bound at peak
+        s = roofline_summary({"flops": 2e12, "bytes_accessed": 1e9},
+                             peak_tflops=100.0, hbm_gbps=1000.0)
+        assert s["bound"] == "compute"
+        assert s["attainable_tflops"] == 100.0
+        # intensity 1 << ridge -> memory bound, attainable = bw * AI
+        s = roofline_summary({"flops": 1e9, "bytes_accessed": 1e9},
+                             peak_tflops=100.0, hbm_gbps=1000.0)
+        assert s["bound"] == "memory"
+        assert s["attainable_tflops"] == pytest.approx(1.0)
+
+    def test_achieved_with_step_time(self):
+        s = roofline_summary({"flops": 1e12, "bytes_accessed": 1e9},
+                             peak_tflops=100.0, hbm_gbps=1000.0,
+                             step_seconds=1.0)
+        assert s["achieved_tflops"] == pytest.approx(1.0)
+        assert s["hw_flops_utilization"] == pytest.approx(0.01)
+
+
+# ---------------------------------------------------------------------------
+# serving latency snapshot (engine_v2 on a single-device mesh — the
+# multi-device kernel path needs jax.shard_map, absent in older jax)
+# ---------------------------------------------------------------------------
+
+class TestServingSnapshot:
+    def test_snapshot_percentiles_and_queue(self, devices):
+        from deepspeed_tpu.inference.engine_v2 import InferenceEngineV2
+        from deepspeed_tpu.models.zoo import get_model
+        from deepspeed_tpu.parallel.topology import (TopologyConfig,
+                                                     build_mesh)
+
+        mesh = build_mesh(TopologyConfig(), devices=jax.devices()[:1])
+        model = get_model("tiny", dtype=jnp.float32,
+                          param_dtype=jnp.float32)
+        eng = InferenceEngineV2(model, mesh=mesh, kv_blocks=64,
+                                kv_block_size=8, max_tokens_per_step=32,
+                                max_seqs_per_step=4, max_blocks_per_seq=8,
+                                dtype=jnp.float32)
+        rng = np.random.default_rng(0)
+        eng.put([1, 2, 3], [rng.integers(0, 64, n) for n in (5, 9, 3)],
+                max_new_tokens=6)
+        snap_live = eng.snapshot()
+        assert snap_live["queue_depth"] == 3
+        assert snap_live["pending_prefill_tokens"] == 17
+
+        out = eng.generate_all()
+        assert {len(v) for v in out.values()} == {6}
+
+        snap = eng.snapshot()
+        ttft = snap["ttft"]
+        assert ttft["count"] == 3
+        for p in ("p50", "p95", "p99"):
+            assert ttft[p] > 0
+        dec = snap["decode_token_latency"]
+        assert dec["count"] == sum(len(v) for v in out.values()) - 3
+        assert 0 < dec["p50"] <= dec["p95"] <= dec["p99"]
+        assert snap["queue_depth"] == 0
+        assert snap["kv_free_blocks"] > 0
+        assert snap["scheduler"]["steps"] > 0
+        assert snap["scheduler"]["prefill_tokens"] == 17
+        if "burst_efficiency" in snap:
+            assert 0 < snap["burst_efficiency"] <= 1.0
+        # serving histograms render on the shared hub's Prometheus page
+        prom = get_hub().to_prometheus()
+        assert "dstpu_serve_ttft_seconds" in prom
+        assert "dstpu_serve_queue_depth" in prom
+
+    def test_ttft_vs_decode_separation(self):
+        """First token records TTFT; later tokens record decode gaps."""
+        from deepspeed_tpu.observability.histogram import Histogram
+
+        class _Eng:
+            # borrow the real method without building an engine
+            _note_emitted = __import__(
+                "deepspeed_tpu.inference.engine_v2",
+                fromlist=["InferenceEngineV2"],
+            ).InferenceEngineV2._note_emitted
+
+        e = _Eng()
+        e._hub = get_hub()
+        e._ttft_hist = Histogram("ttft")
+        e._decode_hist = Histogram("decode")
+        e._admit_time = {1: 100.0}
+        e._last_emit_time = {}
+        e._note_emitted(1, 1, now=100.5)       # first token: TTFT 0.5s
+        e._note_emitted(1, 1, now=100.7)       # decode gap 0.2s
+        e._note_emitted(1, 2, now=101.1)       # burst: 2 tokens over 0.4s
+        assert e._ttft_hist.snapshot()["count"] == 1
+        assert e._ttft_hist.snapshot()["max"] == pytest.approx(0.5,
+                                                               rel=0.01)
+        d = e._decode_hist.snapshot()
+        assert d["count"] == 3
+        assert d["max"] == pytest.approx(0.2, rel=0.02)
+
+
+# ---------------------------------------------------------------------------
+# hub primitives
+# ---------------------------------------------------------------------------
+
+class TestHub:
+    def test_counters_and_gauges(self):
+        hub = get_hub()
+        hub.gauge("x", 1.5)
+        hub.counter_add("y", 2)
+        hub.counter_add("y")
+        snap = hub.snapshot()
+        assert snap["gauges"]["x"] == 1.5
+        assert snap["counters"]["y"] == 3.0
+
+    def test_record_step_updates_everything(self):
+        hub = get_hub()
+        hub.record_step(StepTrace(step=1, wall_ms=100.0, tokens=32,
+                                  loss=2.0, mfu=0.5))
+        hub.record_step(StepTrace(step=2, wall_ms=200.0, tokens=32,
+                                  loss=1.0, mfu=0.3))
+        snap = hub.snapshot()
+        assert snap["gauges"]["train.loss"] == 1.0
+        assert snap["counters"]["train.tokens"] == 64.0
+        assert snap["histograms"]["train.step_seconds"]["count"] == 2
+        assert hub.mean_mfu() == pytest.approx(0.4)
+        assert hub.mean_mfu(last_n=1) == pytest.approx(0.3)
+
+    def test_telemetry_counters_exported(self):
+        from deepspeed_tpu.utils import telemetry
+
+        telemetry.reset()
+        telemetry.count("some.fallback", "why")
+        text = get_hub().to_prometheus()
+        assert 'dstpu_capability_fallback_total{name="some.fallback"} 1' \
+            in text
+        telemetry.reset()
