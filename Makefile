@@ -14,7 +14,7 @@ NPROC := $(shell nproc)
 XDIST ?= $(shell if [ $(NPROC) -gt 2 ] && python -c "import xdist" 2>/dev/null; then echo "-n $$(( $(NPROC) - 1 )) --dist loadfile"; fi)
 PYTEST ?= python -m pytest
 
-.PHONY: test smoke slow bench
+.PHONY: test smoke slow bench bench-hostgap
 
 smoke:
 	$(PYTEST) tests/ -q -m "not slow" $(XDIST)
@@ -27,3 +27,10 @@ slow:
 
 bench:
 	python bench.py
+
+# A/B the pipelined loop: one blocking run (depth 0) then one pipelined
+# run (depth 2). Compare tokens/s/chip and host_gap_ms across the two
+# JSON lines — the gap is the host overhead dispatch-ahead hides.
+bench-hostgap:
+	BENCH_PIPELINE_DEPTH=0 BENCH_PREFETCH_DEPTH=0 python bench.py
+	BENCH_PIPELINE_DEPTH=2 BENCH_PREFETCH_DEPTH=2 python bench.py

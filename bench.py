@@ -24,7 +24,10 @@ BENCH_ZERO_STAGE, BENCH_REMAT_POLICY, BENCH_PEAK_TFLOPS (defaults to the
 detected chip's bf16 peak), BENCH_WINDOWS / BENCH_MAX_WINDOWS /
 BENCH_LOAD_MAX / BENCH_SPREAD_TARGET (measurement-window controls;
 BENCH_WINDOWS=1 restores the single-sample behavior for slow capacity
-probes).
+probes), BENCH_PIPELINE_DEPTH / BENCH_PREFETCH_DEPTH (pipelined-loop
+dispatch-ahead + input-prefetch depths; 0 restores the blocking loop —
+see docs/performance.md). ``host_gap_ms`` in the JSON is the per-step
+host time on the dispatch critical path, medianed over the kept windows.
 """
 
 from __future__ import annotations
@@ -179,6 +182,13 @@ def main():
             model = get_model(model_name, **overrides)
             config_source = "autotuner"
 
+    # pipelined loop: dispatch-ahead keeps K steps in flight so the host
+    # input pull/stack/transfer overlaps device compute, and the engine
+    # promotes the (repeatedly-passed) data iterator to a background
+    # prefetching iterator (runtime/prefetch.py). Depth 0 restores the
+    # blocking loop for A/B comparison (BENCH_PIPELINE_DEPTH=0).
+    pipeline_depth = int(os.environ.get("BENCH_PIPELINE_DEPTH", "2"))
+    prefetch_depth = int(os.environ.get("BENCH_PREFETCH_DEPTH", "2"))
     config = {
         "train_micro_batch_size_per_chip": micro,
         "gradient_accumulation_steps": gas,
@@ -186,6 +196,8 @@ def main():
                       "params": {"lr": 1e-4, "weight_decay": 0.1}},
         "zero_optimization": {"stage": zero_stage},
         "bf16": {"enabled": True},
+        "performance": {"pipeline_depth": pipeline_depth,
+                        "prefetch_depth": prefetch_depth},
         "steps_per_print": 1_000_000,
     }
     offload = int(os.environ.get("BENCH_OFFLOAD", "0"))
@@ -225,6 +237,7 @@ def main():
     data = it()
     for _ in range(warmup):
         loss = engine.train_batch(data)
+    engine.synchronize()  # drain the dispatch-ahead window before timing
     jax.block_until_ready(loss)
 
     # Median-of-k measurement with a host-contention sentinel. This repo
@@ -253,15 +266,22 @@ def main():
         t0 = time.perf_counter()
         for _ in range(steps):
             loss = engine.train_batch(data)
+        engine.synchronize()  # window ends when every in-flight step lands
         jax.block_until_ready(loss)
         dt = time.perf_counter() - t0
         load = min(load0, loadavg()) if load0 >= 0 else load0
         # the engine's own per-step MFU over exactly this window's steps
         # (observability hub StepTrace rows) — same formula + peak table,
         # timed per step instead of per window
-        emfu = (engine.hub.window_mfu(last_n=steps)
-                if getattr(engine, "hub", None) is not None else None)
-        return tokens_per_window / dt / n_chips, load, loss, emfu
+        hub = getattr(engine, "hub", None)
+        emfu = hub.window_mfu(last_n=steps) if hub is not None else None
+        # host time on the dispatch critical path per step (input pull +
+        # stack + transfer + jit-call overhead) — the cost the pipelined
+        # loop hides; a regression here shows up even when device math
+        # still dominates the wall clock
+        hgap = (hub.window_host_gap_ms(last_n=steps)
+                if hub is not None else None)
+        return tokens_per_window / dt / n_chips, load, loss, emfu, hgap
 
     # capacity-probe runs (BENCH_STEPS=1 on host-optimizer shapes where a
     # step takes minutes) default to one window; normal runs take three
@@ -272,10 +292,10 @@ def main():
     load_max = float(os.environ.get("BENCH_LOAD_MAX", "2.0"))
     spread_target = float(os.environ.get("BENCH_SPREAD_TARGET", "0.05"))
 
-    windows = []  # (tok/s/chip, loadavg, engine-window-mfu)
+    windows = []  # (tok/s/chip, loadavg, engine-window-mfu, host-gap-ms)
     for _ in range(n_windows):
-        tps, load, loss, emfu = measure_window()
-        windows.append((tps, load, emfu))
+        tps, load, loss, emfu, hgap = measure_window()
+        windows.append((tps, load, emfu, hgap))
     # resample while spread is wide and budget remains — one contended
     # window out of three still skews the median less than it skews a
     # single-sample mean, and extra clean windows dilute it further.
@@ -295,18 +315,22 @@ def main():
         vals = [w[0] for w in ordered]
         med = statistics.median(vals)
         spread = (max(vals) - min(vals)) / med if med > 0 else 0.0
-        # engine MFU through the SAME window selection, so a contended
-        # window dropped from the throughput median is dropped here too
+        # engine MFU + host gap through the SAME window selection, so a
+        # contended window dropped from the throughput median is dropped
+        # from these medians too
         emfus = [w[2] for w in ordered if w[2] is not None]
         emfu_med = statistics.median(emfus) if emfus else None
-        return kept, med, spread, trimmed, emfu_med
+        hgaps = [w[3] for w in ordered if w[3] is not None]
+        hgap_med = statistics.median(hgaps) if hgaps else None
+        return kept, med, spread, trimmed, emfu_med, hgap_med
 
-    kept, med, spread, trimmed, engine_mfu = kept_and_spread()
+    kept, med, spread, trimmed, engine_mfu, host_gap_ms = kept_and_spread()
     while (len(windows) < max_windows
            and (spread > spread_target or len(kept) < min(3, n_windows))):
-        tps, load, loss, emfu = measure_window()
-        windows.append((tps, load, emfu))
-        kept, med, spread, trimmed, engine_mfu = kept_and_spread()
+        tps, load, loss, emfu, hgap = measure_window()
+        windows.append((tps, load, emfu, hgap))
+        kept, med, spread, trimmed, engine_mfu, host_gap_ms = \
+            kept_and_spread()
 
     tok_per_sec_chip = med
     contended = len(kept) < len(windows) or any(
@@ -337,6 +361,9 @@ def main():
         "mfu": round(mfu, 4),
         "engine_mfu": (round(engine_mfu, 4)
                        if engine_mfu is not None else None),
+        "host_gap_ms": (round(host_gap_ms, 3)
+                        if host_gap_ms is not None else None),
+        "pipeline_depth": pipeline_depth,
         "spread_pct": round(100.0 * spread, 2),
         "windows": [round(w[0], 1) for w in windows],
         "load_avg": [round(w[1], 2) for w in windows],

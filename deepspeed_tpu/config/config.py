@@ -359,6 +359,35 @@ class WatchdogConfig(ConfigModel):
 
 @register_config_model
 @dataclass
+class PerformanceConfig(ConfigModel):
+    """Pipelined training loop (docs/performance.md).
+
+    ``pipeline_depth`` is the number of dispatched-but-unresolved
+    ``train_batch`` steps the engine may keep in flight before blocking
+    (dispatch-ahead): 0 = fully synchronous — the debugging default,
+    where every per-step host read happens inside its own step. The
+    ``DSTPU_DISPATCH_AHEAD`` env var overrides it. ``prefetch_depth``
+    bounds the background input-prefetch buffer
+    (runtime/prefetch.py PrefetchingIterator); 0 disables prefetch, and
+    multi-process runs fall back to synchronous input assembly
+    regardless."""
+
+    pipeline_depth: int = 0
+    prefetch_depth: int = 2
+
+    def validate(self) -> None:
+        if self.pipeline_depth < 0:
+            raise ValueError(
+                f"performance.pipeline_depth must be >= 0, got "
+                f"{self.pipeline_depth}")
+        if self.prefetch_depth < 0:
+            raise ValueError(
+                f"performance.prefetch_depth must be >= 0, got "
+                f"{self.prefetch_depth}")
+
+
+@register_config_model
+@dataclass
 class ObservabilityConfig(ConfigModel):
     """Unified observability hub (observability/hub.py). Per-step
     StepTrace rows (wall time, loss, tokens/s, MFU, comm deltas,
@@ -493,6 +522,7 @@ class Config(ConfigModel):
     monitor: MonitorConfig = field(default_factory=MonitorConfig)
     flops_profiler: FlopsProfilerConfig = field(default_factory=FlopsProfilerConfig)
     observability: ObservabilityConfig = field(default_factory=ObservabilityConfig)
+    performance: PerformanceConfig = field(default_factory=PerformanceConfig)
     sparse_attention: Optional[SparseAttentionConfig] = None
     checkpoint: CheckpointConfig = field(default_factory=CheckpointConfig)
     compile: CompileConfig = field(default_factory=CompileConfig)
@@ -514,6 +544,7 @@ class Config(ConfigModel):
             "activation_checkpointing": ActivationCheckpointingConfig,
             "comms_logger": CommsLoggerConfig, "flops_profiler": FlopsProfilerConfig,
             "observability": ObservabilityConfig,
+            "performance": PerformanceConfig,
             "checkpoint": CheckpointConfig, "compile": CompileConfig,
             "data_efficiency": DataEfficiencyConfig,
         }

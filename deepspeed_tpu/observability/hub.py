@@ -157,7 +157,8 @@ class MetricsHub:
                               ("train.tokens_per_sec", trace.tokens_per_sec),
                               ("train.tokens_per_sec_per_chip",
                                trace.tokens_per_sec_per_chip),
-                              ("train.mfu", trace.mfu)):
+                              ("train.mfu", trace.mfu),
+                              ("train.host_gap_ms", trace.host_gap_ms)):
                 if val is not None:
                     self.gauges[name] = float(val)
             self.counters["train.steps"] = \
@@ -217,6 +218,21 @@ class MetricsHub:
 
         return _mfu(total_tokens / total_s / max(1, last.n_chips),
                     last.flops_per_token, last.peak_tflops)
+
+    def window_host_gap_ms(self, last_n: int = 0) -> Optional[float]:
+        """Mean host-side gap per step over the most recent ``last_n``
+        traced steps (all history when 0) — the per-window aggregate
+        bench.py reports next to tokens/s/chip so host-overhead
+        regressions are visible in every BENCH artifact. None when no
+        step in the window carried the measurement."""
+        with self._lock:
+            rows = list(self.step_history)
+        if last_n > 0:
+            rows = rows[-last_n:]
+        vals = [t.host_gap_ms for t in rows if t.host_gap_ms is not None]
+        if not vals:
+            return None
+        return sum(vals) / len(vals)
 
     def snapshot(self) -> Dict[str, Any]:
         from deepspeed_tpu.utils import telemetry

@@ -40,6 +40,15 @@ class StepTrace:
     mfu_source: Optional[str] = None
     flops_per_token: Optional[float] = None
     peak_tflops: Optional[float] = None
+    # host-side time this step spent on the device critical path before
+    # dispatch (input pull/stack/transfer + jit call overhead). Under the
+    # pipelined loop (performance.pipeline_depth) this is the overhead
+    # the dispatch-ahead window hides; bench.py aggregates it per window
+    # as ``host_gap_ms``
+    host_gap_ms: Optional[float] = None
+    # dispatched-but-unresolved steps in flight when this step resolved
+    # (0 = blocking loop)
+    inflight: int = 0
     # compile/retrace activity observed since the previous step (a
     # nonzero value mid-run is the classic silent-regression smell)
     compile_events: int = 0

@@ -102,14 +102,19 @@ class StallWatchdog:
         return slow
 
     # -- hang detection (armed window + daemon thread) ----------------
-    def arm(self, step: Optional[int] = None) -> None:
+    def arm(self, step: Optional[int] = None, window: int = 1) -> None:
+        """Arm a deadline for ``step``. ``window`` scales the deadline to
+        cover a dispatch-ahead in-flight window: with K unresolved steps
+        queued behind ``step`` the pipelined engine arms the OLDEST one
+        with window=K, so the deadline budgets K steps of device work
+        instead of flagging a healthy full pipeline as a stall."""
         if not self.enabled:
             return
         thr = self.threshold()
         if thr is None:
             return  # not enough history yet
         with self._lock:
-            self._deadline = time.monotonic() + thr
+            self._deadline = time.monotonic() + thr * max(1, int(window))
             self._armed_step = step
             self._fired = False
         self._ensure_thread()

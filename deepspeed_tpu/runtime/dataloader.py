@@ -14,6 +14,8 @@ from typing import Any, Callable, Iterable, Iterator, Optional, Sequence
 
 import numpy as np
 
+from deepspeed_tpu.utils.logging import logger
+
 
 def default_collate(samples: Sequence[Any]):
     """Stack a list of sample pytrees into a batch pytree."""
@@ -46,6 +48,7 @@ class DeepSpeedDataLoader:
         self._epoch = 0
         self._num_procs = jax.process_count()
         self._proc_id = jax.process_index()
+        self._warned_stream_shuffle = False
         try:
             self._len = len(dataset)
         except TypeError:
@@ -65,6 +68,13 @@ class DeepSpeedDataLoader:
 
     def __iter__(self) -> Iterator:
         if self._len is None:
+            if self.shuffle and not self._warned_stream_shuffle:
+                self._warned_stream_shuffle = True
+                logger.warning(
+                    "shuffle=True is ignored for a length-less iterable "
+                    "dataset: samples stream in the order the dataset "
+                    "yields them (shuffle inside the dataset, or provide "
+                    "__len__ + __getitem__ for index shuffling)")
             return self._iter_stream()
         return self._iter_indexed()
 
@@ -113,4 +123,13 @@ class RepeatingLoader:
             if hasattr(self.loader, "set_epoch"):
                 self.loader.set_epoch(self._epoch)
             self.data_iter = iter(self.loader)
-            return next(self.data_iter)
+            try:
+                return next(self.data_iter)
+            except StopIteration:
+                # a restart that immediately exhausts means the wrapped
+                # loader yields nothing — restarting again would spin
+                # forever, so fail loudly instead
+                raise ValueError(
+                    "RepeatingLoader: loader produced no batches (the "
+                    "wrapped loader's iterator was empty after a "
+                    "restart)") from None

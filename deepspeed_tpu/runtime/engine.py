@@ -27,6 +27,8 @@ GAS boundary compiles into ONE XLA program:
 from __future__ import annotations
 
 import os
+import time
+from collections import deque
 from functools import partial
 from typing import Any, Callable, Dict, Iterable, Optional, Tuple
 
@@ -46,6 +48,7 @@ from deepspeed_tpu.runtime.lr_schedules import get_lr_schedule
 from deepspeed_tpu.runtime.optimizer import (
     MixedPrecisionState, apply_mixed_precision_update, get_base_optimizer,
     init_mixed_precision)
+from deepspeed_tpu.runtime.prefetch import PrefetchingIterator
 from deepspeed_tpu.utils.logging import log_dist, logger
 from deepspeed_tpu.utils.timer import (
     BACKWARD_GLOBAL_TIMER, FORWARD_GLOBAL_TIMER, STEP_GLOBAL_TIMER,
@@ -113,6 +116,27 @@ class _FnModel:
         return jax.tree.map(lambda p: tuple("embed" if i == 0 else None
                                             for i in range(jnp.ndim(p))),
                             self._params)
+
+
+class _InflightStep:
+    """One dispatched-but-unresolved train step (dispatch-ahead window):
+    the async metrics plus everything the deferred host reads need —
+    snapshotted at dispatch so drain-time logging reports the step's own
+    numbers, not the engine's current ones."""
+
+    __slots__ = ("step", "metrics", "struct", "samples", "host_ms",
+                 "dispatch_t", "host_t0", "sync")
+
+    def __init__(self, step, metrics, struct, samples, host_ms,
+                 dispatch_t, host_t0, sync):
+        self.step = step
+        self.metrics = metrics
+        self.struct = struct          # abstract batch (shapes/dtypes)
+        self.samples = samples        # global_samples after this step
+        self.host_ms = host_ms        # host time from entry to dispatch
+        self.dispatch_t = dispatch_t  # perf_counter at dispatch return
+        self.host_t0 = host_t0        # perf_counter at train_batch entry
+        self.sync = sync              # dispatched under the blocking loop
 
 
 class Engine:
@@ -361,6 +385,25 @@ class Engine:
         self.skipped_steps = 0
         self._pending = None  # (loss, grads) between forward() and backward()
         self._grad_acc = None  # accumulation buffer for the micro-step path
+
+        # -- pipelined loop (performance block; docs/performance.md) ------
+        # dispatch-ahead: up to pipeline_depth steps stay in flight; the
+        # deferred host reads run when each step drains. 0 = the blocking
+        # loop. DSTPU_DISPATCH_AHEAD env beats the config block.
+        perf = getattr(config, "performance", None)
+        env_depth = os.environ.get("DSTPU_DISPATCH_AHEAD", "")
+        self._dispatch_ahead = (int(env_depth) if env_depth != ""
+                                else int(getattr(perf, "pipeline_depth", 0)
+                                         or 0))
+        self._prefetch_depth = int(getattr(perf, "prefetch_depth", 0) or 0)
+        self._inflight: deque = deque()  # _InflightStep, oldest first
+        self._prefetcher = None       # PrefetchingIterator over data_iter
+        self._prefetch_source = None  # the data_iter the prefetcher owns
+        self._last_drain_t = None     # perf_counter at the previous drain
+        if self._dispatch_ahead > 0:
+            log_dist(f"pipelined loop: dispatch-ahead depth "
+                     f"{self._dispatch_ahead}, input prefetch depth "
+                     f"{self._prefetch_depth}", ranks=[0])
 
         # -- curriculum learning (reference engine curriculum_learning
         # config + set_custom_curriculum_learning_schedule) ---------------
@@ -901,45 +944,172 @@ class Engine:
 
     def _next_microbatches(self, data_iter, n: int):
         out = []
-        for _ in range(n):
-            out.append(next(data_iter))
+        for i in range(n):
+            try:
+                out.append(next(data_iter))
+            except StopIteration:
+                if i == 0:
+                    raise  # clean end-of-data at a boundary
+                raise RuntimeError(
+                    f"data iterator exhausted mid-gradient-accumulation "
+                    f"(got {i} of {n} microbatches): wrap the loader in "
+                    "deepspeed_tpu.runtime.dataloader.RepeatingLoader so "
+                    "epochs restart at the boundary") from None
         stacked = jax.tree.map(lambda *xs: np.stack(xs), *out)
         return self.shard_batch(stacked, leading_dims=2)
+
+    def _next_batches(self, data_iter):
+        """Stacked+sharded microbatches for one boundary, routed through
+        the background prefetcher when the caller is streaming.
+
+        Promotion heuristic: the first time an iterator is seen it is
+        pulled synchronously (a one-shot ``iter([batch])`` must not be
+        consumed ahead of the caller); passing the SAME iterator again
+        means the caller treats it as a stream, so it is handed to a
+        :class:`PrefetchingIterator` whose worker pulls/stacks/transfers
+        the next boundaries while the current step computes. Multi-host
+        runs stay synchronous (cross-host transfer issue order)."""
+        gas = self.gradient_accumulation_steps
+        if self._prefetch_depth <= 0 or jax.process_count() > 1:
+            return self._next_microbatches(data_iter, gas)
+        if data_iter is self._prefetch_source:
+            if self._prefetcher is None:
+                self._prefetcher = PrefetchingIterator(
+                    lambda: self._next_microbatches(data_iter, gas),
+                    depth=self._prefetch_depth, name="train-input")
+            return next(self._prefetcher)
+        if self._prefetcher is not None:
+            self._prefetcher.close()
+            self._prefetcher = None
+        self._prefetch_source = data_iter
+        return self._next_microbatches(data_iter, gas)
 
     # ------------------------------------------------------------------
     # reference-parity training API
     # ------------------------------------------------------------------
+    def _effective_depth(self) -> int:
+        """Dispatch-ahead window for the next step. Paths that must read
+        host values inside the step (host-optimizer offload) or that
+        observe engine state step-by-step (post-step hooks) force the
+        blocking loop."""
+        if self._dispatch_ahead <= 0:
+            return 0
+        if self._offload is not None:
+            return 0  # host optimizer reads grads/gnorm synchronously
+        if self._post_step_hooks:
+            return 0  # hooks expect a settled engine after every step
+        return self._dispatch_ahead
+
     def train_batch(self, data_iter=None) -> jax.Array:
         """One full training step (micro × GAS) — the fast path
-        (reference PipelineEngine.train_batch pipe/engine.py:337 naming)."""
+        (reference PipelineEngine.train_batch pipe/engine.py:337 naming).
+
+        With ``performance.pipeline_depth`` K >= 1 the returned loss is
+        an async ``jax.Array``: up to K dispatched steps stay in flight
+        and the per-step host reads (overflow accounting, steps_per_print
+        logging, monitor/hub rows) defer until each step's metrics
+        resolve at drain time, so the host never sits on the device
+        critical path. ``synchronize()`` drains the window. K = 0 is the
+        blocking loop, bit-identical to the pre-pipelined behavior."""
         if data_iter is None:
             if self.training_dataloader is None:
                 raise ValueError("train_batch needs data_iter or training_data")
             data_iter = iter(self.training_dataloader)
-        self.timers(TRAIN_BATCH_TIMER).start()
-        self.tput_timer.start()
-        batches = self._next_microbatches(data_iter,
-                                          self.gradient_accumulation_steps)
+        depth = self._effective_depth()
+        sync = depth == 0
+        host_t0 = time.perf_counter()
+        if sync:
+            self.timers(TRAIN_BATCH_TIMER).start()
+            self.tput_timer.start()
+        batches = self._next_batches(data_iter)
         step_no = self.global_steps + 1
         if self._trace_capture is not None:
             self._trace_capture.on_step_begin(step_no)
-        if self.watchdog is not None:
+        if sync and self.watchdog is not None:
             # armed until the step's results are blocked on below: a
             # wedged collective fires a stack/memory report
             self.watchdog.arm(step_no)
         with topo.use_mesh(self.mesh):
             metrics = self._dispatch_train_step(batches)
-        self._after_step(metrics)
-        self.timers(TRAIN_BATCH_TIMER).stop(block=metrics["loss"])
-        if self._trace_capture is not None:
-            self._trace_capture.on_step_end(step_no)
-        wall_ms = self._last_step_wall_ms()
-        if self.watchdog is not None:
-            self.watchdog.disarm()
-            self.watchdog.observe(wall_ms / 1000.0, step_no)
-        if self.hub is not None:
-            self._emit_step_trace(step_no, metrics, batches, wall_ms)
+        dispatch_t = time.perf_counter()
+        # dispatch-order bookkeeping; the host READS defer to the drain
+        self.global_steps += 1
+        self.global_samples += self.train_batch_size
+        for hook in self._post_step_hooks:
+            hook(self)
+        self._ckpt_io.maybe_commit()
+        self._inflight.append(_InflightStep(
+            step=step_no, metrics=metrics,
+            struct=jax.tree.map(
+                lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype), batches),
+            samples=self.global_samples,
+            host_ms=(dispatch_t - host_t0) * 1000.0,
+            dispatch_t=dispatch_t, host_t0=host_t0, sync=sync))
+        if not sync and self.watchdog is not None:
+            # one deadline budgets the whole in-flight window (the oldest
+            # step's deadline scaled by the window size) — between
+            # train_batch calls the window stays armed, so a wedged
+            # collective inside it still fires a report
+            self.watchdog.arm(self._inflight[0].step,
+                              window=len(self._inflight))
+        while len(self._inflight) > depth:
+            self._drain_one()
         return metrics["loss"]
+
+    def _drain_one(self) -> None:
+        """Resolve the oldest in-flight step: block on its metrics, then
+        run its deferred host reads and emit its trace row."""
+        entry = self._inflight.popleft()
+        metrics = entry.metrics
+        if entry.sync:
+            # blocking path: identical ordering to the classic loop
+            self._after_step_host(metrics, entry.step, entry.samples)
+            self.timers(TRAIN_BATCH_TIMER).stop(block=metrics["loss"])
+            wall_ms = self._last_step_wall_ms()
+            if self._trace_capture is not None:
+                self._trace_capture.on_step_end(entry.step)
+            if self.watchdog is not None:
+                self.watchdog.disarm()
+                self.watchdog.observe(wall_ms / 1000.0, entry.step)
+            self._last_drain_t = time.perf_counter()
+        else:
+            jax.block_until_ready(metrics["loss"])
+            resolved_t = time.perf_counter()
+            # drain-to-drain span ≈ this step's device time once the
+            # pipeline is full; during fill it degrades to dispatch→done
+            base = (entry.host_t0 if self._last_drain_t is None
+                    else max(self._last_drain_t, entry.host_t0))
+            wall_ms = (resolved_t - base) * 1000.0
+            self._last_drain_t = resolved_t
+            self._after_step_host(metrics, entry.step, entry.samples,
+                                  wall_s=wall_ms / 1000.0)
+            self.timers(TRAIN_BATCH_TIMER).record_ms(wall_ms)
+            if self._trace_capture is not None:
+                self._trace_capture.on_step_end(entry.step)
+            if self.watchdog is not None:
+                self.watchdog.observe(wall_ms / 1000.0, entry.step)
+                if self._inflight:
+                    self.watchdog.arm(self._inflight[0].step,
+                                      window=len(self._inflight))
+                else:
+                    self.watchdog.disarm()
+        if self.hub is not None:
+            self._emit_step_trace(entry.step, metrics, entry.struct,
+                                  wall_ms, host_gap_ms=entry.host_ms,
+                                  samples=entry.samples,
+                                  inflight=len(self._inflight))
+
+    def synchronize(self) -> "Engine":
+        """Drain every dispatched-but-unresolved train step (pipeline
+        barrier for the dispatch-ahead loop): blocks until all in-flight
+        metrics resolve and their deferred host reads — overflow/skip
+        counts, logging, monitor and hub rows — have run. The engine
+        calls it at checkpoint/eval/state-export boundaries; call it
+        manually before reading engine counters mid-run or at exit. A
+        no-op under the blocking loop."""
+        while self._inflight:
+            self._drain_one()
+        return self
 
     def _dispatch_train_step(self, batches):
         lr_over = jnp.asarray(
@@ -1183,6 +1353,7 @@ class Engine:
         return metrics
 
     def eval_batch(self, batch):
+        self.synchronize()  # eval boundary: settle the in-flight window
         batch = self.shard_batch(batch)
         with topo.use_mesh(self.mesh):
             loss, _aux = self._jit_eval(self.params, batch)
@@ -1210,6 +1381,8 @@ class Engine:
         return fn
 
     def _after_step(self, metrics):
+        """Synchronous post-step (micro-step ``step()`` path): dispatch
+        bookkeeping plus the host reads in one go."""
         self.global_steps += 1
         self.global_samples += self.train_batch_size
         for hook in self._post_step_hooks:
@@ -1217,28 +1390,42 @@ class Engine:
         # decoupled checkpoint engine: publish a finished async save at the
         # GAS boundary (reference engine.py:3273)
         self._ckpt_io.maybe_commit()
+        self._after_step_host(metrics, self.global_steps,
+                              self.global_samples)
+
+    def _after_step_host(self, metrics, step_no, samples, wall_s=None):
+        """Per-step host reads. Under dispatch-ahead these run at drain
+        time — reading ``overflow`` forces the sync, so deferring them is
+        what keeps the host off the critical path; ``step_no``/``samples``
+        are the step's own snapshots, not the engine's current counters.
+        ``wall_s`` set means the span was measured externally
+        (drain-to-drain) instead of by the throughput timer's start/stop
+        pair."""
         if bool(metrics.get("overflow", False)):
             self.skipped_steps += 1
-        self.tput_timer.stop(global_step=True)
-        if self.global_steps % self.config.steps_per_print == 0:
+        if wall_s is None:
+            self.tput_timer.stop(global_step=True)
+        else:
+            self.tput_timer.record(wall_s)
+        if step_no % self.config.steps_per_print == 0:
             loss = metrics.get("loss")
             loss_s = f"loss={float(loss):.4f}, " if loss is not None else ""
             log_dist(
-                f"step={self.global_steps}, {loss_s}"
+                f"step={step_no}, {loss_s}"
                 f"lr={float(metrics['lr']):.3e}, "
                 f"grad_norm={float(metrics['grad_norm']):.3f}", ranks=[0])
         if self.monitor is not None and self.monitor.enabled:
             events = [("Train/Samples/train_loss",
-                       float(metrics.get("loss", 0.0)), self.global_samples),
+                       float(metrics.get("loss", 0.0)), samples),
                       ("Train/Samples/lr", float(metrics["lr"]),
-                       self.global_samples)]
+                       samples)]
             self.monitor.write_events(events)
         if self.config.wall_clock_breakdown and \
-                self.global_steps % self.config.steps_per_print == 0:
+                step_no % self.config.steps_per_print == 0:
             self.timers.log([FORWARD_GLOBAL_TIMER, BACKWARD_GLOBAL_TIMER,
                              STEP_GLOBAL_TIMER, TRAIN_BATCH_TIMER])
         fp = self.config.flops_profiler
-        if fp.enabled and self.global_steps == fp.profile_step \
+        if fp.enabled and step_no == fp.profile_step \
                 and jax.process_index() == 0:
             # rank 0 only: the profile recompiles the step (lowering is
             # process-local, no collectives run) and writes output_file
@@ -1301,16 +1488,18 @@ class Engine:
                 self._flops_per_token = 0.0
         return self._flops_per_token or None
 
-    def _emit_step_trace(self, step_no, metrics, batches, wall_ms) -> None:
+    def _emit_step_trace(self, step_no, metrics, struct, wall_ms,
+                         host_gap_ms=None, samples=None,
+                         inflight=0) -> None:
         try:
             from deepspeed_tpu.observability import StepTrace
             from deepspeed_tpu.observability import roofline as _rl
             from deepspeed_tpu.utils.memory import device_memory_stats
 
-            self._last_batches_struct = jax.tree.map(
-                lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype), batches)
+            samples = self.global_samples if samples is None else samples
+            self._last_batches_struct = struct
             dt = wall_ms / 1000.0
-            tokens = self._batch_tokens(batches)
+            tokens = self._batch_tokens(struct)
             n_chips = max(1, len(jax.devices()))
             tps = tokens / dt if (tokens and dt > 0) else None
             tps_chip = tps / n_chips if tps else None
@@ -1340,6 +1529,7 @@ class Engine:
                 skipped_steps=self.skipped_steps,
                 mfu=mfu_val, mfu_source="model" if mfu_val else None,
                 flops_per_token=fpt, peak_tflops=peak,
+                host_gap_ms=host_gap_ms, inflight=inflight,
                 compile_events=int(compile_d["events"]),
                 compile_secs=compile_d["secs"],
                 comm_bytes_total=comm_total or None,
@@ -1348,14 +1538,12 @@ class Engine:
             self.hub.record_step(trace)
             if self.monitor is not None and self.monitor.enabled and \
                     step_no % self.config.steps_per_print == 0:
-                events = [("Train/Samples/step_seconds", dt,
-                           self.global_samples)]
+                events = [("Train/Samples/step_seconds", dt, samples)]
                 if tps is not None:
                     events.append(("Train/Samples/tokens_per_sec", tps,
-                                   self.global_samples))
+                                   samples))
                 if mfu_val is not None:
-                    events.append(("Train/Samples/mfu", mfu_val,
-                                   self.global_samples))
+                    events.append(("Train/Samples/mfu", mfu_val, samples))
                 self.monitor.write_events(events)
             if self._roofline_cost is None and step_no >= 2 and (
                     os.environ.get("DSTPU_ROOFLINE", "") == "1"
@@ -1483,6 +1671,7 @@ class Engine:
         """
         if device != "cpu":
             raise ValueError("offload_states supports device='cpu' only")
+        self.synchronize()
         include = set(include or ("lp_params", "optim_states"))
         known = {"lp_params", "hp_params", "optim_states", "lp_grads",
                  "contiguous_grad_buffer"}
@@ -1565,6 +1754,7 @@ class Engine:
     def module_state_dict(self):
         """Host copy of the model parameters (reference
         module_state_dict engine.py:3693): path → np.ndarray."""
+        self.synchronize()
         flat, _ = jax.tree_util.tree_flatten_with_path(self.params)
         out = {}
         for path, leaf in flat:
@@ -1612,6 +1802,9 @@ class Engine:
     # ------------------------------------------------------------------
     def save_checkpoint(self, save_dir, tag=None, client_state=None,
                         save_latest: bool = True):
+        # drain in-flight steps first: the saved counters (global_steps,
+        # skipped_steps) and state must reflect every dispatched step
+        self.synchronize()
         return self._ckpt_io.save(save_dir, tag=tag,
                                   client_state=client_state,
                                   save_latest=save_latest)
@@ -1620,6 +1813,7 @@ class Engine:
                         load_module_strict: bool = True,
                         load_optimizer_states: bool = True,
                         load_lr_scheduler_states: bool = True):
+        self.synchronize()  # in-flight steps must not outlive old state
         out = self._ckpt_io.load(load_dir, tag=tag,
                                  load_optimizer_states=load_optimizer_states)
         if getattr(self, "_param_host_offload", False):

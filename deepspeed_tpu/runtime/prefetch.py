@@ -1,0 +1,178 @@
+"""Asynchronous input prefetch for the training loop.
+
+The blocking loop pays host time on the device critical path every step:
+microbatches are pulled from the data iterator, ``np.stack``-ed, and
+``device_put`` synchronously between two compiled steps, so the TPU
+idles while the host assembles inputs. :class:`PrefetchingIterator`
+moves that work to a background thread: the worker pulls items from a
+producer, runs the collate/stack + host->device transfer off the
+consumer thread, and parks up to ``depth`` finished items in a bounded
+queue. H2D copies then overlap the previous step's compute — JAX's
+async dispatch gives the rest (docs/performance.md; T3/arxiv 2401.16677
+is the same overlap principle applied one level down).
+
+Semantics:
+
+* worker exceptions are re-raised at ``next()`` — an input-pipeline
+  failure surfaces on the training thread, at the step that needed the
+  data, not as a silent worker death;
+* ``StopIteration`` from the producer ends the stream cleanly (each
+  subsequent ``next()`` keeps raising ``StopIteration``);
+* ``close()`` shuts the worker down promptly even when it is blocked on
+  a full buffer, and is idempotent;
+* under multi-process JAX (``jax.process_count() > 1``) the iterator
+  falls back to synchronous production: every process must issue
+  cross-host array assembly in lockstep with its collectives, and a
+  free-running background thread cannot guarantee that ordering.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Any, Callable, Iterator, Optional
+
+from deepspeed_tpu.utils.logging import logger
+
+
+class _EndOfStream:
+    """Queue sentinel: the producer raised StopIteration."""
+
+
+class _WorkerError:
+    """Queue sentinel carrying the exception the producer raised."""
+
+    def __init__(self, exc: BaseException):
+        self.exc = exc
+
+
+class PrefetchingIterator:
+    """Bounded background prefetch over a producer of ready batches.
+
+    ``source`` is either an iterator (``next()`` is the producer) or a
+    zero-arg callable returning the next item and raising
+    ``StopIteration`` when exhausted — the engine passes a callable that
+    pulls ``gas`` microbatches, stacks them, and issues the sharded
+    device transfer, so the whole input assembly runs off-thread.
+    """
+
+    def __init__(self, source, depth: int = 2, name: str = "prefetch",
+                 allow_multiprocess: bool = False):
+        if depth < 0:
+            raise ValueError(f"prefetch depth must be >= 0, got {depth}")
+        if callable(source) and not hasattr(source, "__next__"):
+            self._produce: Callable[[], Any] = source
+        else:
+            it: Iterator = iter(source)
+            self._produce = lambda: next(it)
+        self.depth = depth
+        self.name = name
+        self._closed = False
+        self._finished = False
+        self._sync = depth == 0
+        if not self._sync and not allow_multiprocess:
+            try:
+                import jax
+
+                if jax.process_count() > 1:
+                    logger.warning(
+                        f"{name}: multi-process run — input prefetch "
+                        "falls back to the synchronous path (background "
+                        "transfers cannot guarantee cross-host issue "
+                        "order)")
+                    self._sync = True
+            except Exception:
+                pass
+        self._queue: Optional[queue.Queue] = None
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        if not self._sync:
+            self._queue = queue.Queue(maxsize=depth)
+            self._thread = threading.Thread(
+                target=self._worker, name=f"dstpu-{name}", daemon=True)
+            self._thread.start()
+
+    # -- worker --------------------------------------------------------
+    def _worker(self) -> None:
+        while not self._stop.is_set():
+            try:
+                item = self._produce()
+            except StopIteration:
+                self._put(_EndOfStream)
+                return
+            except BaseException as e:  # propagate at next(), not here
+                self._put(_WorkerError(e))
+                return
+            if not self._put(item):
+                return  # closed while blocked on a full buffer
+
+    def _put(self, item) -> bool:
+        """Blocking put that still honors close(); False when closed."""
+        while not self._stop.is_set():
+            try:
+                self._queue.put(item, timeout=0.05)
+                return True
+            except queue.Full:
+                continue
+        return False
+
+    # -- consumer ------------------------------------------------------
+    def __iter__(self) -> "PrefetchingIterator":
+        return self
+
+    def __next__(self):
+        if self._closed:
+            raise RuntimeError(f"{self.name}: next() after close()")
+        if self._finished:
+            raise StopIteration
+        if self._sync:
+            return self._produce()  # StopIteration propagates as-is
+        item = self._queue.get()
+        if item is _EndOfStream:
+            self._finished = True
+            raise StopIteration
+        if isinstance(item, _WorkerError):
+            self._finished = True
+            raise item.exc
+        return item
+
+    @property
+    def buffered(self) -> int:
+        """Items currently parked in the bounded buffer."""
+        return self._queue.qsize() if self._queue is not None else 0
+
+    # -- lifecycle -----------------------------------------------------
+    def close(self, timeout: float = 2.0) -> None:
+        """Stop the worker and drop buffered items. Idempotent; safe to
+        call mid-epoch (the worker unblocks even when the buffer is
+        full)."""
+        if self._closed:
+            return
+        self._closed = True
+        self._stop.set()
+        if self._queue is not None:
+            while True:  # unblock a worker waiting in _put
+                try:
+                    self._queue.get_nowait()
+                except queue.Empty:
+                    break
+        if self._thread is not None:
+            self._thread.join(timeout=timeout)
+            if self._thread.is_alive():
+                logger.warning(
+                    f"{self.name}: worker did not exit within "
+                    f"{timeout}s (daemon thread will die with the "
+                    "process)")
+
+    def __enter__(self) -> "PrefetchingIterator":
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        self.close()
+        return False
+
+    def __del__(self):
+        try:
+            self.close(timeout=0.1)
+        except Exception:
+            pass

@@ -54,6 +54,13 @@ class _Timer:
             self.records.append(self._elapsed * 1000.0)
             self._elapsed = 0.0
 
+    def record_ms(self, value_ms: float):
+        """Record an externally-measured duration. The pipelined loop
+        (dispatch-ahead) measures a step's wall time drain-to-drain —
+        start/stop pairs cannot nest across overlapping in-flight steps,
+        so the engine computes the span itself and records it here."""
+        self.records.append(float(value_ms))
+
     def elapsed(self, reset: bool = True) -> float:
         """Milliseconds."""
         now = time.perf_counter()
@@ -129,6 +136,15 @@ class ThroughputTimer:
             return
         self.started = False
         duration = time.perf_counter() - self._start
+        self.record(duration, global_step=global_step,
+                    report_speed=report_speed,
+                    flops_per_sample=flops_per_sample)
+
+    def record(self, duration: float, global_step: bool = True,
+               report_speed: bool = True, flops_per_sample: float = 0.0):
+        """Account an externally-measured step duration (seconds). The
+        dispatch-ahead loop resolves steps out of line with their
+        dispatch, so start()/stop() bracketing does not apply there."""
         self.step_elapsed_time += duration
         if not global_step:
             return
