@@ -14,7 +14,7 @@ NPROC := $(shell nproc)
 XDIST ?= $(shell if [ $(NPROC) -gt 2 ] && python -c "import xdist" 2>/dev/null; then echo "-n $$(( $(NPROC) - 1 )) --dist loadfile"; fi)
 PYTEST ?= python -m pytest
 
-.PHONY: test smoke slow bench bench-hostgap
+.PHONY: test smoke slow bench bench-hostgap fleet-demo
 
 smoke:
 	$(PYTEST) tests/ -q -m "not slow" $(XDIST)
@@ -27,6 +27,12 @@ slow:
 
 bench:
 	python bench.py
+
+# Two-process CPU demo of the fleet observability layer: both ranks
+# publish shards into a temp run dir, then the aggregated report (skew,
+# slowest-rank attribution, straggler score) is printed. No TPU needed.
+fleet-demo:
+	JAX_PLATFORMS=cpu python tools/fleet_top.py --demo
 
 # A/B the pipelined loop: one blocking run (depth 0) then one pipelined
 # run (depth 2). Compare tokens/s/chip and host_gap_ms across the two

@@ -214,8 +214,21 @@ def _nbytes(x) -> int:
 
 
 def _record(op: str, x, axis, log_name=None):
+    nb = None
     try:
-        get_comms_logger().record(op, _nbytes(x), axis, log_name)
+        nb = _nbytes(x)
+        get_comms_logger().record(op, nb, axis, log_name)
+    except Exception:
+        pass
+    try:
+        # flight recorder: one ring append per *traced* collective (these
+        # fire at trace time, not per executed step) so a hang dump shows
+        # which collectives the wedged program contains
+        from deepspeed_tpu.observability.flight_recorder import \
+            get_flight_recorder
+
+        get_flight_recorder().record("collective", op=log_name or op,
+                                     bytes=nb, axis=str(axis))
     except Exception:
         pass
 

@@ -396,7 +396,16 @@ class ObservabilityConfig(ConfigModel):
     (DSTPU_METRICS_JSONL / DSTPU_METRICS_PROM env override).
     ``xla_cost_analysis`` opts into the lazily-computed roofline from
     the compiled step's cost analysis (env: DSTPU_ROOFLINE=1) — it
-    costs one extra lower+compile, so it is off by default."""
+    costs one extra lower+compile, so it is off by default.
+
+    Fleet layer (observability/fleet.py): ``run_dir`` (env override
+    DSTPU_RUN_DIR — the launcher sets it for multi-process runs) points
+    every rank at one shared directory where it publishes heartbeat +
+    step-summary shards every ``publish_every_steps`` steps; a rank
+    whose heartbeat is older than ``stale_after_seconds`` is reported
+    dead by the aggregator. No run dir → no shard I/O. The crash flight
+    recorder keeps a ring of ``flight_events`` structured events
+    (0 disables) dumped on crash/SIGTERM/watchdog fire."""
 
     enabled: bool = True
     jsonl_path: Optional[str] = None
@@ -404,7 +413,21 @@ class ObservabilityConfig(ConfigModel):
     prometheus_every_steps: int = 10
     step_history: int = 512
     xla_cost_analysis: bool = False
+    run_dir: Optional[str] = None
+    publish_every_steps: int = 1
+    stale_after_seconds: float = 30.0
+    flight_events: int = 4096
     watchdog: WatchdogConfig = field(default_factory=WatchdogConfig)
+
+    def validate(self) -> None:
+        if self.flight_events < 0:
+            raise ValueError(
+                f"observability.flight_events must be >= 0, got "
+                f"{self.flight_events}")
+        if self.publish_every_steps < 1:
+            raise ValueError(
+                f"observability.publish_every_steps must be >= 1, got "
+                f"{self.publish_every_steps}")
 
 
 @register_config_model

@@ -101,11 +101,17 @@ class InferenceEngineV2:
         # in the process-wide hub so serving percentiles land on the same
         # Prometheus page as training metrics.
         from deepspeed_tpu.observability import get_hub
+        from deepspeed_tpu.observability.flight_recorder import (
+            get_flight_recorder, install_crash_handlers)
 
         self._hub = get_hub()
         self._ttft_hist = self._hub.histogram("serve.ttft_seconds")
         self._decode_hist = self._hub.histogram("serve.decode_token_seconds")
         self._step_hist = self._hub.histogram("serve.step_seconds")
+        # serving shares the crash flight recorder: a wedged serve step
+        # dumps the last admits/steps the same way a training hang does
+        self._flight = get_flight_recorder()
+        install_crash_handlers()
         self._admit_time: Dict[int, float] = {}
         self._last_emit_time: Dict[int, float] = {}
         self._burst_tokens = 0
@@ -274,6 +280,9 @@ class InferenceEngineV2:
                     seq.done = True
         now = time.perf_counter()
         self._step_hist.observe(now - t0)
+        self._flight.record("serve_step", tokens=batch.num_tokens,
+                            emitted=len(emitted),
+                            wall_ms=round((now - t0) * 1000.0, 3))
         for uid in emitted:
             self._note_emitted(uid, 1, now)
         self._update_serve_gauges()

@@ -88,6 +88,18 @@ def main(argv=None) -> int:
     # forward SIGTERM to a clean interpreter exit so atexit/finalizers run
     signal.signal(signal.SIGTERM, lambda *_: sys.exit(143))
 
+    # arm the crash flight recorder before user code runs: a worker that
+    # dies during import/compile still leaves a dump in the shared run dir
+    try:
+        from deepspeed_tpu.observability.flight_recorder import (
+            get_flight_recorder, install_crash_handlers)
+
+        get_flight_recorder().configure(
+            rank=process_id, run_dir=os.environ.get("DSTPU_RUN_DIR"))
+        install_crash_handlers()
+    except Exception as e:  # observability must never block the launch
+        logger.warning(f"flight recorder unavailable: {e}")
+
     sys.argv = [args.user_script] + list(args.user_args or [])
     if args.module:
         runpy.run_module(args.user_script, run_name="__main__")

@@ -302,6 +302,14 @@ def parse_args(argv=None):
     p.add_argument("--tpu_zone", default=os.environ.get("TPU_ZONE", ""))
     p.add_argument("--dry_run", action="store_true",
                    help="print the per-host commands, do not execute")
+    p.add_argument("--run_dir", default=None,
+                   help="shared fleet-observability run dir (exported as "
+                        "DSTPU_RUN_DIR to every worker; see "
+                        "docs/observability.md). Multi-host launches "
+                        "default to ./dstpu_runs/<timestamp> so per-rank "
+                        "heartbeat/step shards and flight-recorder dumps "
+                        "land somewhere aggregable for free; pass "
+                        "--run_dir '' to disable")
     p.add_argument("--bind_cores_to_rank", action="store_true",
                    help="pin each worker's host threads to its NUMA core "
                         "slice (forwarded to dstpu-launch)")
@@ -317,6 +325,26 @@ def parse_args(argv=None):
     p.add_argument("user_script", nargs="?", default=None)
     p.add_argument("user_args", nargs=argparse.REMAINDER)
     return p.parse_args(argv)
+
+
+def resolve_launch_run_dir(args, multi_host: bool) -> Optional[str]:
+    """Pick the DSTPU_RUN_DIR exported to every worker.
+
+    Precedence: explicit --run_dir ('' disables) > inherited env >
+    auto timestamped dir for multi-host launches. Single-host runs get
+    no implicit dir — they pay zero shard I/O unless asked.
+    """
+    if args.run_dir is not None:
+        return os.path.abspath(args.run_dir) if args.run_dir else None
+    inherited = os.environ.get("DSTPU_RUN_DIR")
+    if inherited:
+        return inherited
+    if multi_host:
+        import time
+
+        return os.path.abspath(
+            os.path.join("dstpu_runs", time.strftime("%Y%m%d-%H%M%S")))
+    return None
 
 
 def main(argv=None) -> int:
@@ -336,14 +364,21 @@ def main(argv=None) -> int:
     if args.elastic_training and not args.hostfile:
         raise RuntimeError("--elastic_training requires --hostfile")
 
-    if not args.elastic_training and \
-            len(active) == 1 and next(iter(active)) == "localhost":
+    single_host = not args.elastic_training and \
+        len(active) == 1 and next(iter(active)) == "localhost"
+    run_dir = resolve_launch_run_dir(args, multi_host=not single_host)
+    if run_dir:
+        logger.info(f"fleet observability run dir: {run_dir}")
+
+    if single_host:
         # single-host: exec in place, no ssh (reference runner does the
         # same for single-node jobs)
         cmd = [sys.executable, args.user_script] + list(args.user_args or [])
         if args.dry_run:
             print(shlex.join(cmd))
             return 0
+        if run_dir:
+            os.environ["DSTPU_RUN_DIR"] = run_dir
         if args.bind_cores_to_rank or args.bind_core_list:
             # bind in the parent; the child inherits affinity + OMP env
             from deepspeed_tpu.utils.numa import bind_current_process
@@ -353,6 +388,8 @@ def main(argv=None) -> int:
         return subprocess.call(cmd)
 
     env = {"DSTPU_WORLD_INFO": world_info}
+    if run_dir:
+        env["DSTPU_RUN_DIR"] = run_dir
     runner = RUNNERS[args.launcher](args, world_info)
     if not args.dry_run and not runner.backend_exists():
         raise RuntimeError(f"launcher backend {args.launcher!r} not found")
@@ -385,11 +422,14 @@ def main(argv=None) -> int:
             r = RUNNERS[args.launcher](args, wi)
             # exported on the remote side too (ssh builds exports from
             # this dict; local-process env alone never crosses ssh)
-            cmds = r.get_cmd({
+            renv = {
                 "DSTPU_WORLD_INFO": wi,
                 "DSTPU_ELASTIC_RESTART_COUNT": str(restart_count),
                 "DSTPU_ELASTIC_WORLD": ",".join(hosts),
-            }, pool)
+            }
+            if run_dir:
+                renv["DSTPU_RUN_DIR"] = run_dir
+            cmds = r.get_cmd(renv, pool)
             return [cmds] if isinstance(cmds[0], str) else cmds
 
         if args.dry_run:

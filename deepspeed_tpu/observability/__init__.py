@@ -7,9 +7,16 @@ existing monitor backends plus JSON-lines and Prometheus text sinks.
 See docs/observability.md.
 """
 
+from deepspeed_tpu.observability.chrome_trace import (
+    chrome_trace_events, export_chrome_trace, export_rank_from_run_dir)
+from deepspeed_tpu.observability.fleet import (FleetAggregator, FleetPublisher,
+                                               format_report, resolve_run_dir)
+from deepspeed_tpu.observability.flight_recorder import (
+    FlightRecorder, dump_flight_recorder, get_flight_recorder,
+    install_crash_handlers, reset_flight_recorder)
 from deepspeed_tpu.observability.histogram import Histogram
 from deepspeed_tpu.observability.hub import (MetricsHub, compile_stats,
-                                             get_hub, reset_hub)
+                                             get_hub, peek_hub, reset_hub)
 from deepspeed_tpu.observability.profile_trace import (TraceCapture,
                                                        parse_trace_steps)
 from deepspeed_tpu.observability.roofline import (HBM_GBPS, PEAK_TFLOPS,
@@ -17,6 +24,7 @@ from deepspeed_tpu.observability.roofline import (HBM_GBPS, PEAK_TFLOPS,
                                                   detect_peak_tflops, mfu,
                                                   roofline_summary)
 from deepspeed_tpu.observability.sinks import (JSONLSink, PrometheusTextSink,
+                                               escape_label_value,
                                                prometheus_name,
                                                render_prometheus)
 from deepspeed_tpu.observability.step_trace import StepTrace
@@ -26,6 +34,7 @@ __all__ = [
     "Histogram",
     "MetricsHub",
     "get_hub",
+    "peek_hub",
     "reset_hub",
     "compile_stats",
     "TraceCapture",
@@ -39,7 +48,20 @@ __all__ = [
     "JSONLSink",
     "PrometheusTextSink",
     "prometheus_name",
+    "escape_label_value",
     "render_prometheus",
     "StepTrace",
     "StallWatchdog",
+    "FlightRecorder",
+    "get_flight_recorder",
+    "reset_flight_recorder",
+    "dump_flight_recorder",
+    "install_crash_handlers",
+    "FleetPublisher",
+    "FleetAggregator",
+    "format_report",
+    "resolve_run_dir",
+    "chrome_trace_events",
+    "export_chrome_trace",
+    "export_rank_from_run_dir",
 ]

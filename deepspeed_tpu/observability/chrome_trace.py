@@ -1,0 +1,151 @@
+"""Chrome-trace (Perfetto-loadable) export of a rank's step history.
+
+Renders the StepTrace history and/or flight-recorder event tail of one
+rank as a ``trace.json`` in the Chrome trace-event format — open it at
+``ui.perfetto.dev`` or ``chrome://tracing``. This is the lightweight
+structural view (step wall, host gap, dispatch window, traced
+collectives as markers) that needs no ``jax.profiler`` capture and can
+be produced *after the fact* from a fleet run dir or a flight dump —
+including for a worker that is already dead.
+
+Track layout (one Chrome "process" per rank):
+
+    tid 0  step      one span per train step (wall time)
+    tid 1  host      the host-gap slice at the start of each step
+    tid 2  dispatch  step_entry → step_dispatch window (flight events)
+    tid 3  comm      traced collectives (instant markers; trace-time)
+    tid 4  events    everything else (compile, checkpoint, offload, ...)
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, Iterable, List, Optional
+
+_TID_NAMES = {0: "step", 1: "host", 2: "dispatch", 3: "comm", 4: "events"}
+
+
+def _us(t_seconds: float, t0: float) -> float:
+    return (t_seconds - t0) * 1e6
+
+
+def chrome_trace_events(step_rows: Iterable[Dict[str, Any]] = (),
+                        flight_events: Iterable[Dict[str, Any]] = (),
+                        rank: int = 0) -> List[Dict[str, Any]]:
+    """Build the ``traceEvents`` list.
+
+    ``step_rows``: StepTrace dicts (``to_dict()``), hub history rows, or
+    fleet shard rows — needs ``step``, ``wall_ms``, ``timestamp`` (step
+    *end*, wall clock). ``flight_events``: flight-recorder event dicts
+    (``ts`` + ``kind`` + fields)."""
+    step_rows = [r for r in step_rows
+                 if r.get("wall_ms") is not None
+                 and r.get("timestamp") is not None]
+    flight_events = [e for e in flight_events if e.get("ts") is not None]
+    starts = [r["timestamp"] - r["wall_ms"] / 1e3 for r in step_rows]
+    t0 = min(starts + [e["ts"] for e in flight_events], default=0.0)
+
+    evs: List[Dict[str, Any]] = [
+        {"name": "thread_name", "ph": "M", "pid": rank, "tid": tid,
+         "args": {"name": name}} for tid, name in _TID_NAMES.items()
+    ] + [{"name": "process_name", "ph": "M", "pid": rank,
+          "args": {"name": f"rank {rank}"}}]
+
+    for row, start in zip(step_rows, starts):
+        args = {k: row[k] for k in ("loss", "tokens_per_sec", "mfu",
+                                    "compile_events", "inflight")
+                if row.get(k) is not None}
+        evs.append({"name": f"step {row['step']}", "ph": "X", "cat": "step",
+                    "ts": _us(start, t0), "dur": row["wall_ms"] * 1e3,
+                    "pid": rank, "tid": 0, "args": args})
+        gap = row.get("host_gap_ms")
+        if gap:
+            evs.append({"name": "host_gap", "ph": "X", "cat": "host",
+                        "ts": _us(start, t0), "dur": gap * 1e3,
+                        "pid": rank, "tid": 1,
+                        "args": {"step": row["step"]}})
+
+    # flight events: pair step_entry → step_dispatch into dispatch-window
+    # spans; everything else becomes an instant marker
+    entry_ts: Dict[int, float] = {}
+    for e in flight_events:
+        kind, ts = e["kind"], e["ts"]
+        fields = {k: v for k, v in e.items() if k not in ("kind", "ts")}
+        if kind == "step_entry":
+            entry_ts[fields.get("step", -1)] = ts
+            continue
+        if kind == "step_dispatch":
+            step = fields.get("step", -1)
+            t_in = entry_ts.pop(step, None)
+            if t_in is not None:
+                evs.append({"name": f"dispatch {step}", "ph": "X",
+                            "cat": "dispatch", "ts": _us(t_in, t0),
+                            "dur": max(ts - t_in, 0.0) * 1e6,
+                            "pid": rank, "tid": 2, "args": fields})
+            continue
+        tid = 3 if kind == "collective" else 4
+        name = fields.get("op", kind) if kind == "collective" else kind
+        evs.append({"name": str(name), "ph": "i", "cat": kind, "s": "t",
+                    "ts": _us(ts, t0), "pid": rank, "tid": tid,
+                    "args": fields})
+    return evs
+
+
+def export_chrome_trace(path: str,
+                        step_rows: Optional[Iterable[Dict[str, Any]]] = None,
+                        flight_events: Optional[
+                            Iterable[Dict[str, Any]]] = None,
+                        rank: Optional[int] = None) -> str:
+    """Write ``{"traceEvents": [...]}`` to ``path``. With no explicit
+    inputs, pulls the live process's hub history and flight recorder."""
+    if step_rows is None and flight_events is None:
+        from deepspeed_tpu.observability.flight_recorder import \
+            get_flight_recorder
+        from deepspeed_tpu.observability.hub import peek_hub
+
+        hub = peek_hub()
+        step_rows = [t.to_dict() for t in hub.step_history] if hub else []
+        rec = get_flight_recorder()
+        flight_events = [{"ts": ts, "kind": kind, **fields}
+                         for ts, kind, fields in rec.events()]
+        rank = rec.rank if rank is None else rank
+    evs = chrome_trace_events(step_rows or (), flight_events or (),
+                              rank=rank or 0)
+    d = os.path.dirname(os.path.abspath(path))
+    os.makedirs(d, exist_ok=True)
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        json.dump({"traceEvents": evs, "displayTimeUnit": "ms"}, f)
+    os.replace(tmp, path)
+    return path
+
+
+def export_rank_from_run_dir(run_dir: str, rank: int, path: str) -> str:
+    """Offline export: read one rank's fleet shard + any flight dumps
+    from a run dir (works for dead workers — that is the point)."""
+    from deepspeed_tpu.observability.fleet import (FLIGHT_DIR, STEPS_DIR,
+                                                   _rank_name)
+
+    rows: List[Dict[str, Any]] = []
+    shard = os.path.join(run_dir, STEPS_DIR, _rank_name(rank) + ".jsonl")
+    if os.path.exists(shard):
+        with open(shard) as f:
+            for line in f:
+                try:
+                    rows.append(json.loads(line))
+                except json.JSONDecodeError:
+                    continue
+    events: List[Dict[str, Any]] = []
+    flight_d = os.path.join(run_dir, FLIGHT_DIR)
+    if os.path.isdir(flight_d):
+        for name in sorted(os.listdir(flight_d)):
+            if name.startswith(f"flight_rank{rank}_") and \
+                    name.endswith(".json"):
+                try:
+                    with open(os.path.join(flight_d, name)) as f:
+                        events.extend(json.load(f).get("events", []))
+                except Exception:
+                    continue
+    return export_chrome_trace(path, step_rows=rows, flight_events=events,
+                               rank=rank)

@@ -1,0 +1,325 @@
+"""Fleet observability: cross-host aggregation over a shared run dir.
+
+The hub (``observability/hub.py``) is per-process; on a multi-host run
+every worker keeps its own StepTrace history and nobody can answer
+"which host is slow". This module adds the pod-scale layer without any
+extra collectives: each process *atomically publishes* per-rank shards
+into a shared run directory (``DSTPU_RUN_DIR`` env or config
+``observability.run_dir`` — any shared filesystem works: GCS fuse, NFS,
+or plain /tmp for the CPU hostsim tests), and an aggregator merges the
+shards into a fleet view:
+
+* per-step cross-rank skew (max-min wall time, attributed to the
+  slowest rank of that step),
+* per-rank EWMA straggler scores (wall time relative to the per-step
+  cross-rank minimum, smoothed — a persistently slow host floats to the
+  top even when individual steps are noisy),
+* stale-heartbeat dead-host detection (a rank whose heartbeat file
+  stops aging is hung or OOM-killed; its flight-recorder dump, if any,
+  sits next to its shard).
+
+Run dir layout (all writes are tmp+rename atomic, all reads tolerate
+missing/partial files):
+
+    <run_dir>/heartbeat/rank_00000.json   rewritten every publish
+    <run_dir>/steps/rank_00000.jsonl      appended one row per step
+    <run_dir>/flight/flight_rank0_*.json  flight-recorder dumps
+
+No run dir configured → no publisher, no shard I/O, zero overhead: the
+single-process path never touches this module.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+from deepspeed_tpu.utils.logging import logger
+
+HEARTBEAT_DIR = "heartbeat"
+STEPS_DIR = "steps"
+FLIGHT_DIR = "flight"
+
+# EWMA straggler score above which a rank is named the straggler (1.0 =
+# exactly the per-step minimum; 1.15 = persistently 15% slower than the
+# fastest rank — beyond cross-host jitter, below a real hang)
+STRAGGLER_THRESHOLD = 1.15
+
+
+def resolve_run_dir(obs_config=None) -> Optional[str]:
+    """Shared run dir: DSTPU_RUN_DIR env beats config
+    ``observability.run_dir``; None when neither is set."""
+    return os.environ.get("DSTPU_RUN_DIR") or getattr(
+        obs_config, "run_dir", None)
+
+
+def _rank_name(rank: int) -> str:
+    return f"rank_{rank:05d}"
+
+
+def _atomic_write_json(path: str, doc: Dict[str, Any]) -> None:
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        json.dump(doc, f)
+    os.replace(tmp, path)
+
+
+class FleetPublisher:
+    """Per-process shard writer: one heartbeat file (rewritten) plus one
+    append-only step-summary JSONL. Write failures disable the publisher
+    (a full shared filesystem must not kill training)."""
+
+    def __init__(self, run_dir: str, rank: Optional[int] = None,
+                 publish_every_steps: int = 1):
+        from deepspeed_tpu.observability.flight_recorder import _env_rank
+
+        self.run_dir = run_dir
+        self.rank = int(rank) if rank is not None else _env_rank()
+        self.publish_every = max(1, int(publish_every_steps or 1))
+        self._lock = threading.Lock()
+        self._failed = False
+        self._fh = None
+        try:
+            os.makedirs(os.path.join(run_dir, HEARTBEAT_DIR), exist_ok=True)
+            os.makedirs(os.path.join(run_dir, STEPS_DIR), exist_ok=True)
+            os.makedirs(os.path.join(run_dir, FLIGHT_DIR), exist_ok=True)
+            self._hb_path = os.path.join(
+                run_dir, HEARTBEAT_DIR, _rank_name(self.rank) + ".json")
+            self._fh = open(
+                os.path.join(run_dir, STEPS_DIR,
+                             _rank_name(self.rank) + ".jsonl"),
+                "a", buffering=1)
+            self.heartbeat(status="starting")
+        except Exception as e:
+            self._failed = True
+            logger.warning(f"fleet publisher disabled: {e}")
+
+    def publish_step(self, trace) -> None:
+        """One shard row per traced step (StepTrace or dict). Rows keep
+        only the cross-rank-comparable scalars — the full trace stays in
+        the per-process JSONL sink."""
+        if self._failed:
+            return
+        d = trace if isinstance(trace, dict) else trace.to_dict()
+        step = int(d.get("step", 0))
+        if step % self.publish_every != 0:
+            return
+        row = {"rank": self.rank, "step": step}
+        for key in ("wall_ms", "host_gap_ms", "loss", "tokens_per_sec",
+                    "mfu", "compile_events", "timestamp", "inflight"):
+            v = d.get(key)
+            if v is not None:
+                row[key] = v
+        try:
+            with self._lock:
+                self._fh.write(json.dumps(row) + "\n")
+                self._fh.flush()
+            self.heartbeat(step=step)
+        except Exception as e:
+            self._failed = True
+            logger.warning(f"fleet publisher disabled after error: {e}")
+
+    def heartbeat(self, step: Optional[int] = None,
+                  status: str = "running") -> None:
+        if self._failed:
+            return
+        try:
+            _atomic_write_json(self._hb_path, {
+                "rank": self.rank,
+                "host": socket.gethostname(),
+                "pid": os.getpid(),
+                "ts": time.time(),
+                "step": step,
+                "status": status,
+            })
+        except Exception as e:
+            self._failed = True
+            logger.warning(f"fleet heartbeat disabled after error: {e}")
+
+    def close(self, status: str = "done") -> None:
+        self.heartbeat(status=status)
+        with self._lock:
+            if self._fh is not None:
+                try:
+                    self._fh.close()
+                except Exception:
+                    pass
+
+
+class FleetAggregator:
+    """Merge per-rank shards into the fleet view. Read-only: runs on any
+    host with the run dir mounted (``tools/fleet_top.py``), or inside a
+    test asserting on the merged report."""
+
+    def __init__(self, run_dir: str, stale_after_seconds: float = 30.0,
+                 ewma_alpha: float = 0.25, tail_steps: int = 2048):
+        self.run_dir = run_dir
+        self.stale_after = float(stale_after_seconds)
+        self.alpha = float(ewma_alpha)
+        self.tail_steps = int(tail_steps)
+
+    # -- shard reading -------------------------------------------------
+    def _read_heartbeats(self) -> Dict[int, Dict[str, Any]]:
+        out: Dict[int, Dict[str, Any]] = {}
+        d = os.path.join(self.run_dir, HEARTBEAT_DIR)
+        if not os.path.isdir(d):
+            return out
+        for name in sorted(os.listdir(d)):
+            if not name.endswith(".json") or ".tmp." in name:
+                continue
+            try:
+                with open(os.path.join(d, name)) as f:
+                    hb = json.load(f)
+                out[int(hb["rank"])] = hb
+            except Exception:
+                continue  # mid-rewrite or foreign file: skip
+        return out
+
+    def _read_steps(self) -> Dict[int, List[Dict[str, Any]]]:
+        out: Dict[int, List[Dict[str, Any]]] = {}
+        d = os.path.join(self.run_dir, STEPS_DIR)
+        if not os.path.isdir(d):
+            return out
+        for name in sorted(os.listdir(d)):
+            if not name.endswith(".jsonl"):
+                continue
+            rows = []
+            try:
+                with open(os.path.join(d, name)) as f:
+                    for line in f:
+                        try:
+                            rows.append(json.loads(line))
+                        except json.JSONDecodeError:
+                            continue  # torn tail line of a live writer
+            except OSError:
+                continue
+            if rows:
+                out[int(rows[0].get("rank", -1))] = rows[-self.tail_steps:]
+        return out
+
+    # -- aggregation ---------------------------------------------------
+    def report(self, now: Optional[float] = None) -> Dict[str, Any]:
+        """The merged fleet view (see module docstring for the signals).
+
+        ``straggler`` names the rank with the highest EWMA score when it
+        clears STRAGGLER_THRESHOLD (None below it — on a healthy fleet
+        nobody is "the straggler"); ``skew.worst_rank`` attributes the
+        largest single-step spread."""
+        now = time.time() if now is None else now
+        heartbeats = self._read_heartbeats()
+        shards = self._read_steps()
+        ranks = sorted(set(heartbeats) | set(shards))
+
+        per_rank: Dict[int, Dict[str, Any]] = {}
+        for r in ranks:
+            rows = shards.get(r, [])
+            walls = [row["wall_ms"] for row in rows if "wall_ms" in row]
+            hb = heartbeats.get(r)
+            age = (now - hb["ts"]) if hb else None
+            per_rank[r] = {
+                "steps": len(rows),
+                "last_step": rows[-1]["step"] if rows else None,
+                "mean_wall_ms": (sum(walls) / len(walls)) if walls else None,
+                "host": hb.get("host") if hb else None,
+                "status": hb.get("status") if hb else "unknown",
+                "heartbeat_age_s": age,
+                "alive": age is not None and age < self.stale_after,
+                "slowest_steps": 0,
+                "straggler_score": None,
+            }
+
+        # merge on step number: skew + slowest-rank attribution + EWMA
+        by_step: Dict[int, Dict[int, float]] = {}
+        for r, rows in shards.items():
+            for row in rows:
+                if "wall_ms" in row:
+                    by_step.setdefault(row["step"], {})[r] = row["wall_ms"]
+        merged = {s: w for s, w in by_step.items() if len(w) >= 2}
+        scores: Dict[int, float] = {}
+        skews: List[float] = []
+        max_skew = {"ms": 0.0, "step": None, "worst_rank": None}
+        for s in sorted(merged):
+            walls = merged[s]
+            lo = min(walls.values())
+            hi_rank = max(walls, key=walls.get)
+            skew = walls[hi_rank] - lo
+            skews.append(skew)
+            per_rank[hi_rank]["slowest_steps"] += 1
+            if skew > max_skew["ms"]:
+                max_skew = {"ms": skew, "step": s, "worst_rank": hi_rank}
+            for r, w in walls.items():
+                ratio = w / lo if lo > 0 else 1.0
+                prev = scores.get(r)
+                scores[r] = ratio if prev is None else \
+                    self.alpha * ratio + (1 - self.alpha) * prev
+        for r, sc in scores.items():
+            per_rank[r]["straggler_score"] = sc
+
+        straggler = None
+        if scores:
+            worst = max(scores, key=scores.get)
+            if scores[worst] >= STRAGGLER_THRESHOLD:
+                straggler = {"rank": worst, "score": scores[worst],
+                             "host": per_rank[worst]["host"]}
+
+        dead = [r for r in ranks
+                if not per_rank[r]["alive"]
+                and per_rank[r]["status"] not in ("done", "crashed")]
+        return {
+            "run_dir": self.run_dir,
+            "ts": now,
+            "n_ranks": len(ranks),
+            "merged_steps": len(merged),
+            "ranks": per_rank,
+            "skew": {
+                "mean_ms": (sum(skews) / len(skews)) if skews else None,
+                "max_ms": max_skew["ms"] if skews else None,
+                "max_step": max_skew["step"],
+                "worst_rank": max_skew["worst_rank"],
+            },
+            "straggler": straggler,
+            "dead_ranks": dead,
+        }
+
+
+def _fmt(v, spec: str, width: int) -> str:
+    return format(v, spec) if v is not None else "-".rjust(width)
+
+
+def format_report(report: Dict[str, Any]) -> str:
+    """Human fleet view (tools/fleet_top.py, the Makefile demo)."""
+    lines = [
+        f"fleet: {report['n_ranks']} ranks, "
+        f"{report['merged_steps']} merged steps  ({report['run_dir']})",
+        f"{'rank':>5} {'host':<16} {'status':<9} {'steps':>6} "
+        f"{'last':>6} {'mean ms':>9} {'slowest':>8} {'score':>7} {'hb age':>7}",
+    ]
+    for r in sorted(report["ranks"]):
+        row = report["ranks"][r]
+        lines.append(
+            f"{r:>5} {str(row['host'] or '?'):<16} {row['status']:<9} "
+            f"{row['steps']:>6} {_fmt(row['last_step'], '>6', 6)} "
+            f"{_fmt(row['mean_wall_ms'], '>9.1f', 9)} "
+            f"{row['slowest_steps']:>8} "
+            f"{_fmt(row['straggler_score'], '>7.3f', 7)} "
+            f"{_fmt(row['heartbeat_age_s'], '>6.1f', 7)}"
+            + ("s" if row["heartbeat_age_s"] is not None else ""))
+    skew = report["skew"]
+    if skew["max_ms"] is not None:
+        lines.append(
+            f"skew: mean {skew['mean_ms']:.1f} ms, max {skew['max_ms']:.1f} "
+            f"ms at step {skew['max_step']} (rank {skew['worst_rank']})")
+    s = report["straggler"]
+    lines.append(
+        f"straggler: rank {s['rank']} (EWMA {s['score']:.2f}x the fastest"
+        f"{', host ' + s['host'] if s.get('host') else ''})" if s
+        else "straggler: none (all ranks within "
+             f"{STRAGGLER_THRESHOLD:.2f}x of the fastest)")
+    if report["dead_ranks"]:
+        lines.append(f"DEAD (stale heartbeat): ranks {report['dead_ranks']} "
+                     f"— check <run_dir>/flight/ for their dumps")
+    return "\n".join(lines)

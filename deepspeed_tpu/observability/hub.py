@@ -82,15 +82,21 @@ class MetricsHub:
         self._jsonl: Optional[JSONLSink] = None
         self._prom: Optional[PrometheusTextSink] = None
         self._prom_every = 10  # steps between Prometheus snapshot rewrites
+        self._fleet = None  # FleetPublisher when a run dir is configured
         self._last_comm_totals: Dict[str, float] = {}
+        self._last_fallbacks: Dict[str, float] = {}
         self._last_compile = compile_stats()
         _register_compile_listeners()
 
     # -- configuration -------------------------------------------------
-    def configure(self, obs_config=None) -> None:
+    def configure(self, obs_config=None, rank=None) -> None:
         """Attach sinks from the config block and/or env vars. Safe to
         call more than once (a second engine in the process reuses the
-        already-attached sinks)."""
+        already-attached sinks). With a run dir configured
+        (``observability.run_dir`` / ``DSTPU_RUN_DIR``) a
+        ``FleetPublisher`` additionally shards every step row into it
+        (docs/observability.md "Fleet view"); no run dir → no publisher,
+        no shard I/O."""
         jsonl = os.environ.get("DSTPU_METRICS_JSONL") or getattr(
             obs_config, "jsonl_path", None)
         prom = os.environ.get("DSTPU_METRICS_PROM") or getattr(
@@ -106,6 +112,19 @@ class MetricsHub:
                 self._prom_every = every
             if hist > 0 and hist != self.step_history.maxlen:
                 self.step_history = deque(self.step_history, maxlen=hist)
+        try:
+            from deepspeed_tpu.observability.fleet import (FleetPublisher,
+                                                           resolve_run_dir)
+
+            run_dir = resolve_run_dir(obs_config)
+            if run_dir and (self._fleet is None
+                            or self._fleet.run_dir != run_dir):
+                self._fleet = FleetPublisher(
+                    run_dir, rank=rank,
+                    publish_every_steps=getattr(
+                        obs_config, "publish_every_steps", 1))
+        except Exception as e:  # the fleet layer must never block startup
+            logger.warning(f"fleet publisher unavailable: {e}")
 
     # -- primitive metrics ---------------------------------------------
     def gauge(self, name: str, value: float) -> None:
@@ -146,6 +165,22 @@ class MetricsHub:
         self._last_compile = now
         return delta
 
+    def fallback_delta(self) -> Dict[str, float]:
+        """Capability-fallback counters (utils/telemetry) that moved
+        since the last call — empty in the steady state, so exporting
+        the delta costs nothing per step."""
+        try:
+            from deepspeed_tpu.utils import telemetry
+
+            now = telemetry.snapshot()
+        except Exception:
+            return {}
+        delta = {k: v - self._last_fallbacks.get(k, 0)
+                 for k, v in now.items()
+                 if v != self._last_fallbacks.get(k, 0)}
+        self._last_fallbacks = {k: float(v) for k, v in now.items()}
+        return delta
+
     def record_step(self, trace: StepTrace) -> None:
         with self._lock:
             self.step_history.append(trace)
@@ -174,8 +209,19 @@ class MetricsHub:
                     self.counters.get("jit.compile_events", 0.0) \
                     + trace.compile_events
         self.histogram("train.step_seconds").observe(trace.wall_ms / 1000.0)
+        # capability downgrades land on the same dashboard as throughput:
+        # moved telemetry counters mirror into hub counters (-> Prometheus
+        # as dstpu_fallback_*_total) and emit one JSONL event per change
+        fb = self.fallback_delta()
+        for name, d in fb.items():
+            self.counter_add(f"fallback.{name}", d)
+        if fb:
+            self.record_event("capability_fallback", step=trace.step,
+                              delta=fb)
         if self._jsonl is not None:
             self._jsonl.write(trace.to_dict())
+        if self._fleet is not None:
+            self._fleet.publish_step(trace)
         if self._prom is not None and \
                 trace.step % max(1, self._prom_every) == 0:
             self.write_prometheus()
@@ -270,6 +316,8 @@ class MetricsHub:
     def close(self) -> None:
         if self._jsonl is not None:
             self._jsonl.close()
+        if self._fleet is not None:
+            self._fleet.close()
         self.write_prometheus()
 
 
@@ -285,6 +333,12 @@ def get_hub() -> MetricsHub:
         return _HUB
 
 
+def peek_hub() -> Optional[MetricsHub]:
+    """The singleton if one exists, without creating it — for report
+    paths (watchdog, crash dumps) that must not allocate mid-failure."""
+    return _HUB
+
+
 def reset_hub() -> None:
     """Drop the singleton (tests). Sinks on the old hub are closed."""
     global _HUB
@@ -293,6 +347,8 @@ def reset_hub() -> None:
             try:
                 if _HUB._jsonl is not None:
                     _HUB._jsonl.close()
+                if _HUB._fleet is not None:
+                    _HUB._fleet.close()
             except Exception:
                 pass
         _HUB = None

@@ -31,7 +31,19 @@ _NAME_RE = re.compile(r"[^a-zA-Z0-9_:]")
 def prometheus_name(name: str, prefix: str = "dstpu") -> str:
     """'serve.ttft_seconds' -> 'dstpu_serve_ttft_seconds'."""
     clean = _NAME_RE.sub("_", name.replace(".", "_").replace("/", "_"))
+    if clean and clean[0].isdigit():
+        clean = "_" + clean  # exposition names must not start with a digit
     return f"{prefix}_{clean}" if prefix else clean
+
+
+def escape_label_value(value: str) -> str:
+    """Prometheus text-exposition label escaping: backslash, double
+    quote, and newline (in that order — escaping the escapes first).
+    Label values are arbitrary strings here (telemetry *reason* text),
+    and a raw newline or quote would corrupt the whole exposition file
+    for every scraper."""
+    return (str(value).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
 
 
 class JSONLSink:
@@ -104,8 +116,8 @@ def render_prometheus(gauges: Dict[str, float], counters: Dict[str, float],
             m += "_total"
         lines.append(f"# TYPE {m} counter")
         for label, v in sorted(per_label.items()):
-            safe = label.replace("\\", "\\\\").replace('"', '\\"')
-            lines.append(f'{m}{{name="{safe}"}} {v:.6g}')
+            lines.append(f'{m}{{name="{escape_label_value(label)}"}} '
+                         f'{v:.6g}')
     for name, hist in sorted(histograms.items()):
         lines.extend(hist.prometheus_lines(prometheus_name(name)))
     return "\n".join(lines) + "\n"

@@ -167,6 +167,17 @@ class StallWatchdog:
                 self._report_fn(report)
         except Exception as e:  # the watchdog must never kill the run
             logger.warning(f"stall watchdog report failed: {e}")
+        try:
+            # the post-mortem artifact for a worker that never recovers:
+            # the ring's last events survive on disk even if the process
+            # is OOM-killed seconds after this fires
+            from deepspeed_tpu.observability.flight_recorder import \
+                dump_flight_recorder
+
+            dump_flight_recorder("watchdog", step=step,
+                                 overdue_s=round(overdue_s, 3))
+        except Exception:
+            pass
 
     def build_report(self, step: Optional[int] = None,
                      overdue_s: float = 0.0) -> str:
@@ -195,5 +206,37 @@ class StallWatchdog:
                 continue
             lines.append(f"--- thread {name} ({ident}) ---")
             lines.append("".join(traceback.format_stack(frame)).rstrip())
+        # the last seconds before the hang: flight-recorder tail (step
+        # phases, traced collectives, checkpoint/offload transitions)
+        # plus the last completed StepTrace rows — together they say what
+        # the worker was *doing*, where the stacks say where it is stuck
+        try:
+            from deepspeed_tpu.observability.flight_recorder import \
+                get_flight_recorder
+
+            tail = get_flight_recorder().tail_lines(last=32)
+            if tail:
+                lines.append("flight recorder tail (newest last):")
+                lines.append(tail)
+        except Exception as e:
+            lines.append(f"flight recorder: error ({e})")
+        try:
+            from deepspeed_tpu.observability.hub import peek_hub
+
+            hub = peek_hub()
+            rows = list(hub.step_history)[-8:] if hub is not None else []
+            if rows:
+                lines.append("last step traces:")
+                for t in rows:
+                    lines.append(
+                        f"  step {t.step}: wall {t.wall_ms:.1f} ms"
+                        + (f", loss {t.loss:.4f}" if t.loss is not None
+                           else "")
+                        + (f", host_gap {t.host_gap_ms:.1f} ms"
+                           if t.host_gap_ms is not None else "")
+                        + (f", compiles {t.compile_events}"
+                           if t.compile_events else ""))
+        except Exception as e:
+            lines.append(f"step traces: error ({e})")
         lines.append("=" * 70)
         return "\n".join(lines)

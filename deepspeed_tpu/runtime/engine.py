@@ -360,6 +360,7 @@ class Engine:
         # watchdog, on-demand profiler capture (docs/observability.md)
         self.hub = None
         self.watchdog = None
+        self.flight = None
         self._trace_capture = None
         self._obs_cfg = getattr(config, "observability", None)
         if self._obs_cfg is None or self._obs_cfg.enabled:
@@ -369,13 +370,34 @@ class Engine:
                                                          get_hub)
 
                 self.hub = get_hub()
-                self.hub.configure(self._obs_cfg)
+                self.hub.configure(self._obs_cfg,
+                                   rank=jax.process_index())
                 self.watchdog = StallWatchdog.from_config(
                     getattr(self._obs_cfg, "watchdog", None),
                     report_fn=self._on_stall_report)
                 self._trace_capture = TraceCapture.from_env()
             except Exception as e:
                 logger.warning(f"observability hub disabled: {e}")
+            try:
+                # crash flight recorder: ring of step/collective/
+                # checkpoint events, dumped on crash/SIGTERM/watchdog
+                # fire (docs/observability.md "Flight recorder")
+                from deepspeed_tpu.observability import flight_recorder \
+                    as _fr
+                from deepspeed_tpu.observability.fleet import \
+                    resolve_run_dir
+
+                self.flight = _fr.get_flight_recorder()
+                self.flight.configure(
+                    capacity=getattr(self._obs_cfg, "flight_events", None),
+                    rank=jax.process_index(),
+                    run_dir=resolve_run_dir(self._obs_cfg))
+                if not self.flight.enabled:
+                    self.flight = None
+                else:
+                    _fr.install_crash_handlers()
+            except Exception as e:
+                logger.warning(f"flight recorder disabled: {e}")
         self._flops_per_token = None   # cached model.flops_per_token()
         self._last_batches_struct = None  # abstract batch for roofline()
         self._roofline_cost = None     # cached XLA cost analysis
@@ -1023,6 +1045,9 @@ class Engine:
             self.tput_timer.start()
         batches = self._next_batches(data_iter)
         step_no = self.global_steps + 1
+        if self.flight is not None:
+            self.flight.record("step_entry", step=step_no,
+                               inflight=len(self._inflight))
         if self._trace_capture is not None:
             self._trace_capture.on_step_begin(step_no)
         if sync and self.watchdog is not None:
@@ -1032,6 +1057,10 @@ class Engine:
         with topo.use_mesh(self.mesh):
             metrics = self._dispatch_train_step(batches)
         dispatch_t = time.perf_counter()
+        if self.flight is not None:
+            self.flight.record(
+                "step_dispatch", step=step_no,
+                host_ms=round((dispatch_t - host_t0) * 1000.0, 3))
         # dispatch-order bookkeeping; the host READS defer to the drain
         self.global_steps += 1
         self.global_samples += self.train_batch_size
@@ -1093,6 +1122,10 @@ class Engine:
                                       window=len(self._inflight))
                 else:
                     self.watchdog.disarm()
+        if self.flight is not None:
+            self.flight.record("step_drain", step=entry.step,
+                               wall_ms=round(wall_ms, 3),
+                               inflight=len(self._inflight))
         if self.hub is not None:
             self._emit_step_trace(entry.step, metrics, entry.struct,
                                   wall_ms, host_gap_ms=entry.host_ms,
@@ -1691,11 +1724,16 @@ class Engine:
         if "optim_states" in include and self.opt_state is not None:
             self.opt_state = to_host(self.opt_state)
         self._states_offloaded = True
+        if self.flight is not None:
+            self.flight.record("offload_states", step=self.global_steps,
+                               include=sorted(include))
 
     def reload_states(self, non_blocking: bool = False):
         """Inverse of offload_states: device placement restored."""
         if not getattr(self, "_states_offloaded", False):
             return
+        if self.flight is not None:
+            self.flight.record("reload_states", step=self.global_steps)
 
         def to_device(tree):
             return jax.tree.map(
@@ -1805,17 +1843,30 @@ class Engine:
         # drain in-flight steps first: the saved counters (global_steps,
         # skipped_steps) and state must reflect every dispatched step
         self.synchronize()
-        return self._ckpt_io.save(save_dir, tag=tag,
-                                  client_state=client_state,
-                                  save_latest=save_latest)
+        if self.flight is not None:
+            self.flight.record("checkpoint_save", step=self.global_steps,
+                               tag=str(tag), phase="begin")
+        out = self._ckpt_io.save(save_dir, tag=tag,
+                                 client_state=client_state,
+                                 save_latest=save_latest)
+        if self.flight is not None:
+            self.flight.record("checkpoint_save", step=self.global_steps,
+                               tag=str(tag), phase="end")
+        return out
 
     def load_checkpoint(self, load_dir, tag=None,
                         load_module_strict: bool = True,
                         load_optimizer_states: bool = True,
                         load_lr_scheduler_states: bool = True):
         self.synchronize()  # in-flight steps must not outlive old state
+        if self.flight is not None:
+            self.flight.record("checkpoint_load", tag=str(tag),
+                               phase="begin")
         out = self._ckpt_io.load(load_dir, tag=tag,
                                  load_optimizer_states=load_optimizer_states)
+        if self.flight is not None:
+            self.flight.record("checkpoint_load", tag=str(tag),
+                               phase="end")
         if getattr(self, "_param_host_offload", False):
             # restored leaves come back in device memory; re-pin layers
             self.params = self._place_layer_params_on_host(self.params)

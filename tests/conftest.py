@@ -44,6 +44,17 @@ def _maybe_reexec_with_affinity_shim(config) -> None:
 
 
 os.environ["JAX_PLATFORMS"] = "cpu"  # force: tests never touch the real TPU
+
+# flight-recorder dumps (e.g. a deliberately-fired stall watchdog in the
+# engine tests) default to ./dstpu_flight — point them at a temp dir so
+# test runs never litter the repo; tests asserting on dump paths
+# monkeypatch or delete this env var themselves
+if "DSTPU_FLIGHT_DIR" not in os.environ:
+    import tempfile
+
+    os.environ["DSTPU_FLIGHT_DIR"] = tempfile.mkdtemp(
+        prefix="dstpu_flight_test_")
+
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (

@@ -1,0 +1,60 @@
+"""Subprocess worker for tests/test_fleet.py: one simulated training
+rank publishing through the REAL wiring — flight recorder + crash
+handlers armed, hub configured with a run dir so ``record_step`` shards
+into it via the FleetPublisher. No devices and no engine build: the
+fleet layer is host-side only, which is what keeps this a tier-1 test.
+
+    python fleet_worker.py train RANK RUN_DIR [SLEEP_MS]
+    python fleet_worker.py crash RANK RUN_DIR [SLEEP_MS]
+
+``train`` publishes 10 steps, each taking ~SLEEP_MS (the straggler test
+gives one rank a bigger SLEEP_MS). ``crash`` raises an uncaught
+exception mid-run; the installed excepthook must leave a flight dump in
+<run_dir>/flight/.
+"""
+
+import os
+import sys
+import time
+import types
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+
+def main() -> int:
+    mode, rank, run_dir = sys.argv[1], int(sys.argv[2]), sys.argv[3]
+    sleep_ms = float(sys.argv[4]) if len(sys.argv) > 4 else 10.0
+
+    from deepspeed_tpu.observability.flight_recorder import (
+        get_flight_recorder, install_crash_handlers)
+    from deepspeed_tpu.observability.hub import get_hub
+    from deepspeed_tpu.observability.step_trace import StepTrace
+
+    fr = get_flight_recorder()
+    fr.configure(rank=rank, run_dir=run_dir)
+    install_crash_handlers()
+
+    hub = get_hub()
+    hub.configure(types.SimpleNamespace(run_dir=run_dir), rank=rank)
+
+    for step in range(1, 11):
+        t0 = time.time()
+        fr.record("step_entry", step=step, inflight=0)
+        time.sleep(sleep_ms / 1000.0)
+        fr.record("step_dispatch", step=step,
+                  host_ms=round((time.time() - t0) * 1000.0, 3))
+        if mode == "crash" and step == 5:
+            raise RuntimeError("induced crash for flight-recorder test")
+        fr.record("step_drain", step=step, inflight=0)
+        hub.record_step(StepTrace(
+            step=step, wall_ms=(time.time() - t0) * 1000.0,
+            loss=3.0 / step, tokens=1024,
+            tokens_per_sec=1024.0 / max(time.time() - t0, 1e-9)))
+    hub.close()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,406 @@
+"""Fleet observability (deepspeed_tpu/observability/{flight_recorder,
+fleet,chrome_trace}.py): the crash flight recorder's ring/dump/handler
+semantics, cross-host shard aggregation (skew, slowest-rank attribution,
+EWMA straggler scores, dead-host detection), the chrome-trace exporter,
+and the end-to-end two-subprocess paths — a straggler named in the
+merged report and a flight dump left behind by an induced crash
+(docs/observability.md "Fleet view" / "Flight recorder")."""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+import types
+
+import pytest
+
+from deepspeed_tpu.observability.chrome_trace import (chrome_trace_events,
+                                                      export_chrome_trace,
+                                                      export_rank_from_run_dir)
+from deepspeed_tpu.observability.fleet import (STRAGGLER_THRESHOLD,
+                                               FleetAggregator, FleetPublisher,
+                                               format_report, resolve_run_dir)
+from deepspeed_tpu.observability.flight_recorder import (
+    FlightRecorder, dump_flight_recorder, get_flight_recorder,
+    reset_flight_recorder)
+from deepspeed_tpu.observability.hub import get_hub, reset_hub
+from deepspeed_tpu.observability.step_trace import StepTrace
+
+WORKER = os.path.join(os.path.dirname(__file__), "fleet_worker.py")
+
+
+@pytest.fixture(autouse=True)
+def _fresh_singletons():
+    reset_hub()
+    reset_flight_recorder()
+    yield
+    reset_hub()
+    reset_flight_recorder()
+
+
+# ---------------------------------------------------------------------------
+# flight recorder: ring semantics + dumps
+# ---------------------------------------------------------------------------
+
+class TestFlightRecorder:
+    def test_ring_keeps_newest(self):
+        fr = FlightRecorder(capacity=8)
+        for i in range(20):
+            fr.record("tick", i=i)
+        evs = fr.events()
+        assert len(evs) == 8
+        assert [f["i"] for _, _, f in evs] == list(range(12, 20))
+
+    def test_capacity_zero_disables(self):
+        fr = FlightRecorder(capacity=0)
+        fr.record("tick")
+        assert fr.events() == []
+        assert not fr.enabled
+
+    def test_configure_resize_keeps_newest(self):
+        fr = FlightRecorder(capacity=16)
+        for i in range(10):
+            fr.record("tick", i=i)
+        fr.configure(capacity=4)
+        assert [f["i"] for _, _, f in fr.events()] == [6, 7, 8, 9]
+
+    def test_dump_writes_valid_json(self, tmp_path):
+        fr = FlightRecorder(capacity=8, rank=3)
+        fr.record("collective", op="all_reduce", bytes=1024)
+        path = fr.dump("manual", path=str(tmp_path / "d.json"), note="x")
+        with open(path) as f:
+            doc = json.load(f)
+        assert doc["kind"] == "flight_recorder_dump"
+        assert doc["reason"] == "manual" and doc["rank"] == 3
+        assert doc["note"] == "x" and doc["n_events"] == 1
+        assert doc["events"][0]["kind"] == "collective"
+        assert doc["events"][0]["op"] == "all_reduce"
+
+    def test_dump_dir_precedence(self, tmp_path, monkeypatch):
+        monkeypatch.delenv("DSTPU_FLIGHT_DIR", raising=False)
+        fr = FlightRecorder(capacity=8, rank=0,
+                            run_dir=str(tmp_path / "run"))
+        fr.record("tick")
+        p = fr.dump("a")
+        assert os.path.dirname(p) == str(tmp_path / "run" / "flight")
+        monkeypatch.setenv("DSTPU_FLIGHT_DIR", str(tmp_path / "env"))
+        assert os.path.dirname(fr.dump("b")) == str(tmp_path / "env")
+
+    def test_module_dump_skips_empty_ring(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("DSTPU_FLIGHT_DIR", str(tmp_path))
+        assert dump_flight_recorder("noop") is None
+        get_flight_recorder().record("tick")
+        assert dump_flight_recorder("real") is not None
+
+    def test_tail_lines_human_format(self):
+        fr = FlightRecorder(capacity=8)
+        fr.record("step_entry", step=7)
+        tail = fr.tail_lines()
+        assert "step_entry" in tail and "step=7" in tail
+
+
+# ---------------------------------------------------------------------------
+# fleet publisher + aggregator (in-process)
+# ---------------------------------------------------------------------------
+
+def _publish(run_dir, rank, walls, start_step=1):
+    pub = FleetPublisher(str(run_dir), rank=rank)
+    for i, w in enumerate(walls):
+        pub.publish_step({"rank": rank, "step": start_step + i,
+                          "wall_ms": w, "timestamp": time.time()})
+    pub.close()
+
+
+class TestFleetAggregation:
+    def test_shard_layout_and_per_rank_stats(self, tmp_path):
+        _publish(tmp_path, 0, [10.0, 10.0, 10.0])
+        assert (tmp_path / "heartbeat" / "rank_00000.json").exists()
+        assert (tmp_path / "steps" / "rank_00000.jsonl").exists()
+        rep = FleetAggregator(str(tmp_path)).report()
+        row = rep["ranks"][0]
+        assert row["steps"] == 3 and row["last_step"] == 3
+        assert row["mean_wall_ms"] == pytest.approx(10.0)
+        assert row["status"] == "done" and row["alive"]
+
+    def test_straggler_and_skew_attribution(self, tmp_path):
+        _publish(tmp_path, 0, [10.0] * 8)
+        _publish(tmp_path, 1, [10.0] * 8)
+        _publish(tmp_path, 2, [30.0] * 8)  # persistently 3x slower
+        rep = FleetAggregator(str(tmp_path)).report()
+        assert rep["merged_steps"] == 8
+        s = rep["straggler"]
+        assert s is not None and s["rank"] == 2
+        assert s["score"] >= STRAGGLER_THRESHOLD
+        assert rep["skew"]["worst_rank"] == 2
+        assert rep["skew"]["max_ms"] == pytest.approx(20.0)
+        assert rep["ranks"][2]["slowest_steps"] == 8
+        scores = {r: rep["ranks"][r]["straggler_score"] for r in (0, 1, 2)}
+        assert scores[2] == max(scores.values())
+        assert scores[0] < STRAGGLER_THRESHOLD
+
+    def test_healthy_fleet_has_no_straggler(self, tmp_path):
+        _publish(tmp_path, 0, [10.0] * 6)
+        _publish(tmp_path, 1, [10.5] * 6)  # 5% jitter: below threshold
+        rep = FleetAggregator(str(tmp_path)).report()
+        assert rep["straggler"] is None
+
+    def test_stale_heartbeat_marks_rank_dead(self, tmp_path):
+        _publish(tmp_path, 0, [10.0] * 4)
+        hb = tmp_path / "heartbeat" / "rank_00001.json"
+        hb.parent.mkdir(exist_ok=True)
+        hb.write_text(json.dumps({
+            "rank": 1, "host": "h1", "pid": 1,
+            "ts": time.time() - 120.0, "step": 2, "status": "running"}))
+        rep = FleetAggregator(str(tmp_path), stale_after_seconds=30).report()
+        assert rep["dead_ranks"] == [1]
+        assert not rep["ranks"][1]["alive"]
+        # a finished rank is stale but not dead
+        assert 0 not in rep["dead_ranks"]
+
+    def test_torn_shard_lines_are_skipped(self, tmp_path):
+        _publish(tmp_path, 0, [10.0, 11.0])
+        shard = tmp_path / "steps" / "rank_00000.jsonl"
+        with open(shard, "a") as f:
+            f.write('{"rank": 0, "step": 3, "wall')  # live-writer torn tail
+        rep = FleetAggregator(str(tmp_path)).report()
+        assert rep["ranks"][0]["steps"] == 2
+
+    def test_publish_every_subsamples(self, tmp_path):
+        pub = FleetPublisher(str(tmp_path), rank=0, publish_every_steps=4)
+        for s in range(1, 13):
+            pub.publish_step({"rank": 0, "step": s, "wall_ms": 1.0})
+        pub.close()
+        rows = (tmp_path / "steps" / "rank_00000.jsonl").read_text()
+        assert [json.loads(x)["step"] for x in rows.splitlines()] == [4, 8, 12]
+
+    def test_format_report_renders(self, tmp_path):
+        _publish(tmp_path, 0, [10.0] * 6)
+        _publish(tmp_path, 1, [40.0] * 6)
+        text = format_report(FleetAggregator(str(tmp_path)).report())
+        assert "straggler: rank 1" in text
+        assert "skew:" in text and "2 ranks" in text
+
+    def test_resolve_run_dir_env_beats_config(self, monkeypatch):
+        cfg = types.SimpleNamespace(run_dir="/from/config")
+        assert resolve_run_dir(cfg) == "/from/config"
+        monkeypatch.setenv("DSTPU_RUN_DIR", "/from/env")
+        assert resolve_run_dir(cfg) == "/from/env"
+        monkeypatch.delenv("DSTPU_RUN_DIR")
+        assert resolve_run_dir(None) is None
+
+
+# ---------------------------------------------------------------------------
+# hub -> fleet wiring
+# ---------------------------------------------------------------------------
+
+class TestHubFleetWiring:
+    def test_record_step_shards_into_run_dir(self, tmp_path):
+        hub = get_hub()
+        hub.configure(types.SimpleNamespace(run_dir=str(tmp_path)), rank=5)
+        hub.record_step(StepTrace(step=1, wall_ms=12.5, loss=2.0))
+        hub.record_step(StepTrace(step=2, wall_ms=13.5))
+        reset_hub()  # closes the publisher -> heartbeat status "done"
+        rep = FleetAggregator(str(tmp_path)).report()
+        assert rep["ranks"][5]["steps"] == 2
+        assert rep["ranks"][5]["status"] == "done"
+        rows = [json.loads(x) for x in
+                (tmp_path / "steps" / "rank_00005.jsonl")
+                .read_text().splitlines()]
+        assert rows[0]["wall_ms"] == 12.5 and rows[0]["loss"] == 2.0
+        assert "grad_norm" not in rows[0]  # shard rows keep scalars only
+
+    def test_no_run_dir_means_no_publisher(self):
+        hub = get_hub()
+        hub.configure(types.SimpleNamespace())
+        assert hub._fleet is None  # zero shard I/O on single-process runs
+
+    def test_fallback_counters_flow_to_prometheus(self):
+        from deepspeed_tpu.utils import telemetry
+
+        telemetry.reset()
+        hub = get_hub()
+        hub.record_step(StepTrace(step=1, wall_ms=1.0))
+        telemetry.count("remat_policy", reason="xla fallback")
+        hub.record_step(StepTrace(step=2, wall_ms=1.0))
+        assert hub.counters["fallback.remat_policy"] == 1.0
+        assert "dstpu_fallback_remat_policy_total 1" in hub.to_prometheus()
+        telemetry.reset()
+
+
+# ---------------------------------------------------------------------------
+# chrome-trace export
+# ---------------------------------------------------------------------------
+
+def _rows_and_events(t0):
+    rows = [{"step": s, "wall_ms": 10.0, "timestamp": t0 + 0.02 * s,
+             "loss": 1.0, "host_gap_ms": 2.0} for s in (1, 2, 3)]
+    events = [
+        {"ts": t0 + 0.001, "kind": "step_entry", "step": 1},
+        {"ts": t0 + 0.004, "kind": "step_dispatch", "step": 1},
+        {"ts": t0 + 0.005, "kind": "collective", "op": "all_reduce",
+         "bytes": 4096, "axis": "fsdp"},
+        {"ts": t0 + 0.006, "kind": "checkpoint_save", "phase": "begin"},
+    ]
+    return rows, events
+
+
+class TestChromeTrace:
+    def test_spans_for_steps_and_collectives(self):
+        rows, events = _rows_and_events(1000.0)
+        evs = chrome_trace_events(rows, events, rank=2)
+        spans = [e for e in evs if e["ph"] == "X" and e["cat"] == "step"]
+        assert [e["name"] for e in spans] == ["step 1", "step 2", "step 3"]
+        assert all(e["pid"] == 2 and e["dur"] == 10_000.0 for e in spans)
+        gaps = [e for e in evs if e.get("cat") == "host"]
+        assert len(gaps) == 3 and gaps[0]["dur"] == 2_000.0
+        disp = [e for e in evs if e.get("cat") == "dispatch"]
+        assert len(disp) == 1 and disp[0]["name"] == "dispatch 1"
+        assert disp[0]["dur"] == pytest.approx(3_000.0)
+        comm = [e for e in evs if e.get("tid") == 3 and e["ph"] == "i"]
+        assert len(comm) == 1 and comm[0]["name"] == "all_reduce"
+        other = [e for e in evs if e.get("tid") == 4 and e["ph"] == "i"]
+        assert [e["name"] for e in other] == ["checkpoint_save"]
+        # all timestamps rebased to the earliest event
+        assert min(e["ts"] for e in evs if "ts" in e) == pytest.approx(0.0)
+
+    def test_export_is_loadable_json(self, tmp_path):
+        rows, events = _rows_and_events(2000.0)
+        path = export_chrome_trace(str(tmp_path / "trace.json"),
+                                   step_rows=rows, flight_events=events,
+                                   rank=1)
+        with open(path) as f:
+            doc = json.load(f)
+        assert isinstance(doc["traceEvents"], list)
+        assert any(e.get("cat") == "step" for e in doc["traceEvents"])
+
+    def test_export_live_process_state(self, tmp_path):
+        hub = get_hub()
+        hub.record_step(StepTrace(step=1, wall_ms=5.0))
+        get_flight_recorder().record("collective", op="ppermute", bytes=8)
+        path = export_chrome_trace(str(tmp_path / "live.json"))
+        with open(path) as f:
+            names = [e["name"] for e in json.load(f)["traceEvents"]]
+        assert "step 1" in names and "ppermute" in names
+
+    def test_export_rank_from_run_dir(self, tmp_path, monkeypatch):
+        monkeypatch.delenv("DSTPU_FLIGHT_DIR", raising=False)
+        _publish(tmp_path, 1, [10.0, 12.0])
+        fr = FlightRecorder(capacity=8, rank=1, run_dir=str(tmp_path))
+        fr.record("collective", op="all_gather", bytes=64)
+        fr.dump("exit")
+        path = export_rank_from_run_dir(str(tmp_path), 1,
+                                        str(tmp_path / "trace.json"))
+        with open(path) as f:
+            doc = json.load(f)
+        names = [e["name"] for e in doc["traceEvents"]]
+        assert "step 1" in names and "all_gather" in names
+
+
+# ---------------------------------------------------------------------------
+# watchdog fire -> flight dump + report tail
+# ---------------------------------------------------------------------------
+
+class TestWatchdogFlightIntegration:
+    def test_stall_fire_dumps_flight_and_report_has_tail(self, tmp_path,
+                                                         monkeypatch):
+        from deepspeed_tpu.observability.watchdog import StallWatchdog
+
+        monkeypatch.setenv("DSTPU_FLIGHT_DIR", str(tmp_path))
+        fr = get_flight_recorder()
+        fr.configure(rank=0)
+        fr.record("step_entry", step=9)
+        hub = get_hub()
+        hub.record_step(StepTrace(step=9, wall_ms=11.0, loss=0.5))
+
+        reports = []
+        wd = StallWatchdog(factor=1.0, min_seconds=0.05, warmup_steps=2,
+                           report_fn=reports.append)
+        for _ in range(4):
+            wd.observe(0.01)
+        wd.arm(step=9)
+        deadline = time.time() + 5.0
+        while wd.stalls == 0 and time.time() < deadline:
+            time.sleep(0.02)
+        wd.stop()
+        assert wd.stalls == 1
+        dump = tmp_path / "flight_rank0_watchdog.json"
+        assert dump.exists()
+        doc = json.loads(dump.read_text())
+        assert doc["reason"] == "watchdog" and doc["step"] == 9
+        report = reports[0]
+        assert "flight recorder tail" in report and "step_entry" in report
+        assert "last step traces:" in report and "step 9" in report
+
+
+# ---------------------------------------------------------------------------
+# end to end: two subprocesses, one slowed; plus an induced crash
+# ---------------------------------------------------------------------------
+
+def _worker_env():
+    env = dict(os.environ)
+    # conftest points DSTPU_FLIGHT_DIR at a temp dir and the env var
+    # beats run_dir — drop it so worker dumps land in <run_dir>/flight
+    env.pop("DSTPU_FLIGHT_DIR", None)
+    return env
+
+
+class TestTwoProcessFleet:
+    def test_slowed_rank_named_straggler(self, tmp_path):
+        procs = [subprocess.Popen(
+            [sys.executable, WORKER, "train", str(rank), str(tmp_path),
+             str(sleep_ms)],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+            env=_worker_env())
+            for rank, sleep_ms in ((0, 5.0), (1, 25.0))]
+        for p in procs:
+            out, err = p.communicate(timeout=120)
+            assert p.returncode == 0, f"worker failed:\n{out}\n{err}"
+        rep = FleetAggregator(str(tmp_path)).report()
+        assert rep["n_ranks"] == 2 and rep["merged_steps"] == 10
+        s = rep["straggler"]
+        assert s is not None and s["rank"] == 1, format_report(rep)
+        assert rep["skew"]["worst_rank"] == 1
+        scores = {r: rep["ranks"][r]["straggler_score"] for r in (0, 1)}
+        assert scores[1] == max(scores.values())
+        assert rep["ranks"][1]["slowest_steps"] == 10
+        assert all(rep["ranks"][r]["status"] == "done" for r in (0, 1))
+        # the straggler's shard exports to a valid chrome trace
+        path = export_rank_from_run_dir(str(tmp_path), 1,
+                                        str(tmp_path / "trace.json"))
+        with open(path) as f:
+            doc = json.load(f)
+        assert any(e.get("cat") == "step" for e in doc["traceEvents"])
+
+    def test_induced_crash_leaves_flight_dump(self, tmp_path):
+        p = subprocess.run(
+            [sys.executable, WORKER, "crash", "0", str(tmp_path)],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+            timeout=120, env=_worker_env())
+        assert p.returncode != 0  # the exception still kills the worker
+        dump = tmp_path / "flight" / "flight_rank0_exception.json"
+        assert dump.exists(), p.stderr
+        doc = json.loads(dump.read_text())
+        assert doc["reason"] == "exception"
+        assert "induced crash" in doc["exception"]
+        kinds = {e["kind"] for e in doc["events"]}
+        assert "step_entry" in kinds and doc["n_events"] > 0
+
+    def test_sigterm_leaves_flight_dump(self, tmp_path):
+        # worker with a long per-step sleep: TERM it mid-run
+        p = subprocess.Popen(
+            [sys.executable, WORKER, "train", "0", str(tmp_path), "500"],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+            env=_worker_env())
+        deadline = time.time() + 60.0
+        shard = tmp_path / "steps" / "rank_00000.jsonl"
+        while time.time() < deadline:  # wait until it has published once
+            if shard.exists() and shard.read_text().strip():
+                break
+            time.sleep(0.05)
+        p.send_signal(signal.SIGTERM)
+        p.communicate(timeout=60)
+        dump = tmp_path / "flight" / "flight_rank0_sigterm.json"
+        assert dump.exists()
+        assert json.loads(dump.read_text())["reason"] == "sigterm"

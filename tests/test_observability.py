@@ -124,6 +124,28 @@ class TestSinks:
         assert 'dstpu_fb_total{name="reason a"} 3' in text
         assert "dstpu_lat_bucket" in text and "dstpu_lat_count 1" in text
 
+    def test_prometheus_name_digit_prefix(self):
+        # exposition metric names must not start with a digit
+        assert prometheus_name("2d.sharding", prefix="") == "_2d_sharding"
+
+    def test_label_value_escaping(self):
+        from deepspeed_tpu.observability.sinks import escape_label_value
+
+        assert escape_label_value('say "hi"') == 'say \\"hi\\"'
+        assert escape_label_value("a\\b") == "a\\\\b"
+        assert escape_label_value("two\nlines") == "two\\nlines"
+        # escaping order: the backslash introduced for the quote must not
+        # itself get re-escaped
+        assert escape_label_value('\\"') == '\\\\\\"'
+
+    def test_render_prometheus_escapes_labels(self):
+        text = render_prometheus({}, {}, {}, {
+            "fb": {'bad "label"\nwith newline': 1.0}})
+        # one logical line per sample: the newline is literal \n text
+        assert 'name="bad \\"label\\"\\nwith newline"' in text
+        assert all(l.count('"') % 2 == 0 for l in text.splitlines()
+                   if "{" in l)
+
     def test_parse_trace_steps(self):
         assert parse_trace_steps("5:8") == (5, 8)
         assert parse_trace_steps("12") == (12, 12)

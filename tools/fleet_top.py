@@ -1,0 +1,148 @@
+"""Fleet observability viewer: aggregate per-rank shards into one report.
+
+Every worker of a run publishes atomic heartbeats + append-only step
+shards into a shared run dir (DSTPU_RUN_DIR; see docs/observability.md).
+This tool is the read side — it runs on any host that can see the run
+dir and needs neither jax nor the training job's config:
+
+  python tools/fleet_top.py RUN_DIR                # one-shot report
+  python tools/fleet_top.py RUN_DIR --watch 5      # live top-style view
+  python tools/fleet_top.py RUN_DIR --chrome-trace 0 --out trace.json
+                                                   # Perfetto export
+  python tools/fleet_top.py --demo                 # 2-process CPU demo
+
+The report names the slowest rank per merged step, cross-rank skew, an
+EWMA straggler score per rank, and dead hosts (stale heartbeats). The
+chrome-trace export renders one rank's step shard + flight-recorder
+dumps as a ``trace.json`` loadable in Perfetto / chrome://tracing.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import time
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+from deepspeed_tpu.observability.fleet import (FleetAggregator, FleetPublisher,
+                                               format_report)
+
+
+def parse_args(argv=None):
+    p = argparse.ArgumentParser(prog="fleet_top")
+    p.add_argument("run_dir", nargs="?",
+                   default=os.environ.get("DSTPU_RUN_DIR"),
+                   help="shared run dir (default: $DSTPU_RUN_DIR)")
+    p.add_argument("--watch", type=float, default=0.0, metavar="SECONDS",
+                   help="refresh the report every N seconds until Ctrl-C")
+    p.add_argument("--stale-after", type=float, default=30.0,
+                   help="heartbeat age (s) after which a rank counts dead")
+    p.add_argument("--json", action="store_true",
+                   help="emit the raw report dict as JSON")
+    p.add_argument("--chrome-trace", type=int, default=None, metavar="RANK",
+                   help="export RANK's steps + flight events as a "
+                        "chrome://tracing / Perfetto trace and exit")
+    p.add_argument("--out", default="trace.json",
+                   help="output path for --chrome-trace")
+    p.add_argument("--demo", action="store_true",
+                   help="spawn a short 2-process CPU job into a temp run "
+                        "dir and print the aggregated report")
+    p.add_argument("--demo-worker", type=int, default=None,
+                   help=argparse.SUPPRESS)  # internal: demo subprocess rank
+    return p.parse_args(argv)
+
+
+def _demo_worker(rank: int, run_dir: str) -> int:
+    """Simulated training rank: publishes step shards + flight events.
+
+    Rank 1 sleeps longer per step so the aggregated report has a real
+    straggler to attribute. Pure host code — no jax."""
+    from deepspeed_tpu.observability.flight_recorder import (
+        get_flight_recorder, install_crash_handlers)
+
+    fr = get_flight_recorder()
+    fr.configure(rank=rank, run_dir=run_dir)
+    install_crash_handlers()
+    pub = FleetPublisher(run_dir, rank=rank)
+    per_step = 0.01 if rank == 0 else 0.03  # rank 1 is the straggler
+    for step in range(1, 13):
+        t0 = time.time()
+        fr.record("step_entry", step=step)
+        time.sleep(per_step)
+        fr.record("step_drain", step=step)
+        pub.publish_step({
+            "rank": rank, "step": step,
+            "wall_ms": (time.time() - t0) * 1000.0,
+            "loss": 2.0 / step, "timestamp": time.time(),
+        })
+    fr.dump("demo_exit", final_step=12)
+    pub.close()
+    return 0
+
+
+def _run_demo() -> int:
+    run_dir = tempfile.mkdtemp(prefix="dstpu_fleet_demo_")
+    print(f"fleet demo: 2 CPU ranks publishing into {run_dir}", flush=True)
+    procs = [
+        subprocess.Popen([sys.executable, os.path.abspath(__file__),
+                          "--demo-worker", str(r), run_dir])
+        for r in (0, 1)
+    ]
+    rc = 0
+    for p in procs:
+        rc |= p.wait()
+    report = FleetAggregator(run_dir).report()
+    print(format_report(report))
+    straggler = report.get("straggler")
+    if straggler:
+        print(f"\n=> rank {straggler['rank']} correctly flagged "
+              f"(score {straggler['score']:.2f}); shards + flight dumps "
+              f"kept in {run_dir}")
+    return rc
+
+
+def main(argv=None) -> int:
+    args = parse_args(argv)
+    if args.demo_worker is not None:
+        return _demo_worker(args.demo_worker, args.run_dir)
+    if args.demo:
+        return _run_demo()
+    if not args.run_dir:
+        print("fleet_top: error: no run dir (pass one or set DSTPU_RUN_DIR)",
+              file=sys.stderr)
+        return 2
+    if args.chrome_trace is not None:
+        from deepspeed_tpu.observability.chrome_trace import \
+            export_rank_from_run_dir
+
+        export_rank_from_run_dir(args.run_dir, args.chrome_trace, args.out)
+        print(f"wrote rank {args.chrome_trace} trace to {args.out} "
+              f"(open in Perfetto or chrome://tracing)")
+        return 0
+
+    agg = FleetAggregator(args.run_dir, stale_after_seconds=args.stale_after)
+    while True:
+        report = agg.report()
+        if args.json:
+            print(json.dumps(report, sort_keys=True))
+        else:
+            if args.watch:
+                print("\x1b[2J\x1b[H", end="")  # clear screen, home cursor
+            print(format_report(report), flush=True)
+        if not args.watch:
+            return 0
+        try:
+            time.sleep(args.watch)
+        except KeyboardInterrupt:
+            return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
