@@ -14,7 +14,7 @@ NPROC := $(shell nproc)
 XDIST ?= $(shell if [ $(NPROC) -gt 2 ] && python -c "import xdist" 2>/dev/null; then echo "-n $$(( $(NPROC) - 1 )) --dist loadfile"; fi)
 PYTEST ?= python -m pytest
 
-.PHONY: test smoke slow bench bench-hostgap fleet-demo
+.PHONY: test smoke slow bench bench-real bench-proxy bench-hostgap fleet-demo
 
 smoke:
 	$(PYTEST) tests/ -q -m "not slow" $(XDIST)
@@ -27,6 +27,15 @@ slow:
 
 bench:
 	python bench.py
+
+# The real shape (8L · 131,072 vocab, ZeRO-Infinity streaming) is the
+# default; bench-real spells it out, bench-proxy restores the 3L/8k
+# resident-param proxy shape (docs/roofline.md round 6).
+bench-real:
+	python bench.py
+
+bench-proxy:
+	BENCH_PROXY=1 python bench.py
 
 # Two-process CPU demo of the fleet observability layer: both ranks
 # publish shards into a temp run dir, then the aggregated report (skew,

@@ -53,12 +53,21 @@ class Autotuner:
       base_config:   dict config every trial starts from
       batch_fn:      (global_batch_size) -> batch dict for one micro step
       tuning_space:  {"micro_batch_sizes": [...], "zero_stages": [...],
-                      "remat": [...]} — defaults enumerate powers of two
+                      "remat": [...], "remat_policies": [...],
+                      "tiled_logits": [...], "attn_chunks": [...],
+                      "prefetch_depths": [...]} — the last three are
+                      model-config axes for the real-shape sweep
+                      (vocab-head tile count, FPDT query chunks, and the
+                      ZeRO-Infinity layer-prefetch ring depth); None in
+                      any of them keeps the model's own setting
       hbm_budget_bytes: prune candidates whose compiled peak exceeds this
                       (default: detected device memory, else 16 GiB)
       topology:      mesh topology dict forwarded to every trial engine —
                       must match the final run's topology or the tuned
                       settings are measured under a different mesh
+      persist_path:  write the winning config (model knobs surfaced as
+                      top-level keys) as JSON here after tune() — the
+                      bench reads it back as its real-shape defaults
     """
 
     STATIC_OVERSHOOT = 1.2  # static peak estimate vs allocator reality
@@ -69,7 +78,8 @@ class Autotuner:
                  tuning_space: Optional[Dict[str, Sequence]] = None,
                  hbm_budget_bytes: Optional[int] = None,
                  results_dir: Optional[str] = None,
-                 topology: Optional[Dict[str, int]] = None):
+                 topology: Optional[Dict[str, int]] = None,
+                 persist_path: Optional[str] = None):
         self.model_factory = model_factory
         self.base_config = dict(base_config)
         self.batch_fn = batch_fn
@@ -81,8 +91,15 @@ class Autotuner:
         # named remat policies (activation_checkpointing registry);
         # None = keep the model's own policy
         self.remat_policies = list(space.get("remat_policies", [None]))
+        # real-shape model axes (ISSUE 4): vocab-head tile count ×
+        # FPDT attention chunks × layer-prefetch ring depth. None in a
+        # list = keep the model's own value for that axis.
+        self.tiled_logits = list(space.get("tiled_logits", [None]))
+        self.attn_chunks = list(space.get("attn_chunks", [None]))
+        self.prefetch_depths = list(space.get("prefetch_depths", [None]))
         self.hbm_budget = hbm_budget_bytes or self._detect_hbm()
         self.results_dir = results_dir
+        self.persist_path = persist_path
         self.topology = dict(topology) if topology else None
         self.results: List[AutotunerResult] = []
 
@@ -103,9 +120,10 @@ class Autotuner:
     # -- candidate enumeration (reference tune_space) -------------------
     def candidates(self) -> List[Dict[str, Any]]:
         out = []
-        for mb, stage, remat, policy in itertools.product(
+        for mb, stage, remat, policy, tl, ac, pd in itertools.product(
                 self.micro_batch_sizes, self.zero_stages, self.remat,
-                self.remat_policies):
+                self.remat_policies, self.tiled_logits, self.attn_chunks,
+                self.prefetch_depths):
             cfg = json.loads(json.dumps(self.base_config))  # deep copy
             cfg["train_micro_batch_size_per_chip"] = int(mb)
             cfg.pop("train_batch_size", None)  # re-derived from micro×gas×dp
@@ -114,6 +132,13 @@ class Autotuner:
             cfg["_remat"] = bool(remat or policy)
             if policy is not None:
                 cfg["_remat_policy"] = str(policy)
+            # model-config axes ride as private keys _build_engine pops
+            if tl is not None:
+                cfg["_tiled_logits"] = int(tl)
+            if ac is not None:
+                cfg["_attn_chunks"] = int(ac)
+            if pd is not None:
+                cfg["_prefetch_depth"] = int(pd)
             out.append(cfg)
         return out
 
@@ -124,6 +149,12 @@ class Autotuner:
         cfg = dict(cfg)
         remat = cfg.pop("_remat", False)
         policy = cfg.pop("_remat_policy", None)
+        model_axes = {name: cfg.pop(key)
+                      for key, name in (("_tiled_logits", "tiled_logits"),
+                                        ("_attn_chunks", "attn_chunks"),
+                                        ("_prefetch_depth",
+                                         "prefetch_depth"))
+                      if key in cfg}
         model = self.model_factory()
         if hasattr(model, "config") and hasattr(model.config, "remat"):
             # set BOTH ways: models default remat=True, so a remat=False
@@ -133,6 +164,8 @@ class Autotuner:
             updates = {"remat": bool(remat)}
             if policy is not None:
                 updates["remat_policy"] = policy
+            updates.update({k: v for k, v in model_axes.items()
+                            if hasattr(model.config, k)})
             model.config = _dc.replace(model.config, **updates)
         engine, *_ = dstpu.initialize(model=model, config=cfg,
                                       topology=self.topology)
@@ -265,6 +298,7 @@ class Autotuner:
         if fast:
             best = viable[0]
             self._write_results()
+            self._persist_best(best.config)
             return best.config
         timed = [self._measure(r.config, measure_steps)
                  for r in viable[:top_k]]
@@ -272,6 +306,7 @@ class Autotuner:
         ran = [r for r in timed if r.ran]
         self._write_results()
         if not ran:
+            self._persist_best(viable[0].config)
             return viable[0].config
         best = max(ran, key=lambda r: r.metric_value)
         log_dist(
@@ -279,7 +314,40 @@ class Autotuner:
             f"{best.config['train_micro_batch_size_per_chip']} "
             f"zero={best.config['zero_optimization']['stage']} "
             f"→ {best.metric_value:.1f} samples/s", ranks=[0])
+        self._persist_best(best.config, best.metric_value)
         return best.config
+
+    @staticmethod
+    def tuned_defaults(cfg: Dict[str, Any]) -> Dict[str, Any]:
+        """Surface a candidate's private model-axis keys as the public
+        knob names the bench / engine understand."""
+        out = json.loads(json.dumps(cfg))
+        out["remat"] = bool(out.pop("_remat", False))
+        if "_remat_policy" in out:
+            out["remat_policy"] = out.pop("_remat_policy")
+        if "_tiled_logits" in out:
+            out["tiled_logits"] = int(out.pop("_tiled_logits"))
+        if "_attn_chunks" in out:
+            out["attn_chunks"] = int(out.pop("_attn_chunks"))
+        if "_prefetch_depth" in out:
+            out.setdefault("performance", {})["param_prefetch_depth"] = \
+                int(out.pop("_prefetch_depth"))
+        return out
+
+    def _persist_best(self, cfg: Dict[str, Any],
+                      metric_value: Optional[float] = None) -> None:
+        if not self.persist_path:
+            return
+        payload = self.tuned_defaults(cfg)
+        if metric_value is not None:
+            payload["_tuned_samples_per_sec"] = float(metric_value)
+        d = os.path.dirname(self.persist_path)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        with open(self.persist_path, "w") as f:
+            json.dump(payload, f, indent=2, default=str)
+        log_dist(f"autotuner: persisted best config → {self.persist_path}",
+                 ranks=[0])
 
     def _write_results(self):
         if not self.results_dir:
@@ -313,10 +381,23 @@ def main(argv=None) -> int:
     ap.add_argument("--zero-stages", type=int, nargs="+", default=None)
     ap.add_argument("--remat", type=int, nargs="+", default=None,
                     help="0/1 values to try")
+    ap.add_argument("--remat-policies", nargs="+", default=None,
+                    help="named remat policies to try (activation_"
+                         "checkpointing registry); 'none' = model default")
+    ap.add_argument("--tiled-logits", type=int, nargs="+", default=None,
+                    help="vocab-head tile counts to try (0 = untiled)")
+    ap.add_argument("--attn-chunks", type=int, nargs="+", default=None,
+                    help="FPDT attention query-chunk counts to try")
+    ap.add_argument("--prefetch-depths", type=int, nargs="+", default=None,
+                    help="layer-prefetch ring depths to try (1 = plain "
+                         "double buffering)")
     ap.add_argument("--fast", action="store_true",
                     help="rank by compiled memory only (no timed runs)")
     ap.add_argument("--steps", type=int, default=3)
     ap.add_argument("--results-dir", default=None)
+    ap.add_argument("--persist", default=None, metavar="PATH",
+                    help="write the winning config JSON here (bench.py "
+                         "reads it back as real-shape defaults)")
     args = ap.parse_args(argv)
 
     import numpy as np
@@ -347,15 +428,24 @@ def main(argv=None) -> int:
         space["zero_stages"] = args.zero_stages
     if args.remat is not None:
         space["remat"] = [bool(v) for v in args.remat]
+    if args.remat_policies is not None:
+        space["remat_policies"] = [None if p == "none" else p
+                                   for p in args.remat_policies]
+    if args.tiled_logits is not None:
+        space["tiled_logits"] = args.tiled_logits
+    if args.attn_chunks is not None:
+        space["attn_chunks"] = args.attn_chunks
+    if args.prefetch_depths is not None:
+        space["prefetch_depths"] = args.prefetch_depths
     tuner = Autotuner(model_factory, base, batch_fn,
                       tuning_space=space or None,
-                      results_dir=args.results_dir)
+                      results_dir=args.results_dir,
+                      persist_path=args.persist)
     best = tuner.tune(fast=args.fast, measure_steps=args.steps)
     if best is None:
         print(json.dumps({"error": "no viable config"}))
         return 1
-    # surface the winning remat choice (a model flag, not a config key)
-    # as a top-level entry so the printed config reproduces the result
-    best["remat"] = bool(best.pop("_remat", False))
-    print(json.dumps(best))
+    # surface winning model knobs (model flags, not config keys) as
+    # top-level entries so the printed config reproduces the result
+    print(json.dumps(Autotuner.tuned_defaults(best)))
     return 0

@@ -370,10 +370,25 @@ class PerformanceConfig(ConfigModel):
     bounds the background input-prefetch buffer
     (runtime/prefetch.py PrefetchingIterator); 0 disables prefetch, and
     multi-process runs fall back to synchronous input assembly
-    regardless."""
+    regardless.
+
+    ``param_prefetch_depth`` sets the depth of the ZeRO-Infinity layer
+    prefetch ring (runtime/param_stream.py streamed_layers_prefetch):
+    K layers of host→device fetches ride in flight ahead of the compute
+    when ``offload_param`` streams the layer stack. None (default)
+    keeps the model's own default (2, or the DSTPU_PREFETCH_DEPTH env);
+    1 reproduces plain double-buffering bit-for-bit. HBM cost is K
+    fp32 layers.
+
+    ``fp8_mlp`` routes the MLP-block matmuls through fp8 (e4m3 operands,
+    fp32 accumulation, straight-through gradients — ops/fp_quantizer.py
+    fp8_matmul_ste). Opt-in: off by default for exact parity; on v5p+
+    the MXU runs fp8 at 2x the bf16 rate."""
 
     pipeline_depth: int = 0
     prefetch_depth: int = 2
+    param_prefetch_depth: Optional[int] = None
+    fp8_mlp: bool = False
 
     def validate(self) -> None:
         if self.pipeline_depth < 0:
@@ -384,6 +399,11 @@ class PerformanceConfig(ConfigModel):
             raise ValueError(
                 f"performance.prefetch_depth must be >= 0, got "
                 f"{self.prefetch_depth}")
+        if self.param_prefetch_depth is not None \
+                and self.param_prefetch_depth < 1:
+            raise ValueError(
+                f"performance.param_prefetch_depth must be >= 1, got "
+                f"{self.param_prefetch_depth}")
 
 
 @register_config_model

@@ -29,6 +29,7 @@ from deepspeed_tpu.models.transformer import (
     TransformerConfig, _norm, _rope, act_fn)
 from deepspeed_tpu.runtime.sharding import (effective_dtype,
                                             vocab_parallel_lookup)
+from deepspeed_tpu.utils import jaxcompat
 
 
 def _qkv(cfg: TransformerConfig, layer_params, y, positions):
@@ -270,7 +271,7 @@ def _tp_shard_map(kernel, mesh, q_spec, n_extra: int):
 
     kv_spec = PS(None, None, None, "tp", None)
     in_specs = (q_spec, kv_spec) + (PS(),) * n_extra
-    return jax.shard_map(kernel, mesh=mesh, in_specs=in_specs,
+    return jaxcompat.shard_map(kernel, mesh=mesh, in_specs=in_specs,
                          out_specs=q_spec, check_vma=False)
 
 

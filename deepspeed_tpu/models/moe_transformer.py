@@ -179,10 +179,12 @@ def apply(cfg: MoETransformerConfig, params, tokens, positions=None,
         # inside the rematerialized body, so HBM holds one layer's
         # experts at a time
         def fetch_layer(i):
+            from deepspeed_tpu.utils import memspace
+
             return jax.tree.map(
-                lambda a: jax.device_put(
+                lambda a: memspace.put(
                     lax.dynamic_index_in_dim(a, i, keepdims=False),
-                    jax.memory.Space.Device),
+                    "device"),
                 params["layers"])
 
         def fetched_fn(x, i):

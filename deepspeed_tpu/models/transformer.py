@@ -111,6 +111,11 @@ class TransformerConfig:
     # inside the backward scan (see runtime/param_stream.py)
     prefetch_depth: Optional[int] = None
     grads_to_host: Optional[bool] = None
+    # fp8 MLP matmuls (ops/fp_quantizer.py fp8_matmul_ste): e4m3
+    # operands into an fp32-accumulating matmul with straight-through
+    # gradients. Opt-in — off keeps exact bf16/fp32 parity. Set by the
+    # engine from config.performance.fp8_mlp.
+    fp8_mlp: bool = False
 
     def __post_init__(self):
         import os as _os
@@ -558,20 +563,32 @@ def _layer_mlp(cfg: TransformerConfig, x, attn, layer_params):
     if not cfg.parallel_block:
         x = x + attn
 
+    if cfg.fp8_mlp:
+        # fp8 MLP GEMMs (performance.fp8_mlp): e4m3 operands, fp32
+        # accumulation, straight-through grads — the projections are
+        # the real-shape compute bulk and tolerate fp8 forward noise
+        from deepspeed_tpu.ops.fp_quantizer import fp8_matmul_ste
+
+        def matmul(y, w):
+            return fp8_matmul_ste(y, w.astype(dt), out_dtype=dt)
+    else:
+        def matmul(y, w):
+            return jnp.einsum("...h,hf->...f", y, w.astype(dt))
+
     def mlp_fn(y):
         if cfg.activation == "swiglu":
-            g = jnp.einsum("bsh,hf->bsf", y, mp["wg"].astype(dt))
-            u = jnp.einsum("bsh,hf->bsf", y, mp["wi"].astype(dt))
+            g = matmul(y, mp["wg"])
+            u = matmul(y, mp["wi"])
             z = jax.nn.silu(g) * u
         else:
             act = act_fn(cfg.activation)
-            pre = jnp.einsum("bsh,hf->bsf", y, mp["wi"].astype(dt))
+            pre = matmul(y, mp["wi"])
             if cfg.use_biases:
                 pre = pre + mp["bi"].astype(dt)
             z = act(pre)
         z = constrain_activation(
             checkpoint_name(z, "mlp_wi"), ("batch", "seq", "mlp"))
-        out = jnp.einsum("bsf,fh->bsh", z, mp["wo"].astype(dt))
+        out = matmul(z, mp["wo"])
         if cfg.use_biases:
             out = out + mp["bo"].astype(dt)
         return checkpoint_name(out, "mlp_out")
@@ -682,10 +699,12 @@ def apply_hidden(cfg: TransformerConfig, params: Dict[str, Any],
                 grads_to_host=cfg.grads_to_host)
         else:
             def fetch_layer(i):
+                from deepspeed_tpu.utils import memspace
+
                 return jax.tree.map(
-                    lambda a: jax.device_put(
+                    lambda a: memspace.put(
                         lax.dynamic_index_in_dim(a, i, keepdims=False),
-                        jax.memory.Space.Device),
+                        "device"),
                     params["layers"])
 
             def fetched_layer_fn(carry, i):
@@ -774,8 +793,9 @@ def apply_hidden_hosted(cfg: TransformerConfig, params: Dict[str, Any],
     for li in range(cfg.num_layers):
         lp = jax.tree.map(lambda a: a[li], params["layers"])
         if cfg.param_host_offload:
-            lp = jax.tree.map(
-                lambda a: jax.device_put(a, jax.memory.Space.Device), lp)
+            from deepspeed_tpu.utils import memspace
+
+            lp = jax.tree.map(lambda a: memspace.put(a, "device"), lp)
         x_t = _layer(cfg, x_t, lp, positions, hosted_seq_len=S)
     return x_t, S, C
 
@@ -845,7 +865,9 @@ def apply(cfg: TransformerConfig, params: Dict[str, Any], tokens: jax.Array,
         x_t, S, C = apply_hidden_hosted(cfg, params, tokens, positions)
         T, BC, H = x_t.shape
         B = BC // C
-        x = jax.device_put(x_t, jax.memory.Space.Device)
+        from deepspeed_tpu.utils import memspace
+
+        x = memspace.put(x_t, "device")
         x = x.reshape(T, B, C, H).transpose(1, 0, 2, 3).reshape(B, T * C, H)
         x = x[:, :S]
         x = _norm(x, params["final_norm"], cfg.norm, cfg.norm_eps)

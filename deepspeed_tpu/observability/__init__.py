@@ -7,6 +7,9 @@ existing monitor backends plus JSON-lines and Prometheus text sinks.
 See docs/observability.md.
 """
 
+from deepspeed_tpu.observability.attribution import (REGIONS, RegionCost,
+                                                     attribute_step,
+                                                     attribution_markdown)
 from deepspeed_tpu.observability.chrome_trace import (
     chrome_trace_events, export_chrome_trace, export_rank_from_run_dir)
 from deepspeed_tpu.observability.fleet import (FleetAggregator, FleetPublisher,
@@ -31,6 +34,10 @@ from deepspeed_tpu.observability.step_trace import StepTrace
 from deepspeed_tpu.observability.watchdog import StallWatchdog
 
 __all__ = [
+    "REGIONS",
+    "RegionCost",
+    "attribute_step",
+    "attribution_markdown",
     "Histogram",
     "MetricsHub",
     "get_hub",

@@ -1,0 +1,331 @@
+"""Per-region roofline attribution for a training step.
+
+Splits the step's cost into the five buckets that matter at the real
+shape (8L · 131k vocab on one chip, docs/roofline.md): **attn**,
+**mlp**, **vocab_head**, **optimizer**, **param_fetch**.
+
+The three compute buckets are measured, not modeled: each region is a
+small jitted closure over the model's own block functions
+(``models.transformer._layer`` / ``_layer_mlp`` / the fused
+final-norm+unembed+CE tail), lowered + compiled on abstract
+``ShapeDtypeStruct`` inputs and read back through XLA's cost analysis —
+so the numbers track whatever the compiler actually emits (remat, fp8,
+tiling) and the pass runs anywhere jax compiles, including CPU CI.
+The attn bucket is the full-block cost minus the MLP-half cost
+(the block is fused end-to-end; XLA cannot attribute a residual add to
+one side, and the subtraction is exact for the matmul-dominated terms).
+
+The two non-compute buckets are analytic transfer models:
+
+- ``optimizer``: fused-Adam HBM (or host-RAM, under offload) traffic —
+  reads master+m+v (12 B/param) + the grad, writes master+m+v + the
+  bf16 model cast.
+- ``param_fetch``: ZeRO-Infinity layer streaming — per-layer param
+  bytes × layers × (fwd + bwd), against the host link bandwidth
+  (``DSTPU_FETCH_GBPS``, default the measured ~3.3 GB/s tunnel H2D).
+  This traffic *overlaps* compute via the prefetch ring
+  (``performance.param_prefetch_depth``); its row reports the bandwidth
+  floor it needs to stay hidden, not an additive cost.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from typing import Any, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.observability.roofline import roofline_summary
+
+REGIONS = ("attn", "mlp", "vocab_head", "optimizer", "param_fetch")
+
+# measured sustained H2D on the tunnel-attached v5e (docs/roofline.md);
+# a pod's per-layer bf16 all-gather over ICI is ≥20x this
+_DEFAULT_FETCH_GBPS = 3.3
+
+
+@dataclasses.dataclass
+class RegionCost:
+    region: str
+    flops: float            # total for the step (already × num_layers)
+    bytes_accessed: float
+    note: str = ""
+    overlapped: bool = False  # traffic hidden behind compute when true
+
+    @property
+    def intensity(self) -> float:
+        if self.bytes_accessed <= 0:
+            return float("inf")
+        return self.flops / self.bytes_accessed
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {**dataclasses.asdict(self),
+                "arithmetic_intensity": (
+                    None if self.bytes_accessed <= 0
+                    else round(self.intensity, 3))}
+
+
+def _grad_cost(fn, *abstract_args,
+               argnums: Optional[tuple] = None) -> Dict[str, float]:
+    """Compile grad-of-sum of ``fn`` on abstract inputs; return XLA cost
+    analysis (fwd+bwd flops / bytes — the shape a train step pays).
+    ``argnums`` defaults to every non-integer argument."""
+    from deepspeed_tpu.profiling.flops_profiler import profile_compiled
+
+    def total(*a):
+        out = fn(*a)
+        if isinstance(out, tuple):
+            out = out[0]
+        return jnp.sum(out.astype(jnp.float32))
+
+    if argnums is None:
+        argnums = tuple(
+            i for i, a in enumerate(abstract_args)
+            if not all(jnp.issubdtype(jnp.dtype(s.dtype), jnp.integer)
+                       for s in jax.tree.leaves(a)))
+    g = jax.jit(jax.grad(total, argnums=argnums))
+    return profile_compiled(g, *abstract_args)
+
+
+def _abstract_params(cfg):
+    """ShapeDtypeStruct tree of the full model params (no compute)."""
+    from deepspeed_tpu.models.transformer import init_params
+
+    return jax.eval_shape(lambda k: init_params(cfg, k),
+                          jax.random.PRNGKey(0))
+
+
+def _per_layer_shapes(stacked_layers):
+    """Strip the leading stacked-layer dim: [L, ...] -> [...]."""
+    return jax.tree.map(
+        lambda s: jax.ShapeDtypeStruct(s.shape[1:], s.dtype),
+        stacked_layers)
+
+
+def _tree_bytes(tree) -> int:
+    return int(sum(
+        int(jnp.prod(jnp.asarray(s.shape))) * jnp.dtype(s.dtype).itemsize
+        for s in jax.tree.leaves(tree)))
+
+
+def _head_fn(cfg):
+    """Fused final-norm + unembed + CE tail (mirrors loss_fn's tiled and
+    plain branches; the qwz fetch hooks are identity when unconfigured)."""
+    from deepspeed_tpu.models.transformer import _norm
+    from deepspeed_tpu.runtime.sharding import effective_dtype
+
+    dt = effective_dtype(cfg.dtype)
+
+    def head(hidden, head_params, labels):
+        unembed = head_params["unembed"].astype(dt)
+        if cfg.tiled_logits > 1:
+            from deepspeed_tpu.parallel.tiled_compute import \
+                tiled_logits_loss
+
+            def fnorm_tile(h):
+                return _norm(h, head_params["final_norm"], cfg.norm,
+                             cfg.norm_eps)
+
+            nll_sum, total = tiled_logits_loss(
+                hidden, unembed, labels, None, cfg.tiled_logits,
+                transpose_unembed=cfg.tie_embeddings,
+                tile_transform=fnorm_tile)
+            return nll_sum / jnp.maximum(total, 1.0)
+        normed = _norm(hidden, head_params["final_norm"], cfg.norm,
+                       cfg.norm_eps)
+        eq = ("bsh,vh->bsv" if cfg.tie_embeddings else "bsh,hv->bsv")
+        logits = jnp.einsum(eq, normed.astype(dt), unembed).astype(
+            jnp.float32)
+        logz = jax.nn.logsumexp(logits, axis=-1)
+        gold = jnp.take_along_axis(
+            logits, labels[..., None], axis=-1)[..., 0]
+        return jnp.mean(logz - gold)
+
+    return head
+
+
+def attribute_step(cfg, micro_batch: int, seq: int, *,
+                   fetch_gbps: Optional[float] = None,
+                   optimizer: str = "adamw",
+                   optimizer_on_host: Optional[bool] = None,
+                   grad_bytes_per_param: int = 2) -> List[RegionCost]:
+    """Measure/model the five region costs for one fwd+bwd+update step.
+
+    ``cfg`` is a TransformerConfig; compute regions are compiled at
+    [micro_batch, seq, hidden] activations and scaled by ``num_layers``.
+    """
+    from deepspeed_tpu.models.transformer import _layer, _layer_mlp
+    from deepspeed_tpu.runtime.sharding import effective_dtype
+
+    dt = effective_dtype(cfg.dtype)
+    H, L = cfg.hidden_size, cfg.num_layers
+    x = jax.ShapeDtypeStruct((micro_batch, seq, H), dt)
+    pos = jax.ShapeDtypeStruct((micro_batch, seq), jnp.int32)
+    labels = jax.ShapeDtypeStruct((micro_batch, seq), jnp.int32)
+
+    params = _abstract_params(cfg)
+    lp = _per_layer_shapes(params["layers"])
+
+    layer_cost = _grad_cost(
+        lambda lp_, x_, pos_: _layer(cfg, x_, lp_, pos_), lp, x, pos)
+    mlp_cost = _grad_cost(
+        lambda lp_, x_, attn_: _layer_mlp(cfg, x_, attn_, lp_),
+        lp, x, x)
+
+    unembed = (params["embed"]["tokens"] if cfg.tie_embeddings
+               else params["unembed"]["kernel"])
+    head_params = {"final_norm": params["final_norm"], "unembed": unembed}
+    head_cost = _grad_cost(
+        lambda h_, hp_, lab_: _head_fn(cfg)(h_, hp_, lab_),
+        x, head_params, labels)
+
+    regions = [
+        RegionCost(
+            "attn",
+            max(0.0, (layer_cost["flops"] - mlp_cost["flops"])) * L,
+            max(0.0, (layer_cost["bytes_accessed"]
+                      - mlp_cost["bytes_accessed"])) * L,
+            note="block minus MLP-half, x num_layers"),
+        RegionCost(
+            "mlp", mlp_cost["flops"] * L,
+            mlp_cost["bytes_accessed"] * L,
+            note=("fp8 GEMMs" if cfg.fp8_mlp else "bf16 GEMMs")
+                 + ", x num_layers"),
+        RegionCost(
+            "vocab_head", head_cost["flops"],
+            head_cost["bytes_accessed"],
+            note=(f"tiled_logits={cfg.tiled_logits}"
+                  if cfg.tiled_logits > 1 else "untiled logits")),
+    ]
+
+    # -- optimizer: analytic fused-Adam traffic -------------------------
+    n_params = cfg.num_params()
+    model_bytes = jnp.dtype(dt).itemsize
+    if optimizer.lower() in ("adam", "adamw"):
+        opt_reads = 12 + grad_bytes_per_param    # master+m+v + grad
+        opt_writes = 12 + model_bytes            # master+m+v + cast
+    else:                                        # sgd-class
+        opt_reads = 4 + grad_bytes_per_param
+        opt_writes = 4 + model_bytes
+    on_host = (optimizer_on_host if optimizer_on_host is not None
+               else bool(cfg.prefetch_stream))
+    regions.append(RegionCost(
+        "optimizer", float(n_params) * 4,        # ~4 flop/param update
+        float(n_params) * (opt_reads + opt_writes),
+        note=("host-RAM traffic (offload_optimizer)" if on_host
+              else "HBM traffic, overlapped with backward"),
+        overlapped=not on_host))
+
+    # -- param_fetch: ZeRO-Infinity layer streaming ---------------------
+    layer_bytes = _tree_bytes(lp)
+    fetch = (fetch_gbps if fetch_gbps is not None
+             else float(os.environ.get("DSTPU_FETCH_GBPS",
+                                       _DEFAULT_FETCH_GBPS)))
+    depth = cfg.prefetch_depth if cfg.prefetch_depth else 1
+    regions.append(RegionCost(
+        "param_fetch", 0.0,
+        float(layer_bytes) * L * 2,              # fwd + bwd passes
+        note=(f"host->device @ ~{fetch:g} GB/s, prefetch ring depth "
+              f"{depth}" if cfg.prefetch_stream
+              else "params resident (no streaming)"),
+        overlapped=True))
+    return regions
+
+
+def attribution_markdown(regions: List[RegionCost], peak_tflops: float,
+                         hbm_gbps: float,
+                         fetch_gbps: Optional[float] = None,
+                         title: str = "Per-region roofline attribution"
+                         ) -> str:
+    """Render the region table docs/roofline.md embeds."""
+    fetch = (fetch_gbps if fetch_gbps is not None
+             else float(os.environ.get("DSTPU_FETCH_GBPS",
+                                       _DEFAULT_FETCH_GBPS)))
+    lines = [f"### {title}", "",
+             "| region | GFLOPs | GB moved | F/B | bound | "
+             "roofline ms | notes |",
+             "|---|---|---|---|---|---|---|"]
+    for r in regions:
+        if r.region == "param_fetch":
+            ms = r.bytes_accessed / (fetch * 1e9) * 1e3
+            bound = "host-link"
+        else:
+            summ = roofline_summary(
+                {"flops": r.flops, "bytes_accessed": r.bytes_accessed},
+                peak_tflops, hbm_gbps)
+            bound = summ["bound"]
+            compute_ms = r.flops / (peak_tflops * 1e12) * 1e3
+            mem_ms = r.bytes_accessed / (hbm_gbps * 1e9) * 1e3
+            ms = max(compute_ms, mem_ms)
+        inten = ("—" if r.bytes_accessed <= 0 or r.flops <= 0
+                 else f"{r.flops / r.bytes_accessed:.1f}")
+        note = r.note + (" (overlapped)" if r.overlapped else "")
+        lines.append(
+            f"| {r.region} | {r.flops / 1e9:,.1f} | "
+            f"{r.bytes_accessed / 1e9:,.2f} | {inten} | {bound} | "
+            f"{ms:,.2f} | {note} |")
+    lines.append("")
+    lines.append(
+        "Roofline ms = max(flops/peak, bytes/HBM-bw) per region in "
+        "isolation; overlapped rows stream behind compute and bound "
+        "throughput only if their bandwidth floor is missed.")
+    return "\n".join(lines)
+
+
+# ---------------------------------------------------------------------------
+# CLI: python -m deepspeed_tpu.observability.attribution --layers 8 \
+#          --vocab 131072 --out docs/roofline.md  (appends the table)
+# ---------------------------------------------------------------------------
+
+
+def main(argv=None) -> int:
+    import argparse
+
+    ap = argparse.ArgumentParser(
+        prog="dstpu-attribution",
+        description="compile per-region closures at a given shape and "
+                    "print the roofline attribution table")
+    ap.add_argument("--model", default="llama3-8b")
+    ap.add_argument("--layers", type=int, default=8)
+    ap.add_argument("--vocab", type=int, default=131072)
+    ap.add_argument("--seq", type=int, default=2048)
+    ap.add_argument("--micro", type=int, default=4)
+    ap.add_argument("--tiled-logits", type=int, default=None)
+    ap.add_argument("--peak-tflops", type=float, default=None)
+    ap.add_argument("--hbm-gbps", type=float, default=None)
+    ap.add_argument("--json", action="store_true",
+                    help="emit the raw region dicts instead of markdown")
+    args = ap.parse_args(argv)
+
+    import dataclasses as _dc
+
+    from deepspeed_tpu.models.zoo import get_model
+    from deepspeed_tpu.observability.roofline import (detect_hbm_gbps,
+                                                      detect_peak_tflops)
+
+    model = get_model(args.model, max_seq_len=args.seq)
+    updates = {"num_layers": args.layers, "vocab_size": args.vocab}
+    if args.tiled_logits is not None:
+        updates["tiled_logits"] = args.tiled_logits
+    cfg = _dc.replace(model.config, **updates)
+
+    dev = jax.devices()[0]
+    peak = args.peak_tflops or detect_peak_tflops(dev)
+    hbm = args.hbm_gbps or detect_hbm_gbps(dev)
+    regions = attribute_step(cfg, args.micro, args.seq)
+    if args.json:
+        print(json.dumps([r.to_dict() for r in regions], indent=2))
+    else:
+        shape = (f"{args.model} {args.layers}L vocab {args.vocab:,} "
+                 f"seq {args.seq} micro {args.micro}")
+        print(attribution_markdown(
+            regions, peak, hbm,
+            title=f"Per-region roofline attribution — {shape}"))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

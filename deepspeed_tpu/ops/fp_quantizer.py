@@ -101,6 +101,53 @@ def fp8_matmul(a: jax.Array, b: jax.Array,
     return (acc * (sa * sb)).astype(out_dtype)
 
 
+def fp8_matmul_ste(x: jax.Array, w: jax.Array, fmt: str = "e4m3",
+                   out_dtype=None) -> jax.Array:
+    """fp8 forward matmul with STRAIGHT-THROUGH gradients: the forward
+    quantizes both operands to fp8 (per-tensor scales, fp32 MXU
+    accumulation — on v5p+ fp8 runs the MXU at 2x the bf16 rate), while
+    the backward differentiates as if the matmul were exact, in the
+    operands' own precision:
+
+        dx = g @ w.T        dw = x.T @ g   (batch dims summed)
+
+    This is the training-time fp8 recipe (Transformer-Engine-style
+    delayed/just-in-time scaling without the history window): quantizing
+    the gradient path too would need per-tensor e5m2 grad scaling for
+    stability, and the backward matmuls are not the real-shape
+    bottleneck — the forward MLP GEMMs are.
+
+    ``x`` is [..., K] (any leading batch dims), ``w`` is [K, N].
+    Returns [..., N] in ``out_dtype`` (default: x.dtype).
+    """
+    if out_dtype is None:
+        out_dtype = x.dtype
+
+    @jax.custom_vjp
+    def _mm(x, w):
+        lead = x.shape[:-1]
+        x2 = x.reshape(-1, x.shape[-1])
+        return fp8_matmul(x2, w, fmt=fmt,
+                          out_dtype=out_dtype).reshape(*lead, w.shape[-1])
+
+    def _fwd(x, w):
+        return _mm(x, w), (x, w)
+
+    def _bwd(res, g):
+        x, w = res
+        gx = g.astype(x.dtype)
+        dx = jnp.matmul(gx, w.astype(x.dtype).T,
+                        preferred_element_type=jnp.float32).astype(x.dtype)
+        g2 = g.reshape(-1, g.shape[-1])
+        x2 = x.reshape(-1, x.shape[-1])
+        dw = jnp.matmul(x2.astype(jnp.float32).T, g2.astype(jnp.float32),
+                        preferred_element_type=jnp.float32).astype(w.dtype)
+        return dx, dw
+
+    _mm.defvjp(_fwd, _bwd)
+    return _mm(x, w)
+
+
 class FPQuantizer:
     """Object API parity with the reference's ``FP_Quantize`` wrapper
     (deepspeed/ops/fp_quantizer/quantize.py): quantize / dequantize /

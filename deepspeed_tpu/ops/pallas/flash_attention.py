@@ -45,6 +45,7 @@ import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
+from deepspeed_tpu.utils import jaxcompat
 
 DEFAULT_BLOCK_Q = 128
 DEFAULT_BLOCK_K = 128
@@ -252,7 +253,7 @@ def _flash_fwd(q, k, v, seg_q, seg_k, scale: float, causal: bool,
             jax.ShapeDtypeStruct((BHq, S, D), q.dtype),
             jax.ShapeDtypeStruct((BHq, S, STAT_LANES), jnp.float32),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=jaxcompat.pallas_tpu_compiler_params(
             dimension_semantics=("parallel", "arbitrary")),
         interpret=_interpret(),
     )(*args)
@@ -430,7 +431,7 @@ def _flash_bwd(q, k, v, seg_q, seg_k, o, lse, do, scale, causal,
             jax.ShapeDtypeStruct((BHkv, S, D), k.dtype),
             jax.ShapeDtypeStruct((BHkv, S, D), v.dtype),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=jaxcompat.pallas_tpu_compiler_params(
             dimension_semantics=("parallel", "arbitrary", "arbitrary")),
         interpret=_interpret(),
     )(*dkdv_args)
@@ -472,7 +473,7 @@ def _flash_bwd(q, k, v, seg_q, seg_k, o, lse, do, scale, causal,
                                lambda b, t: (b, d_q(t)[0], 0)),
         scratch_shapes=[pltpu.VMEM((block_q, D), jnp.float32)],
         out_shape=jax.ShapeDtypeStruct((BHq, S, D), q.dtype),
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=jaxcompat.pallas_tpu_compiler_params(
             dimension_semantics=("parallel", "arbitrary")),
         interpret=_interpret(),
     )(*dq_args)

@@ -31,6 +31,7 @@ import jax.numpy as jnp
 from jax import lax
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
+from deepspeed_tpu.utils import jaxcompat
 
 DEFAULT_BLOCK = 256
 
@@ -170,7 +171,7 @@ def quantized_psum_scatter(x: jax.Array, axis: str, bits: int = 8,
     local reduce (reference all_to_all_quant_reduce,
     runtime/comm/coalesced_collectives.py:31). Inside shard_map; scatters
     dim 0. Returns the mean-reduced shard in x.dtype."""
-    n = lax.axis_size(axis)
+    n = jaxcompat.axis_size(axis)
     shard = x.shape[0] // n
     q, s = quantize_blockwise(x, bits=bits, block=block)
     if bits == 4:

@@ -153,11 +153,15 @@ def chunked_attention(q, k, v, causal: bool = True, q_chunks: int = 4,
 
 def _to_host(x):
     """Move to pinned host memory inside jit (no-op placement on CPU)."""
-    return jax.device_put(x, jax.memory.Space.Host)
+    from deepspeed_tpu.utils import memspace
+
+    return memspace.put(x, "pinned_host")
 
 
 def _to_device(x):
-    return jax.device_put(x, jax.memory.Space.Device)
+    from deepspeed_tpu.utils import memspace
+
+    return memspace.put(x, "device")
 
 
 def _fetch_tile(stacked, t_idx):

@@ -24,6 +24,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from deepspeed_tpu.runtime.sharding import constrain_activation
+from deepspeed_tpu.utils import jaxcompat
 
 
 @dataclasses.dataclass(frozen=True)
@@ -492,16 +493,29 @@ def moe_ffn_dropless(x: jax.Array, router_w: jax.Array,
             prod *= sz
     batch_axes = tuple(batch_axes)
     sp = _auto("sp") if S_in % max(_auto("sp"), 1) == 0 else 1
+    # token axes the batch dim can't absorb fall through to the sequence
+    # dim: routing is per-token, so a batch of 1 still shards its S
+    # tokens over ep/dp/fsdp (the dryrun's B=1,S=32,ep=2 case) instead
+    # of replicating the whole dispatch on every ep shard
+    seq_axes, sprod = [], max(sp, 1)
+    for a in ("dp", "fsdp", "ep"):
+        sz = _auto(a)
+        if sz > 1 and a not in batch_axes and S_in % (sprod * sz) == 0:
+            seq_axes.append(a)
+            sprod *= sz
+    seq_axes = tuple(seq_axes)
+    placed = set(batch_axes) | set(seq_axes)
     if mesh is not None and (
-            len(batch_axes) < sum(1 for a in ("dp", "fsdp", "ep")
-                                  if _auto(a) > 1)
+            any(_auto(a) > 1 and a not in placed
+                for a in ("dp", "fsdp", "ep"))
             or sp != _auto("sp")):
         from deepspeed_tpu.utils import telemetry
         telemetry.count(
             "moe.grouped_replicated_tokens",
             f"batch {B_in}x{S_in} not shardable over all token axes "
             f"{ {a: sizes.get(a, 1) for a in ('dp', 'fsdp', 'ep', 'sp')} }")
-    if mesh is None or (not batch_axes and tp == 1 and sp == 1 and ep == 1):
+    if mesh is None or (not batch_axes and not seq_axes
+                        and tp == 1 and sp == 1 and ep == 1):
         out, stats = _dropless_shard_core(x, router_w, expert_params, cfg,
                                           activation, train=train)
         out = constrain_activation(out, ("batch", "seq", "embed"))
@@ -517,7 +531,8 @@ def moe_ffn_dropless(x: jax.Array, router_w: jax.Array,
     ep_ax = "ep" if ep > 1 else None
     tp_ax = "tp" if tp > 1 else None
     sp_ax = "sp" if sp > 1 else None
-    token_axes = batch_axes + ((sp_ax,) if sp_ax else ())
+    seq_entry = seq_axes + ((sp_ax,) if sp_ax else ())
+    token_axes = batch_axes + seq_entry
 
     def local_fn(x, router_w, experts):
         out, stats = _dropless_shard_core(
@@ -525,7 +540,7 @@ def moe_ffn_dropless(x: jax.Array, router_w: jax.Array,
             ep_axis=ep_ax, ep=ep, tp_axis=tp_ax, tp=tp, train=train)
         return out, jax.tree.map(lambda s: s[None], stats)  # lead shard dim
 
-    x_spec = P(batch_axes or None, sp_ax, None)
+    x_spec = P(batch_axes or None, seq_entry or None, None)
     # stacked experts: expert dim stays on ep, mlp dim on tp, embed dim
     # gathered (the ZeRO-3 fetch — over fsdp only)
     exp_specs = {"wi": P(ep_ax, None, tp_ax), "wo": P(ep_ax, tp_ax, None)}
@@ -536,11 +551,11 @@ def moe_ffn_dropless(x: jax.Array, router_w: jax.Array,
         # nested inside a partial-manual region (the pipeline stage body
         # is manual over pp): shard_map must take the context abstract
         # mesh and may only manualize the axes still under GSPMD
-        sm_mesh = jax.sharding.get_abstract_mesh()
+        sm_mesh = jaxcompat.get_abstract_mesh(fallback=mesh)
     else:
         sm_mesh = mesh
     names = frozenset(a for a in mesh.axis_names if a not in manual)
-    out, stats_sh = jax.shard_map(
+    out, stats_sh = jaxcompat.shard_map(
         local_fn, mesh=sm_mesh,
         in_specs=(x_spec, P(), exp_specs),
         out_specs=(x_spec, stat_spec), axis_names=names, check_vma=False,

@@ -49,6 +49,7 @@ from deepspeed_tpu.runtime.optimizer import (
     MixedPrecisionState, apply_mixed_precision_update, get_base_optimizer,
     init_mixed_precision)
 from deepspeed_tpu.runtime.prefetch import PrefetchingIterator
+from deepspeed_tpu.utils import memspace
 from deepspeed_tpu.utils.logging import log_dist, logger
 from deepspeed_tpu.utils.timer import (
     BACKWARD_GLOBAL_TIMER, FORWARD_GLOBAL_TIMER, STEP_GLOBAL_TIMER,
@@ -204,6 +205,27 @@ class Engine:
             import dataclasses as _dc
 
             model.config = _dc.replace(mcfg, moe_impl=config.moe.impl)
+
+        # -- performance block → model config (docs/performance.md) -------
+        # fp8 MLP matmuls and the layer-prefetch ring depth live on the
+        # model config (they change the traced program); the engine is
+        # the bridge from the DeepSpeed-style config block. An explicit
+        # performance.param_prefetch_depth beats the model/env default.
+        perf = getattr(config, "performance", None)
+        mcfg = getattr(model, "config", None)
+        perf_updates = {}
+        if perf is not None and mcfg is not None:
+            if getattr(perf, "fp8_mlp", False) \
+                    and hasattr(mcfg, "fp8_mlp") and not mcfg.fp8_mlp:
+                perf_updates["fp8_mlp"] = True
+            ppd = getattr(perf, "param_prefetch_depth", None)
+            if ppd is not None and hasattr(mcfg, "prefetch_depth") \
+                    and mcfg.prefetch_depth != int(ppd):
+                perf_updates["prefetch_depth"] = int(ppd)
+        if perf_updates:
+            import dataclasses as _dc
+
+            model.config = _dc.replace(mcfg, **perf_updates)
 
         self.micro_batch_size = config.train_micro_batch_size_per_chip
         self.gradient_accumulation_steps = config.gradient_accumulation_steps
@@ -617,7 +639,8 @@ class Engine:
             # tree is small — init on device and move below
             host_init = jax.default_backend() == "tpu"
             out_sh = (jax.tree.map(
-                lambda s: s.with_memory_kind("pinned_host"), opt_sh)
+                lambda s: memspace.with_memory_kind(s, "pinned_host"),
+                opt_sh)
                 if host_init else opt_sh)
             with jax.set_mesh(mesh) if hasattr(jax, "set_mesh") else _nullctx():
                 p32 = jax.jit(init32, out_shardings=out_sh)(self._rng)
@@ -625,7 +648,8 @@ class Engine:
                 def _pin(a):
                     try:
                         return jax.device_put(
-                            a, a.sharding.with_memory_kind("pinned_host"))
+                            a, memspace.with_memory_kind(
+                                a.sharding, "pinned_host"))
                     except Exception:
                         # multi-process CPU sim: jax routes this
                         # device_put through a jit reshard (device order
@@ -663,7 +687,8 @@ class Engine:
                 cast = jax.jit(
                     lambda t: jax.tree.map(lambda m: m.astype(cdt), t),
                     out_shardings=jax.tree.map(
-                        lambda s: s.with_memory_kind("device"), param_sh))
+                        lambda s: memspace.with_memory_kind(s, "device"),
+                        param_sh))
                 self.params = cast(p32)
             else:
                 cast = jax.jit(
@@ -671,7 +696,7 @@ class Engine:
                         jax.tree.map(lambda m: m.astype(cdt), t), param_sh))
                 self.params = jax.tree.map(
                     lambda a: jax.device_put(
-                        a, a.sharding.with_memory_kind("device")),
+                        a, memspace.with_memory_kind(a.sharding, "device")),
                     cast(p32))
             if host_prefixes and isinstance(p32, dict):
                 # streamed params stay the pinned fp32 masters (the
@@ -930,7 +955,7 @@ class Engine:
             host_sh = dict(param_sh)
             for key in stream_paths:
                 host_sh[key] = jax.tree.map(
-                    lambda s: s.with_memory_kind("pinned_host"),
+                    lambda s: memspace.with_memory_kind(s, "pinned_host"),
                     param_sh[key])
             self._jit_reshard_to_params = lambda t: jax.device_put(
                 t, host_sh)
@@ -1715,8 +1740,9 @@ class Engine:
         def to_host(tree):
             return jax.tree.map(
                 lambda a: jax.device_put(
-                    a, a.sharding.with_memory_kind("pinned_host"))
+                    a, memspace.with_memory_kind(a.sharding, "pinned_host"))
                 if isinstance(a, jax.Array)
+                and memspace.memories_supported()
                 and a.sharding.memory_kind != "pinned_host" else a, tree)
 
         if include & {"lp_params", "hp_params"}:
@@ -1738,9 +1764,10 @@ class Engine:
         def to_device(tree):
             return jax.tree.map(
                 lambda a: jax.device_put(
-                    a, a.sharding.with_memory_kind("device"))
+                    a, memspace.with_memory_kind(a.sharding, "device"))
                 if isinstance(a, jax.Array)
-                and a.sharding.memory_kind == "pinned_host" else a, tree)
+                and memspace.memory_kind_of(a) == "pinned_host"
+                else a, tree)
 
         if getattr(self, "_param_host_offload", False):
             # streamed params live on host by design; restore the rest

@@ -33,6 +33,7 @@ from deepspeed_tpu.ops.native.builder import build_native_lib
 from deepspeed_tpu.ops.native.cpu_optimizer import (
     CPU_OPTIMIZERS, CPUAdam, bf16_to_f32, f32_to_bf16)
 from deepspeed_tpu.runtime.swap_tensor.swapper import TensorSwapStore
+from deepspeed_tpu.utils import memspace
 from deepspeed_tpu.utils.logging import log_dist, logger
 
 try:
@@ -52,6 +53,16 @@ def _leaf_paths(tree):
 
 def _index_key(index) -> str:
     return repr(index)
+
+
+def _pinned_single_device(device):
+    """Single-device pinned-host sharding, degrading to plain device
+    placement on backends without a pinned-host space (CPU sim)."""
+    from jax.sharding import SingleDeviceSharding
+
+    if not memspace.memories_supported():
+        return SingleDeviceSharding(device)
+    return SingleDeviceSharding(device, memory_kind="pinned_host")
 
 
 def _to_f32(host: np.ndarray) -> np.ndarray:
@@ -297,7 +308,8 @@ class HostOffloadOptimizer:
             cdt = pleaf.dtype
             to_host = any(path.startswith(p)
                           for p in self.host_memory_leaf_prefixes)
-            sharding = (gleaf.sharding.with_memory_kind("pinned_host")
+            sharding = (memspace.with_memory_kind(gleaf.sharding,
+                                                  "pinned_host")
                         if to_host else gleaf.sharding)
             bufs = []
             for shard in gleaf.addressable_shards:
@@ -306,12 +318,10 @@ class HostOffloadOptimizer:
                     # host-memory leaves stay FP32 (master precision;
                     # sub-32-bit host->device streaming is unsupported);
                     # pleaf.dtype is fp32 for them, so updated[] is too
-                    from jax.sharding import SingleDeviceSharding
-
                     piece = np.ascontiguousarray(updated[key],
                                                  dtype=np.float32)
-                    bufs.append(jax.device_put(piece, SingleDeviceSharding(
-                        shard.device, memory_kind="pinned_host")))
+                    bufs.append(jax.device_put(
+                        piece, _pinned_single_device(shard.device)))
                 else:
                     piece = updated[key].astype(cdt, copy=False)
                     bufs.append(jax.device_put(piece, shard.device))
@@ -476,8 +486,8 @@ class HostOffloadOptimizer:
             # pinned); the rebuilt compute tree must be pinned only for
             # streamed prefixes and device elsewhere, and the buffer
             # placement below must match the sharding exactly
-            sharding = sharding.with_memory_kind(
-                "pinned_host" if to_host else "device")
+            sharding = memspace.with_memory_kind(
+                sharding, "pinned_host" if to_host else "device")
             bufs = []
             idx_map = sharding.addressable_devices_indices_map(gshape)
             for device, index in idx_map.items():
@@ -492,10 +502,8 @@ class HostOffloadOptimizer:
                 else:
                     piece = master.reshape(shape).astype(cdt)
                 if to_host:
-                    from jax.sharding import SingleDeviceSharding
-
-                    bufs.append(jax.device_put(piece, SingleDeviceSharding(
-                        device, memory_kind="pinned_host")))
+                    bufs.append(jax.device_put(
+                        piece, _pinned_single_device(device)))
                 else:
                     bufs.append(jax.device_put(piece, device))
             new_leaves.append(jax.make_array_from_single_device_arrays(

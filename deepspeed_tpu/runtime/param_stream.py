@@ -38,15 +38,16 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from deepspeed_tpu.utils import memspace
+
 
 def fetch_slice(stacked_tree: Any, i) -> Any:
     """Fetch layer ``i`` of a host-pinned ``[L, ...]`` stacked tree to
     device memory. Usable inside jit/scan bodies; under remat the
     backward replay re-issues the copy instead of saving the layer."""
     return jax.tree.map(
-        lambda a: jax.device_put(
-            lax.dynamic_index_in_dim(a, i, keepdims=False),
-            jax.memory.Space.Device),
+        lambda a: memspace.put(
+            lax.dynamic_index_in_dim(a, i, keepdims=False), "device"),
         stacked_tree)
 
 
@@ -172,8 +173,7 @@ def streamed_layers_prefetch(layer_fn: Callable[..., Any],
                 # layer's recompute, and the stacked cotangent lives in
                 # host memory (matching the host-pinned primal stack)
                 dp = jax.tree.map(
-                    lambda a: jax.device_put(a, jax.memory.Space.Host),
-                    dp)
+                    lambda a: memspace.put(a, "pinned_host"), dp)
             return (dx, bufs[1:] + (prv,)), dp
 
         # reverse=True: iterate L-1..0, outputs stacked in FORWARD
@@ -193,11 +193,10 @@ def pin_to_host(tree: Any) -> Any:
     (sub-32-bit host→device streaming is unsupported on current TPU
     runtimes; fp32 is the master precision anyway)."""
     def pin(a):
-        if getattr(a.sharding, "memory_kind", None) == "pinned_host" \
-                and a.dtype == jnp.float32:
+        if memspace.is_on_host(a) and a.dtype == jnp.float32:
             return a  # already staged (init pins the fp32 masters)
         return jax.device_put(
             a.astype(jnp.float32),
-            a.sharding.with_memory_kind("pinned_host"))
+            memspace.with_memory_kind(a.sharding, "pinned_host"))
 
     return jax.tree.map(pin, tree)

@@ -35,6 +35,7 @@ import jax
 import jax.numpy as jnp
 
 from deepspeed_tpu.ops.native.cpu_optimizer import CPUAdam
+from deepspeed_tpu.utils import memspace
 from deepspeed_tpu.utils.logging import log_dist, logger
 
 
@@ -163,9 +164,9 @@ class ZenFlowOptimizer:
         # rebuild/consume masters as device arrays
         def _dev_sharding(x):
             s = getattr(x, "sharding", None)
-            if s is not None and getattr(s, "memory_kind", None) not in (
-                    None, "device"):
-                s = s.with_memory_kind("device")
+            if s is not None and getattr(s, "memory_kind", None) == \
+                    "pinned_host":
+                s = memspace.with_memory_kind(s, "device")
             return s
 
         self._shardings = [_dev_sharding(x) for x in leaves]

@@ -34,6 +34,7 @@ from jax import lax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from deepspeed_tpu.utils.logging import log_dist
+from deepspeed_tpu.utils import jaxcompat
 
 QUANT_BLOCK = 256
 
@@ -142,7 +143,7 @@ def build_zeropp_step(model, mesh, gas: int, base_lr: float,
                                              block=QUANT_BLOCK)
             else:  # qwZ-only config: exact (unquantized) grad reduce
                 red = lax.psum_scatter(flat, "dp", scatter_dimension=0,
-                                       tiled=True) / lax.axis_size("dp")
+                                       tiled=True) / jaxcompat.axis_size("dp")
             g_shards.append(red.reshape(-1))
 
         sq = sum(jnp.sum(gs.astype(jnp.float32) ** 2) for gs in g_shards)
@@ -190,7 +191,7 @@ def build_zeropp_step(model, mesh, gas: int, base_lr: float,
     rep = P()
     shard_spec = P("dp")
 
-    mapped = jax.shard_map(
+    mapped = jaxcompat.shard_map(
         local_step, mesh=mesh,
         in_specs=(rep, shard_spec, shard_spec, shard_spec, rep, rep,
                   batch_spec),

@@ -1,0 +1,143 @@
+"""Version-portable wrappers for jax APIs that moved between releases.
+
+The tree targets the jax 0.5+ spellings; older jax (0.4.x, still common
+on TPU pods pinned to a libtpu release) keeps the same functionality
+under different names/keywords. Everything version-sensitive routes
+through here so a jax bump is a one-file change:
+
+  * ``jax.shard_map`` — 0.4.x: ``jax.experimental.shard_map.shard_map``
+    with ``auto=`` (complement of ``axis_names``) and ``check_rep=``
+    (renamed ``check_vma``).
+  * pallas-TPU ``CompilerParams`` — 0.4.x: ``TPUCompilerParams``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+
+_UNSET = object()
+
+
+def shard_map(f, *, mesh, in_specs, out_specs, axis_names=None,
+              check_vma=_UNSET, **kw):
+    """``jax.shard_map`` with new-API keywords on any supported jax.
+
+    ``axis_names`` is the set of mesh axes the body is MANUAL over
+    (None = all); ``check_vma`` toggles replication checking.
+    """
+    native = getattr(jax, "shard_map", None)
+    if native is not None:
+        if axis_names is not None:
+            kw["axis_names"] = axis_names
+        if check_vma is not _UNSET:
+            kw["check_vma"] = check_vma
+        return native(f, mesh=mesh, in_specs=in_specs,
+                      out_specs=out_specs, **kw)
+    from jax.experimental.shard_map import shard_map as _esm
+
+    if check_vma is not _UNSET:
+        kw["check_rep"] = check_vma
+    if axis_names is not None:
+        kw["auto"] = (frozenset(getattr(mesh, "axis_names", ()))
+                      - frozenset(axis_names))
+    return _esm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                **kw)
+
+
+def axis_size(name) -> Any:
+    """``lax.axis_size`` (jax 0.5+); older jax spells it ``psum(1, ax)``
+    which constant-folds to the same static size inside shard_map."""
+    fn = getattr(jax.lax, "axis_size", None)
+    if fn is not None:
+        return fn(name)
+    return jax.lax.psum(1, name)
+
+
+def get_abstract_mesh(fallback=None) -> Any:
+    """Context abstract mesh (jax 0.5+) for nesting shard_map inside a
+    partial-manual region; older jax nests on the concrete mesh, whose
+    manual axes are excluded via ``auto=`` instead."""
+    fn = getattr(jax.sharding, "get_abstract_mesh", None)
+    if fn is not None:
+        return fn()
+    return fallback
+
+
+def pallas_tpu_compiler_params(**kw) -> Optional[Any]:
+    """Construct pallas-TPU compiler params under either spelling."""
+    from jax.experimental.pallas import tpu as pltpu
+
+    cls = getattr(pltpu, "CompilerParams", None) \
+        or getattr(pltpu, "TPUCompilerParams", None)
+    if cls is None:  # pragma: no cover - ancient jax
+        return None
+    return cls(**kw)
+
+
+def _patch_old_shard_map_residual_names() -> None:
+    """jax 0.4.x: residuals hoisted out of a shard_map under AD are
+    named over EVERY mesh axis (``_all_mesh_names_except_spmd``) —
+    including the eqn's own ``auto`` axes, which a nested shard_map may
+    not reference (an enclosing region already manualized them), so
+    lowering dies with "Axis: pp ... is also found in manual_axes".
+    Newer jax fixed this with typed mesh axes. Here we thread each
+    partial-eval/transpose rule's ``auto`` set into the naming helper
+    and subtract it: residuals are named over the eqn's own manual axes
+    only (all dims are marked unspecified under partial-auto anyway, so
+    GSPMD re-infers the auto-axis placement either way)."""
+    if getattr(jax, "shard_map", None) is not None:
+        return  # new jax: fixed upstream
+    try:
+        from jax.experimental import shard_map as _sm
+        from jax._src.interpreters import ad as _ad
+        from jax._src.interpreters import partial_eval as _pe
+    except Exception:  # pragma: no cover - ancient jax
+        return
+    orig_names = getattr(_sm, "_all_mesh_names_except_spmd", None)
+    if orig_names is None or getattr(orig_names, "_dstpu_patched", False):
+        return
+
+    state = {"auto": frozenset()}
+
+    def patched_names(mesh, *a, **kw):
+        names = orig_names(mesh, *a, **kw)
+        return tuple(n for n in names if n not in state["auto"])
+
+    patched_names._dstpu_patched = True
+    _sm._all_mesh_names_except_spmd = patched_names
+
+    def _scoped(_auto_axes, fn, *args, **kw):
+        prev, state["auto"] = state["auto"], frozenset(_auto_axes or ())
+        try:
+            return fn(*args, **kw)
+        finally:
+            state["auto"] = prev
+
+    orig_custom = _pe.partial_eval_jaxpr_custom_rules[_sm.shard_map_p]
+
+    def custom_rule(saveable, unks_in, inst_in, eqn):
+        return _scoped(eqn.params.get("auto"), orig_custom,
+                       saveable, unks_in, inst_in, eqn)
+
+    _pe.partial_eval_jaxpr_custom_rules[_sm.shard_map_p] = custom_rule
+
+    orig_pe = _pe.JaxprTrace.process_shard_map
+
+    def process(trace, prim, f, tracers, **params):
+        return _scoped(params.get("auto"), orig_pe, trace, prim, f,
+                       tracers, **params)
+
+    _pe.JaxprTrace.process_shard_map = process
+
+    orig_tr = _ad.primitive_transposes[_sm.shard_map_p]
+
+    def transpose(out_cts, *args, **params):
+        return _scoped(params.get("auto"), orig_tr, out_cts, *args,
+                       **params)
+
+    _ad.primitive_transposes[_sm.shard_map_p] = transpose
+
+
+_patch_old_shard_map_residual_names()

@@ -88,3 +88,55 @@ def test_cli_fast_mode(capsys, devices):
     best = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
     assert best["train_micro_batch_size_per_chip"] == 1
     assert best["remat"] is False
+
+
+def test_candidates_enumerate_perf_axes(tmp_path):
+    """The real-shape sweep axes (tiled_logits x attn_chunks x
+    prefetch_depths) ride as private keys the engine-builder pops."""
+    t = make_tuner(tmp_path, {
+        "micro_batch_sizes": [2], "zero_stages": [2],
+        "tiled_logits": [4, 8], "attn_chunks": [None, 4],
+        "prefetch_depths": [2, 4]})
+    cands = t.candidates()
+    assert len(cands) == 8
+    tls = {c.get("_tiled_logits") for c in cands}
+    assert tls == {4, 8}
+    acs = {c.get("_attn_chunks") for c in cands}
+    assert acs == {None, 4}            # None omits the key entirely
+    pds = {c.get("_prefetch_depth") for c in cands}
+    assert pds == {2, 4}
+
+
+def test_tuned_defaults_surfaces_public_knobs():
+    cfg = {"train_micro_batch_size_per_chip": 4,
+           "zero_optimization": {"stage": 2},
+           "_remat": True, "_remat_policy": "nothing_saveable",
+           "_tiled_logits": 8, "_attn_chunks": 4, "_prefetch_depth": 4}
+    out = Autotuner.tuned_defaults(cfg)
+    assert out["remat"] is True
+    assert out["remat_policy"] == "nothing_saveable"
+    assert out["tiled_logits"] == 8
+    assert out["attn_chunks"] == 4
+    assert out["performance"]["param_prefetch_depth"] == 4
+    assert not any(k.startswith("_") for k in out)
+
+
+def test_fast_tune_persists_winner(tmp_path, devices):
+    import json
+
+    persist = tmp_path / "real_shape.json"
+    t = Autotuner(model_factory=lambda: TransformerLM(TINY),
+                  base_config=dict(BASE), batch_fn=batch_fn,
+                  tuning_space={"micro_batch_sizes": [2],
+                                "zero_stages": [1],
+                                "prefetch_depths": [2]},
+                  results_dir=str(tmp_path),
+                  persist_path=str(persist))
+    best = t.tune(fast=True)
+    assert best is not None
+    saved = json.loads(persist.read_text())
+    # persisted through tuned_defaults: public knob names, no privates
+    assert saved["train_micro_batch_size_per_chip"] == 2
+    assert saved["performance"]["param_prefetch_depth"] == 2
+    assert not any(k.startswith("_") for k in saved
+                   if k != "_tuned_samples_per_sec")

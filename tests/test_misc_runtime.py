@@ -8,6 +8,7 @@ import jax
 import jax.numpy as jnp
 
 from deepspeed_tpu.runtime.eigenvalue import Eigenvalue
+from deepspeed_tpu.utils.jaxcompat import shard_map
 from deepspeed_tpu.runtime.progressive_layer_drop import ProgressiveLayerDrop
 from deepspeed_tpu.runtime.sparse_tensor import (SparseTensor,
                                                  sparse_allreduce)
@@ -92,7 +93,7 @@ def test_sparse_allreduce_matches_dense(devices):
     def body(grad, toks):
         return sparse_allreduce(grad[0], toks[0], axis="dp")
 
-    fn = jax.shard_map(body, mesh=mesh,
+    fn = shard_map(body, mesh=mesh,
                        in_specs=(P("dp"), P("dp")),
                        out_specs=P(), check_vma=False)
     out = fn(grads, tokens)

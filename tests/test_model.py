@@ -55,7 +55,9 @@ def test_loss_decreases_under_sgd():
         return params, loss
 
     losses = []
-    for _ in range(10):
+    # 16 steps: 10 left the 0.5-drop margin at the mercy of backend
+    # numerics (one jaxlib lands at 0.498); the trend is what matters
+    for _ in range(16):
         params, loss = step(params)
         losses.append(float(loss))
     assert losses[-1] < losses[0] - 0.5, losses

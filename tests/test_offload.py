@@ -488,9 +488,16 @@ def test_offload_param_protocol_custom_model(devices):
     }
     engine, *_ = dstpu.initialize(model=model, config=cfg)
     assert model.param_host_offload is True
+    # jax CPU backends without memory spaces degrade to the (single)
+    # default space — placement is only assertable where it exists
+    from deepspeed_tpu.utils import memspace
+
+    pinned = ({"pinned_host"} if memspace.memories_supported()
+              else {memspace.memory_kind_of(
+                  jax.tree.leaves(engine.params["blocks"])[0])})
     kinds = {l.sharding.memory_kind
              for l in jax.tree.leaves(engine.params["blocks"])}
-    assert kinds == {"pinned_host"}
+    assert kinds == pinned
     it = data_iter(engine.micro_batch_size * engine.dp_world_size,
                    n_fixed=1)
     losses = [float(engine.train_batch(it)) for _ in range(16)]
@@ -503,7 +510,7 @@ def test_offload_param_protocol_custom_model(devices):
                            np.asarray(w0["blocks"]["w"], np.float32))
     kinds = {l.sharding.memory_kind
              for l in jax.tree.leaves(engine.params["blocks"])}
-    assert kinds == {"pinned_host"}, "placement lost after reshard"
+    assert kinds == pinned, "placement lost after reshard"
 
 
 def test_param_offload_requires_offload_optimizer(devices):

@@ -37,7 +37,13 @@ os.environ["XLA_FLAGS"] = (
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", ndev)
+try:
+    jax.config.update("jax_num_cpu_devices", ndev)
+except AttributeError:
+    # older jax (<0.5) has no jax_num_cpu_devices option; the XLA_FLAGS
+    # --xla_force_host_platform_device_count set above provides the
+    # simulated devices there (same fallback as tests/conftest.py)
+    pass
 if os.environ.get("ZF_CACHE"):
     # persistent compile cache: on single-core CI hosts the two
     # processes' first-run compiles drift by minutes while gloo's pair
