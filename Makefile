@@ -14,7 +14,7 @@ NPROC := $(shell nproc)
 XDIST ?= $(shell if [ $(NPROC) -gt 2 ] && python -c "import xdist" 2>/dev/null; then echo "-n $$(( $(NPROC) - 1 )) --dist loadfile"; fi)
 PYTEST ?= python -m pytest
 
-.PHONY: test smoke slow bench bench-real bench-proxy bench-hostgap fleet-demo
+.PHONY: test smoke slow bench bench-real bench-proxy bench-hostgap fleet-demo chaos
 
 smoke:
 	$(PYTEST) tests/ -q -m "not slow" $(XDIST)
@@ -49,3 +49,11 @@ fleet-demo:
 bench-hostgap:
 	BENCH_PIPELINE_DEPTH=0 BENCH_PREFETCH_DEPTH=0 python bench.py
 	BENCH_PIPELINE_DEPTH=2 BENCH_PREFETCH_DEPTH=2 python bench.py
+
+# Fault-injection drill on the 8-device CPU sim: SIGKILL a training rank
+# mid-run, let the elastic agent restart it, and assert the auto-resumed
+# run's final loss is bit-identical to a fault-free run
+# (docs/resilience.md; tools/chaos_run.py --signal SIGTERM drills the
+# graceful drain + emergency-checkpoint path instead).
+chaos:
+	JAX_PLATFORMS=cpu python tools/chaos_run.py

@@ -132,6 +132,15 @@ class CheckpointIO:
                 np.savez(tmp, **flat)
                 os.replace(tmp, dst)  # atomic: no half-written rank files
 
+        # data-pipeline cursor: consumed GAS boundaries + loader state,
+        # snapshotted at this (drained) boundary so auto-resume replays
+        # the exact remaining batch stream (resilience/resume.py)
+        try:
+            from deepspeed_tpu.resilience.resume import data_cursor
+            cursor = data_cursor(e)
+        except Exception as err:
+            logger.warning(f"data cursor snapshot failed: {err}")
+            cursor = {}
         meta = {
             "tag": str(tag),
             "framework_version": __version__,
@@ -140,7 +149,9 @@ class CheckpointIO:
             "global_samples": e.global_samples,
             "skipped_steps": e.skipped_steps,
             "mesh_shape": {k: int(v) for k, v in e.mesh.shape.items()},
+            "world_size": jax.process_count(),
             "zero_stage": e.config.zero_optimization.stage,
+            "data_cursor": cursor,
             "config": e.config.to_dict(),
             "client_state": client_state or {},
         }
@@ -159,9 +170,12 @@ class CheckpointIO:
         return ckpt_dir
 
     def _publish(self, tag, save_dir, ckpt_dir, meta, save_latest):
-        """Barrier + metadata + 'latest' pointer — only after every rank's
-        payload is durable, or a preemption could leave 'latest' pointing
-        at a checkpoint that cannot restore on some ranks."""
+        """Barrier + metadata + manifest + 'latest' pointer — only after
+        every rank's payload is durable, or a preemption could leave
+        'latest' pointing at a checkpoint that cannot restore on some
+        ranks. Ordering matters: the manifest (the durability witness)
+        goes down before 'latest', so 'latest' never names a checkpoint
+        without one."""
         if jax.process_count() > 1:
             from jax.experimental import multihost_utils
 
@@ -169,6 +183,19 @@ class CheckpointIO:
         if _is_primary():
             with open(os.path.join(ckpt_dir, METADATA_FILE), "w") as f:
                 json.dump(meta, f, indent=2, default=str)
+            rcfg = getattr(self.engine.config, "resilience", None)
+            if rcfg is None or (rcfg.enabled and rcfg.manifest):
+                from deepspeed_tpu.resilience.manifest import write_manifest
+
+                write_manifest(
+                    ckpt_dir, tag,
+                    global_steps=int(meta.get("global_steps", 0)),
+                    world={
+                        "mesh_shape": meta.get("mesh_shape", {}),
+                        "process_count": jax.process_count(),
+                        "device_count": jax.device_count(),
+                    },
+                    data_cursor=meta.get("data_cursor", {}))
             if save_latest:
                 with open(os.path.join(os.path.abspath(save_dir),
                                        LATEST_FILE), "w") as f:
@@ -266,6 +293,25 @@ class CheckpointIO:
             if os.path.exists(meta_path):
                 with open(meta_path) as f:
                     meta = json.load(f)
+        # manifest validation (resilience/manifest.py): a torn or corrupt
+        # save must be REFUSED here, before any tensor restore — a silent
+        # bad restore is worse than a failed one. Validity is computed
+        # per-host but folded into the cross-process assert below so all
+        # ranks take the same accept/fallback path in lockstep.
+        manifest_doc = manifest_err = None
+        rcfg = getattr(e.config, "resilience", None)
+        check_manifest = rcfg is None or (rcfg.enabled and rcfg.manifest)
+        if dir_ok and check_manifest:
+            from deepspeed_tpu.resilience.manifest import (
+                CheckpointCorruptError, validate_manifest)
+
+            try:
+                manifest_doc = validate_manifest(
+                    ckpt_dir,
+                    check_checksums=(rcfg is None
+                                     or rcfg.manifest_checksums))
+            except CheckpointCorruptError as err:
+                manifest_err = err
         # multi-host: every process must see the SAME checkpoint (a
         # skewed shared-filesystem view or per-host load_dir typo
         # otherwise desynchronizes training silently — reference
@@ -279,13 +325,36 @@ class CheckpointIO:
             "checkpoint_load",
             [str(tag) if tag else "<missing-latest>", int(dir_ok),
              int(meta.get("global_steps", -1)),
-             int(load_optimizer_states)])
+             int(load_optimizer_states), int(manifest_err is None)])
         if tag is None:
             logger.warning(f"no '{LATEST_FILE}' file at {load_dir}; "
                            "nothing loaded")
             return None, None
         if not dir_ok:
             raise FileNotFoundError(f"checkpoint not found: {ckpt_dir}")
+        if manifest_err is not None:
+            from deepspeed_tpu.resilience.manifest import \
+                find_latest_valid_tag
+            from deepspeed_tpu.utils import telemetry
+
+            telemetry.count("resilience.corrupt_checkpoint",
+                            reason=str(tag))
+            fallback = find_latest_valid_tag(
+                load_dir, exclude=[str(tag)],
+                check_checksums=(rcfg is None or rcfg.manifest_checksums))
+            if fallback is None:
+                raise manifest_err
+            logger.error(
+                f"checkpoint '{tag}' failed manifest validation "
+                f"({manifest_err.reason}); falling back to the previous "
+                f"good tag '{fallback}'")
+            return self.load(load_dir, tag=fallback,
+                             load_optimizer_states=load_optimizer_states)
+        if manifest_doc is None and check_manifest:
+            logger.warning(
+                f"checkpoint '{tag}' has no manifest (saved before the "
+                "resilience subsystem, or by a non-primary writer): "
+                "accepting without integrity verification")
         self._validate_tag(meta, tag)
 
         abstract = self._abstract_state()
@@ -434,21 +503,57 @@ class CheckpointIO:
         e.global_steps = int(meta.get("global_steps", int(e.step_count)))
         e.global_samples = int(meta.get("global_samples", 0))
         e.skipped_steps = int(meta.get("skipped_steps", 0))
+        # data-pipeline cursor for deterministic auto-resume
+        # (engine.resume_data_iter / resilience/resume.py); the manifest
+        # copy wins — it is only written for fully-durable saves
+        e.loaded_data_cursor = ((manifest_doc or {}).get("data_cursor")
+                                or meta.get("data_cursor") or None)
         log_dist(f"loaded checkpoint: {ckpt_dir} (tag={tag})", ranks=[0])
         return ckpt_dir, meta.get("client_state", {})
 
     def _validate_tag(self, meta: Dict, tag: str):
         """Reference _checkpoint_tag_validation (engine.py:4540): ensure
-        the tag is consistent; here also warn on topology change (which is
-        legal — orbax reshards — but worth surfacing)."""
-        mode = self.engine.config.checkpoint.tag_validation.lower()
-        if mode == "ignore" or not meta:
+        the tag is consistent; here also surface topology change (which
+        is legal — orbax reshards — but must never be silent: explicit
+        log + ``resilience.resharded_restore`` telemetry, and when the
+        config is elastic the batch math is re-checked for the new world
+        so a reshard onto an invalid node count fails at load, not ten
+        steps into a wrong-batch run)."""
+        if not meta:
             return
+        e = self.engine
         saved_mesh = meta.get("mesh_shape")
-        cur_mesh = {k: int(v) for k, v in self.engine.mesh.shape.items()}
-        if saved_mesh and saved_mesh != cur_mesh:
-            msg = (f"checkpoint '{tag}' was saved on mesh {saved_mesh}, "
-                   f"loading onto {cur_mesh}: state will be resharded")
-            if mode == "fail":
-                raise ValueError(msg)
-            logger.warning(msg)
+        cur_mesh = {k: int(v) for k, v in e.mesh.shape.items()}
+        if not saved_mesh or saved_mesh == cur_mesh:
+            return
+        from deepspeed_tpu.utils import telemetry
+
+        telemetry.count("resilience.resharded_restore",
+                        reason=f"{saved_mesh} -> {cur_mesh}")
+        logger.warning(
+            f"resharded restore: checkpoint '{tag}' was saved on mesh "
+            f"{saved_mesh} (world_size {meta.get('world_size', '?')}), "
+            f"loading onto {cur_mesh} (world_size {jax.process_count()})")
+        ecfg = (meta.get("config") or {}).get("elasticity") \
+            or e.config.to_dict().get("elasticity")
+        if ecfg and ecfg.get("enabled", False):
+            from deepspeed_tpu.elasticity.elasticity import (
+                ElasticityError, compute_elastic_config)
+
+            try:
+                compute_elastic_config({"elasticity": dict(ecfg)},
+                                       target_deployment_size=int(
+                                           e.dp_world_size))
+            except ElasticityError as err:
+                raise ValueError(
+                    f"resharded restore rejected: elastic batch math "
+                    f"does not hold for dp={e.dp_world_size} "
+                    f"({err})") from err
+        mode = e.config.checkpoint.tag_validation.lower()
+        if mode == "ignore":
+            return
+        msg = (f"checkpoint '{tag}' was saved on mesh {saved_mesh}, "
+               f"loading onto {cur_mesh}: state will be resharded")
+        if mode == "fail":
+            raise ValueError(msg)
+        logger.warning(msg)

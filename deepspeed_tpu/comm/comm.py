@@ -40,10 +40,41 @@ __all__ = [
     "has_all_gather_into_tensor", "has_reduce_scatter_tensor",
     "has_coalescing_manager", "all_reduce", "all_gather", "reduce_scatter",
     "all_to_all", "ppermute", "broadcast", "axis_index", "axis_size",
-    "configure", "log_summary",
+    "configure", "log_summary", "get_retry_policy",
 ]
 
 _INITIALIZED = False
+
+# -- control-plane health (resilience block; docs/resilience.md) -------------
+# A RetryPolicy bounds the process-level ops a wedged peer turns into a
+# silent fleet-wide hang: rendezvous init, barrier, cross-process asserts.
+# With no `resilience` config applied the default policy has no timeouts
+# and every op is a plain passthrough.
+_POLICY = None
+
+
+def get_retry_policy():
+    """The active control-plane RetryPolicy (timeout-less until
+    ``configure`` installs one from the ``resilience`` config block)."""
+    global _POLICY
+    if _POLICY is None:
+        from deepspeed_tpu.resilience.policy import RetryPolicy
+
+        _POLICY = RetryPolicy()
+    return _POLICY
+
+
+def _chaos_collective(op: str) -> None:
+    """Chaos hook: lets DSTPU_CHAOS delay/fail the Kth control-plane op
+    (injected ChaosCollectiveError propagates; everything else is inert)."""
+    try:
+        from deepspeed_tpu.resilience.chaos import get_chaos_injector
+
+        inj = get_chaos_injector()
+    except Exception:
+        return
+    if inj.armed:
+        inj.on_collective(op)
 
 
 def is_initialized() -> bool:
@@ -72,20 +103,32 @@ def init_distributed(
         "COORDINATOR_ADDRESS") or os.environ.get("JAX_COORDINATOR_ADDRESS")
     num_processes = num_processes or _env_int("NUM_PROCESSES")
     process_id = process_id if process_id is not None else _env_int("PROCESS_ID")
+    policy = get_retry_policy()
     try:
         # Only rendezvous when multi-host is explicitly configured; never
         # infer from TPU_* env alone (single-host sandboxes set those).
         if coordinator_address or (num_processes or 0) > 1 or dist_init_required:
-            jax.distributed.initialize(
-                coordinator_address=coordinator_address,
-                num_processes=num_processes,
-                process_id=process_id,
+            # bounded by resilience.init_timeout_s: a peer that never
+            # shows up at rendezvous becomes a typed CommTimeoutError
+            # (transient exit code) instead of an indefinite hang
+            policy.run(
+                "init_distributed",
+                lambda: jax.distributed.initialize(
+                    coordinator_address=coordinator_address,
+                    num_processes=num_processes,
+                    process_id=process_id,
+                ),
+                timeout_s=policy.init_timeout_s,
             )
             log_dist(
                 f"initialized distributed runtime: {jax.process_count()} processes",
                 ranks=[0],
             )
     except RuntimeError as e:
+        from deepspeed_tpu.resilience.policy import CommTimeoutError
+
+        if isinstance(e, CommTimeoutError):
+            raise  # exhausted rendezvous deadline — not "already init'd"
         # already initialized by the launcher — fine
         logger.debug(f"jax.distributed.initialize skipped: {e}")
     _INITIALIZED = True
@@ -126,11 +169,17 @@ def get_process_count() -> int:
 
 
 def barrier(group: Any = None) -> None:
-    """Cross-host barrier: tiny psum over all devices."""
+    """Cross-host barrier: tiny psum over all devices. Bounded by
+    ``resilience.collective_timeout_s`` when configured — a peer that
+    never arrives raises CommTimeoutError instead of hanging the host."""
+    _chaos_collective("barrier")
     if jax.process_count() > 1:
         from jax.experimental import multihost_utils
 
-        multihost_utils.sync_global_devices("deepspeed_tpu.barrier")
+        get_retry_policy().run(
+            "barrier",
+            lambda: multihost_utils.sync_global_devices(
+                "deepspeed_tpu.barrier"))
 
 
 def assert_same_across_processes(name: str, values) -> None:
@@ -143,6 +192,7 @@ def assert_same_across_processes(name: str, values) -> None:
     corrupts training silently. ``values`` is a scalar/sequence of ints
     (strings hash to ints); no-op on a single process.
     """
+    _chaos_collective(f"assert_same:{name}")
     if jax.process_count() <= 1:
         return
     import numpy as np
@@ -159,7 +209,11 @@ def assert_same_across_processes(name: str, values) -> None:
         local = np.asarray([canon(v) for v in values], np.int64)
     else:
         local = np.asarray([canon(values)], np.int64)
-    gathered = np.asarray(multihost_utils.process_allgather(local))
+    # only the allgather runs under the deadline: the divergence check
+    # below must raise its own RuntimeError, never a retried one
+    gathered = np.asarray(get_retry_policy().run(
+        f"assert_same:{name}",
+        lambda: multihost_utils.process_allgather(local)))
     if not (gathered == gathered[0]).all():
         rows = {i: gathered[i].tolist() for i in range(gathered.shape[0])}
         raise RuntimeError(
@@ -179,8 +233,10 @@ def any_process(value: bool) -> bool:
     import numpy as np
     from jax.experimental import multihost_utils
 
-    gathered = np.asarray(multihost_utils.process_allgather(
-        np.asarray([int(bool(value))], np.int64)))
+    gathered = np.asarray(get_retry_policy().run(
+        "any_process",
+        lambda: multihost_utils.process_allgather(
+            np.asarray([int(bool(value))], np.int64))))
     return bool(gathered.any())
 
 
@@ -298,9 +354,17 @@ def axis_size(axis):
 
 
 def configure(config=None) -> None:
-    """Wire the comms logger (reference dist.configure engine.py:323)."""
+    """Wire the comms logger (reference dist.configure engine.py:323)
+    and install the control-plane RetryPolicy from the ``resilience``
+    config block."""
+    global _POLICY
     if config is not None:
         get_comms_logger().configure(config.comms_logger)
+        rcfg = getattr(config, "resilience", None)
+        if rcfg is not None and getattr(rcfg, "enabled", True):
+            from deepspeed_tpu.resilience.policy import RetryPolicy
+
+            _POLICY = RetryPolicy.from_config(rcfg)
 
 
 def log_summary(show_straggler: bool = False) -> str:

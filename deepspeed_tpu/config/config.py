@@ -493,6 +493,69 @@ class CheckpointConfig(ConfigModel):
 
 @register_config_model
 @dataclass
+class ResilienceConfig(ConfigModel):
+    """Fault tolerance (deepspeed_tpu/resilience/, docs/resilience.md).
+
+    Preemption: ``preemption_guard`` installs a SIGTERM listener on the
+    engine (first signal drains in-flight steps and forces an emergency
+    checkpoint at the next GAS boundary within
+    ``preemption_save_deadline_s``; a second signal escalates to
+    immediate shutdown). The emergency save lands in
+    ``emergency_save_dir``, defaulting to the directory of the last
+    explicit ``save_checkpoint`` call.
+
+    Checkpoint manifests: ``manifest`` writes an atomic per-tag manifest
+    (topology, per-file checksums, data cursor) at publish and validates
+    it at load, falling back to the previous good tag on corruption;
+    ``manifest_checksums`` controls the (streaming crc32) content
+    verification at load — size/presence checks always run.
+
+    Collective health: ``init_timeout_s`` bounds ``init_distributed``;
+    ``collective_timeout_s`` bounds the process-level control-plane ops
+    (barrier, cross-process asserts, heartbeat I/O). ``None`` (default)
+    leaves an op unbounded — zero behavior change until the block opts
+    in. On deadline, ops retry up to ``max_retries`` times with
+    exponential backoff (``backoff_base_s`` doubling to
+    ``backoff_max_s``, ±``jitter``) and then raise ``CommTimeoutError``
+    (worker exit code 75) carrying the flight-ring tail."""
+
+    enabled: bool = True
+    preemption_guard: bool = True
+    preemption_save_deadline_s: float = 60.0
+    emergency_save_dir: Optional[str] = None
+    manifest: bool = True
+    manifest_checksums: bool = True
+    init_timeout_s: Optional[float] = None
+    collective_timeout_s: Optional[float] = None
+    max_retries: int = 2
+    backoff_base_s: float = 1.0
+    backoff_max_s: float = 30.0
+    jitter: float = 0.25
+
+    def validate(self) -> None:
+        if self.preemption_save_deadline_s <= 0:
+            raise ValueError(
+                f"resilience.preemption_save_deadline_s must be > 0, got "
+                f"{self.preemption_save_deadline_s}")
+        if self.max_retries < 0:
+            raise ValueError(
+                f"resilience.max_retries must be >= 0, got "
+                f"{self.max_retries}")
+        for name in ("backoff_base_s", "backoff_max_s", "jitter"):
+            if getattr(self, name) < 0:
+                raise ValueError(
+                    f"resilience.{name} must be >= 0, got "
+                    f"{getattr(self, name)}")
+        for name in ("init_timeout_s", "collective_timeout_s"):
+            val = getattr(self, name)
+            if val is not None and val <= 0:
+                raise ValueError(
+                    f"resilience.{name} must be > 0 (or null for "
+                    f"unbounded), got {val}")
+
+
+@register_config_model
+@dataclass
 class CompileConfig(ConfigModel):
     """Reference: deepspeed/compile/config.py. On TPU everything is compiled;
     these knobs tune donation/remat instead."""
@@ -568,7 +631,13 @@ class Config(ConfigModel):
     performance: PerformanceConfig = field(default_factory=PerformanceConfig)
     sparse_attention: Optional[SparseAttentionConfig] = None
     checkpoint: CheckpointConfig = field(default_factory=CheckpointConfig)
+    resilience: ResilienceConfig = field(default_factory=ResilienceConfig)
     compile: CompileConfig = field(default_factory=CompileConfig)
+    # raw elasticity block: consumed by deepspeed_tpu/elasticity/ (the
+    # launcher and compute_elastic_config take the dict form); kept
+    # unparsed here so it survives into checkpoint metadata, where the
+    # resharded-restore path re-checks the batch math for the new world
+    elasticity: Optional[Dict[str, Any]] = None
     data_efficiency: DataEfficiencyConfig = field(default_factory=DataEfficiencyConfig)
 
     # monitor blocks may also appear top-level in reference configs
@@ -588,7 +657,8 @@ class Config(ConfigModel):
             "comms_logger": CommsLoggerConfig, "flops_profiler": FlopsProfilerConfig,
             "observability": ObservabilityConfig,
             "performance": PerformanceConfig,
-            "checkpoint": CheckpointConfig, "compile": CompileConfig,
+            "checkpoint": CheckpointConfig,
+            "resilience": ResilienceConfig, "compile": CompileConfig,
             "data_efficiency": DataEfficiencyConfig,
         }
         # sparse_attention stays None unless configured (Optional block:

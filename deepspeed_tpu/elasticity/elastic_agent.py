@@ -24,6 +24,8 @@ import time
 from typing import Callable, Dict, List, Optional, Sequence
 
 from deepspeed_tpu.elasticity.elasticity import compute_elastic_config
+from deepspeed_tpu.resilience.policy import (TRANSIENT_EXIT_CODE,
+                                             RetryPolicy)
 from deepspeed_tpu.utils.logging import logger
 
 
@@ -55,7 +57,8 @@ class ElasticAgent:
                  min_nodes: int = 1, max_nodes: int = 64,
                  max_restarts: int = 100, poll_interval: float = 5.0,
                  ds_config: Optional[Dict] = None,
-                 env: Optional[Dict[str, str]] = None):
+                 env: Optional[Dict[str, str]] = None,
+                 restart_backoff_s: float = 1.0):
         if min_nodes < 1 or max_nodes < min_nodes:
             raise ValueError(f"bad node range [{min_nodes}, {max_nodes}]")
         self.cmd_builder = cmd_builder
@@ -69,6 +72,15 @@ class ElasticAgent:
         self.restart_count = 0
         self._procs: List[subprocess.Popen] = []
         self._last_membership: List[str] = []
+        # failure classification for the last round (resilience/policy.py:
+        # workers dying of CommTimeoutError exit with TRANSIENT_EXIT_CODE,
+        # so the agent can tell "transient comm wedge → back off and
+        # retry the same group" from "rank dead → restart immediately,
+        # membership may have changed")
+        self.last_exit_codes: List[Optional[int]] = []
+        self.last_failure_kind: str = "none"
+        self._backoff = RetryPolicy(backoff_base_s=restart_backoff_s,
+                                    max_retries=max_restarts)
 
     # -- membership --------------------------------------------------------
     def _poll_membership(self) -> List[str]:
@@ -147,6 +159,11 @@ class ElasticAgent:
         worker failed (restart now); -1 = partial clean exit (grace)."""
         rcs = [p.poll() for p in self._procs]
         if any(rc not in (None, 0) for rc in rcs):
+            self.last_exit_codes = list(rcs)
+            bad = [rc for rc in rcs if rc not in (None, 0)]
+            self.last_failure_kind = (
+                "transient" if all(rc == TRANSIENT_EXIT_CODE for rc in bad)
+                else "fatal")
             return 1
         if all(rc is not None for rc in rcs):
             return 0
@@ -171,10 +188,26 @@ class ElasticAgent:
             if self.restart_count > self.max_restarts:
                 raise WorkerGroupFailure(
                     f"worker group failed {self.restart_count} times "
-                    f"(max_restarts={self.max_restarts})")
-            logger.warning(
-                f"elastic agent: restarting group "
-                f"({self.restart_count}/{self.max_restarts})")
+                    f"(max_restarts={self.max_restarts}); last failure "
+                    f"kind={self.last_failure_kind} exit codes="
+                    f"{self.last_exit_codes}")
+            if self.last_failure_kind == "transient":
+                # CommTimeoutError exits (code 75): the group wedged on a
+                # slow/flaky control-plane op — same membership is worth
+                # retrying, but back off so a persistently sick network
+                # doesn't thrash restart cycles
+                delay = self._backoff.backoff_s(self.restart_count)
+                logger.warning(
+                    f"elastic agent: transient comm failure (exit "
+                    f"{TRANSIENT_EXIT_CODE}); backing off {delay:.1f}s "
+                    f"before restart "
+                    f"({self.restart_count}/{self.max_restarts})")
+                time.sleep(delay)
+            else:
+                logger.warning(
+                    f"elastic agent: restarting group "
+                    f"({self.restart_count}/{self.max_restarts}, "
+                    f"cause={self.last_failure_kind})")
 
     def _supervise(self, hosts: Sequence[str]) -> int:
         """Run one group round; returns aggregate rc (1 = needs restart)."""
@@ -193,12 +226,14 @@ class ElasticAgent:
                         "elastic agent: workers still running "
                         f"{self.drain_grace}s after a peer exited cleanly "
                         "(likely deadlocked collective); restarting group")
+                    self.last_failure_kind = "fatal"
                     return 1
             current = self._poll_membership()
             if current != list(hosts):
                 logger.warning(
                     f"elastic agent: membership changed {list(hosts)} -> "
                     f"{current}; restarting group")
+                self.last_failure_kind = "membership"
                 return 1
             time.sleep(self.poll_interval)
 

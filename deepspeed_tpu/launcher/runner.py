@@ -322,6 +322,11 @@ def parse_args(argv=None):
     p.add_argument("--min_elastic_nodes", type=int, default=1)
     p.add_argument("--max_elastic_nodes", type=int, default=64)
     p.add_argument("--max_restarts", type=int, default=100)
+    p.add_argument("--restart_backoff_s", type=float, default=1.0,
+                   help="base backoff before restarting a group that died "
+                        "of a transient comm failure (exit 75, see "
+                        "docs/resilience.md); grows exponentially with "
+                        "the restart count")
     p.add_argument("user_script", nargs="?", default=None)
     p.add_argument("user_args", nargs=argparse.REMAINDER)
     return p.parse_args(argv)
@@ -440,7 +445,8 @@ def main(argv=None) -> int:
             build_cmds, membership,
             min_nodes=args.min_elastic_nodes,
             max_nodes=args.max_elastic_nodes,
-            max_restarts=args.max_restarts)
+            max_restarts=args.max_restarts,
+            restart_backoff_s=args.restart_backoff_s)
         return agent.run()
     cmds = runner.get_cmd(env, active)
     if isinstance(cmds[0], str):

@@ -95,6 +95,14 @@ class FlightRecorder:
             return env
         if self.run_dir:
             return os.path.join(self.run_dir, "flight")
+        if os.path.isdir(".git"):
+            # bare default inside a repo checkout would litter the working
+            # tree (and tempt a `git add .`) — park dumps under tmp instead
+            import tempfile
+
+            uid = os.getuid() if hasattr(os, "getuid") else 0
+            return os.path.join(tempfile.gettempdir(),
+                                f"dstpu_flight-{uid}")
         return "dstpu_flight"
 
     def dump(self, reason: str = "manual",

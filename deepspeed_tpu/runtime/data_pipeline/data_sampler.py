@@ -22,6 +22,7 @@ import numpy as np
 
 from deepspeed_tpu.runtime.data_pipeline.curriculum_scheduler import \
     CurriculumScheduler
+from deepspeed_tpu.utils.logging import logger
 
 
 class DeepSpeedDataSampler:
@@ -76,6 +77,14 @@ class DeepSpeedDataSampler:
 
     def load_state_dict(self, sd: Dict[str, Any]):
         self.consumed_batches = int(sd["consumed_batches"])
+        if int(sd.get("seed", self.seed)) != int(self.seed):
+            # the restored stream is seeded from the checkpoint, not the
+            # (different) configured seed — say so, since "resumed" with
+            # another seed silently means "another batch order"
+            logger.warning(
+                f"data sampler resume: adopting checkpoint seed "
+                f"{sd['seed']} over configured seed {self.seed} so the "
+                "replayed batch stream matches the original run")
         self.seed = int(sd.get("seed", self.seed))
         if self.curriculum is not None and "curriculum" in sd:
             self.curriculum.load_state_dict(sd["curriculum"])
@@ -135,6 +144,13 @@ class CurriculumDataLoader:
         self.key = key
         self.truncate = truncate_to_difficulty
         self.pad_id = pad_id
+
+    # -- resume (resilience/resume.py): position lives in the sampler ---
+    def state_dict(self) -> Dict[str, Any]:
+        return {"sampler": self.sampler.state_dict(), "offset_batches": 0}
+
+    def load_state_dict(self, sd: Dict[str, Any]) -> None:
+        self.sampler.load_state_dict(sd.get("sampler", sd))
 
     def __iter__(self):
         for batch_ids in self.sampler:

@@ -66,6 +66,22 @@ class DeepSpeedDataLoader:
     def set_epoch(self, epoch: int):
         self._epoch = epoch
 
+    # -- resume (resilience/resume.py; docs/resilience.md) --------------
+    # Iteration order is a pure function of (seed, epoch): restoring the
+    # epoch and replaying the intra-epoch offset reproduces the exact
+    # remaining batch stream.
+    def state_dict(self):
+        return {"epoch": int(self._epoch), "seed": int(self.seed),
+                "shuffle": bool(self.shuffle)}
+
+    def load_state_dict(self, sd) -> None:
+        if int(sd.get("seed", self.seed)) != int(self.seed):
+            logger.warning(
+                f"dataloader resume: checkpoint seed {sd.get('seed')} != "
+                f"configured seed {self.seed} — the replayed batch "
+                "stream will differ from the original run")
+        self.set_epoch(int(sd.get("epoch", 0)))
+
     def __iter__(self) -> Iterator:
         if self._len is None:
             if self.shuffle and not self._warned_stream_shuffle:
@@ -111,20 +127,46 @@ class RepeatingLoader:
         self.loader = loader
         self.data_iter = iter(self.loader)
         self._epoch = 0
+        self._offset = 0  # batches yielded since the last epoch restart
 
     def __iter__(self):
         return self
 
+    # -- resume (resilience/resume.py; docs/resilience.md) --------------
+    def state_dict(self):
+        sd = {"epoch": int(self._epoch),
+              "offset_batches": int(self._offset)}
+        if hasattr(self.loader, "state_dict"):
+            sd["loader"] = self.loader.state_dict()
+        return sd
+
+    def load_state_dict(self, sd) -> None:
+        """Restore epoch position and restart the inner iterator; the
+        caller (resume_data_iter) then replays ``offset_batches`` pulls
+        to land on the first unconsumed batch."""
+        if "loader" in sd and hasattr(self.loader, "load_state_dict"):
+            self.loader.load_state_dict(sd["loader"])
+        self._epoch = int(sd.get("epoch", 0))
+        if hasattr(self.loader, "set_epoch"):
+            self.loader.set_epoch(self._epoch)
+        self.data_iter = iter(self.loader)
+        self._offset = 0
+
     def __next__(self):
         try:
-            return next(self.data_iter)
+            batch = next(self.data_iter)
+            self._offset += 1
+            return batch
         except StopIteration:
             self._epoch += 1
+            self._offset = 0
             if hasattr(self.loader, "set_epoch"):
                 self.loader.set_epoch(self._epoch)
             self.data_iter = iter(self.loader)
             try:
-                return next(self.data_iter)
+                batch = next(self.data_iter)
+                self._offset += 1
+                return batch
             except StopIteration:
                 # a restart that immediately exhausts means the wrapped
                 # loader yields nothing — restarting again would spin

@@ -420,6 +420,40 @@ class Engine:
                     _fr.install_crash_handlers()
             except Exception as e:
                 logger.warning(f"flight recorder disabled: {e}")
+
+        # -- resilience (resilience block; docs/resilience.md) ------------
+        # PreemptionGuard: SIGTERM → drain + emergency checkpoint at the
+        # next GAS boundary (second SIGTERM escalates through the flight
+        # recorder's chained dump-and-kill handler, installed above).
+        # Chaos injector: armed only when DSTPU_CHAOS is set — one `is
+        # None` check per step/input-pull otherwise.
+        self.preempted = False
+        self.loaded_data_cursor = None  # manifest cursor from last load
+        self._last_save_dir = None      # emergency-save fallback target
+        self._last_data_iter = None     # data_cursor loader-state source
+        self._resilience_cfg = rcfg = getattr(config, "resilience", None)
+        self._preempt_guard = None
+        self._chaos = None
+        try:
+            from deepspeed_tpu.resilience.chaos import get_chaos_injector
+
+            inj = get_chaos_injector()
+            self._chaos = inj if inj.armed else None
+        except Exception as e:
+            logger.warning(f"chaos injector unavailable: {e}")
+        if rcfg is None or (rcfg.enabled and rcfg.preemption_guard):
+            try:
+                from deepspeed_tpu.resilience.preemption import \
+                    PreemptionGuard
+
+                self._preempt_guard = PreemptionGuard(
+                    save_deadline_s=getattr(
+                        rcfg, "preemption_save_deadline_s", 60.0)
+                    if rcfg is not None else 60.0)
+                self._preempt_guard.install()
+            except Exception as e:
+                logger.warning(f"preemption guard disabled: {e}")
+                self._preempt_guard = None
         self._flops_per_token = None   # cached model.flops_per_token()
         self._last_batches_struct = None  # abstract batch for roofline()
         self._roofline_cost = None     # cached XLA cost analysis
@@ -992,6 +1026,8 @@ class Engine:
     def _next_microbatches(self, data_iter, n: int):
         out = []
         for i in range(n):
+            if self._chaos is not None:
+                self._chaos.on_input_batch()
             try:
                 out.append(next(data_iter))
             except StopIteration:
@@ -1062,6 +1098,7 @@ class Engine:
             if self.training_dataloader is None:
                 raise ValueError("train_batch needs data_iter or training_data")
             data_iter = iter(self.training_dataloader)
+        self._last_data_iter = data_iter  # data_cursor loader-state source
         depth = self._effective_depth()
         sync = depth == 0
         host_t0 = time.perf_counter()
@@ -1070,6 +1107,8 @@ class Engine:
             self.tput_timer.start()
         batches = self._next_batches(data_iter)
         step_no = self.global_steps + 1
+        if self._chaos is not None:
+            self._chaos.on_step(step_no)
         if self.flight is not None:
             self.flight.record("step_entry", step=step_no,
                                inflight=len(self._inflight))
@@ -1108,6 +1147,11 @@ class Engine:
                               window=len(self._inflight))
         while len(self._inflight) > depth:
             self._drain_one()
+        if (self._preempt_guard is not None
+                and self._preempt_guard.should_checkpoint()):
+            # GAS boundary after a preemption notice: drain the window
+            # and land an emergency checkpoint before the grace runs out
+            self._emergency_checkpoint()
         return metrics["loss"]
 
     def _drain_one(self) -> None:
@@ -1168,6 +1212,69 @@ class Engine:
         while self._inflight:
             self._drain_one()
         return self
+
+    def _emergency_checkpoint(self) -> None:
+        """Preemption-notice path: drain, save, force-commit — bounded by
+        ``resilience.preemption_save_deadline_s``. Sets ``preempted`` so
+        the training loop can exit cleanly; a torn save is harmless (no
+        manifest ⇒ auto-resume falls back to the previous good tag)."""
+        guard = self._preempt_guard
+        rcfg = self._resilience_cfg
+        save_dir = ((getattr(rcfg, "emergency_save_dir", None)
+                     if rcfg is not None else None)
+                    or self._last_save_dir)
+        self.preempted = True
+        if self.flight is not None:
+            self.flight.record("preempt_drain", step=self.global_steps,
+                               inflight=len(self._inflight))
+        self.synchronize()
+        if save_dir is None:
+            logger.error(
+                "resilience: preemption notice but no checkpoint dir is "
+                "known (no prior save_checkpoint and no "
+                "resilience.emergency_save_dir) — exiting WITHOUT an "
+                "emergency save")
+            if self.flight is not None:
+                self.flight.record("preempt_save_skipped", reason="no_dir")
+            return
+        from deepspeed_tpu.resilience.policy import (_DeadlineExpired,
+                                                     run_with_deadline)
+
+        t0 = time.perf_counter()
+
+        def _save():
+            self.save_checkpoint(save_dir)
+            self._ckpt_io.commit_pending()  # async engines: force durable
+
+        try:
+            if jax.process_count() > 1:
+                # multi-host publish runs collectives that must issue
+                # from this thread in lockstep on every rank — the
+                # deadline is advisory there (the scheduler's SIGKILL is
+                # the real bound)
+                _save()
+            else:
+                run_with_deadline(_save, guard.save_deadline_s,
+                                  name="preempt_save")
+        except _DeadlineExpired:
+            logger.error(
+                f"resilience: emergency checkpoint blew its "
+                f"{guard.save_deadline_s:g}s deadline — exiting with the "
+                "save incomplete (manifest validation will reject it and "
+                "resume from the previous good tag)")
+            if self.flight is not None:
+                self.flight.record("preempt_save_timeout",
+                                   deadline_s=guard.save_deadline_s)
+            return
+        wall = time.perf_counter() - t0
+        if self.flight is not None:
+            self.flight.record("preempt_save_done",
+                               step=self.global_steps,
+                               wall_ms=round(wall * 1000.0, 1))
+        logger.warning(
+            f"resilience: emergency checkpoint committed to {save_dir} "
+            f"in {wall:.2f}s; engine.preempted=True — stop training and "
+            "exit")
 
     def _dispatch_train_step(self, batches):
         lr_over = jnp.asarray(
@@ -1870,6 +1977,7 @@ class Engine:
         # drain in-flight steps first: the saved counters (global_steps,
         # skipped_steps) and state must reflect every dispatched step
         self.synchronize()
+        self._last_save_dir = save_dir  # emergency-save fallback target
         if self.flight is not None:
             self.flight.record("checkpoint_save", step=self.global_steps,
                                tag=str(tag), phase="begin")
@@ -1898,6 +2006,19 @@ class Engine:
             # restored leaves come back in device memory; re-pin layers
             self.params = self._place_layer_params_on_host(self.params)
         return out
+
+    def resume_data_iter(self, data_iter, source=None):
+        """Position ``data_iter`` at the first microbatch the checkpoint
+        never consumed, using the manifest's data cursor from the last
+        ``load_checkpoint`` (no-op on a fresh run). Call BEFORE the first
+        ``train_batch`` so the prefetcher only ever sees the positioned
+        stream; ``source`` optionally names the loader object (e.g. a
+        ``RepeatingLoader``) whose ``load_state_dict`` restores
+        epoch/rng state. See docs/resilience.md."""
+        from deepspeed_tpu.resilience.resume import resume_data_iter
+
+        return resume_data_iter(data_iter, self.loaded_data_cursor,
+                                source=source)
 
 
 class _LRGroup(dict):

@@ -69,6 +69,11 @@ class PrefetchingIterator:
         self.name = name
         self._closed = False
         self._finished = False
+        # observability for resume accounting (resilience/resume.py):
+        # produced - consumed = batches pulled ahead of training, i.e.
+        # the work a preemption discards and auto-resume must replay
+        self.produced = 0
+        self.consumed = 0
         self._sync = depth == 0
         if not self._sync and not allow_multiprocess:
             try:
@@ -103,6 +108,7 @@ class PrefetchingIterator:
             except BaseException as e:  # propagate at next(), not here
                 self._put(_WorkerError(e))
                 return
+            self.produced += 1
             if not self._put(item):
                 return  # closed while blocked on a full buffer
 
@@ -126,7 +132,10 @@ class PrefetchingIterator:
         if self._finished:
             raise StopIteration
         if self._sync:
-            return self._produce()  # StopIteration propagates as-is
+            item = self._produce()  # StopIteration propagates as-is
+            self.produced += 1
+            self.consumed += 1
+            return item
         item = self._queue.get()
         if item is _EndOfStream:
             self._finished = True
@@ -134,6 +143,7 @@ class PrefetchingIterator:
         if isinstance(item, _WorkerError):
             self._finished = True
             raise item.exc
+        self.consumed += 1
         return item
 
     @property

@@ -1,0 +1,112 @@
+"""Subprocess worker for the chaos/fault-injection harness.
+
+One real training rank on the 8-device CPU sim: tiny gpt2 engine,
+deterministic shuffled loader, periodic checkpoints with manifests, and
+auto-resume — if a previous incarnation left a valid checkpoint in the
+run dir, this one loads it and repositions the data stream with
+``engine.resume_data_iter`` before the first step.
+
+Faults arrive via the standard ``DSTPU_CHAOS`` env spec
+(resilience/chaos.py): the engine arms the injector itself, so a
+``kill_rank=0,kill_step=3,kill_signal=SIGKILL`` spec kills THIS process
+mid-run exactly like a scheduler preemption would. A restarted worker
+(``DSTPU_ELASTIC_RESTART_COUNT`` > 0, set by the elastic agent) disarms
+the injector first — the fault is one-shot, else the group would crash
+loop on the same step forever.
+
+    python chaos_worker.py RUN_DIR [--steps N] [--save-interval K]
+
+Per-step losses append to <RUN_DIR>/losses.jsonl (a killed process loses
+its stdout, the file survives); a clean finish prints one JSON line with
+the final step/loss. tests/test_resilience.py and tools/chaos_run.py
+compare these across fault-free and fault-injected runs.
+"""
+
+import argparse
+import json
+import os
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+SEQ = 16
+VOCAB = 128
+
+
+def main() -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("run_dir")
+    p.add_argument("--steps", type=int, default=5)
+    p.add_argument("--save-interval", type=int, default=2)
+    args = p.parse_args()
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    os.environ.setdefault("XLA_FLAGS",
+                          "--xla_force_host_platform_device_count=8")
+    os.environ.setdefault("DSTPU_FLIGHT_DIR",
+                          os.path.join(args.run_dir, "flight"))
+    if int(os.environ.get("DSTPU_ELASTIC_RESTART_COUNT", "0")) > 0:
+        # the injected fault already fired in a previous incarnation;
+        # re-arming it would kill the resumed run at the same step again
+        os.environ.pop("DSTPU_CHAOS", None)
+
+    import numpy as np
+
+    import deepspeed_tpu as dstpu
+    from deepspeed_tpu.models.zoo import get_model
+    from deepspeed_tpu.runtime.dataloader import (DeepSpeedDataLoader,
+                                                  RepeatingLoader)
+
+    config = {
+        "train_micro_batch_size_per_chip": 1,
+        "gradient_accumulation_steps": 2,
+        "optimizer": {"type": "adamw", "params": {"lr": 1e-2}},
+        "zero_optimization": {"stage": 1},
+        "steps_per_print": 10_000,
+    }
+    model = get_model("gpt2-125m", num_layers=2, hidden_size=64,
+                      num_heads=4, vocab_size=VOCAB, max_seq_len=64,
+                      remat=False)
+    engine, _, _, _ = dstpu.initialize(model=model, config=config,
+                                       topology={"dp": 1, "fsdp": 8})
+
+    B = engine.micro_batch_size * engine.dp_world_size
+    rng = np.random.default_rng(42)
+    data = [{"input_ids": rng.integers(0, VOCAB, (SEQ,)).astype(np.int32)}
+            for _ in range(40)]
+    loader = RepeatingLoader(
+        DeepSpeedDataLoader(data, batch_size=B, shuffle=True, seed=7))
+    data_iter = iter(loader)
+
+    ckpt_dir = os.path.join(args.run_dir, "ckpt")
+    if os.path.exists(os.path.join(ckpt_dir, "latest")):
+        engine.load_checkpoint(ckpt_dir)
+        data_iter = engine.resume_data_iter(data_iter, source=loader)
+
+    losses_path = os.path.join(args.run_dir, "losses.jsonl")
+    loss = None
+    while engine.global_steps < args.steps:
+        loss = engine.train_batch(data_iter)
+        with open(losses_path, "a") as f:
+            f.write(json.dumps({"step": engine.global_steps,
+                                "loss": float(loss),
+                                "pid": os.getpid()}) + "\n")
+        if engine.preempted:
+            # the guard already drained + committed the emergency save;
+            # exit cleanly so the supervisor restarts (or not) on policy
+            print(json.dumps({"preempted": True,
+                              "step": engine.global_steps}))
+            return 0
+        if engine.global_steps % args.save_interval == 0 and \
+                engine.global_steps < args.steps:
+            engine.save_checkpoint(ckpt_dir)
+    engine.save_checkpoint(ckpt_dir)
+    print(json.dumps({"final_step": engine.global_steps,
+                      "final_loss": float(loss)}))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

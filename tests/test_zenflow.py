@@ -260,28 +260,39 @@ def test_host_pass_workers_match_serial(devices):
 
 
 @pytest.mark.slow
-@pytest.mark.xfail(
-    reason="infrastructure: XLA-CPU gloo's fixed ~30s pair timeout "
-    "fires mid-run when both worker processes share one starved CI "
-    "core (the 'Application timeout caused pair closure' abort; no "
-    "public knob raises it). The DIVERGENCE this test originally "
-    "recorded was real and is fixed in round 5: the fold schedule was "
-    "asymmetric (multi-process folded at 2*interval, single at "
-    "interval+1) — the schedule is now step-deterministic and "
-    "process-count-invariant by construction (zenflow.py step(): no "
-    "multi-host-only branch remains), and per-step device work batches "
-    "the whole tree into one dispatch to shrink the rendezvous "
-    "surface. Runs green on hosts with >=2 real cores.",
-    strict=False)
 def test_multihost_two_process_matches_single():
     """VERDICT r2 #6: ZenFlow on 2 jax.distributed processes x 4 devices
     (per-process per-shard host masters, gloo collectives) produces the
-    same loss stream as the single-process 8-device run."""
+    same loss stream as the single-process 8-device run.
+
+    Failure policy (docs/resilience.md): the environmental hazard here is
+    XLA-CPU gloo's fixed ~30s pair timeout, which fires when both worker
+    processes share one starved core ('Application timeout caused pair
+    closure'; no public knob raises it). That is *deterministically*
+    detectable — skip when the host cannot co-schedule two workers —
+    and otherwise *transient*, so gloo aborts get the resilience retry
+    treatment (persistent compile cache makes retries near-instant) and
+    exhaustion raises a typed CommTimeoutError instead of an opaque
+    assert. Any divergence in the loss streams still fails hard: the
+    asymmetric fold schedule this test originally caught was a real bug
+    (fixed in round 5; zenflow.py step() has no multi-host-only branch).
+    """
     import json
     import os
     import socket
     import subprocess
     import sys
+
+    from deepspeed_tpu.resilience.policy import CommTimeoutError
+
+    try:
+        cores = len(os.sched_getaffinity(0))
+    except AttributeError:
+        cores = os.cpu_count() or 1
+    if cores < 2:
+        pytest.skip("two-process gloo rendezvous needs >=2 schedulable "
+                    f"cores (host exposes {cores}); gloo's fixed ~30s "
+                    "pair timeout would abort mid-run")
 
     here = os.path.dirname(os.path.abspath(__file__))
     worker = os.path.join(here, "zenflow_worker.py")
@@ -298,7 +309,10 @@ def test_multihost_two_process_matches_single():
         assert out.returncode == 0, out.stderr[-2000:]
         return json.loads(out.stdout.strip().splitlines()[-1])["losses"]
 
+    MAX_ATTEMPTS = 3
+
     def run_multi(attempt):
+        """Loss stream, or None on a retryable gloo pair-timeout abort."""
         with socket.socket() as s:  # free rendezvous port
             s.bind(("127.0.0.1", 0))
             env["ZF_PORT"] = str(s.getsockname()[1])
@@ -310,10 +324,15 @@ def test_multihost_two_process_matches_single():
         for p, (so, se) in zip(procs, outs):
             if p.returncode != 0:
                 # first-run compile drift can outlive gloo's ~30s pair
-                # timeout on single-core hosts; the persistent compile
-                # cache (ZF_CACHE) makes the retry near-instant
-                if attempt == 0 and "Gloo" in se:
+                # timeout; the persistent compile cache (ZF_CACHE) makes
+                # the retry near-instant, so gloo aborts are transient
+                if attempt < MAX_ATTEMPTS - 1 and "Gloo" in se:
                     return None
+                if "Gloo" in se:
+                    raise CommTimeoutError(
+                        op="zenflow_two_process_rendezvous",
+                        timeout_s=30.0, attempts=MAX_ATTEMPTS,
+                        flight_tail=se[-2000:])
                 assert p.returncode == 0, se[-2000:]
         return json.loads(outs[0][0].strip().splitlines()[-1])["losses"]
 
@@ -321,7 +340,9 @@ def test_multihost_two_process_matches_single():
 
     env["ZF_CACHE"] = tempfile.mkdtemp(prefix="zf_cache_")
     single = run_single()
-    multi = run_multi(0)
-    if multi is None:
-        multi = run_multi(1)
+    multi = None
+    for attempt in range(MAX_ATTEMPTS):
+        multi = run_multi(attempt)
+        if multi is not None:
+            break
     np.testing.assert_allclose(multi, single, rtol=2e-4)

@@ -1,0 +1,138 @@
+"""Chaos harness: kill a training rank mid-run, restart it under the
+elastic agent, and prove auto-resume reproduces the fault-free run.
+
+The drill (docs/resilience.md):
+
+1. **baseline** — one fault-free worker (tests/chaos_worker.py) trains
+   N steps, checkpointing every K, and records per-step losses;
+2. **chaos** — a fresh run dir, same worker, but ``DSTPU_CHAOS`` arms
+   the in-process fault injector (default: SIGKILL at step 3, exactly a
+   scheduler preemption with no grace). The ElasticAgent supervises it:
+   the kill is observed as a worker failure, the group restarts, and the
+   restarted worker auto-resumes from the latest *valid* manifest and
+   replays the remaining batch stream;
+3. **verdict** — the chaos run's final loss must be bit-identical to the
+   baseline's. Not "close": identical. Anything else means resume
+   changed the batch stream or the optimizer state and the run silently
+   became a different run.
+
+    python tools/chaos_run.py [--steps 5] [--kill-step 3]
+                              [--signal SIGKILL|SIGTERM] [--keep]
+
+Exit 0 on a bit-identical resume, 1 otherwise. ``make chaos`` runs this
+on the 8-device CPU sim; no TPU needed.
+"""
+
+import argparse
+import json
+import os
+import shutil
+import subprocess
+import sys
+import tempfile
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+WORKER = os.path.join(_REPO, "tests", "chaos_worker.py")
+
+
+def _worker_env(run_dir: str, chaos: str = "") -> dict:
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    env.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+    env["DSTPU_FLIGHT_DIR"] = os.path.join(run_dir, "flight")
+    if chaos:
+        env["DSTPU_CHAOS"] = chaos
+    else:
+        env.pop("DSTPU_CHAOS", None)
+    return env
+
+
+def _final_loss(run_dir: str):
+    path = os.path.join(run_dir, "losses.jsonl")
+    with open(path) as f:
+        rows = [json.loads(line) for line in f if line.strip()]
+    # a replayed step appears twice (pre-kill + post-resume); last wins
+    by_step = {r["step"]: r["loss"] for r in rows}
+    return by_step, max(by_step)
+
+
+def run_baseline(run_dir: str, steps: int) -> None:
+    rc = subprocess.call(
+        [sys.executable, WORKER, run_dir, "--steps", str(steps)],
+        env=_worker_env(run_dir))
+    if rc != 0:
+        raise SystemExit(f"baseline worker failed (rc={rc})")
+
+
+def run_chaos(run_dir: str, steps: int, kill_step: int, sig: str) -> None:
+    from deepspeed_tpu.elasticity.elastic_agent import ElasticAgent
+
+    chaos = f"kill_rank=0,kill_step={kill_step},kill_signal={sig}"
+
+    def build_cmds(hosts, restart_count):
+        return [[sys.executable, WORKER, run_dir, "--steps", str(steps)]]
+
+    agent = ElasticAgent(
+        build_cmds, lambda: ["localhost"], max_restarts=3,
+        poll_interval=0.2,
+        env=_worker_env(run_dir, chaos))
+    rc = agent.run()
+    if rc != 0:
+        raise SystemExit(f"chaos group never finished cleanly (rc={rc})")
+    print(f"chaos: agent restarted the group {agent.restart_count} "
+          f"time(s); last failure kind={agent.last_failure_kind} "
+          f"exit codes={agent.last_exit_codes}")
+    if agent.restart_count == 0:
+        raise SystemExit("chaos: fault never fired (0 restarts) — the "
+                         "run proved nothing")
+
+
+def main() -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--steps", type=int, default=5)
+    p.add_argument("--kill-step", type=int, default=3)
+    p.add_argument("--signal", default="SIGKILL",
+                   choices=["SIGKILL", "SIGTERM"])
+    p.add_argument("--keep", action="store_true",
+                   help="keep the run dirs for inspection")
+    args = p.parse_args()
+
+    root = tempfile.mkdtemp(prefix="dstpu_chaos_")
+    base_dir = os.path.join(root, "baseline")
+    chaos_dir = os.path.join(root, "chaos")
+    os.makedirs(base_dir)
+    os.makedirs(chaos_dir)
+    try:
+        print(f"chaos: baseline run ({args.steps} steps) -> {base_dir}")
+        run_baseline(base_dir, args.steps)
+        print(f"chaos: fault run (kill step {args.kill_step} via "
+              f"{args.signal}) -> {chaos_dir}")
+        run_chaos(chaos_dir, args.steps, args.kill_step, args.signal)
+
+        base, bstep = _final_loss(base_dir)
+        got, gstep = _final_loss(chaos_dir)
+        ok = bstep == gstep and base[bstep] == got[gstep]
+        print(json.dumps({"kind": "chaos_verdict",
+                          "baseline_final": base[bstep],
+                          "chaos_final": got[gstep],
+                          "steps": bstep,
+                          "bit_identical": ok}))
+        if not ok:
+            print("chaos: FAIL — resumed run diverged from baseline",
+                  file=sys.stderr)
+            return 1
+        print("chaos: OK — kill/restart/resume reproduced the "
+              "fault-free run bit-for-bit")
+        return 0
+    finally:
+        if args.keep:
+            print(f"chaos: run dirs kept at {root}")
+        else:
+            shutil.rmtree(root, ignore_errors=True)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
