@@ -14,7 +14,7 @@ NPROC := $(shell nproc)
 XDIST ?= $(shell if [ $(NPROC) -gt 2 ] && python -c "import xdist" 2>/dev/null; then echo "-n $$(( $(NPROC) - 1 )) --dist loadfile"; fi)
 PYTEST ?= python -m pytest
 
-.PHONY: test smoke slow bench bench-real bench-proxy bench-hostgap fleet-demo chaos
+.PHONY: test smoke slow bench bench-real bench-proxy bench-hostgap bench-overlap fleet-demo chaos
 
 smoke:
 	$(PYTEST) tests/ -q -m "not slow" $(XDIST)
@@ -36,6 +36,15 @@ bench-real:
 
 bench-proxy:
 	BENCH_PROXY=1 python bench.py
+
+# A/B the per-layer overlap engine: one unstaged run (depth 0 — the
+# pre-round-7 schedule; the prefetch ring stays on) then one staged run
+# (depth 4 — pin_stage barriers sequence the full ring's fetches against
+# layer compute). Compare tokens/s/chip, hidden_comm_frac and
+# exposed_param_fetch_ms across the two JSON lines (docs/performance.md).
+bench-overlap:
+	BENCH_OVERLAP_DEPTH=0 python bench.py
+	BENCH_OVERLAP_DEPTH=4 python bench.py
 
 # Two-process CPU demo of the fleet observability layer: both ranks
 # publish shards into a temp run dir, then the aggregated report (skew,

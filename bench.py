@@ -39,6 +39,9 @@ single-sample behavior for slow capacity probes), BENCH_PIPELINE_DEPTH /
 BENCH_PREFETCH_DEPTH (pipelined-loop dispatch-ahead + input-prefetch
 depths; 0 restores the blocking loop — see docs/performance.md),
 BENCH_PARAM_PREFETCH (ZeRO-Infinity layer-prefetch ring depth),
+BENCH_OVERLAP_DEPTH (per-layer overlap engine stage depth — pin_stage
+staging in runtime/param_stream.py; 0 restores the unstaged schedule
+for A/B, see ``make bench-overlap``),
 BENCH_FP8_MLP (opt-in fp8 MLP GEMMs), BENCH_MEASURE
 (device_step | train_batch), BENCH_TUNED_DEFAULTS (tuned-config JSON
 path). ``host_gap_ms`` in the JSON is the per-step host time on the
@@ -87,7 +90,8 @@ def resolve_bench_defaults(env=None, on_tpu=True, n_chips=1):
     Returns a dict: model_name, real_shape, proxy, long_ctx, seq,
     layers, vocab (layers/vocab None off the llama headline), micro,
     remat_policy, tiled_logits, tiled_mlp, offload, zero_stage,
-    param_prefetch_depth, fp8_mlp, measure, config_source, tuned.
+    param_prefetch_depth, overlap_depth, fp8_mlp, measure,
+    config_source, tuned.
     """
     env = os.environ if env is None else env
     model_name = env.get("BENCH_MODEL", "llama3-8b")
@@ -136,6 +140,15 @@ def resolve_bench_defaults(env=None, on_tpu=True, n_chips=1):
     param_prefetch = (int(ppd_env) if ppd_env is not None
                       else (int(ppd_tuned) if ppd_tuned is not None
                             else (4 if real else None)))
+    # per-layer overlap engine (runtime/param_stream.py pin_stage): the
+    # real shape pins the full depth-4 ring — each fetch may hide behind
+    # 4 layer-stages of compute; 0 keeps the ring but drops the barriers
+    # (the pre-round-7 schedule) for A/B runs
+    od_env = env.get("BENCH_OVERLAP_DEPTH")
+    od_tuned = (tuned.get("performance") or {}).get("overlap_depth")
+    overlap_depth = (int(od_env) if od_env is not None
+                     else (int(od_tuned) if od_tuned is not None
+                           else (4 if real else None)))
     fp8_mlp = bool(int(env.get("BENCH_FP8_MLP", "0")))
     # the full step at the real shape is host-Adam-bound on a 1-core
     # rig; the chip-side MFU question is answered by the device fwd+bwd
@@ -150,11 +163,50 @@ def resolve_bench_defaults(env=None, on_tpu=True, n_chips=1):
         "tiled_logits": tiled, "tiled_mlp": tiled_mlp,
         "attn_chunks": attn_chunks, "offload": offload,
         "zero_stage": zero_stage,
-        "param_prefetch_depth": param_prefetch, "fp8_mlp": fp8_mlp,
+        "param_prefetch_depth": param_prefetch,
+        "overlap_depth": overlap_depth, "fp8_mlp": fp8_mlp,
         "measure": measure,
         "config_source": ("autotuned-file" if tuned
                           else "measured-defaults"),
     }
+
+
+def overlap_report(model, step_ms, overlap_depth, streaming,
+                   fetch_gbps=None):
+    """(hidden_comm_frac, exposed_param_fetch_ms) for the JSON line.
+
+    The param-stream bytes come from the model's abstract layer shapes
+    (eval_shape — no compute); the compute window is the MEASURED step
+    split across the 2L scheduling stages, so the split reflects this
+    run's actual step time rather than the roofline model. (None, None)
+    when the run doesn't stream params or the knob is off the table.
+    """
+    if not streaming or overlap_depth is None or not step_ms:
+        return None, None
+    try:
+        import jax
+
+        from deepspeed_tpu.models.transformer import init_params
+        from deepspeed_tpu.observability.attribution import (
+            _DEFAULT_FETCH_GBPS, _per_layer_shapes, _tree_bytes,
+            overlap_split_ms)
+
+        cfg = model.config
+        params = jax.eval_shape(lambda k: init_params(cfg, k),
+                                jax.random.PRNGKey(0))
+        layer_bytes = _tree_bytes(_per_layer_shapes(params["layers"]))
+        fetch = (fetch_gbps if fetch_gbps is not None
+                 else float(os.environ.get("DSTPU_FETCH_GBPS",
+                                           _DEFAULT_FETCH_GBPS)))
+        transfer_ms = (layer_bytes * cfg.num_layers * 2  # fwd + bwd
+                       / (fetch * 1e9) * 1e3)
+        stages = 2 * max(int(cfg.num_layers), 1)
+        split = overlap_split_ms(transfer_ms, float(step_ms) / stages,
+                                 int(overlap_depth), stages)
+        return (round(split["hidden_frac"], 4),
+                round(split["exposed_ms"], 2))
+    except Exception:
+        return None, None
 
 
 def main():
@@ -274,6 +326,7 @@ def main():
             space["tiled_logits"] = [4, 8, 16]
             space["attn_chunks"] = [None, 4]
             space["prefetch_depths"] = [2, 4]
+            space["overlap_depths"] = [0, 2, 4]
             persist = os.environ.get(
                 "BENCH_TUNED_DEFAULTS",
                 os.path.join(os.path.dirname(os.path.abspath(__file__)),
@@ -299,6 +352,9 @@ def main():
                 "param_prefetch_depth")
             if ppd_best is not None:
                 knobs["param_prefetch_depth"] = int(ppd_best)
+            od_best = (best.get("performance") or {}).get("overlap_depth")
+            if od_best is not None:
+                knobs["overlap_depth"] = int(od_best)
             model = get_model(model_name, **overrides)
             config_source = "autotuner"
 
@@ -317,6 +373,10 @@ def main():
         performance["param_prefetch_depth"] = knobs["param_prefetch_depth"]
     if knobs["fp8_mlp"]:
         performance["fp8_mlp"] = True
+    if knobs["overlap_depth"] is not None:
+        # per-layer overlap engine stage depth (docs/performance.md);
+        # 0 = keep the ring, drop the pin_stage barriers (A/B baseline)
+        performance["overlap_depth"] = knobs["overlap_depth"]
     config = {
         "train_micro_batch_size_per_chip": micro,
         "gradient_accumulation_steps": gas,
@@ -508,6 +568,14 @@ def main():
     base_tps = baseline.get(base_key)
     vs_baseline = (tok_per_sec_chip / base_tps) if base_tps else 1.0
 
+    # overlap-engine accounting: how much of the param-stream traffic
+    # the staged schedule hides behind this run's measured step, and the
+    # exposed remainder (the round-7 headline delta — docs/roofline.md)
+    step_ms = (B * seq * gas / (tok_per_sec_chip * n_chips) * 1e3
+               if tok_per_sec_chip > 0 else None)
+    hidden_comm_frac, exposed_param_fetch_ms = overlap_report(
+        model, step_ms, knobs["overlap_depth"], offload >= 2)
+
     desc = (f"{model_name}-geometry({model.config.num_layers}L, "
             f"vocab {model.config.vocab_size})"
             if llama_headline else model_name)
@@ -540,6 +608,9 @@ def main():
         "offload": offload,
         "measure": "device_step" if device_step else "train_batch",
         "param_prefetch_depth": knobs["param_prefetch_depth"],
+        "overlap_depth": knobs["overlap_depth"],
+        "hidden_comm_frac": hidden_comm_frac,
+        "exposed_param_fetch_ms": exposed_param_fetch_ms,
         "fp8_mlp": knobs["fp8_mlp"],
         "loss": round(float(loss), 4),
         "chips": n_chips,

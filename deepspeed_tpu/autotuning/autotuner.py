@@ -55,11 +55,12 @@ class Autotuner:
       tuning_space:  {"micro_batch_sizes": [...], "zero_stages": [...],
                       "remat": [...], "remat_policies": [...],
                       "tiled_logits": [...], "attn_chunks": [...],
-                      "prefetch_depths": [...]} — the last three are
-                      model-config axes for the real-shape sweep
-                      (vocab-head tile count, FPDT query chunks, and the
-                      ZeRO-Infinity layer-prefetch ring depth); None in
-                      any of them keeps the model's own setting
+                      "prefetch_depths": [...], "overlap_depths": [...]}
+                      — the last four are model-config axes for the
+                      real-shape sweep (vocab-head tile count, FPDT
+                      query chunks, the ZeRO-Infinity layer-prefetch
+                      ring depth, and the overlap-engine stage depth);
+                      None in any of them keeps the model's own setting
       hbm_budget_bytes: prune candidates whose compiled peak exceeds this
                       (default: detected device memory, else 16 GiB)
       topology:      mesh topology dict forwarded to every trial engine —
@@ -97,6 +98,10 @@ class Autotuner:
         self.tiled_logits = list(space.get("tiled_logits", [None]))
         self.attn_chunks = list(space.get("attn_chunks", [None]))
         self.prefetch_depths = list(space.get("prefetch_depths", [None]))
+        # overlap-engine depth (ISSUE 6): pin_stage barrier staging of
+        # the K newest in-flight transfers per layer. None = model/env
+        # default; 0 = today's unstaged schedule
+        self.overlap_depths = list(space.get("overlap_depths", [None]))
         self.hbm_budget = hbm_budget_bytes or self._detect_hbm()
         self.results_dir = results_dir
         self.persist_path = persist_path
@@ -120,10 +125,10 @@ class Autotuner:
     # -- candidate enumeration (reference tune_space) -------------------
     def candidates(self) -> List[Dict[str, Any]]:
         out = []
-        for mb, stage, remat, policy, tl, ac, pd in itertools.product(
+        for mb, stage, remat, policy, tl, ac, pd, od in itertools.product(
                 self.micro_batch_sizes, self.zero_stages, self.remat,
                 self.remat_policies, self.tiled_logits, self.attn_chunks,
-                self.prefetch_depths):
+                self.prefetch_depths, self.overlap_depths):
             cfg = json.loads(json.dumps(self.base_config))  # deep copy
             cfg["train_micro_batch_size_per_chip"] = int(mb)
             cfg.pop("train_batch_size", None)  # re-derived from micro×gas×dp
@@ -139,6 +144,8 @@ class Autotuner:
                 cfg["_attn_chunks"] = int(ac)
             if pd is not None:
                 cfg["_prefetch_depth"] = int(pd)
+            if od is not None:
+                cfg["_overlap_depth"] = int(od)
             out.append(cfg)
         return out
 
@@ -153,7 +160,9 @@ class Autotuner:
                       for key, name in (("_tiled_logits", "tiled_logits"),
                                         ("_attn_chunks", "attn_chunks"),
                                         ("_prefetch_depth",
-                                         "prefetch_depth"))
+                                         "prefetch_depth"),
+                                        ("_overlap_depth",
+                                         "overlap_depth"))
                       if key in cfg}
         model = self.model_factory()
         if hasattr(model, "config") and hasattr(model.config, "remat"):
@@ -332,6 +341,9 @@ class Autotuner:
         if "_prefetch_depth" in out:
             out.setdefault("performance", {})["param_prefetch_depth"] = \
                 int(out.pop("_prefetch_depth"))
+        if "_overlap_depth" in out:
+            out.setdefault("performance", {})["overlap_depth"] = \
+                int(out.pop("_overlap_depth"))
         return out
 
     def _persist_best(self, cfg: Dict[str, Any],
@@ -391,6 +403,10 @@ def main(argv=None) -> int:
     ap.add_argument("--prefetch-depths", type=int, nargs="+", default=None,
                     help="layer-prefetch ring depths to try (1 = plain "
                          "double buffering)")
+    ap.add_argument("--overlap-depths", type=int, nargs="+", default=None,
+                    help="overlap-engine depths to try (0 = unstaged "
+                         "schedule; k pins the k newest in-flight "
+                         "transfers into the issuing layer's stage)")
     ap.add_argument("--fast", action="store_true",
                     help="rank by compiled memory only (no timed runs)")
     ap.add_argument("--steps", type=int, default=3)
@@ -437,6 +453,8 @@ def main(argv=None) -> int:
         space["attn_chunks"] = args.attn_chunks
     if args.prefetch_depths is not None:
         space["prefetch_depths"] = args.prefetch_depths
+    if args.overlap_depths is not None:
+        space["overlap_depths"] = args.overlap_depths
     tuner = Autotuner(model_factory, base, batch_fn,
                       tuning_space=space or None,
                       results_dir=args.results_dir,

@@ -270,79 +270,108 @@ def _nbytes(x) -> int:
         return int(np.prod(aval.shape) * jnp.dtype(aval.dtype).itemsize)
 
 
-def _record(op: str, x, axis, log_name=None):
-    nb = None
-    try:
-        nb = _nbytes(x)
-        get_comms_logger().record(op, nb, axis, log_name)
-    except Exception:
-        pass
-    try:
-        # flight recorder: one ring append per *traced* collective (these
-        # fire at trace time, not per executed step) so a hang dump shows
-        # which collectives the wedged program contains
-        from deepspeed_tpu.observability.flight_recorder import \
-            get_flight_recorder
+class _traced_op:
+    """Dispatch→completion span around one traced collective: records
+    the comms logger at entry (byte accounting, unchanged) and appends
+    ONE flight-recorder event stamped with the dispatch start plus a
+    ``dur_ms`` field at exit — so chrome_trace.py renders each traced
+    collective as a Perfetto "X" slice on the comm lane instead of an
+    instant marker, and overlapping dispatches show as overlapping
+    slices. These fire at trace time (timing executed collectives inside
+    a compiled program from Python is meaningless); the span covers the
+    primitive's trace-time dispatch, which is also what a hang dump
+    needs: which collectives the wedged program contains, in order."""
 
-        get_flight_recorder().record("collective", op=log_name or op,
-                                     bytes=nb, axis=str(axis))
-    except Exception:
-        pass
+    __slots__ = ("_op", "_nb", "_axis", "_t0")
+
+    def __init__(self, op: str, x, axis, log_name=None):
+        name = log_name or op
+        self._op = name
+        self._axis = str(axis)
+        self._nb = None
+        try:
+            self._nb = _nbytes(x)
+            get_comms_logger().record(op, self._nb, axis, log_name)
+        except Exception:
+            pass
+
+    def __enter__(self):
+        import time as _time
+
+        self._t0 = _time.time()
+        return self
+
+    def __exit__(self, *exc):
+        import time as _time
+
+        try:
+            from deepspeed_tpu.observability.flight_recorder import \
+                get_flight_recorder
+
+            rec = get_flight_recorder()
+            if rec.enabled:
+                rec._ring.append((self._t0, "collective", {
+                    "op": self._op, "bytes": self._nb, "axis": self._axis,
+                    "dur_ms": (_time.time() - self._t0) * 1e3}))
+        except Exception:
+            pass
+        return False
 
 
 def all_reduce(x, axis, op: str = "sum", log_name: Optional[str] = None):
     """lax.psum/pmean/pmax over a named mesh axis (reference all_reduce
     comm/comm.py:497)."""
-    _record("all_reduce", x, axis, log_name)
-    if op == "sum":
-        return lax.psum(x, axis)
-    if op in ("avg", "mean"):
-        return lax.pmean(x, axis)
-    if op == "max":
-        return lax.pmax(x, axis)
-    if op == "min":
-        return lax.pmin(x, axis)
+    with _traced_op("all_reduce", x, axis, log_name):
+        if op == "sum":
+            return lax.psum(x, axis)
+        if op in ("avg", "mean"):
+            return lax.pmean(x, axis)
+        if op == "max":
+            return lax.pmax(x, axis)
+        if op == "min":
+            return lax.pmin(x, axis)
     raise ValueError(f"unsupported reduce op: {op}")
 
 
 def all_gather(x, axis, *, tiled: bool = True, gather_dim: int = 0,
                log_name: Optional[str] = None):
     """all_gather_into_tensor analog (comm/comm.py:320)."""
-    _record("all_gather", x, axis, log_name)
-    return lax.all_gather(x, axis, axis=gather_dim, tiled=tiled)
+    with _traced_op("all_gather", x, axis, log_name):
+        return lax.all_gather(x, axis, axis=gather_dim, tiled=tiled)
 
 
 def reduce_scatter(x, axis, *, scatter_dim: int = 0, op: str = "sum",
                    log_name: Optional[str] = None):
     """reduce_scatter_tensor analog (comm/comm.py:257)."""
-    _record("reduce_scatter", x, axis, log_name)
-    out = lax.psum_scatter(x, axis, scatter_dimension=scatter_dim, tiled=True)
-    if op in ("avg", "mean"):
-        out = out / jaxcompat.axis_size(axis)
-    return out
+    with _traced_op("reduce_scatter", x, axis, log_name):
+        out = lax.psum_scatter(x, axis, scatter_dimension=scatter_dim,
+                               tiled=True)
+        if op in ("avg", "mean"):
+            out = out / jaxcompat.axis_size(axis)
+        return out
 
 
 def all_to_all(x, axis, *, split_dim: int, concat_dim: int,
                log_name: Optional[str] = None):
     """all_to_all_single analog (comm/comm.py:392); the Ulysses primitive."""
-    _record("all_to_all", x, axis, log_name)
-    return lax.all_to_all(x, axis, split_axis=split_dim, concat_axis=concat_dim,
-                          tiled=True)
+    with _traced_op("all_to_all", x, axis, log_name):
+        return lax.all_to_all(x, axis, split_axis=split_dim,
+                              concat_axis=concat_dim, tiled=True)
 
 
 def ppermute(x, axis, perm, log_name: Optional[str] = None):
     """Point-to-point ring shift (the reference's p2p send/recv
     pipe/p2p.py:46,67 becomes a collective-permute on TPU)."""
-    _record("ppermute", x, axis, log_name)
-    return lax.ppermute(x, axis, perm)
+    with _traced_op("ppermute", x, axis, log_name):
+        return lax.ppermute(x, axis, perm)
 
 
 def broadcast(x, axis, root: int = 0, log_name: Optional[str] = None):
     """Broadcast from `root` along a named axis (comm/comm.py:227)."""
-    _record("broadcast", x, axis, log_name)
-    idx = lax.axis_index(axis)
-    masked = jnp.where(idx == root, x, jnp.zeros_like(x))
-    return lax.psum(masked, axis)
+    with _traced_op("broadcast", x, axis, log_name):
+        idx = lax.axis_index(axis)
+        masked = jnp.where(idx == root, x, jnp.zeros_like(x))
+        return lax.psum(masked, axis)
 
 
 def axis_index(axis):

@@ -383,12 +383,22 @@ class PerformanceConfig(ConfigModel):
     ``fp8_mlp`` routes the MLP-block matmuls through fp8 (e4m3 operands,
     fp32 accumulation, straight-through gradients — ops/fp_quantizer.py
     fp8_matmul_ste). Opt-in: off by default for exact parity; on v5p+
-    the MXU runs fp8 at 2x the bf16 rate."""
+    the MXU runs fp8 at 2x the bf16 rate.
+
+    ``overlap_depth`` arms the per-layer overlap engine
+    (runtime/param_stream.py pin_stage): the K newest in-flight
+    transfers — h2d layer fetches on the ZeRO-Infinity path, fsdp
+    all-gathers on the stage-3 resident path, plus the backward grad
+    streams — are barrier-pinned into the issuing layer's scheduling
+    stage, so each transfer provably overlaps that layer's compute.
+    0 disables (today's program, bit-for-bit); None keeps the model/env
+    default (DSTPU_OVERLAP_DEPTH). Identity on values at any depth."""
 
     pipeline_depth: int = 0
     prefetch_depth: int = 2
     param_prefetch_depth: Optional[int] = None
     fp8_mlp: bool = False
+    overlap_depth: Optional[int] = None
 
     def validate(self) -> None:
         if self.pipeline_depth < 0:
@@ -404,6 +414,10 @@ class PerformanceConfig(ConfigModel):
             raise ValueError(
                 f"performance.param_prefetch_depth must be >= 1, got "
                 f"{self.param_prefetch_depth}")
+        if self.overlap_depth is not None and self.overlap_depth < 0:
+            raise ValueError(
+                f"performance.overlap_depth must be >= 0, got "
+                f"{self.overlap_depth}")
 
 
 @register_config_model

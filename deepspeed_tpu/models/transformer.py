@@ -111,6 +111,15 @@ class TransformerConfig:
     # inside the backward scan (see runtime/param_stream.py)
     prefetch_depth: Optional[int] = None
     grads_to_host: Optional[bool] = None
+    # per-layer overlap engine depth (runtime/param_stream.py
+    # pin_stage): the K newest in-flight transfers — h2d layer fetches
+    # on the offload path, fsdp all-gathers on the stage-3 resident
+    # path, plus the backward grad streams — are barrier-pinned into
+    # the issuing layer's scheduling stage. 0 disables (today's
+    # program, bit-for-bit). Same env-at-construction contract:
+    # DSTPU_OVERLAP_DEPTH; the engine bridges
+    # config.performance.overlap_depth onto it.
+    overlap_depth: Optional[int] = None
     # fp8 MLP matmuls (ops/fp_quantizer.py fp8_matmul_ste): e4m3
     # operands into an fp32-accumulating matmul with straight-through
     # gradients. Opt-in — off keeps exact bf16/fp32 parity. Set by the
@@ -131,6 +140,9 @@ class TransformerConfig:
         if self.grads_to_host is None:
             object.__setattr__(self, "grads_to_host", bool(int(
                 _os.environ.get("DSTPU_GRADS_TO_HOST", "1"))))
+        if self.overlap_depth is None:
+            object.__setattr__(self, "overlap_depth", int(
+                _os.environ.get("DSTPU_OVERLAP_DEPTH", "0")))
         if self.sp_mode not in ("ulysses", "ring"):
             raise ValueError(
                 f"sp_mode must be ulysses|ring, got {self.sp_mode!r}")
@@ -696,7 +708,8 @@ def apply_hidden(cfg: TransformerConfig, params: Dict[str, Any],
             x = streamed_layers_prefetch(
                 layer_fn, params["layers"], x, length=cfg.num_layers,
                 extra=(positions,), prefetch_depth=cfg.prefetch_depth,
-                grads_to_host=cfg.grads_to_host)
+                grads_to_host=cfg.grads_to_host,
+                overlap_depth=cfg.overlap_depth or 0)
         else:
             def fetch_layer(i):
                 from deepspeed_tpu.utils import memspace
@@ -723,6 +736,29 @@ def apply_hidden(cfg: TransformerConfig, params: Dict[str, Any],
                 return fetched_layer_fn(carry, i), None
 
             x, _ = lax.scan(host_scan_body, x, jnp.arange(cfg.num_layers))
+    elif (cfg.overlap_depth and _topo._GLOBAL_MESH is not None
+          and _topo._GLOBAL_MESH.shape.get("fsdp", 1) > 1):
+        # stage-3 resident overlap: the SAME overlap engine, with the
+        # per-layer fsdp all-gather as the fetch and the per-layer grad
+        # reduce-scatter as the sink — layer i+k's gather is
+        # barrier-pinned into layer i's stage, and each layer's grad
+        # scatter issues inside the backward scan where it overlaps the
+        # previous layer's recompute (T3-style, PAPERS.md). The
+        # streamer's custom VJP implies per-layer recompute, same as
+        # the nothing_saveable remat the real shape runs anyway.
+        from deepspeed_tpu.runtime.param_stream import \
+            streamed_layers_prefetch
+        from deepspeed_tpu.runtime.sharding import (fsdp_gather_slice,
+                                                    fsdp_scatter_grads)
+
+        _logical = logical_axes(cfg)["layers"]
+        k = max(1, int(cfg.overlap_depth))
+        x = streamed_layers_prefetch(
+            layer_fn, params["layers"], x, length=cfg.num_layers,
+            extra=(positions,), prefetch_depth=k,
+            grads_to_host=False, overlap_depth=k,
+            fetch=lambda stack, i: fsdp_gather_slice(stack, i, _logical),
+            grad_sink=lambda dp: fsdp_scatter_grads(dp, _logical))
     else:
         if cfg.remat:
             from deepspeed_tpu.runtime.activation_checkpointing import \

@@ -113,7 +113,8 @@ def get_model(name: str, **overrides) -> TransformerLM:
     # __post_init__) or a later DSTPU_PREFETCH/DSTPU_SERIALIZE_FETCH
     # flip would be silently ignored for zoo models
     env_fields = {f: None for f in ("prefetch_stream", "serialize_fetch",
-                                    "prefetch_depth", "grads_to_host")
+                                    "prefetch_depth", "grads_to_host",
+                                    "overlap_depth")
                   if f not in overrides}
     cfg = dataclasses.replace(cfg, **env_fields, **overrides)
     from deepspeed_tpu.models.moe_transformer import (

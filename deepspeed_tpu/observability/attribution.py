@@ -235,19 +235,103 @@ def attribute_step(cfg, micro_batch: int, seq: int, *,
     return regions
 
 
-def attribution_markdown(regions: List[RegionCost], peak_tflops: float,
-                         hbm_gbps: float,
+# ---------------------------------------------------------------------------
+# Exposed-vs-hidden split (ISSUE 6 overlap engine)
+# ---------------------------------------------------------------------------
+# The overlap engine (runtime/param_stream.py pin_stage) stages each
+# layer's transfers against that layer's compute: with overlap_depth=k,
+# the transfer of one stage can hide behind up to k stages of compute
+# before the consumer needs it. The split below is the analytic form of
+# that schedule — per-stage transfer time clipped by the k-stage compute
+# window — calibrated by the measured probe (tools/
+# latency_hiding_probe.py): at k=0 XLA's default schedule hid none of
+# the host-link traffic on v5e-1, so k=0 reports fully exposed.
+
+
+def overlap_split_ms(transfer_ms: float, stage_ms: float,
+                     overlap_depth: int, stages: int) -> Dict[str, float]:
+    """Split a transfer's roofline time into hidden vs exposed ms under
+    the staged overlap schedule.
+
+    ``transfer_ms`` total transfer time for the step; ``stage_ms`` the
+    compute time of ONE scheduling stage (a layer's fwd or bwd);
+    ``stages`` how many stages the transfer is spread across (2 x layers
+    for a per-layer stream); ``overlap_depth`` k = how many stages of
+    compute each stage's transfer may hide behind. k=0 -> fully exposed
+    (the measured no-overlap default schedule)."""
+    total = max(float(transfer_ms), 0.0)
+    n = max(int(stages), 1)
+    k = max(int(overlap_depth), 0)
+    per_stage = total / n
+    hidden_per = min(per_stage, k * max(float(stage_ms), 0.0))
+    hidden = hidden_per * n
+    exposed = total - hidden
+    return {"total_ms": total, "hidden_ms": hidden, "exposed_ms": exposed,
+            "hidden_frac": 0.0 if total <= 0 else hidden / total}
+
+
+def split_exposed_hidden(regions: List[RegionCost], *,
+                         peak_tflops: float, hbm_gbps: float,
                          fetch_gbps: Optional[float] = None,
-                         title: str = "Per-region roofline attribution"
-                         ) -> str:
-    """Render the region table docs/roofline.md embeds."""
+                         overlap_depth: int = 0,
+                         num_layers: int = 1) -> List[Dict[str, Any]]:
+    """Per-region exposed/hidden attribution: compute regions are fully
+    exposed (they ARE the step); transfer regions (param_fetch) split by
+    :func:`overlap_split_ms` against the per-layer compute window."""
     fetch = (fetch_gbps if fetch_gbps is not None
              else float(os.environ.get("DSTPU_FETCH_GBPS",
                                        _DEFAULT_FETCH_GBPS)))
+    ms: Dict[str, float] = {}
+    for r in regions:
+        if r.region == "param_fetch":
+            ms[r.region] = r.bytes_accessed / (fetch * 1e9) * 1e3
+        else:
+            compute_ms = r.flops / (peak_tflops * 1e12) * 1e3
+            mem_ms = r.bytes_accessed / (hbm_gbps * 1e9) * 1e3
+            ms[r.region] = max(compute_ms, mem_ms)
+    stages = 2 * max(int(num_layers), 1)  # fwd + bwd stage per layer
+    stage_ms = (ms.get("attn", 0.0) + ms.get("mlp", 0.0)) / stages
+    out = []
+    for r in regions:
+        if r.region == "param_fetch":
+            split = overlap_split_ms(ms[r.region], stage_ms,
+                                     overlap_depth, stages)
+            out.append({"region": r.region, "kind": "dma",
+                        "bytes": r.bytes_accessed, **split})
+        else:
+            total = ms[r.region]
+            out.append({"region": r.region, "kind": "compute",
+                        "bytes": r.bytes_accessed, "total_ms": total,
+                        "hidden_ms": 0.0, "exposed_ms": total,
+                        "hidden_frac": 0.0})
+    return out
+
+
+def attribution_markdown(regions: List[RegionCost], peak_tflops: float,
+                         hbm_gbps: float,
+                         fetch_gbps: Optional[float] = None,
+                         title: str = "Per-region roofline attribution",
+                         overlap_depth: Optional[int] = None,
+                         num_layers: int = 1) -> str:
+    """Render the region table docs/roofline.md embeds. Passing
+    ``overlap_depth`` adds exposed/hidden ms columns from
+    :func:`split_exposed_hidden` (same rows, wider table)."""
+    fetch = (fetch_gbps if fetch_gbps is not None
+             else float(os.environ.get("DSTPU_FETCH_GBPS",
+                                       _DEFAULT_FETCH_GBPS)))
+    with_split = overlap_depth is not None
+    split_by: Dict[str, Dict[str, Any]] = {}
+    if with_split:
+        split_by = {s["region"]: s for s in split_exposed_hidden(
+            regions, peak_tflops=peak_tflops, hbm_gbps=hbm_gbps,
+            fetch_gbps=fetch, overlap_depth=int(overlap_depth),
+            num_layers=num_layers)}
+    extra_hdr = " exposed ms | hidden ms |" if with_split else ""
+    extra_sep = "---|---|" if with_split else ""
     lines = [f"### {title}", "",
              "| region | GFLOPs | GB moved | F/B | bound | "
-             "roofline ms | notes |",
-             "|---|---|---|---|---|---|---|"]
+             f"roofline ms |{extra_hdr} notes |",
+             f"|---|---|---|---|---|---|{extra_sep}---|"]
     for r in regions:
         if r.region == "param_fetch":
             ms = r.bytes_accessed / (fetch * 1e9) * 1e3
@@ -263,15 +347,24 @@ def attribution_markdown(regions: List[RegionCost], peak_tflops: float,
         inten = ("—" if r.bytes_accessed <= 0 or r.flops <= 0
                  else f"{r.flops / r.bytes_accessed:.1f}")
         note = r.note + (" (overlapped)" if r.overlapped else "")
+        extra = ""
+        if with_split:
+            s = split_by[r.region]
+            extra = (f" {s['exposed_ms']:,.2f} | "
+                     f"{s['hidden_ms']:,.2f} |")
         lines.append(
             f"| {r.region} | {r.flops / 1e9:,.1f} | "
             f"{r.bytes_accessed / 1e9:,.2f} | {inten} | {bound} | "
-            f"{ms:,.2f} | {note} |")
+            f"{ms:,.2f} |{extra} {note} |")
     lines.append("")
     lines.append(
         "Roofline ms = max(flops/peak, bytes/HBM-bw) per region in "
         "isolation; overlapped rows stream behind compute and bound "
-        "throughput only if their bandwidth floor is missed.")
+        "throughput only if their bandwidth floor is missed."
+        + ((" Exposed/hidden split: overlap_depth="
+            f"{int(overlap_depth)} staged schedule "
+            "(observability/attribution.py overlap_split_ms).")
+           if with_split else ""))
     return "\n".join(lines)
 
 
@@ -294,6 +387,9 @@ def main(argv=None) -> int:
     ap.add_argument("--seq", type=int, default=2048)
     ap.add_argument("--micro", type=int, default=4)
     ap.add_argument("--tiled-logits", type=int, default=None)
+    ap.add_argument("--overlap-depth", type=int, default=None,
+                    help="add exposed/hidden ms columns for the overlap "
+                         "engine at this stage depth (0 = unstaged)")
     ap.add_argument("--peak-tflops", type=float, default=None)
     ap.add_argument("--hbm-gbps", type=float, default=None)
     ap.add_argument("--json", action="store_true",
@@ -317,13 +413,23 @@ def main(argv=None) -> int:
     hbm = args.hbm_gbps or detect_hbm_gbps(dev)
     regions = attribute_step(cfg, args.micro, args.seq)
     if args.json:
-        print(json.dumps([r.to_dict() for r in regions], indent=2))
+        payload = [r.to_dict() for r in regions]
+        if args.overlap_depth is not None:
+            payload = {"regions": payload,
+                       "overlap_depth": args.overlap_depth,
+                       "split": split_exposed_hidden(
+                           regions, peak_tflops=peak, hbm_gbps=hbm,
+                           overlap_depth=args.overlap_depth,
+                           num_layers=cfg.num_layers)}
+        print(json.dumps(payload, indent=2))
     else:
         shape = (f"{args.model} {args.layers}L vocab {args.vocab:,} "
                  f"seq {args.seq} micro {args.micro}")
         print(attribution_markdown(
             regions, peak, hbm,
-            title=f"Per-region roofline attribution — {shape}"))
+            title=f"Per-region roofline attribution — {shape}",
+            overlap_depth=args.overlap_depth,
+            num_layers=cfg.num_layers))
     return 0
 
 

@@ -13,8 +13,14 @@ Track layout (one Chrome "process" per rank):
     tid 0  step      one span per train step (wall time)
     tid 1  host      the host-gap slice at the start of each step
     tid 2  dispatch  step_entry → step_dispatch window (flight events)
-    tid 3  comm      traced collectives (instant markers; trace-time)
+    tid 3  comm      traced collectives — dispatch→completion "X" spans
+                     when the event carries ``dur_ms`` (comm.py
+                     _traced_op), instant markers otherwise; overlapping
+                     dispatches render as overlapping slices (the
+                     overlap lanes the ISSUE-6 engine is tuned against)
     tid 4  events    everything else (compile, checkpoint, offload, ...)
+                     — also "X" spans when the event has ``dur_ms``
+                     (flight_recorder.span)
 """
 
 from __future__ import annotations
@@ -86,9 +92,19 @@ def chrome_trace_events(step_rows: Iterable[Dict[str, Any]] = (),
             continue
         tid = 3 if kind == "collective" else 4
         name = fields.get("op", kind) if kind == "collective" else kind
-        evs.append({"name": str(name), "ph": "i", "cat": kind, "s": "t",
-                    "ts": _us(ts, t0), "pid": rank, "tid": tid,
-                    "args": fields})
+        dur_ms = fields.get("dur_ms")
+        if dur_ms is not None:
+            # dispatch→completion span (comm._traced_op /
+            # flight_recorder.span): a real slice on the lane, so
+            # concurrent dispatches visibly overlap
+            evs.append({"name": str(name), "ph": "X", "cat": kind,
+                        "ts": _us(ts, t0),
+                        "dur": max(float(dur_ms), 0.0) * 1e3,
+                        "pid": rank, "tid": tid, "args": fields})
+        else:
+            evs.append({"name": str(name), "ph": "i", "cat": kind,
+                        "s": "t", "ts": _us(ts, t0), "pid": rank,
+                        "tid": tid, "args": fields})
     return evs
 
 

@@ -60,6 +60,15 @@ class FlightRecorder:
             return
         self._ring.append((time.time(), kind, fields))
 
+    def span(self, kind: str, **fields):
+        """Context manager recording one event with a ``dur_ms`` field —
+        the dispatch→completion span of the wrapped block (comm.py wraps
+        each traced collective's dispatch; chrome_trace.py renders
+        dur_ms events as Perfetto "X" slices on the overlap lanes). The
+        event timestamp is the span START so lanes line up with the step
+        timeline; one append at exit, same GIL-atomic hot path."""
+        return _Span(self, kind, fields)
+
     # -- configuration -------------------------------------------------
     def configure(self, capacity: Optional[int] = None,
                   rank: Optional[int] = None,
@@ -146,6 +155,29 @@ class FlightRecorder:
         except Exception as e:
             logger.warning(f"flight recorder dump failed: {e}")
             return None
+
+
+class _Span:
+    __slots__ = ("_rec", "_kind", "_fields", "_t0")
+
+    def __init__(self, rec: "FlightRecorder", kind: str,
+                 fields: Dict[str, Any]):
+        self._rec = rec
+        self._kind = kind
+        self._fields = fields
+
+    def __enter__(self):
+        self._t0 = time.time()
+        return self
+
+    def __exit__(self, *exc):
+        rec = self._rec
+        if rec.enabled:
+            t0 = self._t0
+            rec._ring.append((t0, self._kind, {
+                **self._fields,
+                "dur_ms": (time.time() - t0) * 1e3}))
+        return False
 
 
 def _env_rank() -> int:

@@ -222,6 +222,10 @@ class Engine:
             if ppd is not None and hasattr(mcfg, "prefetch_depth") \
                     and mcfg.prefetch_depth != int(ppd):
                 perf_updates["prefetch_depth"] = int(ppd)
+            od = getattr(perf, "overlap_depth", None)
+            if od is not None and hasattr(mcfg, "overlap_depth") \
+                    and mcfg.overlap_depth != int(od):
+                perf_updates["overlap_depth"] = int(od)
         if perf_updates:
             import dataclasses as _dc
 

@@ -615,6 +615,108 @@ def vocab_parallel_lookup(table, ids, axis: str = "tp"):
     return out.astype(out_dtype) if cast else out
 
 
+# ---------------------------------------------------------------------------
+# Stage-3 per-layer overlap engine hooks (PR 6)
+# ---------------------------------------------------------------------------
+# The ZeRO-Infinity path streams layers host->device; the stage-3 path
+# has the same shape of problem one tier up: fsdp-sharded resident layer
+# stacks whose per-layer all-gather XLA schedules however it likes.
+# These two hooks plug the fsdp gather / grad reduce-scatter into
+# runtime/param_stream.py::streamed_layers_prefetch as its ``fetch`` /
+# ``grad_sink``, so the SAME staged-carry overlap engine (pin_stage
+# optimization barriers) sequences per-layer collectives: layer i+k's
+# all-gather issues while layer i computes, and layer i's gradient
+# reduce-scatter issues inside the backward scan where it overlaps layer
+# i-1's recompute. Reference: the reference's stage-3 prefetch +
+# reduce-scatter-inside-backward (partition_parameters.py fetch on
+# pre-forward, stage3.py reduce_scatter hooks), and T3's fused
+# track-and-trigger overlap (PAPERS.md).
+
+
+def gathered_layer_spec(logical_axes: Sequence[Optional[str]]
+                        ) -> PartitionSpec:
+    """Spec of ONE layer's weight after the stage-3 fsdp gather: the
+    full param rules minus fsdp (tp/ep stay sharded — only the ZeRO
+    partition is gathered, matching the reference's stage-3 fetch)."""
+    rules = TP_RULES + EP_RULES + PP_RULES + FSDP_RULES
+    spec = spec_from_logical(logical_axes, rules)
+    return PartitionSpec(*_strip_fsdp(list(spec)))
+
+
+def _walk_with_logical(params, logical, fn, path=""):
+    # logical_axes leaves are TUPLES of axis names, so jax.tree.map
+    # would descend into them; walk the dict tree by hand (same pattern
+    # as models/transformer.py::_qwz_fetch_tree)
+    if isinstance(logical, tuple):
+        return fn(params, logical, path)
+    return {k: _walk_with_logical(params[k], logical[k], fn,
+                                  f"{path}['{k}']")
+            for k in params}
+
+
+def fsdp_gather_slice(stacked_tree: Any, i, logical_tree: Any) -> Any:
+    """``fetch`` hook for the overlap engine on the stage-3 path: slice
+    layer ``i`` out of the fsdp-sharded resident ``[L, ...]`` stack and
+    constrain it to the fsdp-GATHERED spec, so GSPMD emits that layer's
+    all-gather at the point in the staged scan where the engine issues
+    it. ``logical_tree`` is ``logical_axes(cfg)["layers"]`` (each leaf a
+    tuple starting with "layers", dropped for the per-layer slice).
+
+    Falls back to a plain dynamic slice (gather left to GSPMD's default
+    placement) when no mesh / fsdp==1 / constraints disabled / inside a
+    manual region.
+    """
+    from jax import lax
+
+    from deepspeed_tpu.parallel import topology
+
+    mesh = topology._GLOBAL_MESH
+    passthrough = (_CONSTRAINTS_DISABLED or mesh is None
+                   or mesh.shape.get("fsdp", 1) <= 1)
+
+    def slice_one(stack, axes, path):
+        sl = jax.tree.map(
+            lambda a: lax.dynamic_index_in_dim(a, i, keepdims=False),
+            stack)
+        if passthrough:
+            return sl
+        spec = gathered_layer_spec(axes[1:])  # drop the "layers" dim
+        if _MANUAL_AXES:
+            spec = _strip_axes_spec(spec, _MANUAL_AXES)
+        return jax.tree.map(
+            lambda a: jax.lax.with_sharding_constraint(
+                a, NamedSharding(mesh, spec)), sl)
+
+    return _walk_with_logical(stacked_tree, logical_tree, slice_one)
+
+
+def fsdp_scatter_grads(grads: Any, logical_tree: Any) -> Any:
+    """``grad_sink`` hook for the overlap engine on the stage-3 path:
+    constrain one layer's parameter cotangent back to the fsdp-SHARDED
+    spec inside the backward scan, so GSPMD emits the per-layer gradient
+    reduce-scatter right there — overlapping the previous layer's
+    recompute instead of coalescing at the scan epilogue. This is the
+    GSPMD expression of the reference's reduce-scatter-inside-backward
+    (stage3.py gradient hooks)."""
+    from deepspeed_tpu.parallel import topology
+
+    mesh = topology._GLOBAL_MESH
+    if (_CONSTRAINTS_DISABLED or mesh is None
+            or mesh.shape.get("fsdp", 1) <= 1):
+        return grads
+    rules = TP_RULES + EP_RULES + PP_RULES + FSDP_RULES
+
+    def scatter_one(dp, axes, path):
+        spec = spec_from_logical(axes[1:], rules)  # drop "layers"
+        if _MANUAL_AXES:
+            spec = _strip_axes_spec(spec, _MANUAL_AXES)
+        return jax.tree.map(
+            lambda a: jax.lax.with_sharding_constraint(
+                a, NamedSharding(mesh, spec)), dp)
+
+    return _walk_with_logical(grads, logical_tree, scatter_one)
+
+
 def constrain_activation(x, logical_axes: Sequence[Optional[str]]):
     """Apply the activation sharding rules to an intermediate value.
 

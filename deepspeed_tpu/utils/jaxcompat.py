@@ -9,15 +9,61 @@ through here so a jax bump is a one-file change:
     with ``auto=`` (complement of ``axis_names``) and ``check_rep=``
     (renamed ``check_vma``).
   * pallas-TPU ``CompilerParams`` — 0.4.x: ``TPUCompilerParams``.
+  * :func:`supports_spmd_partition_id` — capability probe for the
+    partial-auto shard_map lowerings that emit a ``partition-id`` HLO
+    (jax 0.4.x XLA:CPU rejects it under SPMD partitioning; tests that
+    need it skip deterministically instead of failing).
 """
 
 from __future__ import annotations
 
+import functools
 from typing import Any, Optional
 
 import jax
 
 _UNSET = object()
+
+
+@functools.lru_cache(maxsize=1)
+def supports_spmd_partition_id() -> bool:
+    """True when the backend can execute a partial-auto shard_map (the
+    lowering that materializes a ``partition-id`` HLO instruction).
+
+    jax 0.4.x's XLA:CPU dies at execute time with "UNIMPLEMENTED:
+    PartitionId instruction is not supported for SPMD partitioning" the
+    moment a multi-device partial-auto region runs — which the vocab-
+    parallel lookup and pipeline wave schedules rely on. The probe runs
+    the smallest such program (2x2 mesh, one manual axis, one auto axis,
+    an ``axis_index`` in the body) and reports whether execution
+    succeeds; <2 devices can never trip the partitioner, so it reports
+    True there. Cached — the answer is a property of the installed
+    jax/backend pair, not of the callsite."""
+    import numpy as np
+
+    if len(jax.devices()) < 2:
+        return True
+    try:
+        from jax.sharding import Mesh, NamedSharding
+        from jax.sharding import PartitionSpec as P
+
+        devs = np.asarray(jax.devices()[:4]).reshape(2, 2)
+        mesh = Mesh(devs, ("a", "b"))
+
+        def body(x):
+            return x + jax.lax.axis_index("a").astype(x.dtype)
+
+        f = jax.jit(shard_map(body, mesh=mesh, in_specs=P("a"),
+                              out_specs=P("a"), axis_names={"a"},
+                              check_vma=False))
+        x = jax.device_put(np.zeros(4, np.float32),
+                           NamedSharding(mesh, P("a")))
+        jax.block_until_ready(f(x))
+        return True
+    except Exception as e:
+        if "PartitionId" in str(e):
+            return False
+        return True  # unrelated failure: don't mask it behind a skip
 
 
 def shard_map(f, *, mesh, in_specs, out_specs, axis_names=None,

@@ -32,6 +32,7 @@ def test_real_shape_is_the_tpu_default():
     assert d["offload"] == 2            # ZeRO-Infinity streaming
     assert d["zero_stage"] == 2
     assert d["param_prefetch_depth"] == 4
+    assert d["overlap_depth"] == 4      # full ring staged against compute
     assert d["remat_policy"] == "nothing_saveable"
     assert d["tiled_logits"] == 8
     assert d["fp8_mlp"] is False        # opt-in only
@@ -45,17 +46,35 @@ def test_proxy_shape_behind_env_flag():
     assert d["measure"] == "train_batch"
     assert d["offload"] == 0
     assert d["param_prefetch_depth"] is None
+    assert d["overlap_depth"] is None   # no stream, nothing to stage
 
 
 def test_env_overrides_beat_defaults():
     d = resolve_bench_defaults(
         env={"BENCH_LAYERS": "4", "BENCH_VOCAB": "4096",
              "BENCH_PARAM_PREFETCH": "2", "BENCH_FP8_MLP": "1",
+             "BENCH_OVERLAP_DEPTH": "0",
              "BENCH_MEASURE": "train_batch"}, on_tpu=True)
     assert d["layers"] == 4 and d["vocab"] == 4096
     assert d["param_prefetch_depth"] == 2
+    assert d["overlap_depth"] == 0      # explicit A/B baseline wins
     assert d["fp8_mlp"] is True
     assert d["measure"] == "train_batch"
+
+
+def test_tuned_file_overlap_depth_read_back(monkeypatch, tmp_path):
+    # dstpu-autotune --persist writes performance.overlap_depth; the
+    # bench reads it back as the default, env still wins
+    import json
+    p = tmp_path / "tuned.json"
+    p.write_text(json.dumps({"performance": {"overlap_depth": 3}}))
+    monkeypatch.setenv("BENCH_TUNED_DEFAULTS", str(p))
+    d = resolve_bench_defaults(env={}, on_tpu=True)
+    assert d["overlap_depth"] == 3
+    assert d["config_source"] == "autotuned-file"
+    d = resolve_bench_defaults(env={"BENCH_OVERLAP_DEPTH": "1"},
+                               on_tpu=True)
+    assert d["overlap_depth"] == 1
 
 
 def test_long_context_branch_unaffected():
