@@ -14,7 +14,7 @@ NPROC := $(shell nproc)
 XDIST ?= $(shell if [ $(NPROC) -gt 2 ] && python -c "import xdist" 2>/dev/null; then echo "-n $$(( $(NPROC) - 1 )) --dist loadfile"; fi)
 PYTEST ?= python -m pytest
 
-.PHONY: test smoke slow bench bench-real bench-proxy bench-hostgap bench-overlap fleet-demo chaos
+.PHONY: test smoke slow bench bench-real bench-proxy bench-hostgap bench-overlap bench-longctx fleet-demo chaos
 
 smoke:
 	$(PYTEST) tests/ -q -m "not slow" $(XDIST)
@@ -45,6 +45,15 @@ bench-proxy:
 bench-overlap:
 	BENCH_OVERLAP_DEPTH=0 python bench.py
 	BENCH_OVERLAP_DEPTH=4 python bench.py
+
+# Long-context tier: the unified sp planner + analytic per-region
+# attribution (attn / sp_comm / host_kv_stream, exposed vs hidden) at
+# 256k and 1M tokens on a simulated sp degree — no compiled step, runs
+# on the CPU sim (docs/roofline.md round 8; BENCH_SEQ/BENCH_SP/
+# BENCH_HBM_GB and the dim knobs documented in bench.py).
+bench-longctx:
+	BENCH_LONGCTX=1 python bench.py
+	BENCH_LONGCTX=1 BENCH_SEQ=1048576 BENCH_SP=8 python bench.py
 
 # Two-process CPU demo of the fleet observability layer: both ranks
 # publish shards into a temp run dir, then the aggregated report (skew,

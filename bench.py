@@ -97,8 +97,13 @@ def resolve_bench_defaults(env=None, on_tpu=True, n_chips=1):
     model_name = env.get("BENCH_MODEL", "llama3-8b")
     llama = model_name == "llama3-8b"
     proxy = bool(int(env.get("BENCH_PROXY", "0")))
+    # BENCH_LONGCTX=1: the analytic long-context tier (256k+ tokens) —
+    # planner + per-region attribution table, no compiled step (O(S²)
+    # attention does not compile at 256k on the CPU sim)
+    longctx_bench = bool(int(env.get("BENCH_LONGCTX", "0")))
     seq = int(env.get("BENCH_SEQ",
-                      (2048 if llama else 1024) if on_tpu else 128))
+                      262144 if longctx_bench
+                      else ((2048 if llama else 1024) if on_tpu else 128)))
     long_ctx = llama and on_tpu and seq >= 32768
     real = llama and not proxy and not long_ctx
     tuned = read_tuned_defaults() if real else {}
@@ -168,7 +173,88 @@ def resolve_bench_defaults(env=None, on_tpu=True, n_chips=1):
         "measure": measure,
         "config_source": ("autotuned-file" if tuned
                           else "measured-defaults"),
+        "longctx_bench": longctx_bench,
+        "longctx_sp": int(env.get("BENCH_SP", "4")),
     }
+
+
+def longctx_bench_report(env=None):
+    """The BENCH_LONGCTX tier: plan + attribute a 256k–1M-token step.
+
+    Runs the unified sequence-parallel planner
+    (parallel/auto_sp.plan_sequence_parallel) on a SIMULATED sp degree
+    (BENCH_SP — an int, no device mesh needed) and models the three
+    long-context regions analytically
+    (observability/attribution.attribute_longctx_step): a compiled step
+    at 256k is O(S²) and infeasible on the CPU sim, and the closed forms
+    are what the planner itself reasons with. Dims default to CPU-sim
+    scale (hidden 256, 8q/4kv heads, 2 layers — override BENCH_HIDDEN /
+    BENCH_HEADS / BENCH_KV_HEADS / BENCH_LAYERS for real-shape
+    projections; docs/roofline.md round 8 records both). BENCH_HBM_GB
+    sizes the planner's spill budget — default 0.25 so the CPU-sim dims
+    exercise the host-KV spill mechanics a 16 GB chip hits at real dims.
+
+    Returns (markdown_table, json_payload).
+    """
+    import jax
+
+    from deepspeed_tpu.observability.attribution import (
+        attribute_longctx_step, attribution_markdown,
+        split_exposed_hidden)
+    from deepspeed_tpu.observability.roofline import (detect_hbm_gbps,
+                                                      detect_peak_tflops)
+    from deepspeed_tpu.parallel.auto_sp import plan_sequence_parallel
+
+    env = os.environ if env is None else env
+    seq = int(env.get("BENCH_SEQ", "262144"))
+    sp = int(env.get("BENCH_SP", "4"))
+    micro = int(env.get("BENCH_MICRO", "1"))
+    layers = int(env.get("BENCH_LAYERS", "2"))
+    hidden = int(env.get("BENCH_HIDDEN", "256"))
+    heads = int(env.get("BENCH_HEADS", "8"))
+    kv_heads = int(env.get("BENCH_KV_HEADS", "4"))
+    head_dim = hidden // heads
+    budget_gb = float(env.get("BENCH_HBM_GB", "0.25"))
+
+    plan = plan_sequence_parallel(
+        seq, heads, kv_heads, sp, int(budget_gb * 2 ** 30),
+        head_dim=head_dim, hidden_size=hidden, batch_size=micro,
+        dtype_bytes=2)
+    regions = attribute_longctx_step(
+        seq_len=seq, hidden_size=hidden, num_heads=heads,
+        num_kv_heads=kv_heads, head_dim=head_dim, num_layers=layers,
+        batch_size=micro, sp=plan.sp_degree, strategy=plan.strategy,
+        attn_chunks=plan.attn_chunks, fpdt_host_kv=plan.fpdt_host_kv,
+        dtype_bytes=2)
+
+    dev = jax.devices()[0]
+    peak = float(env.get("BENCH_PEAK_TFLOPS", 0)) or detect_peak_tflops(dev)
+    hbm = detect_hbm_gbps(dev)
+    depth = plan.overlap_depth_hint
+    table = attribution_markdown(
+        regions, peak, hbm,
+        title=(f"Long-context attribution — seq {seq:,} sp={plan.sp_degree}"
+               f" ({plan.strategy}) chunks={plan.attn_chunks} "
+               f"spill={plan.fpdt_host_kv}"),
+        overlap_depth=depth, num_layers=layers)
+    split = split_exposed_hidden(regions, peak_tflops=peak, hbm_gbps=hbm,
+                                 overlap_depth=depth, num_layers=layers)
+    exposed_ms = sum(s["exposed_ms"] for s in split)
+    payload = {
+        "metric": (f"longctx analytic step (seq={seq}, sp={plan.sp_degree}"
+                   f"/{plan.strategy}, h={hidden}, {heads}q/{kv_heads}kv, "
+                   f"{layers}L, cpu-sim dims)"),
+        "value": round(exposed_ms, 2),
+        "unit": "modeled exposed ms/step",
+        "plan": {"strategy": plan.strategy, "sp_degree": plan.sp_degree,
+                 "attn_chunks": plan.attn_chunks,
+                 "fpdt_host_kv": plan.fpdt_host_kv,
+                 "overlap_depth_hint": plan.overlap_depth_hint,
+                 "reasons": list(plan.reasons)},
+        "regions": [dict(s) for s in split],
+        "hbm_budget_gb": budget_gb,
+    }
+    return table, payload
 
 
 def overlap_report(model, step_ms, overlap_depth, streaming,
@@ -221,6 +307,15 @@ def main():
         import serve_bench
 
         print(json.dumps(serve_bench.run()))
+        return
+
+    if int(os.environ.get("BENCH_LONGCTX", "0")):
+        # long-context tier: planner + analytic per-region attribution
+        # (attn / sp_comm / host_kv_stream, exposed vs hidden) — no
+        # compiled step; see longctx_bench_report and make bench-longctx
+        table, payload = longctx_bench_report()
+        print(table)
+        print(json.dumps(payload))
         return
 
     import jax

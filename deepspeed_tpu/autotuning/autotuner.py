@@ -55,8 +55,9 @@ class Autotuner:
       tuning_space:  {"micro_batch_sizes": [...], "zero_stages": [...],
                       "remat": [...], "remat_policies": [...],
                       "tiled_logits": [...], "attn_chunks": [...],
-                      "prefetch_depths": [...], "overlap_depths": [...]}
-                      — the last four are model-config axes for the
+                      "prefetch_depths": [...], "overlap_depths": [...],
+                      "sp_modes": [...]}
+                      — the last five are model-config axes for the
                       real-shape sweep (vocab-head tile count, FPDT
                       query chunks, the ZeRO-Infinity layer-prefetch
                       ring depth, and the overlap-engine stage depth);
@@ -102,6 +103,10 @@ class Autotuner:
         # the K newest in-flight transfers per layer. None = model/env
         # default; 0 = today's unstaged schedule
         self.overlap_depths = list(space.get("overlap_depths", [None]))
+        # sp strategy (ISSUE 7 planner): 'ulysses' | 'ring' candidates
+        # for models running sequence-parallel; None = keep the model's
+        # own sp_mode (or whatever the planner composed at init)
+        self.sp_modes = list(space.get("sp_modes", [None]))
         self.hbm_budget = hbm_budget_bytes or self._detect_hbm()
         self.results_dir = results_dir
         self.persist_path = persist_path
@@ -125,10 +130,11 @@ class Autotuner:
     # -- candidate enumeration (reference tune_space) -------------------
     def candidates(self) -> List[Dict[str, Any]]:
         out = []
-        for mb, stage, remat, policy, tl, ac, pd, od in itertools.product(
+        for (mb, stage, remat, policy, tl, ac, pd, od,
+             sm) in itertools.product(
                 self.micro_batch_sizes, self.zero_stages, self.remat,
                 self.remat_policies, self.tiled_logits, self.attn_chunks,
-                self.prefetch_depths, self.overlap_depths):
+                self.prefetch_depths, self.overlap_depths, self.sp_modes):
             cfg = json.loads(json.dumps(self.base_config))  # deep copy
             cfg["train_micro_batch_size_per_chip"] = int(mb)
             cfg.pop("train_batch_size", None)  # re-derived from micro×gas×dp
@@ -146,6 +152,8 @@ class Autotuner:
                 cfg["_prefetch_depth"] = int(pd)
             if od is not None:
                 cfg["_overlap_depth"] = int(od)
+            if sm is not None:
+                cfg["_sp_mode"] = str(sm)
             out.append(cfg)
         return out
 
@@ -162,7 +170,8 @@ class Autotuner:
                                         ("_prefetch_depth",
                                          "prefetch_depth"),
                                         ("_overlap_depth",
-                                         "overlap_depth"))
+                                         "overlap_depth"),
+                                        ("_sp_mode", "sp_mode"))
                       if key in cfg}
         model = self.model_factory()
         if hasattr(model, "config") and hasattr(model.config, "remat"):
@@ -344,6 +353,8 @@ class Autotuner:
         if "_overlap_depth" in out:
             out.setdefault("performance", {})["overlap_depth"] = \
                 int(out.pop("_overlap_depth"))
+        if "_sp_mode" in out:
+            out["sp_mode"] = str(out.pop("_sp_mode"))
         return out
 
     def _persist_best(self, cfg: Dict[str, Any],
@@ -403,6 +414,9 @@ def main(argv=None) -> int:
     ap.add_argument("--prefetch-depths", type=int, nargs="+", default=None,
                     help="layer-prefetch ring depths to try (1 = plain "
                          "double buffering)")
+    ap.add_argument("--sp-modes", nargs="+", default=None,
+                    help="sequence-parallel strategy candidates "
+                         "(ulysses/ring) for sp-enabled models")
     ap.add_argument("--overlap-depths", type=int, nargs="+", default=None,
                     help="overlap-engine depths to try (0 = unstaged "
                          "schedule; k pins the k newest in-flight "
@@ -455,6 +469,8 @@ def main(argv=None) -> int:
         space["prefetch_depths"] = args.prefetch_depths
     if args.overlap_depths is not None:
         space["overlap_depths"] = args.overlap_depths
+    if args.sp_modes is not None:
+        space["sp_modes"] = args.sp_modes
     tuner = Autotuner(model_factory, base, batch_fn,
                       tuning_space=space or None,
                       results_dir=args.results_dir,

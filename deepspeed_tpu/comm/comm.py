@@ -40,7 +40,7 @@ __all__ = [
     "has_all_gather_into_tensor", "has_reduce_scatter_tensor",
     "has_coalescing_manager", "all_reduce", "all_gather", "reduce_scatter",
     "all_to_all", "ppermute", "broadcast", "axis_index", "axis_size",
-    "configure", "log_summary", "get_retry_policy",
+    "traced_span", "configure", "log_summary", "get_retry_policy",
 ]
 
 _INITIALIZED = False
@@ -364,6 +364,22 @@ def ppermute(x, axis, perm, log_name: Optional[str] = None):
     pipe/p2p.py:46,67 becomes a collective-permute on TPU)."""
     with _traced_op("ppermute", x, axis, log_name):
         return lax.ppermute(x, axis, perm)
+
+
+def traced_span(op: str, x, axis, log_name: Optional[str] = None):
+    """Context manager giving GSPMD-implicit collectives the same byte
+    accounting + flight-recorder span the explicit wrappers above get.
+
+    Some collectives are not dispatched as lax primitives but emitted by
+    the partitioner from sharding constraints (Ulysses's all-to-alls in
+    parallel/ulysses.py). Wrap the constraint in ``traced_span`` so the
+    collective still lands in the comms logger and on the chrome-trace
+    collective lane::
+
+        with comm.traced_span("all_to_all", q, "sp", "ulysses_qkv"):
+            q = _constrain(q, head_sharded_spec)
+    """
+    return _traced_op(op, x, axis, log_name)
 
 
 def broadcast(x, axis, root: int = 0, log_name: Optional[str] = None):

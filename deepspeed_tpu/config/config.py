@@ -223,6 +223,15 @@ class SequenceParallelConfig(ConfigModel):
     tiled_mlp: bool = False
     tiled_logits: bool = False
     tile_size: int = 0  # 0 = auto
+    # unified long-context planner (parallel/auto_sp.py
+    # plan_sequence_parallel): when the mesh has an sp axis the engine
+    # composes strategy/chunking/host-KV-spill onto the model config at
+    # init — conservatively, never overriding explicit model settings.
+    # False opts out.
+    auto_plan: bool = True
+    # per-chip activation HBM budget (GiB) the planner sizes chunking
+    # and host-KV spill against; None plans without spill pressure.
+    hbm_budget_gb: Optional[float] = None
 
 
 @register_config_model

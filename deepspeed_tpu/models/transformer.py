@@ -146,13 +146,11 @@ class TransformerConfig:
         if self.sp_mode not in ("ulysses", "ring"):
             raise ValueError(
                 f"sp_mode must be ulysses|ring, got {self.sp_mode!r}")
-        if self.fpdt_host_kv and self.sequence_parallel:
-            # silently running the full-S SP path would OOM at exactly
-            # the lengths the flag promises to enable
-            raise ValueError(
-                "fpdt_host_kv does not compose with sequence_parallel "
-                "yet; shard the sequence (sp) or stream host KV chunks, "
-                "not both")
+        # fpdt_host_kv + sequence_parallel compose: the layer runs
+        # inside shard_map over sp, each rank streaming the
+        # sp-all-gathered host KV stacks through its local q chunks
+        # (parallel/fpdt.py sp_axis mode) — the former hard error here
+        # is lifted (ROADMAP item 4 planner composition).
         if self.fpdt_host_residual:
             if not self.fpdt_host_kv:
                 raise ValueError(
@@ -164,6 +162,11 @@ class TransformerConfig:
                     "fpdt_host_residual requires the fused sequential "
                     "block (attention+MLP per chunk); parallel_block "
                     "is not chunk-fusable this way")
+            if self.sequence_parallel:
+                raise ValueError(
+                    "fpdt_host_residual does not compose with "
+                    "sequence_parallel: the residual lives as a host "
+                    "chunk stack, which cannot also be sharded over sp")
 
     @property
     def kv_heads(self) -> int:
@@ -424,6 +427,93 @@ def _qwz_fetch_tree(cfg: TransformerConfig, layer_params):
     return walk(layer_params, axes, "['layers']")
 
 
+def _fpdt_post_fn(cfg: TransformerConfig, layer_params, dt):
+    """Per-chunk fused block tail (residual add + ln2 + MLP) for the
+    fpdt paths — built from the GIVEN param tree so the sp shard_map
+    body can construct it from its own operand instead of closing over
+    outer traced arrays (closure capture is not allowed across the
+    shard_map boundary)."""
+    ap = layer_params["attn"]
+    mp = layer_params.get("mlp")
+
+    def post_fn(x_chunk, attn_chunk):
+        if cfg.use_biases:
+            attn_chunk = attn_chunk + ap["bo"].astype(dt)
+        xc = x_chunk + attn_chunk
+        yc = _norm(xc, layer_params["ln2"], cfg.norm, cfg.norm_eps)
+        if cfg.activation == "swiglu":
+            gt = jnp.einsum("bch,hf->bcf", yc, mp["wg"].astype(dt))
+            ut = jnp.einsum("bch,hf->bcf", yc, mp["wi"].astype(dt))
+            zt = jax.nn.silu(gt) * ut
+        else:
+            pre = jnp.einsum("bch,hf->bcf", yc, mp["wi"].astype(dt))
+            if cfg.use_biases:
+                pre = pre + mp["bi"].astype(dt)
+            zt = act_fn(cfg.activation)(pre)
+        out = jnp.einsum("bcf,fh->bch", zt, mp["wo"].astype(dt))
+        if cfg.use_biases:
+            out = out + mp["bo"].astype(dt)
+        return xc + out
+
+    return post_fn
+
+
+def _fpdt_sp_block(cfg: TransformerConfig, x, layer_params, positions,
+                   fuse: bool):
+    """fpdt_host_kv × sequence_parallel composed layer attention:
+    shard_map over the sp mesh axis — each rank runs FPDT chunked
+    attention on its LOCAL sequence shard against the sp-all-gathered,
+    host-spilled KV stacks (parallel/fpdt.py ``sp_axis`` mode). Exact:
+    the rank-major tiled gather keeps the global tile order
+    position-sorted, and query positions carry the shard offset.
+
+    Layer params enter the manual region replicated (P() specs), so tp
+    does not further split the projections inside this block; the device
+    transient is the gathered full-S KV at kv_heads width (~2·S·kv·D
+    bytes — ~2 GB at 1M tokens / 8 KV heads / d128 / bf16), which is
+    what the host spill then bounds. Works independently of sp_mode —
+    this path replaces the ulysses/ring dispatch when KV streams from
+    host. Returns the fused block output when ``fuse`` else the raw
+    attention branch (wo applied, no bias)."""
+    from jax.sharding import PartitionSpec as P
+
+    from deepspeed_tpu.parallel import topology as _topo
+    from deepspeed_tpu.parallel.fpdt import fpdt_attention_block
+    from deepspeed_tpu.runtime.sharding import effective_dtype
+    from deepspeed_tpu.utils import jaxcompat
+
+    mesh = _topo._GLOBAL_MESH
+    dt = effective_dtype(cfg.dtype)
+    B, S, H = x.shape
+    sp = int(mesh.shape["sp"])
+    if S % sp:
+        raise ValueError(
+            f"fpdt_host_kv + sequence_parallel needs seq {S} divisible "
+            f"by sp={sp}: pad-free shards keep global positions exact")
+    positions = jnp.broadcast_to(positions, (B, S))
+    batch_axes = tuple(a for a in _topo.BATCH_AXES if a in mesh.shape)
+    x_spec = P(batch_axes, "sp", None)
+    pos_spec = P(batch_axes, "sp")
+    p_specs = jax.tree.map(lambda _: P(), layer_params)
+
+    def body(x_loc, lp, pos_loc):
+        post = _fpdt_post_fn(cfg, lp, dt) if fuse else None
+        return fpdt_attention_block(
+            x_loc, lp["attn"], pos_loc, num_heads=cfg.num_heads,
+            kv_heads=cfg.kv_heads, head_dim=cfg.head_dim,
+            rope_theta=cfg.rope_theta if cfg.pos_emb == "rope" else None,
+            q_chunks=max(cfg.attn_chunks, 2), causal=True,
+            use_biases=cfg.use_biases,
+            norm_fn=lambda t: _norm(t, lp["ln1"], cfg.norm,
+                                    cfg.norm_eps),
+            post_fn=post, sp_axis="sp", sp_size=sp)
+
+    fn = jaxcompat.shard_map(body, mesh=mesh,
+                             in_specs=(x_spec, p_specs, pos_spec),
+                             out_specs=x_spec, check_vma=False)
+    return fn(x, layer_params, positions)
+
+
 def _layer(cfg: TransformerConfig, x, layer_params, positions,
            hosted_seq_len: Optional[int] = None):
     """One transformer block. x: [B, S, H] in cfg.dtype — or, when
@@ -447,30 +537,32 @@ def _layer(cfg: TransformerConfig, x, layer_params, positions,
         # and (for the sequential-block default) the residual add + MLP
         # fuse into the same chunk — the whole layer emits one full-S
         # buffer (parallel/fpdt.py fpdt_attention_block);
-        # fpdt_host_kv + sequence_parallel rejected at config time
+        # fpdt_host_kv + sequence_parallel composes via _fpdt_sp_block
         from deepspeed_tpu.parallel.fpdt import fpdt_attention_block
 
         mp = layer_params.get("mlp")
         fuse_mlp = (not cfg.parallel_block) and mp is not None
+        post_fn = _fpdt_post_fn(cfg, layer_params, dt)
 
-        def post_fn(x_chunk, attn_chunk):
-            if cfg.use_biases:
-                attn_chunk = attn_chunk + ap["bo"].astype(dt)
-            xc = x_chunk + attn_chunk
-            yc = _norm(xc, layer_params["ln2"], cfg.norm, cfg.norm_eps)
-            if cfg.activation == "swiglu":
-                gt = jnp.einsum("bch,hf->bcf", yc, mp["wg"].astype(dt))
-                ut = jnp.einsum("bch,hf->bcf", yc, mp["wi"].astype(dt))
-                zt = jax.nn.silu(gt) * ut
-            else:
-                pre = jnp.einsum("bch,hf->bcf", yc, mp["wi"].astype(dt))
+        if not hosted and cfg.sequence_parallel:
+            from deepspeed_tpu.parallel import topology as _topo
+
+            _mesh = _topo._GLOBAL_MESH
+            if _mesh is not None and _mesh.shape.get("sp", 1) > 1:
+                res = _fpdt_sp_block(cfg, x, layer_params, positions,
+                                     fuse=fuse_mlp)
+                if fuse_mlp:
+                    return constrain_activation(
+                        res, ("batch", "seq", "embed"))
+                attn = res
                 if cfg.use_biases:
-                    pre = pre + mp["bi"].astype(dt)
-                zt = act_fn(cfg.activation)(pre)
-            out = jnp.einsum("bcf,fh->bch", zt, mp["wo"].astype(dt))
-            if cfg.use_biases:
-                out = out + mp["bo"].astype(dt)
-            return xc + out
+                    attn = attn + ap["bo"].astype(dt)
+                attn = constrain_activation(
+                    checkpoint_name(attn, "attn_out"),
+                    ("batch", "seq", "embed"))
+                return _layer_mlp(cfg, x, attn, layer_params)
+            # sp requested but the mesh has no sp axis > 1: degree-1
+            # sequence parallelism IS the plain local path — fall through
 
         if hosted:
             if not fuse_mlp:

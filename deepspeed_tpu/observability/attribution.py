@@ -26,6 +26,13 @@ The two non-compute buckets are analytic transfer models:
   This traffic *overlaps* compute via the prefetch ring
   (``performance.param_prefetch_depth``); its row reports the bandwidth
   floor it needs to stay hidden, not an additive cost.
+
+The long-context bench tier adds two more transfer regions —
+**sp_comm** (sequence-parallel collectives on ICI) and
+**host_kv_stream** (FPDT host-KV D2H/H2D) — modeled analytically by
+:func:`attribute_longctx_step` (a compiled step at 256k tokens is
+O(S²)-infeasible on the CPU sim). All three transfer regions share the
+``DMA_REGIONS`` exposed/hidden machinery.
 """
 
 from __future__ import annotations
@@ -42,9 +49,34 @@ from deepspeed_tpu.observability.roofline import roofline_summary
 
 REGIONS = ("attn", "mlp", "vocab_head", "optimizer", "param_fetch")
 
+# the BENCH_LONGCTX tier's analytic regions (attribute_longctx_step)
+LONGCTX_REGIONS = ("attn", "sp_comm", "host_kv_stream")
+
+# Transfer (DMA) regions: their roofline time is bytes/bandwidth on the
+# link they ride, not flops/bytes against HBM. sp_comm rides ICI; the
+# host streams ride the host link.
+DMA_REGIONS = frozenset({"param_fetch", "sp_comm", "host_kv_stream"})
+
 # measured sustained H2D on the tunnel-attached v5e (docs/roofline.md);
 # a pod's per-layer bf16 all-gather over ICI is ≥20x this
 _DEFAULT_FETCH_GBPS = 3.3
+
+# one v5e ICI link direction (sustained, docs/roofline.md); override
+# with DSTPU_ICI_GBPS for other topologies
+_DEFAULT_ICI_GBPS = 45.0
+
+
+def _dma_gbps(region: str, fetch_gbps: Optional[float] = None,
+              ici_gbps: Optional[float] = None) -> float:
+    """Bandwidth a DMA region's bytes divide by: sp collectives ride
+    ICI, param/KV streams ride the host link."""
+    if region == "sp_comm":
+        return (ici_gbps if ici_gbps is not None
+                else float(os.environ.get("DSTPU_ICI_GBPS",
+                                          _DEFAULT_ICI_GBPS)))
+    return (fetch_gbps if fetch_gbps is not None
+            else float(os.environ.get("DSTPU_FETCH_GBPS",
+                                      _DEFAULT_FETCH_GBPS)))
 
 
 @dataclasses.dataclass
@@ -236,6 +268,91 @@ def attribute_step(cfg, micro_batch: int, seq: int, *,
 
 
 # ---------------------------------------------------------------------------
+# Analytic long-context attribution (BENCH_LONGCTX tier)
+# ---------------------------------------------------------------------------
+# At ≥256k tokens the O(S²) attention cannot be compiled on the CPU sim
+# (attribute_step's measured closures would run for hours), so this tier
+# models the three long-context regions analytically, per chip, from the
+# same closed forms the planner (parallel/auto_sp.py) reasons with. The
+# formulas are stated inline; docs/roofline.md round 8 records a table.
+
+
+def attribute_longctx_step(*, seq_len: int, hidden_size: int,
+                           num_heads: int,
+                           num_kv_heads: Optional[int] = None,
+                           head_dim: Optional[int] = None,
+                           num_layers: int = 1, batch_size: int = 1,
+                           sp: int = 1, strategy: Optional[str] = None,
+                           attn_chunks: int = 0,
+                           fpdt_host_kv: bool = False,
+                           dtype_bytes: int = 2) -> List[RegionCost]:
+    """Per-chip analytic costs for the long-context regions of one
+    fwd+bwd step: **attn** (compute), **sp_comm** (ICI collectives for
+    the chosen sp strategy), **host_kv_stream** (FPDT host-KV D2H/H2D
+    when spilling). Regions with zero cost at this plan are still
+    emitted (zero rows) so the bench table shape is stable.
+
+    - attn flops: causal QKᵀ+PV is 4·B·S²·H halved by causality, ×3 for
+      fwd+bwd, ÷sp (each rank owns S/sp query rows): 6·B·S²·H/sp.
+    - sp_comm bytes (×2 fwd+bwd, per layer):
+      ulysses — 4 all-to-alls (q, out at num_heads width; k, v at
+      kv_heads width), each moving (sp-1)/sp of its tensor;
+      ring — KV blocks traverse sp-1 hops: 2·B·S·kv·D·(sp-1)/sp;
+      fpdt-composed (attn_chunks>1 under sp) — KV all-gather fwd +
+      reduce-scatter bwd, same (sp-1)/sp fraction of the full KV.
+    - host_kv_stream bytes: full KV stacks D2H once, then H2D refetch
+      averaged over the causal chunk schedule ((chunks+1)/2 of the
+      stacks per pass), ×2 for the backward re-stream.
+    """
+    kv = num_kv_heads or num_heads
+    D = head_dim or hidden_size // num_heads
+    H = hidden_size
+    B, S, L = batch_size, seq_len, num_layers
+    p = max(int(sp), 1)
+    db = dtype_bytes
+
+    attn_flops = 6.0 * B * float(S) * S * H / p * L
+    # score-free streaming traffic: q + out + per-chunk KV rereads
+    kv_bytes = 2.0 * B * S * kv * D * db          # full K+V stacks
+    chunks = max(int(attn_chunks), 1)
+    attn_bytes = (2.0 * B * (S / p) * num_heads * D * db
+                  + chunks * kv_bytes / p) * L
+
+    if p > 1:
+        frac = (p - 1) / p
+        if strategy == "ulysses" and chunks <= 1:
+            per_layer = (2.0 * B * S * num_heads * D
+                         + 2.0 * B * S * kv * D) * db * frac
+            note = "ulysses: 4 all-to-alls/layer (q,out + k,v @ GQA width)"
+        elif strategy == "ring" and chunks <= 1:
+            per_layer = kv_bytes * frac
+            note = f"ring: {p - 1} ppermute KV hops/layer"
+        else:
+            per_layer = kv_bytes * frac
+            note = ("fpdt+sp: KV all-gather fwd / reduce-scatter bwd "
+                    "per layer")
+        sp_bytes = per_layer * 2 * L              # fwd + bwd
+    else:
+        sp_bytes, note = 0.0, "sp=1: no sequence-parallel collectives"
+    regions = [
+        RegionCost("attn", attn_flops, attn_bytes,
+                   note=f"causal, per chip (S/sp={S // p} query rows), "
+                        "x num_layers"),
+        RegionCost("sp_comm", 0.0, sp_bytes, note=note, overlapped=True),
+    ]
+
+    if fpdt_host_kv:
+        hk_bytes = kv_bytes * (1.0 + (chunks + 1) / 2.0) * 2 * L
+        hk_note = (f"D2H once + causal-avg H2D over {chunks} chunks, "
+                   "x2 bwd, x num_layers")
+    else:
+        hk_bytes, hk_note = 0.0, "KV resident on device (no spill)"
+    regions.append(RegionCost("host_kv_stream", 0.0, hk_bytes,
+                              note=hk_note, overlapped=True))
+    return regions
+
+
+# ---------------------------------------------------------------------------
 # Exposed-vs-hidden split (ISSUE 6 overlap engine)
 # ---------------------------------------------------------------------------
 # The overlap engine (runtime/param_stream.py pin_stage) stages each
@@ -276,15 +393,14 @@ def split_exposed_hidden(regions: List[RegionCost], *,
                          overlap_depth: int = 0,
                          num_layers: int = 1) -> List[Dict[str, Any]]:
     """Per-region exposed/hidden attribution: compute regions are fully
-    exposed (they ARE the step); transfer regions (param_fetch) split by
+    exposed (they ARE the step); transfer regions (``DMA_REGIONS`` —
+    param_fetch, sp_comm, host_kv_stream) split by
     :func:`overlap_split_ms` against the per-layer compute window."""
-    fetch = (fetch_gbps if fetch_gbps is not None
-             else float(os.environ.get("DSTPU_FETCH_GBPS",
-                                       _DEFAULT_FETCH_GBPS)))
     ms: Dict[str, float] = {}
     for r in regions:
-        if r.region == "param_fetch":
-            ms[r.region] = r.bytes_accessed / (fetch * 1e9) * 1e3
+        if r.region in DMA_REGIONS:
+            bw = _dma_gbps(r.region, fetch_gbps)
+            ms[r.region] = r.bytes_accessed / (bw * 1e9) * 1e3
         else:
             compute_ms = r.flops / (peak_tflops * 1e12) * 1e3
             mem_ms = r.bytes_accessed / (hbm_gbps * 1e9) * 1e3
@@ -293,7 +409,7 @@ def split_exposed_hidden(regions: List[RegionCost], *,
     stage_ms = (ms.get("attn", 0.0) + ms.get("mlp", 0.0)) / stages
     out = []
     for r in regions:
-        if r.region == "param_fetch":
+        if r.region in DMA_REGIONS:
             split = overlap_split_ms(ms[r.region], stage_ms,
                                      overlap_depth, stages)
             out.append({"region": r.region, "kind": "dma",
@@ -316,9 +432,7 @@ def attribution_markdown(regions: List[RegionCost], peak_tflops: float,
     """Render the region table docs/roofline.md embeds. Passing
     ``overlap_depth`` adds exposed/hidden ms columns from
     :func:`split_exposed_hidden` (same rows, wider table)."""
-    fetch = (fetch_gbps if fetch_gbps is not None
-             else float(os.environ.get("DSTPU_FETCH_GBPS",
-                                       _DEFAULT_FETCH_GBPS)))
+    fetch = fetch_gbps
     with_split = overlap_depth is not None
     split_by: Dict[str, Dict[str, Any]] = {}
     if with_split:
@@ -333,9 +447,9 @@ def attribution_markdown(regions: List[RegionCost], peak_tflops: float,
              f"roofline ms |{extra_hdr} notes |",
              f"|---|---|---|---|---|---|{extra_sep}---|"]
     for r in regions:
-        if r.region == "param_fetch":
-            ms = r.bytes_accessed / (fetch * 1e9) * 1e3
-            bound = "host-link"
+        if r.region in DMA_REGIONS:
+            ms = r.bytes_accessed / (_dma_gbps(r.region, fetch) * 1e9) * 1e3
+            bound = "ici" if r.region == "sp_comm" else "host-link"
         else:
             summ = roofline_summary(
                 {"flops": r.flops, "bytes_accessed": r.bytes_accessed},

@@ -1,4 +1,4 @@
-"""AutoSP: automatic sequence-parallel strategy selection.
+"""AutoSP: unified sequence-parallel planning (Ulysses × ring × FPDT).
 
 Reference: ``deepspeed/sequence/auto_sp.py:42``
 (``auto_wrap_model_for_sp``) + ``autosp_detector.py`` + the DeepCompile
@@ -7,19 +7,33 @@ graph and rewrite it to Ulysses sequence parallelism automatically.
 
 TPU-native: there is no graph surgery to do — our models express
 attention through one dispatcher, so "rewriting to Ulysses" is flipping
-``sequence_parallel`` in the model config. What remains genuinely
-automatic is the *strategy choice*, which the reference leaves to the
-user: Ulysses's head-scatter all-to-all requires attention heads ≥ sp
-degree (each rank needs ≥ 1 head); when heads (or KV heads, which bound
-the scatter for GQA) are fewer than sp, ring attention (ppermute context
-parallelism) is the right mechanism. ``auto_wrap_model_for_sp`` inspects
-the mesh and the model's head layout and picks.
+``sequence_parallel`` in the model config. Two levels of automation
+live here:
+
+  * ``detect_sp_strategy`` — the strategy choice the reference leaves
+    to the user: Ulysses's head-scatter all-to-all requires attention
+    heads ≥ sp degree (each rank needs ≥ 1 head; KV heads bound the
+    scatter for GQA); otherwise ring attention (ppermute context
+    parallelism) shards the sequence dim instead.
+  * ``plan_sequence_parallel`` — the full long-context composition
+    (ROADMAP item 4): given (seq_len, heads, kv_heads, mesh,
+    hbm_budget) it returns an :class:`SPPlan` choosing the sp strategy
+    and degree, the FPDT q-chunk count, whether the KV stacks spill to
+    host (``fpdt_host_kv`` — composes with sp via the shard_map path in
+    models/transformer.py since the planner PR), and an
+    ``overlap_depth`` interplay hint (PR 6's per-layer overlap engine
+    hides the host KV stream behind chunk compute the same way it hides
+    the param stream). The engine applies the plan to the model config
+    at init when the mesh has an sp axis (runtime/engine.py).
+
+All decisions are deterministic pure functions of their inputs so the
+planner grid is unit-testable without a TPU (tests/test_auto_sp.py).
 """
 
 from __future__ import annotations
 
 import dataclasses
-from typing import Optional
+from typing import Optional, Tuple
 
 from deepspeed_tpu.utils.logging import log_dist, logger
 
@@ -68,3 +82,186 @@ def auto_wrap_model_for_sp(model, mesh=None, force: Optional[str] = None):
              f"{getattr(cfg, 'num_kv_heads', None) or cfg.num_heads} → "
              f"{strategy}", ranks=[0])
     return type(model)(new_cfg)
+
+
+# ---------------------------------------------------------------------------
+# unified long-context planner (ROADMAP item 4)
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class SPPlan:
+    """A composed sequence-parallel plan.
+
+    ``strategy`` is the sp attention mechanism ('ulysses' | 'ring' |
+    None when sp is off); ``attn_chunks`` the FPDT q-chunk count (0 =
+    unchunked); ``fpdt_host_kv`` whether the KV tile stacks spill to
+    pinned host memory (utils/memspace.py — identity placement on
+    single-memory backends); ``overlap_depth_hint`` how many chunk
+    stages of host-KV streaming PR 6's overlap engine should pin behind
+    compute (0 = no hint). ``reasons`` carries the human-readable
+    decision trail for logs and the bench JSON line.
+    """
+
+    strategy: Optional[str]
+    sp_degree: int
+    attn_chunks: int
+    fpdt_host_kv: bool
+    fpdt_host_residual: bool = False
+    overlap_depth_hint: int = 0
+    reasons: Tuple[str, ...] = ()
+
+    def apply(self, cfg):
+        """Compose the plan onto a TransformerConfig, conservatively:
+        only fields still at their defaults change, so an explicit user
+        choice (sp_mode, attn_chunks, fpdt_host_kv, overlap_depth) is
+        never overridden. Returns a new config (or ``cfg`` unchanged)."""
+        updates = {}
+        if self.strategy is not None \
+                and not getattr(cfg, "sequence_parallel", False):
+            updates["sequence_parallel"] = True
+            updates["sp_mode"] = self.strategy
+        if self.attn_chunks > 1 \
+                and getattr(cfg, "attn_chunks", 0) in (0, 1):
+            updates["attn_chunks"] = self.attn_chunks
+        if self.fpdt_host_kv and not getattr(cfg, "fpdt_host_kv", False):
+            updates["fpdt_host_kv"] = True
+        if self.overlap_depth_hint \
+                and not getattr(cfg, "overlap_depth", 0) \
+                and hasattr(cfg, "overlap_depth"):
+            updates["overlap_depth"] = self.overlap_depth_hint
+        if not updates:
+            return cfg
+        return dataclasses.replace(cfg, **updates)
+
+
+def _sp_degree_of(mesh) -> int:
+    """sp degree from a Mesh, a bare int (bench/CLI convenience — plan
+    for a simulated degree without building a device mesh), or None."""
+    if mesh is None:
+        return 1
+    if isinstance(mesh, int):
+        return max(1, int(mesh))
+    shape = getattr(mesh, "shape", None)
+    if shape is None:
+        return 1
+    return int(dict(shape).get("sp", 1))
+
+
+def _pick_chunks(s_loc: int, target_tokens: int) -> int:
+    """Smallest power-of-2 chunk count dividing ``s_loc`` whose chunk
+    length is ≤ ``target_tokens`` — power-of-2 so the grid keeps
+    dividing under further sp resharding, and a divisor of s_loc so the
+    sp composition stays pad-free."""
+    c = 1
+    while s_loc // c > target_tokens and s_loc % (c * 2) == 0:
+        c *= 2
+    return c
+
+
+# With no HBM budget given, chunk so one q-chunk stays at most this many
+# tokens — the regime where the [C × kv_tile] fp32 score block (not the
+# residual) stops dominating peak memory.
+_DEFAULT_CHUNK_TOKENS = 4096
+
+
+def plan_sequence_parallel(seq_len: int, num_heads: int,
+                           num_kv_heads: Optional[int], mesh=None,
+                           hbm_budget: Optional[int] = None, *,
+                           head_dim: int = 128,
+                           hidden_size: Optional[int] = None,
+                           batch_size: int = 1,
+                           dtype_bytes: int = 2) -> SPPlan:
+    """Compose a long-context plan for one step shape.
+
+    ``mesh`` may be a device Mesh (sp degree read from its 'sp' axis),
+    a bare int degree, or None. ``hbm_budget`` is per-chip bytes
+    available for activations; None plans without spill pressure (the
+    deterministic no-budget plan). Pure function — no device access.
+
+    Decision order: (1) sp degree and strategy from the mesh and head
+    layout (`detect_sp_strategy`); (2) FPDT chunk count so one chunk's
+    fp32 score block fits the budget slice (power-of-2 divisor of the
+    LOCAL shard — the sp composition is pad-free); (3) host-KV spill
+    when the full-sequence KV stacks at kv_heads width would eat more
+    than a quarter of the budget; (4) overlap_depth hint = chunk stages
+    the PR 6 engine can pin the host KV stream behind.
+    """
+    sp = _sp_degree_of(mesh)
+    kv = num_kv_heads or num_heads
+    hidden = hidden_size or num_heads * head_dim
+    strategy = detect_sp_strategy(num_heads, num_kv_heads, sp)
+    s_loc = -(-seq_len // sp)
+    reasons = []
+    if strategy is None:
+        reasons.append(f"sp={sp}: sequence parallelism off")
+    else:
+        reasons.append(
+            f"sp={sp} heads={num_heads}/{kv} → {strategy} "
+            + ("(head-scatter divides)" if strategy == "ulysses"
+               else "(heads indivisible by sp → ring)"))
+
+    # (2) chunk grid — local shard, pad-free divisors only
+    if hbm_budget is not None:
+        # one chunk's score block is B·N·C² fp32; budget a sixteenth
+        target = max(int((hbm_budget
+                          / (16.0 * 4.0 * batch_size * num_heads)) ** 0.5),
+                     256)
+    else:
+        target = _DEFAULT_CHUNK_TOKENS
+    chunks = _pick_chunks(s_loc, target)
+
+    # (3) host-KV spill: the composed path's device transient is the
+    # sp-gathered full-S KV at kv_heads width; spill when it crowds HBM
+    kv_bytes = 2 * batch_size * seq_len * kv * head_dim * dtype_bytes
+    spill = hbm_budget is not None and kv_bytes > hbm_budget // 4
+    if spill:
+        reasons.append(
+            f"KV stacks {kv_bytes / 2**30:.2f} GiB > budget/4 "
+            f"(budget {hbm_budget / 2**30:.2f} GiB) → fpdt_host_kv")
+        if chunks < 2:
+            if sp <= 1 or s_loc % 2 == 0:
+                chunks = 2  # the fpdt path needs ≥ 2 q chunks
+            else:
+                spill = False
+                reasons.append(
+                    f"local shard {s_loc} has no even chunk grid — "
+                    "cannot stream host KV pad-free under sp; spill off")
+    elif hbm_budget is not None:
+        reasons.append(
+            f"KV stacks {kv_bytes / 2**30:.2f} GiB fit on device "
+            "(no spill)")
+    if chunks > 1:
+        reasons.append(
+            f"attn_chunks={chunks} (local shard {s_loc} → "
+            f"{s_loc // chunks}-token chunks ≤ target {target})")
+
+    if spill:
+        from deepspeed_tpu.utils import memspace
+
+        if not memspace.memories_supported():
+            reasons.append(
+                "host spill degrades to device placement on this "
+                "single-memory backend (CPU sim) — placement semantics "
+                "and numerics preserved")
+
+    # (4) overlap interplay: each q chunk's KV refetch is a pinnable
+    # stage for the PR 6 overlap engine, like the param-stream ring
+    overlap_hint = min(4, chunks) if spill and chunks > 1 else 0
+    if overlap_hint:
+        reasons.append(
+            f"overlap_depth={overlap_hint}: pin host-KV chunk streams "
+            "behind per-chunk attention compute")
+
+    residual_bytes = batch_size * s_loc * hidden * dtype_bytes
+    if hbm_budget is not None and residual_bytes > hbm_budget // 4:
+        reasons.append(
+            f"NOTE: per-layer residual {residual_bytes / 2**30:.2f} GiB "
+            "also crowds the budget — consider fpdt_host_residual "
+            "(single-chip only; does not compose with sp)")
+
+    return SPPlan(strategy=strategy, sp_degree=sp,
+                  attn_chunks=chunks if chunks > 1 else 0,
+                  fpdt_host_kv=spill,
+                  overlap_depth_hint=overlap_hint,
+                  reasons=tuple(reasons))

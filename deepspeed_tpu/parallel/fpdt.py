@@ -337,7 +337,9 @@ def fpdt_attention_block(y, ap, positions, *, num_heads: int,
                          norm_fn: Optional[callable] = None,
                          post_fn: Optional[callable] = None,
                          hosted: bool = False,
-                         seq_len: Optional[int] = None) -> jax.Array:
+                         seq_len: Optional[int] = None,
+                         sp_axis: Optional[str] = None,
+                         sp_size: int = 1) -> jax.Array:
     """Full FPDT attention sub-layer with host-resident KV streaming —
     the reference ``_FPDTGPUOffloadingAttentionImpl_``'s pinned
     double-buffered sequence chunks (sequence/fpdt_layer.py:545,
@@ -369,7 +371,23 @@ def fpdt_attention_block(y, ap, positions, *, num_heads: int,
     — the device never holds any full-S [B, S, H] buffer, only one
     chunk (+ one KV-build tile) at a time. The KV tile grid is forced
     onto the chunk grid so both scans fetch the same host tiles.
+
+    ``sp_axis`` is the sequence-parallel composition mode: the call runs
+    INSIDE ``shard_map`` over that mesh axis with ``y``/``positions``
+    holding this rank's LOCAL [B, S/p, ...] shard (rank r owns the
+    contiguous global span [r·S/p, (r+1)·S/p)). Each rank builds its
+    local KV tile stacks, all-gathers them over ``sp_axis`` (rank-major
+    tiled gather ⇒ the gathered tile order is position-sorted, so tile j
+    still starts at global position j·kv_tile), spills the GLOBAL stacks
+    to host, and streams them through its local q chunks with
+    shard-offset query positions. ``sp_size`` must be the static degree
+    of ``sp_axis`` (the global valid length S·p is a nondiff argument of
+    the streaming kernel, so it cannot be derived from a traced
+    ``axis_size`` on older jax).
     """
+    if sp_axis is not None and hosted:
+        raise ValueError("fpdt sp composition does not support the "
+                         "hosted-residual mode (fpdt_host_residual)")
     if hosted:
         if seq_len is None:
             raise ValueError(
@@ -402,6 +420,34 @@ def fpdt_attention_block(y, ap, positions, *, num_heads: int,
             """Fetch residual chunk t from the host stack → [B, C, H]."""
             return _to_device(lax.dynamic_index_in_dim(
                 y, t, keepdims=False)).reshape(B, C, H)
+    elif sp_axis is not None:
+        # sp composition (runs inside shard_map): y/positions are the
+        # LOCAL shard. Padding a local shard would insert pad rows
+        # mid-sequence GLOBALLY and break the position math, so the
+        # chunk/tile grids must divide the shard exactly — the planner
+        # (parallel/auto_sp.py) only ever picks divisible counts.
+        B, S, H = y.shape
+        dt = y.dtype
+        g = num_heads // kv_heads
+        positions = jnp.broadcast_to(positions, (B, S))
+        if S % q_chunks:
+            raise ValueError(
+                f"fpdt+sp: local sequence shard {S} must be divisible "
+                f"by q_chunks={q_chunks} (pad-free composition only)")
+        pad_q = 0
+        Sp = S
+        C = S // q_chunks
+        kv_tile = kv_tile or C
+        if S % kv_tile:
+            raise ValueError(
+                f"fpdt+sp: local sequence shard {S} must be divisible "
+                f"by kv_tile={kv_tile} (pad-free composition only)")
+        T_loc = S // kv_tile               # tiles this rank builds
+        T = sp_size * T_loc                # global tile count streamed
+        y_p, pos_p = y, positions
+
+        def _res_tile(t):
+            return lax.dynamic_slice_in_dim(y_p, t * kv_tile, kv_tile, 1)
     else:
         B, S, H = y.shape
         dt = y.dtype
@@ -424,6 +470,16 @@ def fpdt_attention_block(y, ap, positions, *, num_heads: int,
 
         def _res_tile(t):
             return lax.dynamic_slice_in_dim(y_p, t * kv_tile, kv_tile, 1)
+
+    # sp composition globals: this rank's queries live at global
+    # positions shard_off + [0, S); KV/softmax masking runs against the
+    # GLOBAL valid length (static — _stream_attn nondiff arg)
+    if sp_axis is not None:
+        shard_off = lax.axis_index(sp_axis) * S
+        s_valid = sp_size * S
+    else:
+        shard_off = 0
+        s_valid = S
 
     def maybe_norm(t):
         return norm_fn(t) if norm_fn is not None else t
@@ -463,11 +519,31 @@ def fpdt_attention_block(y, ap, positions, *, num_heads: int,
     # _stream_attn re-shapes per fetched tile.
     kv_tile_fn = jax.checkpoint(kv_tile_fn)
 
-    def kv_body(_, t):
-        kt, vt = kv_tile_fn(t)
-        return None, (_to_host(kt), _to_host(vt))
+    if sp_axis is not None:
+        # build the LOCAL tile stacks on device, all-gather them over
+        # the sp axis, then spill the GLOBAL stacks to host. The tiled
+        # gather concatenates in axis-index (= rank) order and rank r's
+        # tokens occupy the contiguous global span [r·S, (r+1)·S), so
+        # the gathered stack is position-sorted: _stream_attn's internal
+        # k_pos = t·kv_tile + arange(kv_tile) stays valid unchanged.
+        # The gather's AD transpose is a reduce-scatter, which routes
+        # each rank's dk/dv tile cotangents back to the owning rank.
+        from deepspeed_tpu.comm import comm as _comm
 
-    _, (k_t, v_t) = lax.scan(kv_body, None, jnp.arange(T))
+        def kv_body(_, t):
+            return None, kv_tile_fn(t)
+
+        _, (k_loc, v_loc) = lax.scan(kv_body, None, jnp.arange(T_loc))
+        k_t = _to_host(_comm.all_gather(k_loc, sp_axis, gather_dim=0,
+                                        log_name="fpdt_sp_kv"))
+        v_t = _to_host(_comm.all_gather(v_loc, sp_axis, gather_dim=0,
+                                        log_name="fpdt_sp_kv"))
+    else:
+        def kv_body(_, t):
+            kt, vt = kv_tile_fn(t)
+            return None, (_to_host(kt), _to_host(vt))
+
+        _, (k_t, v_t) = lax.scan(kv_body, None, jnp.arange(T))
 
     wo = ap["wo"].astype(dt)
 
@@ -478,16 +554,18 @@ def fpdt_attention_block(y, ap, positions, *, num_heads: int,
             q_c = q_c + ap["bq"].astype(dt)
         if rope_theta:
             q_c = _rope_chunk(q_c, pos_chunk, rope_theta)
-        q_pos = chunk_idx * C + jnp.arange(C)
+        q_pos = shard_off + chunk_idx * C + jnp.arange(C)
 
         # causal: later tiles are fully masked for this chunk — skipped
-        # entirely inside _stream_attn (no H2D fetch, no compute)
+        # entirely inside _stream_attn (no H2D fetch, no compute).
+        # shard_off shifts the cutoff to this rank's global span in the
+        # sp composition (0 otherwise).
         n_tiles = (jnp.minimum(
-            ((chunk_idx + 1) * C + kv_tile - 1) // kv_tile, T)
+            (shard_off + (chunk_idx + 1) * C + kv_tile - 1) // kv_tile, T)
             if causal else jnp.asarray(T, jnp.int32))
 
-        ctx = _stream_attn(q_c, k_t, v_t, q_pos, n_tiles, g, S, causal,
-                           kv_tile)
+        ctx = _stream_attn(q_c, k_t, v_t, q_pos, n_tiles, g, s_valid,
+                           causal, kv_tile)
         attn_c = jnp.einsum("bcnd,ndh->bch", ctx, wo)
         if post_fn is not None:
             # fuse the rest of the transformer block into the same

@@ -34,8 +34,8 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
+from deepspeed_tpu.comm import comm
 from deepspeed_tpu.parallel import topology
-from deepspeed_tpu.utils.comms_logging import get_comms_logger
 from deepspeed_tpu.utils import jaxcompat
 
 BATCH = ("dp", "fsdp", "ep")
@@ -77,12 +77,18 @@ def _ring_attn_local(q, k, v, seg, *, axis: str, causal: bool,
                        seg if has_seg else None,
                        seg_blk if has_seg else None)
         o_acc, m_acc, l_acc = online_merge(o_acc, m_acc, l_acc, blk)
-        # rotate kv forward around the ring (device i -> i+1)
+        # rotate kv forward around the ring (device i -> i+1) — via the
+        # traced comm facade so each hop gets a flight-recorder span and
+        # a chrome-trace collective-lane slice (bytes are per-hop local
+        # block size; the scan dispatches the hop once at trace time)
         perm = [(i, (i + 1) % p_size) for i in range(p_size)]
-        k_blk = lax.ppermute(k_blk, axis, perm)
-        v_blk = lax.ppermute(v_blk, axis, perm)
+        k_blk = comm.ppermute(k_blk, axis, perm,
+                              log_name="ring_attention_kv")
+        v_blk = comm.ppermute(v_blk, axis, perm,
+                              log_name="ring_attention_kv")
         if has_seg:
-            seg_blk = lax.ppermute(seg_blk, axis, perm)
+            seg_blk = comm.ppermute(seg_blk, axis, perm,
+                                    log_name="ring_attention_seg")
         return (k_blk, v_blk, seg_blk, o_acc, m_acc, l_acc), None
 
     (k, v, seg, o_acc, m_acc, l_acc), _ = lax.scan(
@@ -113,12 +119,7 @@ def ring_attention(q, k, v, causal: bool = True, axis: str = "sp",
         return multi_head_attention(q, k, v, causal=causal,
                                     segment_ids=segment_ids)
 
-    logger = get_comms_logger()
     p_size = mesh.shape[axis]
-    for t in (k, v):
-        # each kv block traverses p-1 hops
-        logger.record("ppermute", t.size * t.dtype.itemsize * (p_size - 1)
-                      // p_size, axis, "ring_attention_kv")
 
     # pad S to a multiple of the ring size; padded KV positions are masked
     # inside the blockwise compute, padded Q rows are sliced off

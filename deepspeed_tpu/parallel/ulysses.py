@@ -24,8 +24,8 @@ from typing import Optional
 import jax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from deepspeed_tpu.comm import comm
 from deepspeed_tpu.parallel import topology
-from deepspeed_tpu.utils.comms_logging import get_comms_logger
 
 BATCH = ("dp", "fsdp", "ep")
 
@@ -63,23 +63,23 @@ def ulysses_attention(q, k, v, causal: bool = True, impl: str = "auto",
     if mesh is None or mesh.shape["sp"] == 1:
         return local_attn(q, k, v)
 
-    logger = get_comms_logger()
-    for t in (q, k, v):
-        logger.record("all_to_all", t.size * t.dtype.itemsize, "sp",
-                      "ulysses_qkv")
-
-    # seq-sharded -> head-sharded (all-to-all #1, on ICI)
+    # seq-sharded -> head-sharded (all-to-all #1, on ICI). The
+    # collectives are GSPMD-implicit (emitted from the sharding
+    # constraints), so wrap each constraint in comm.traced_span to give
+    # them the facade's byte accounting + flight-recorder spans
     inner = P(BATCH, None, ("tp", "sp"), None)
-    q = _constrain(q, inner)
-    k = _constrain(k, inner)
-    v = _constrain(v, inner)
+    with comm.traced_span("all_to_all", q, "sp", "ulysses_qkv"):
+        q = _constrain(q, inner)
+    with comm.traced_span("all_to_all", k, "sp", "ulysses_qkv"):
+        k = _constrain(k, inner)
+    with comm.traced_span("all_to_all", v, "sp", "ulysses_qkv"):
+        v = _constrain(v, inner)
 
     out = local_attn(q, k, v)
 
-    logger.record("all_to_all", out.size * out.dtype.itemsize, "sp",
-                  "ulysses_out")
     # head-sharded -> seq-sharded (all-to-all #2)
-    return _constrain(out, P(BATCH, "sp", "tp", None))
+    with comm.traced_span("all_to_all", out, "sp", "ulysses_out"):
+        return _constrain(out, P(BATCH, "sp", "tp", None))
 
 
 # ---------------------------------------------------------------------------

@@ -231,6 +231,45 @@ class Engine:
 
             model.config = _dc.replace(mcfg, **perf_updates)
 
+        # -- sequence-parallel planner (parallel/auto_sp.py) --------------
+        # When the mesh has an sp axis AND sp was opted into (model flag
+        # or sequence_parallel.size > 1 — an sp mesh axis alone also
+        # serves sequence-sharded activations without sp attention, so
+        # it is not treated as opt-in), compose the long-context plan
+        # onto the model config at init. SPPlan.apply is conservative:
+        # only fields still at their defaults change;
+        # sequence_parallel.auto_plan=False opts out entirely.
+        sp_cfg = getattr(config, "sequence_parallel", None)
+        mcfg = getattr(model, "config", None)
+        if (sp_cfg is not None and getattr(sp_cfg, "auto_plan", True)
+                and mcfg is not None and hasattr(mcfg, "num_heads")
+                and int(dict(mesh.shape).get("sp", 1)) > 1
+                and (getattr(mcfg, "sequence_parallel", False)
+                     or getattr(sp_cfg, "size", 1) > 1)):
+            from deepspeed_tpu.parallel.auto_sp import \
+                plan_sequence_parallel
+
+            budget_gb = getattr(sp_cfg, "hbm_budget_gb", None)
+            try:
+                _dbytes = int(jnp.dtype(mcfg.dtype).itemsize)
+            except Exception:
+                _dbytes = 2
+            sp_plan = plan_sequence_parallel(
+                mcfg.max_seq_len, mcfg.num_heads,
+                getattr(mcfg, "num_kv_heads", None), mesh,
+                int(budget_gb * 2 ** 30) if budget_gb else None,
+                head_dim=mcfg.head_dim, hidden_size=mcfg.hidden_size,
+                batch_size=config.train_micro_batch_size_per_chip or 1,
+                dtype_bytes=_dbytes)
+            self.sp_plan = sp_plan
+            new_mcfg = sp_plan.apply(mcfg)
+            if new_mcfg is not mcfg:
+                model.config = new_mcfg
+                log_dist("sp planner: " + "; ".join(sp_plan.reasons),
+                         ranks=[0])
+        else:
+            self.sp_plan = None
+
         self.micro_batch_size = config.train_micro_batch_size_per_chip
         self.gradient_accumulation_steps = config.gradient_accumulation_steps
         self.train_batch_size = config.train_batch_size

@@ -76,3 +76,101 @@ def test_region_cost_dict_roundtrip():
     assert z["arithmetic_intensity"] == 0.0
     assert RegionCost("x", 1.0, 0.0).to_dict()[
         "arithmetic_intensity"] is None
+
+
+# ---------------------------------------------------------------------------
+# long-context regions (sp_comm / host_kv_stream) — analytic, per chip
+# ---------------------------------------------------------------------------
+
+
+def test_longctx_regions_shape_and_order():
+    from deepspeed_tpu.observability.attribution import (
+        DMA_REGIONS, attribute_longctx_step)
+
+    regs = attribute_longctx_step(
+        seq_len=262144, hidden_size=256, num_heads=8, num_kv_heads=4,
+        num_layers=2, sp=4, strategy="ulysses", attn_chunks=0,
+        fpdt_host_kv=False)
+    assert [r.region for r in regs] == ["attn", "sp_comm",
+                                        "host_kv_stream"]
+    by = {r.region: r for r in regs}
+    assert by["attn"].flops > 0
+    assert by["sp_comm"].bytes_accessed > 0 and by["sp_comm"].overlapped
+    assert by["host_kv_stream"].bytes_accessed == 0  # no spill planned
+    assert {"sp_comm", "host_kv_stream"} <= DMA_REGIONS
+
+
+def test_longctx_attn_flops_quadratic_and_sharded():
+    from deepspeed_tpu.observability.attribution import \
+        attribute_longctx_step
+
+    kw = dict(hidden_size=256, num_heads=8, num_kv_heads=4, num_layers=1)
+    base = attribute_longctx_step(seq_len=65536, sp=1, **kw)[0]
+    twice = attribute_longctx_step(seq_len=131072, sp=1, **kw)[0]
+    sharded = attribute_longctx_step(seq_len=65536, sp=4,
+                                     strategy="ulysses", **kw)[0]
+    assert twice.flops == pytest.approx(4 * base.flops)   # O(S^2)
+    assert sharded.flops == pytest.approx(base.flops / 4)  # / sp
+
+
+def test_longctx_host_kv_stream_scales_with_chunks():
+    from deepspeed_tpu.observability.attribution import \
+        attribute_longctx_step
+
+    kw = dict(seq_len=262144, hidden_size=256, num_heads=8,
+              num_kv_heads=4, num_layers=2, sp=4, strategy="ulysses",
+              fpdt_host_kv=True)
+    few = attribute_longctx_step(attn_chunks=4, **kw)
+    many = attribute_longctx_step(attn_chunks=64, **kw)
+    hk_few = [r for r in few if r.region == "host_kv_stream"][0]
+    hk_many = [r for r in many if r.region == "host_kv_stream"][0]
+    assert hk_many.bytes_accessed > hk_few.bytes_accessed
+
+
+def test_longctx_ring_vs_ulysses_comm_bytes():
+    from deepspeed_tpu.observability.attribution import \
+        attribute_longctx_step
+
+    kw = dict(seq_len=65536, hidden_size=256, num_heads=8,
+              num_kv_heads=4, num_layers=1, sp=4)
+    uly = attribute_longctx_step(strategy="ulysses", **kw)[1]
+    ring = attribute_longctx_step(strategy="ring", **kw)[1]
+    # ulysses moves q+out at full head width on top of kv; ring moves
+    # only the kv blocks around the ring
+    assert uly.bytes_accessed > ring.bytes_accessed
+
+
+def test_dma_regions_split_and_markdown():
+    from deepspeed_tpu.observability.attribution import (
+        attribute_longctx_step, attribution_markdown,
+        split_exposed_hidden)
+
+    regs = attribute_longctx_step(
+        seq_len=262144, hidden_size=256, num_heads=8, num_kv_heads=4,
+        num_layers=2, sp=4, strategy="ulysses", attn_chunks=32,
+        fpdt_host_kv=True)
+    split = split_exposed_hidden(regs, peak_tflops=100.0, hbm_gbps=800.0,
+                                 overlap_depth=4, num_layers=2)
+    by = {s["region"]: s for s in split}
+    assert by["attn"]["kind"] == "compute"
+    assert by["sp_comm"]["kind"] == "dma"
+    assert by["host_kv_stream"]["kind"] == "dma"
+    for s in split:
+        assert s["exposed_ms"] + s["hidden_ms"] == pytest.approx(
+            s["total_ms"])
+    md = attribution_markdown(regs, 100.0, 800.0, overlap_depth=4,
+                              num_layers=2)
+    assert "| sp_comm |" in md and "| host_kv_stream |" in md
+    assert " ici " in md  # sp_comm bound column rides ICI
+
+
+def test_ici_bandwidth_env_override(monkeypatch):
+    from deepspeed_tpu.observability import attribution
+
+    monkeypatch.setenv("DSTPU_ICI_GBPS", "90.0")
+    assert attribution._dma_gbps("sp_comm") == 90.0
+    monkeypatch.delenv("DSTPU_ICI_GBPS")
+    assert attribution._dma_gbps("sp_comm") == \
+        attribution._DEFAULT_ICI_GBPS
+    assert attribution._dma_gbps("param_fetch", fetch_gbps=5.0) == 5.0
+    assert attribution._dma_gbps("host_kv_stream", fetch_gbps=5.0) == 5.0

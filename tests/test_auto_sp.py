@@ -1,0 +1,226 @@
+"""AutoSP (parallel/auto_sp.py): strategy detection GQA edges, the
+auto-wrap warning path, and the unified long-context planner — a pure
+deterministic function, so the decision grid is asserted exactly."""
+
+import dataclasses
+
+import pytest
+
+from deepspeed_tpu.models.transformer import TransformerConfig
+from deepspeed_tpu.parallel.auto_sp import (
+    SPPlan, auto_wrap_model_for_sp, detect_sp_strategy,
+    plan_sequence_parallel)
+
+
+# -- detect_sp_strategy: GQA edge cases -------------------------------------
+
+
+@pytest.mark.parametrize("heads,kv,sp,expect", [
+    (8, 8, 1, None),          # sp off
+    (8, 8, 0, None),          # degenerate degree
+    (8, 8, 4, "ulysses"),     # MHA, divisible
+    (8, 2, 2, "ulysses"),     # GQA, kv divisible
+    (8, 2, 4, "ring"),        # GQA: q divides but kv=2 < sp=4
+    (8, None, 4, "ulysses"),  # kv None -> MHA semantics
+    (6, 6, 4, "ring"),        # heads indivisible by sp
+    (2, 2, 4, "ring"),        # fewer heads than ranks
+    (32, 8, 8, "ulysses"),    # llama3-8b GQA at sp=8
+    (32, 8, 16, "ring"),      # same model past its kv width
+])
+def test_detect_sp_strategy_grid(heads, kv, sp, expect):
+    assert detect_sp_strategy(heads, kv, sp) == expect
+
+
+def test_auto_wrap_warns_and_leaves_headless_model(monkeypatch):
+    # the repo logger sets propagate=False, so capture the call directly
+    from deepspeed_tpu.utils import logging as ds_logging
+
+    warnings = []
+    monkeypatch.setattr(ds_logging.logger, "warning",
+                        lambda msg, *a: warnings.append(msg))
+
+    class NoHeads:
+        config = None
+
+    m = NoHeads()
+    out = auto_wrap_model_for_sp(m, mesh=None)
+    assert out is m
+    assert any("no head config" in w for w in warnings)
+
+
+def test_auto_wrap_no_mesh_is_identity_for_plain_model():
+    from deepspeed_tpu.models.transformer import TransformerLM
+
+    m = TransformerLM(TransformerConfig(
+        vocab_size=64, hidden_size=32, num_layers=1, num_heads=4,
+        max_seq_len=32))
+    out = auto_wrap_model_for_sp(m, mesh=None)
+    assert out.config.sequence_parallel is False
+
+
+# -- planner decision grid ---------------------------------------------------
+
+
+def test_plan_sp_off_at_degree_1():
+    plan = plan_sequence_parallel(4096, 8, 8, mesh=None)
+    assert plan.strategy is None and plan.sp_degree == 1
+    assert plan.fpdt_host_kv is False
+    assert plan.attn_chunks == 0  # 4096 fits one default chunk
+
+
+@pytest.mark.parametrize("seq,heads,kv,sp,expect_strategy", [
+    (65536, 8, 8, 4, "ulysses"),
+    (65536, 8, 2, 4, "ring"),
+    (262144, 32, 8, 8, "ulysses"),
+    (1048576, 32, 8, 16, "ring"),
+])
+def test_plan_strategy_grid(seq, heads, kv, sp, expect_strategy):
+    plan = plan_sequence_parallel(seq, heads, kv, sp)
+    assert plan.strategy == expect_strategy
+    assert plan.sp_degree == sp
+    assert plan.reasons  # decision trail always populated
+
+
+def test_plan_chunks_divide_the_local_shard():
+    # pad-free contract: chunk count must divide S/sp exactly
+    for seq, sp in [(262144, 4), (1048576, 8), (98304, 4)]:
+        plan = plan_sequence_parallel(seq, 8, 8, sp)
+        s_loc = seq // sp
+        if plan.attn_chunks:
+            assert s_loc % plan.attn_chunks == 0
+            assert s_loc // plan.attn_chunks <= 4096
+
+
+def test_plan_no_budget_no_spill():
+    plan = plan_sequence_parallel(1048576, 32, 8, 8, None)
+    assert plan.fpdt_host_kv is False
+    assert plan.overlap_depth_hint == 0
+
+
+def test_plan_spill_under_tight_budget():
+    # 1M tokens, GQA 8kv x 128: KV stacks = 2*1M*8*128*2B = 4 GiB,
+    # far above 16GiB/4 quarter-budget? 4 GiB == 16/4 exactly; use 8 GiB
+    plan = plan_sequence_parallel(
+        1048576, 32, 8, 8, 8 * 2 ** 30, head_dim=128)
+    assert plan.fpdt_host_kv is True
+    assert plan.attn_chunks >= 2
+    assert plan.overlap_depth_hint >= 1  # streams pinned behind compute
+    assert any("fpdt_host_kv" in r for r in plan.reasons)
+
+
+def test_plan_budget_relaxed_keeps_kv_on_device():
+    plan = plan_sequence_parallel(
+        8192, 8, 8, 4, 64 * 2 ** 30, head_dim=64)
+    assert plan.fpdt_host_kv is False
+    assert any("fit on device" in r for r in plan.reasons)
+
+
+def test_plan_accepts_mesh_object(mesh8):
+    # a real Mesh without an sp axis plans sp off
+    plan = plan_sequence_parallel(4096, 8, 8, mesh8)
+    assert plan.sp_degree == 1 and plan.strategy is None
+
+
+def test_plan_deterministic():
+    a = plan_sequence_parallel(262144, 32, 8, 8, 4 * 2 ** 30)
+    b = plan_sequence_parallel(262144, 32, 8, 8, 4 * 2 ** 30)
+    assert a == b
+
+
+# -- SPPlan.apply: conservative composition ---------------------------------
+
+
+CFG = TransformerConfig(
+    vocab_size=64, hidden_size=32, num_layers=1, num_heads=4,
+    num_kv_heads=2, max_seq_len=128)
+
+
+def test_apply_fills_defaults():
+    plan = SPPlan(strategy="ring", sp_degree=4, attn_chunks=4,
+                  fpdt_host_kv=True, overlap_depth_hint=2)
+    out = plan.apply(CFG)
+    assert out is not CFG
+    assert out.sequence_parallel is True and out.sp_mode == "ring"
+    assert out.attn_chunks == 4 and out.fpdt_host_kv is True
+    assert out.overlap_depth == 2
+
+
+def test_apply_never_overrides_explicit_choices():
+    explicit = dataclasses.replace(
+        CFG, sequence_parallel=True, sp_mode="ulysses", attn_chunks=8,
+        fpdt_host_kv=True, overlap_depth=1)
+    plan = SPPlan(strategy="ring", sp_degree=4, attn_chunks=4,
+                  fpdt_host_kv=True, overlap_depth_hint=3)
+    out = plan.apply(explicit)
+    assert out is explicit  # nothing to change -> same object
+
+
+def test_apply_noop_plan_is_identity():
+    plan = SPPlan(strategy=None, sp_degree=1, attn_chunks=0,
+                  fpdt_host_kv=False)
+    assert plan.apply(CFG) is CFG
+
+
+# -- engine integration: the planner composes at init -----------------------
+
+
+def test_engine_applies_planner_on_sp_optin(devices):
+    """A plain model + a ds-config sequence_parallel.size opt-in on an
+    sp mesh: the engine runs the planner and flips the model config."""
+    import deepspeed_tpu as dstpu
+    from deepspeed_tpu.models.transformer import TransformerLM
+
+    cfg = TransformerConfig(
+        vocab_size=64, hidden_size=32, num_layers=1, num_heads=4,
+        num_kv_heads=2, max_seq_len=64, remat=False)
+    engine, *_ = dstpu.initialize(
+        model=TransformerLM(cfg),
+        config={"train_micro_batch_size_per_chip": 1,
+                "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+                "zero_optimization": {"stage": 0},
+                "sequence_parallel": {"size": 4},
+                "steps_per_print": 100},
+        topology={"dp": 2, "sp": 4})
+    assert engine.sp_plan is not None
+    assert engine.sp_plan.strategy == "ring"  # kv=2 < sp=4 -> ring
+    assert engine.module.config.sequence_parallel is True
+    assert engine.module.config.sp_mode == "ring"
+
+
+def test_engine_skips_planner_without_optin(devices):
+    """An sp mesh axis alone (sequence-sharded activations) is not an
+    opt-in: models that left sequence_parallel off keep their program."""
+    import deepspeed_tpu as dstpu
+    from deepspeed_tpu.models.transformer import TransformerLM
+
+    cfg = TransformerConfig(
+        vocab_size=64, hidden_size=32, num_layers=1, num_heads=4,
+        max_seq_len=64, remat=False)
+    engine, *_ = dstpu.initialize(
+        model=TransformerLM(cfg),
+        config={"train_micro_batch_size_per_chip": 1,
+                "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+                "zero_optimization": {"stage": 0},
+                "steps_per_print": 100},
+        topology={"dp": 2, "sp": 4})
+    assert engine.sp_plan is None
+    assert engine.module.config.sequence_parallel is False
+
+
+def test_engine_auto_plan_false_opts_out(devices):
+    import deepspeed_tpu as dstpu
+    from deepspeed_tpu.models.transformer import TransformerLM
+
+    cfg = TransformerConfig(
+        vocab_size=64, hidden_size=32, num_layers=1, num_heads=4,
+        max_seq_len=64, remat=False)
+    engine, *_ = dstpu.initialize(
+        model=TransformerLM(cfg),
+        config={"train_micro_batch_size_per_chip": 1,
+                "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+                "zero_optimization": {"stage": 0},
+                "sequence_parallel": {"size": 4, "auto_plan": False},
+                "steps_per_print": 100},
+        topology={"dp": 2, "sp": 4})
+    assert engine.sp_plan is None
+    assert engine.module.config.sequence_parallel is False

@@ -86,3 +86,31 @@ def test_long_context_branch_unaffected():
 def test_cpu_smoke_stays_small():
     d = resolve_bench_defaults(env={}, on_tpu=False)
     assert d["seq"] == 128 and d["micro"] == 1
+
+
+def test_longctx_bench_tier_resolves():
+    d = resolve_bench_defaults(env={"BENCH_LONGCTX": "1"}, on_tpu=False)
+    assert d["longctx_bench"] is True
+    assert d["seq"] == 262144          # 256k default, BENCH_SEQ wins
+    assert d["longctx_sp"] == 4
+    d = resolve_bench_defaults(
+        env={"BENCH_LONGCTX": "1", "BENCH_SEQ": "1048576",
+             "BENCH_SP": "8"}, on_tpu=False)
+    assert d["seq"] == 1048576 and d["longctx_sp"] == 8
+    # the flag is off by default and does not disturb the real shape
+    d = resolve_bench_defaults(env={}, on_tpu=True)
+    assert d["longctx_bench"] is False and d["real_shape"] is True
+
+
+def test_longctx_bench_report_emits_three_regions():
+    from bench import longctx_bench_report
+
+    table, payload = longctx_bench_report(env={"BENCH_SEQ": "262144",
+                                               "BENCH_SP": "4"})
+    assert "| attn |" in table and "| sp_comm |" in table
+    assert "| host_kv_stream |" in table
+    assert payload["unit"] == "modeled exposed ms/step"
+    assert payload["plan"]["sp_degree"] == 4
+    assert [r["region"] for r in payload["regions"]] == [
+        "attn", "sp_comm", "host_kv_stream"]
+    assert payload["plan"]["reasons"]

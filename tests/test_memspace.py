@@ -1,0 +1,88 @@
+"""utils/memspace.py: the single degradation policy every memory-space
+placement goes through. On the CPU sim the backend has one memory space
+(unpinned_host), so every placement must degrade to identity —
+preserving the array's existing placement AND exact numerics — while
+the same call sites place into pinned_host for real on TPU."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.utils import memspace
+
+
+def test_backend_memory_kinds_nonempty():
+    kinds = memspace.backend_memory_kinds()
+    assert isinstance(kinds, frozenset)
+    assert kinds  # CPU sim exposes at least unpinned_host
+
+
+def test_cpu_sim_has_single_space():
+    # the degradation policy's premise: no pinned_host on the CPU sim
+    assert memspace.memories_supported() is False
+    assert memspace.space("device") is None
+    assert memspace.space("pinned_host") is None
+
+
+def test_space_rejects_unknown_kind():
+    with pytest.raises(AssertionError):
+        memspace.space("unpinned_host")
+
+
+def test_put_degrades_to_identity_preserving_numerics():
+    x = jnp.arange(12, dtype=jnp.float32).reshape(3, 4)
+    for kind in ("device", "pinned_host"):
+        y = memspace.put(x, kind)
+        assert y is x  # identity, not a copy — placement preserved
+        np.testing.assert_array_equal(np.asarray(y), np.asarray(x))
+
+
+def test_put_tree_maps_every_leaf():
+    tree = {"a": jnp.ones((2, 2)), "b": [jnp.zeros(3), jnp.arange(4)]}
+    out = memspace.put_tree(tree, "pinned_host")
+    for a, b in zip(jax.tree.leaves(tree), jax.tree.leaves(out)):
+        assert b is a
+
+
+def test_put_safe_inside_jit():
+    # the no-op branch resolves at trace time; jit must not see a
+    # device_put with a None target
+    @jax.jit
+    def f(x):
+        return memspace.put(x, "pinned_host") * 2.0
+
+    np.testing.assert_allclose(f(jnp.ones(4)), 2.0 * np.ones(4))
+
+
+def test_with_memory_kind_degrades_on_cpu_sim():
+    mesh = jax.sharding.Mesh(np.array(jax.devices()).reshape(8),
+                             ("fsdp",))
+    sh = jax.sharding.NamedSharding(mesh, jax.sharding.PartitionSpec())
+    assert memspace.with_memory_kind(sh, "pinned_host") is sh
+    assert memspace.with_memory_kind(None, "pinned_host") is None
+
+
+def test_with_memory_kind_swallows_backend_rejection(monkeypatch):
+    # force the supported path so the ValueError-degradation branch runs
+    monkeypatch.setattr(memspace, "memories_supported", lambda: True)
+
+    class Rejecting:
+        def with_memory_kind(self, kind):
+            raise ValueError("no such memory space")
+
+    sh = Rejecting()
+    assert memspace.with_memory_kind(sh, "pinned_host") is sh
+
+    class Accepting:
+        def with_memory_kind(self, kind):
+            return ("placed", kind)
+
+    assert memspace.with_memory_kind(Accepting(), "pinned_host") == (
+        "placed", "pinned_host")
+
+
+def test_is_on_host_false_on_single_space_backend():
+    x = jnp.ones(3)
+    assert memspace.is_on_host(x) is False
+    assert memspace.memory_kind_of(object()) is None
