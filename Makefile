@@ -14,7 +14,7 @@ NPROC := $(shell nproc)
 XDIST ?= $(shell if [ $(NPROC) -gt 2 ] && python -c "import xdist" 2>/dev/null; then echo "-n $$(( $(NPROC) - 1 )) --dist loadfile"; fi)
 PYTEST ?= python -m pytest
 
-.PHONY: test smoke slow bench bench-real bench-proxy bench-hostgap bench-overlap bench-longctx fleet-demo chaos
+.PHONY: test smoke slow bench bench-real bench-proxy bench-hostgap bench-overlap bench-longctx fleet-demo chaos serve-slo
 
 smoke:
 	$(PYTEST) tests/ -q -m "not slow" $(XDIST)
@@ -67,6 +67,17 @@ fleet-demo:
 bench-hostgap:
 	BENCH_PIPELINE_DEPTH=0 BENCH_PREFETCH_DEPTH=0 python bench.py
 	BENCH_PIPELINE_DEPTH=2 BENCH_PREFETCH_DEPTH=2 python bench.py
+
+# Open-loop serving SLO harness (tools/serve_bench.py run_slo): Poisson
+# arrivals against the v2 engine with the admission queue, shared-prefix
+# KV cache and prompt-lookup speculation on, then the same workload with
+# both off (SLO_COMPARE=1). One JSON line: p50/p99 TTFT (queue wait
+# included), per-decode-token latency, goodput under SLO_DEADLINE_MS,
+# queue-depth timeline, speedup_vs_baseline. CPU-sized defaults; scale
+# with SLO_REQUESTS/SLO_RATE/SLO_PROMPT/SLO_GEN/SLO_KV_BLOCKS
+# (docs/serving.md).
+serve-slo:
+	BENCH_MODE=serve_slo SLO_COMPARE=1 python bench.py
 
 # Fault-injection drill on the 8-device CPU sim: SIGKILL a training rank
 # mid-run, let the elastic agent restart it, and assert the auto-resumed

@@ -296,17 +296,23 @@ def overlap_report(model, step_ms, overlap_depth, streaming,
 
 
 def main():
-    if os.environ.get("BENCH_MODE") == "serve":
-        # serving throughput instead of the training headline: v2 ragged
-        # continuous batching + multi-step decode vs the naive v1 dense
-        # path (tools/serve_bench.py; SERVE_* env knobs)
+    if os.environ.get("BENCH_MODE") in ("serve", "serve_slo"):
+        # serving benchmarks instead of the training headline
+        # (tools/serve_bench.py): "serve" is the closed-loop v2-vs-v1
+        # throughput comparison (SERVE_* env knobs); "serve_slo" is the
+        # open-loop Poisson-arrival SLO harness — p50/p99 TTFT, goodput
+        # under deadline, queue-depth timeline (SLO_* env knobs,
+        # SLO_COMPARE=1 for the no-spec/no-prefix-cache baseline)
         import sys
 
         sys.path.insert(0, os.path.join(os.path.dirname(
             os.path.abspath(__file__)), "tools"))
         import serve_bench
 
-        print(json.dumps(serve_bench.run()))
+        if os.environ.get("BENCH_MODE") == "serve_slo":
+            print(json.dumps(serve_bench.run_slo()))
+        else:
+            print(json.dumps(serve_bench.run()))
         return
 
     if int(os.environ.get("BENCH_LONGCTX", "0")):

@@ -579,6 +579,48 @@ class ResilienceConfig(ConfigModel):
 
 @register_config_model
 @dataclass
+class ServingConfig(ConfigModel):
+    """Serving-engine knobs (inference/engine_v2.py, docs/serving.md).
+
+    Admission: since PR 8, ``InferenceEngineV2.put()`` NEVER raises on a
+    full KV pool — the pre-PR-8 contract (put() raised ``RuntimeError``
+    when ``can_schedule`` failed) is retired. Requests wait in a FIFO
+    queue and admit as blocks free up; ``max_queue_depth`` (default
+    unbounded) restores fail-fast backpressure for callers that want an
+    error instead of queueing. ``can_schedule()`` remains as an advisory
+    capacity probe.
+
+    ``prefix_cache`` shares full KV blocks across requests whose prompt
+    prefixes match by content hash (repeated system prompts prefill
+    once); ``spec_decode`` enables model-free prompt-lookup speculative
+    decoding — ``spec_k`` drafted tokens per sequence verified in one
+    ragged forward, n-gram match length up to ``spec_ngram``. Greedy
+    output is token-identical with speculation on or off.
+    ``decode_steps`` is the steady-state multi-token decode burst length
+    (1 restores strict per-token SplitFuse admission)."""
+
+    max_queue_depth: Optional[int] = None
+    prefix_cache: bool = True
+    spec_decode: bool = False
+    spec_k: int = 4
+    spec_ngram: int = 3
+    decode_steps: int = 8
+
+    def validate(self) -> None:
+        if self.max_queue_depth is not None and self.max_queue_depth < 1:
+            raise ValueError(
+                f"serving.max_queue_depth must be >= 1 (or null for "
+                f"unbounded), got {self.max_queue_depth}")
+        for name, lo in (("spec_k", 1), ("spec_ngram", 1),
+                         ("decode_steps", 1)):
+            if getattr(self, name) < lo:
+                raise ValueError(
+                    f"serving.{name} must be >= {lo}, got "
+                    f"{getattr(self, name)}")
+
+
+@register_config_model
+@dataclass
 class CompileConfig(ConfigModel):
     """Reference: deepspeed/compile/config.py. On TPU everything is compiled;
     these knobs tune donation/remat instead."""
@@ -654,6 +696,7 @@ class Config(ConfigModel):
     performance: PerformanceConfig = field(default_factory=PerformanceConfig)
     sparse_attention: Optional[SparseAttentionConfig] = None
     checkpoint: CheckpointConfig = field(default_factory=CheckpointConfig)
+    serving: ServingConfig = field(default_factory=ServingConfig)
     resilience: ResilienceConfig = field(default_factory=ResilienceConfig)
     compile: CompileConfig = field(default_factory=CompileConfig)
     # raw elasticity block: consumed by deepspeed_tpu/elasticity/ (the
@@ -680,7 +723,7 @@ class Config(ConfigModel):
             "comms_logger": CommsLoggerConfig, "flops_profiler": FlopsProfilerConfig,
             "observability": ObservabilityConfig,
             "performance": PerformanceConfig,
-            "checkpoint": CheckpointConfig,
+            "checkpoint": CheckpointConfig, "serving": ServingConfig,
             "resilience": ResilienceConfig, "compile": CompileConfig,
             "data_efficiency": DataEfficiencyConfig,
         }

@@ -6,5 +6,7 @@ v2/engine_v2.py:30 InferenceEngineV2).
 
 from deepspeed_tpu.inference.engine import InferenceEngine, init_inference
 from deepspeed_tpu.inference.engine_v2 import InferenceEngineV2
+from deepspeed_tpu.inference.spec_decode import Drafter, PromptLookupDrafter
 
-__all__ = ["InferenceEngine", "InferenceEngineV2", "init_inference"]
+__all__ = ["Drafter", "InferenceEngine", "InferenceEngineV2",
+           "PromptLookupDrafter", "init_inference"]

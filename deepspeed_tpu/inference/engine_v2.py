@@ -15,9 +15,11 @@ serves the whole workload.
 
 from __future__ import annotations
 
+import dataclasses
 import time
+from collections import deque
 from functools import partial
-from typing import Any, Dict, List, Optional, Tuple
+from typing import Any, Deque, Dict, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -26,12 +28,28 @@ from jax.sharding import Mesh
 
 from deepspeed_tpu.inference import model_runner
 from deepspeed_tpu.inference.ragged import (
-    BlockedKVCache, KVCacheConfig, RaggedBatch, StateManager)
+    BlockedKVCache, KVCacheConfig, PrefixCache, RaggedBatch, StateManager)
 from deepspeed_tpu.inference.ragged.ragged_batch import build_ragged_batch
 from deepspeed_tpu.inference.scheduler import SplitFuseScheduler
+from deepspeed_tpu.inference.spec_decode import PromptLookupDrafter
 from deepspeed_tpu.models.transformer import TransformerLM
 from deepspeed_tpu.parallel import topology as topo
 from deepspeed_tpu.utils.logging import log_dist
+
+
+@dataclasses.dataclass
+class _QueuedRequest:
+    """A request waiting for KV admission (FIFO). Requeued preemption
+    victims carry their already-generated tokens inside ``tokens`` (for
+    prefix recompute) and count them via ``prior_generated``."""
+    uid: int
+    tokens: np.ndarray
+    max_new_tokens: int
+    enqueue_time: float
+    prior_generated: int = 0
+    # original put() time while TTFT is still unmeasured; None once the
+    # request has emitted its first token (pre-preemption)
+    admit_time: Optional[float] = None
 
 
 class InferenceEngineV2:
@@ -41,8 +59,25 @@ class InferenceEngineV2:
                  max_tokens_per_step: int = 128, max_seqs_per_step: int = 16,
                  max_blocks_per_seq: int = 32, dtype=jnp.bfloat16, seed: int = 0,
                  quantize_weights: Optional[str] = None,
-                 decode_steps: int = 8):
+                 decode_steps: int = 8,
+                 prefix_cache: bool = True,
+                 spec_decode: bool = False, spec_k: int = 4,
+                 spec_ngram: int = 3, drafter: Optional[Any] = None,
+                 max_queue_depth: Optional[int] = None,
+                 serving: Optional[Any] = None):
         from deepspeed_tpu.inference.engine import InferenceEngine
+
+        if serving is not None:
+            # a config.ServingConfig block supplies the serving knobs;
+            # explicit kwargs above keep their call-site values only when
+            # the caller passed no block (the block is the source of
+            # truth for config-driven deployments)
+            prefix_cache = serving.prefix_cache
+            spec_decode = serving.spec_decode
+            spec_k = serving.spec_k
+            spec_ngram = serving.spec_ngram
+            decode_steps = serving.decode_steps
+            max_queue_depth = serving.max_queue_depth
 
         # reuse v1's TP placement logic for params/mesh
         self._v1 = InferenceEngine(model, mesh=mesh, params=params,
@@ -63,6 +98,11 @@ class InferenceEngineV2:
 
         self.kv_cache.allocator = BlockedAllocator(kv_blocks - 1)
         self._scratch_block = kv_blocks - 1
+        # shared-prefix KV reuse: full blocks whose content-hash chain
+        # matches a cached prefix are shared by reference and skip
+        # prefill (ragged/prefix_cache.py; docs/serving.md)
+        if prefix_cache:
+            self.kv_cache.prefix_cache = PrefixCache(kv_block_size)
 
         self.state = StateManager(self.kv_cache,
                                   max_tracked_sequences=4 * max_seqs_per_step,
@@ -93,7 +133,24 @@ class InferenceEngineV2:
         # logger's op counts, utils/comms_logging.py)
         self.stats = {"decode_kernel_steps": 0, "prefill_kernel_steps": 0,
                       "prefill_gather_fallbacks": 0,
-                      "fallback_reasons": {"vmem": 0, "padding": 0}}
+                      "fallback_reasons": {"vmem": 0, "padding": 0},
+                      "queued": 0, "admitted": 0, "preempted": 0,
+                      "requeued": 0, "truncated": 0,
+                      "prefix_hit_tokens": 0,
+                      "spec_steps": 0, "spec_proposed": 0,
+                      "spec_accepted": 0}
+        # admission queue: put() never raises on a full KV pool — requests
+        # wait FIFO here and admit as blocks free up; preemption victims
+        # requeue at the FRONT with their generated tokens preserved
+        self._queue: Deque[_QueuedRequest] = deque()
+        self._max_queue_depth = max_queue_depth
+        # speculative decoding: model-free prompt-lookup drafts verified
+        # through the ragged step (spec_decode.py; greedy acceptance is
+        # token-identical to non-speculative greedy)
+        self.spec_k = max(1, int(spec_k))
+        self._drafter = drafter if drafter is not None else (
+            PromptLookupDrafter(max_ngram=spec_ngram) if spec_decode
+            else None)
         # request-latency observability (docs/observability.md): TTFT is
         # put()->first emitted token; decode latency is the gap between
         # consecutive emitted tokens of one sequence (a burst spreads its
@@ -108,6 +165,9 @@ class InferenceEngineV2:
         self._ttft_hist = self._hub.histogram("serve.ttft_seconds")
         self._decode_hist = self._hub.histogram("serve.decode_token_seconds")
         self._step_hist = self._hub.histogram("serve.step_seconds")
+        self._admission_hist = self._hub.histogram(
+            "serve.admission_wait_seconds")
+        self._spec_hist = self._hub.histogram("serve.spec_accepted_len")
         # serving shares the crash flight recorder: a wedged serve step
         # dumps the last admits/steps the same way a training hang does
         self._flight = get_flight_recorder()
@@ -131,6 +191,13 @@ class InferenceEngineV2:
             axis=-1).astype(jnp.int32))
         self._take_rows = jax.jit(
             lambda lg, idx: lg.reshape(-1, lg.shape[-1])[idx])
+        # speculative verification consumes the greedy id of EVERY chunk
+        # row (draft j is accepted iff it equals row j-1's argmax), so
+        # fetch all T ids in one device round trip — still 4 bytes/row,
+        # never the [T, V] logits
+        self._pick_greedy_all = jax.jit(lambda lg: jnp.argmax(
+            lg.reshape(-1, lg.shape[-1]).astype(jnp.float32),
+            axis=-1).astype(jnp.int32))
         # multi-step greedy decode: one device program per `decode_steps`
         # tokens when every live sequence is in steady decode
         # (model_runner.ragged_multi_decode; decode_steps=1 restores
@@ -147,45 +214,168 @@ class InferenceEngineV2:
     # -- admission (reference engine_v2.py:184 query/can_schedule) --------
 
     def can_schedule(self, prompt_len: int) -> bool:
+        """Capacity probe: would a prompt of this length admit RIGHT NOW?
+        KV blocks allocate lazily (the scheduler's ensure_capacity), so
+        the free list alone over-admits — count the blocks already
+        COMMITTED to live sequences: each sequence's private claim at its
+        current length, plus every cache-shared block once. Idle
+        prefix-cached blocks stay admissible (reclaimed on demand).
+        Since the admission queue landed this is advisory only: put()
+        enqueues regardless and admission happens as blocks free up.
+        Admission also stops at ``max_seqs_per_step`` live sequences:
+        the scheduler can't run more per step, and the fast multi-step
+        decode/spec paths require every live sequence to fit one batch —
+        over-admitting past the slots would silently degrade them to
+        per-token steps for zero scheduling benefit."""
         blocks = self.kv_cache.blocks_needed(prompt_len + 1)
-        return (blocks <= self.kv_cache.free_blocks
-                and blocks <= self.max_blocks_per_seq
-                and len(self.state.seqs) < self.state.max_tracked_sequences)
+        if (blocks > self.max_blocks_per_seq
+                or len(self.state.seqs) >= self.max_seqs
+                or len(self.state.seqs)
+                >= self.state.max_tracked_sequences):
+            return False
+        committed = 0
+        for s in self.state.seqs.values():
+            need = self.kv_cache.blocks_needed(s.total_tokens + 1)
+            committed += max(need, len(s.kv_blocks)) - len(s.prefix_keys)
+        cache = self.kv_cache.prefix_cache
+        if cache is not None:
+            committed += cache.referenced_blocks
+        return blocks + committed <= self.kv_cache.allocator.total_blocks
 
     # -- core step (reference engine_v2.py:107 put) -----------------------
 
     def put(self, uids: List[int], tokens_list: List[np.ndarray],
             max_new_tokens: int = 64) -> None:
-        """Admit new sequences (uid -> prompt tokens)."""
+        """Submit new sequences (uid -> prompt tokens). Requests enter a
+        FIFO waiting queue and admit as KV blocks free up — a full pool
+        means backpressure (``serve.queue_wait_depth``), never an error.
+        (The pre-PR-8 contract — put() raised RuntimeError when the pool
+        was full — is retired; see docs/serving.md.) Raises ValueError
+        only for a prompt that can NEVER fit (per-seq block cap / total
+        pool), and RuntimeError when ``max_queue_depth`` is configured
+        and the queue is full (opt-in fail-fast backpressure)."""
         now = time.perf_counter()
         for uid, toks in zip(uids, tokens_list):
             toks = np.asarray(toks, np.int32).ravel()
-            if not self.can_schedule(len(toks)):
-                raise RuntimeError(f"cannot schedule uid={uid}: KV pool full")
-            self.state.get_or_create(uid, toks, max_new_tokens)
-            self._admit_time[uid] = now
+            blocks = self.kv_cache.blocks_needed(len(toks) + 1)
+            if (blocks > self.max_blocks_per_seq
+                    or blocks > self.kv_cache.allocator.total_blocks):
+                raise ValueError(
+                    f"uid={uid}: prompt of {len(toks)} tokens needs "
+                    f"{blocks} KV blocks and can never be scheduled "
+                    f"(max_blocks_per_seq={self.max_blocks_per_seq}, "
+                    f"pool={self.kv_cache.allocator.total_blocks})")
+            if (self._max_queue_depth is not None
+                    and len(self._queue) >= self._max_queue_depth):
+                raise RuntimeError(
+                    f"uid={uid}: admission queue full "
+                    f"(max_queue_depth={self._max_queue_depth})")
+            self._queue.append(_QueuedRequest(
+                uid=uid, tokens=toks, max_new_tokens=max_new_tokens,
+                enqueue_time=now, admit_time=now))
+            self.stats["queued"] += 1
             self._hub.counter_add("serve.requests")
+        self._admit_from_queue()
+        self._hub.gauge("serve.queue_wait_depth", len(self._queue))
+
+    def _admit_from_queue(self) -> None:
+        """Admit waiting requests strictly FIFO while capacity lasts.
+        Strict head-of-line order keeps big prompts from starving behind
+        a stream of small ones; the rotation fairness lives in the
+        scheduler's prefill scan instead."""
+        now = time.perf_counter()
+        while self._queue and self.can_schedule(len(self._queue[0].tokens)):
+            req = self._queue.popleft()
+            seq = self.state.get_or_create(req.uid, req.tokens,
+                                           req.max_new_tokens)
+            seq.prior_generated = req.prior_generated
+            skipped = self.state.attach_prefix(seq)
+            if skipped:
+                self.stats["prefix_hit_tokens"] += skipped
+                self._hub.counter_add("serve.prefix_hit_tokens", skipped)
+            if req.admit_time is not None:
+                self._admit_time[req.uid] = req.admit_time
+            self._admission_hist.observe(now - req.enqueue_time)
+            self.stats["admitted"] += 1
+        self._hub.gauge("serve.queue_wait_depth", len(self._queue))
+
+    def _release_seq(self, uid: int) -> Optional[float]:
+        """The ONE sequence-teardown path: frees state + KV and pops the
+        latency maps (both the finished and the preempted path route
+        here, so neither leaks ``_admit_time``/``_last_emit_time`` under
+        sustained overload). Returns the pending admit time, if TTFT was
+        still unmeasured, for requeue to carry forward."""
+        self.state.release(uid)
+        admit = self._admit_time.pop(uid, None)
+        self._last_emit_time.pop(uid, None)
+        return admit
+
+    def _requeue(self, seq) -> None:
+        """Preempt-and-requeue: park the victim back at the FRONT of the
+        admission queue with its generated-so-far tokens folded into the
+        prompt, so readmission recomputes the prefix (often straight
+        from the prefix cache) and the request continues where it
+        stopped — no work is discarded and nothing is dropped."""
+        tokens = np.concatenate(
+            [np.asarray(seq.input_tokens, np.int32),
+             np.asarray(seq.generated, np.int32)])
+        if (self.kv_cache.blocks_needed(len(tokens) + 1)
+                > self.max_blocks_per_seq):
+            # grown to the per-seq block cap: readmission could never
+            # fit, so end it (the pre-existing cap-truncation contract)
+            # instead of queueing it forever
+            seq.done = True
+            seq.truncated = True
+            self.stats["truncated"] += 1
+            self._release_seq(seq.uid)
+            log_dist(f"uid={seq.uid} at per-seq KV cap on preemption: "
+                     "truncated", ranks=[0])
+            return
+        prior = seq.prior_generated + len(seq.generated)
+        admit = self._release_seq(seq.uid)
+        self._queue.appendleft(_QueuedRequest(
+            uid=seq.uid, tokens=tokens, max_new_tokens=seq.max_new_tokens,
+            enqueue_time=time.perf_counter(), prior_generated=prior,
+            admit_time=admit))
+        self.stats["preempted"] += 1
+        self.stats["requeued"] += 1
+        self._hub.counter_add("serve.preempted")
+        self._hub.gauge("serve.queue_wait_depth", len(self._queue))
 
     def step(self, temperature: float = 0.0, seed: int = 0,
              eos_token_id: Optional[int] = None) -> Dict[int, int]:
         """Run one SplitFuse step. Returns {uid: new_token} for sequences
         that produced a token this step."""
         t0 = time.perf_counter()
+        self._admit_from_queue()
         scheduled = self.scheduler.schedule()
         self._release_finished()
         if not scheduled:
             # all live sequences starved for KV (pool exhausted mid-decode):
             # preempt the last-admitted sequence so the others can progress
-            # — without this the engine deadlocks and leaks the pool
+            # — without this the engine deadlocks and leaks the pool. The
+            # victim requeues at the queue front with its generated tokens
+            # kept for prefix recompute; it is never silently dropped.
             live = [s for s in self.state.seqs.values() if not s.done]
-            if live:
+            if len(live) > 1 or (live and self._queue):
                 victim = live[-1]
                 log_dist(
                     f"KV pool exhausted: preempting uid={victim.uid} "
-                    f"({len(victim.generated)} tokens generated)", ranks=[0])
+                    f"({len(victim.generated)} tokens generated) — "
+                    "requeued for readmission", ranks=[0])
+                self._requeue(victim)
+            elif live:
+                # a lone sequence the pool cannot grow for: requeueing
+                # would just readmit it into the same wall, so end it
+                # (the only remaining truncation path)
+                victim = live[0]
+                log_dist(
+                    f"KV pool exhausted by lone uid={victim.uid}: "
+                    "truncated (pool smaller than one request)", ranks=[0])
                 victim.done = True
                 victim.truncated = True
-                self.state.release(victim.uid)
+                self.stats["truncated"] += 1
+                self._release_seq(victim.uid)
             return {}
         batch = build_ragged_batch(scheduled, self.max_tokens, self.max_seqs,
                                    self.max_blocks_per_seq)
@@ -248,6 +438,8 @@ class InferenceEngineV2:
         for slot, (seq, new_tokens, start_pos) in enumerate(scheduled):
             n = len(new_tokens)
             seq.seen_tokens = start_pos + n
+            # prompt blocks the step just completed become shareable
+            self.state.register_prefix_blocks(seq)
             if seq.seen_tokens < len(seq.input_tokens):
                 continue  # mid-prefill: no logits consumed
             if seg_plan is not None:
@@ -276,7 +468,7 @@ class InferenceEngineV2:
                 emitted[seq.uid] = tok
                 if eos_token_id is not None and tok == eos_token_id:
                     seq.done = True
-                if len(seq.generated) >= seq.max_new_tokens:
+                if seq.gen_budget_left <= 0:
                     seq.done = True
         now = time.perf_counter()
         self._step_hist.observe(now - t0)
@@ -327,9 +519,7 @@ class InferenceEngineV2:
 
     def _release_finished(self) -> None:
         for uid in [s.uid for s in self.state.seqs.values() if s.done]:
-            self.state.release(uid)
-            self._admit_time.pop(uid, None)
-            self._last_emit_time.pop(uid, None)
+            self._release_seq(uid)
 
     def _note_emitted(self, uid: int, n_tokens: int, now: float) -> None:
         """Fold ``n_tokens`` just-emitted tokens of ``uid`` into the
@@ -352,9 +542,13 @@ class InferenceEngineV2:
     def _update_serve_gauges(self) -> None:
         live = [s for s in self.state.seqs.values() if not s.done]
         self._hub.gauge("serve.queue_depth", len(live))
+        self._hub.gauge("serve.queue_wait_depth", len(self._queue))
         self._hub.gauge("serve.pending_prefill_tokens",
                         sum(s.pending_prefill for s in live))
         self._hub.gauge("serve.kv_free_blocks", self.kv_cache.free_blocks)
+        if self.kv_cache.prefix_cache is not None:
+            self._hub.gauge("serve.prefix_cached_blocks",
+                            self.kv_cache.prefix_cache.cached_blocks)
         self._hub.gauge("serve.batch_seq_occupancy",
                         self.scheduler.last_scheduled_seqs
                         / max(1, self.max_seqs))
@@ -382,8 +576,7 @@ class InferenceEngineV2:
         # trip ensure_capacity's per-seq-cap kill and truncate output
         # that per-token stepping would have finished
         K = min(self.decode_steps,
-                max(1, min(s.max_new_tokens - len(s.generated)
-                           for s in live)))
+                max(1, min(s.gen_budget_left for s in live)))
         if K <= 1:
             return None
         # side-effect-free capacity probe first: per-seq cap, then total
@@ -425,13 +618,14 @@ class InferenceEngineV2:
         emitted: Dict[int, List[int]] = {}
         for i, s in enumerate(live):
             accepted = []
+            budget_left = s.gen_budget_left
             for k in range(K):
                 tok = int(toks_np[k, i])
                 accepted.append(tok)
                 if eos_token_id is not None and tok == eos_token_id:
                     s.done = True
                     break
-                if len(s.generated) + len(accepted) >= s.max_new_tokens:
+                if len(accepted) >= budget_left:
                     s.done = True
                     break
             s.generated.extend(accepted)
@@ -450,33 +644,163 @@ class InferenceEngineV2:
         self._release_finished()
         return emitted
 
+    def _try_spec_step(self, eos_token_id: Optional[int]
+                       ) -> Optional[Dict[int, List[int]]]:
+        """One speculative greedy decode round: the drafter proposes up
+        to ``spec_k`` tokens per sequence and ONE ragged forward verifies
+        them (the SplitFuse chunk machinery doubles as the verifier —
+        each chunk is [last real token, draft 1..k] and row j's argmax is
+        the greedy token after prefix+drafts[:j]). The longest matching
+        draft prefix is accepted plus one bonus token, so every emitted
+        token is the model's own argmax chain — token-identical to
+        non-speculative greedy. Returns None when a plain step should
+        run instead (prefill pending, no drafts, or KV-starved)."""
+        live = [s for s in self.state.seqs.values() if not s.done]
+        if (self._drafter is None or not live or len(live) > self.max_seqs
+                or len(live) > self.max_tokens
+                or any((not s.in_decode) or s.pending_prefill for s in live)):
+            return None
+        # pass 1 — side-effect-free: propose drafts and probe capacity.
+        # KV writes land for every chunk token (rejected drafts leave
+        # garbage PAST the accepted frontier that the next real token
+        # overwrites in place), so capacity must cover 1 + k per seq —
+        # shrink a proposal rather than trip the per-seq-cap kill, and
+        # bail to the plain step (which owns preemption) when the pool
+        # cannot cover even the plain decode tokens.
+        chunks: List[np.ndarray] = []
+        total = 0
+        need_total = 0
+        n_drafted = 0
+        for s in live:
+            k = min(self.spec_k, s.gen_budget_left - 1,
+                    self.max_tokens - total - 1)
+            drafts: List[int] = []
+            if k > 0:
+                drafts = list(self._drafter.propose(
+                    s.input_tokens.tolist() + s.generated, k))[:k]
+            while drafts and (self.kv_cache.blocks_needed(
+                    s.seen_tokens + 1 + len(drafts))
+                    > self.max_blocks_per_seq):
+                drafts.pop()
+            blocks = self.kv_cache.blocks_needed(
+                s.seen_tokens + 1 + len(drafts))
+            if blocks > self.max_blocks_per_seq:
+                return None  # at the per-seq cap: plain step decides
+            need_total += max(0, blocks - len(s.kv_blocks))
+            if drafts:
+                n_drafted += 1
+            t0 = (s.generated[-1] if s.generated
+                  else int(s.input_tokens[-1]))
+            chunks.append(np.asarray([t0] + drafts, np.int32))
+            total += 1 + len(drafts)
+        if n_drafted == 0:
+            return None  # nothing proposed: the burst path is faster
+        if need_total > self.kv_cache.available_blocks:
+            return None
+        sched: List[Tuple[Any, np.ndarray, int]] = []
+        for s, chunk in zip(live, chunks):
+            ok = self.state.ensure_capacity(s, s.seen_tokens + len(chunk))
+            assert ok, "spec capacity probe said yes but allocation failed"
+            sched.append((s, chunk, s.seen_tokens))
+        t_start = time.perf_counter()
+        batch = build_ragged_batch(sched, self.max_tokens, self.max_seqs,
+                                   self.max_blocks_per_seq)
+        with self.mesh:
+            logits, new_kv = self._step_fn(
+                self.params, self.kv_cache.data,
+                jnp.asarray(batch.token_ids), jnp.asarray(batch.token_seq),
+                jnp.asarray(batch.token_pos), jnp.asarray(batch.block_table),
+                jnp.asarray(batch.num_tokens, jnp.int32))
+            greedy = np.asarray(self._pick_greedy_all(logits))
+        self.kv_cache.data = new_kv
+        emitted: Dict[int, List[int]] = {}
+        cursor = 0
+        for s, chunk, start_pos in sched:
+            n = len(chunk)
+            rows = greedy[cursor:cursor + n]
+            cursor += n
+            emit = [int(rows[0])]
+            for j in range(1, n):
+                if int(chunk[j]) != emit[-1]:
+                    break  # draft j diverged from the greedy chain
+                emit.append(int(rows[j]))
+            self.stats["spec_proposed"] += n - 1
+            self.stats["spec_accepted"] += len(emit) - 1
+            self._spec_hist.observe(len(emit) - 1)
+            budget_left = s.gen_budget_left
+            final: List[int] = []
+            for tok in emit:
+                final.append(tok)
+                if eos_token_id is not None and tok == eos_token_id:
+                    s.done = True
+                    break
+                if len(final) >= budget_left:
+                    s.done = True
+                    break
+            s.generated.extend(final)
+            s.seen_tokens = start_pos + len(final)
+            emitted[s.uid] = final
+        self.stats["spec_steps"] += 1
+        now = time.perf_counter()
+        self._step_hist.observe(now - t_start)
+        self._flight.record("serve_step", tokens=batch.num_tokens,
+                            emitted=sum(len(v) for v in emitted.values()),
+                            spec=True,
+                            wall_ms=round((now - t_start) * 1000.0, 3))
+        for uid, toks in emitted.items():
+            if toks:
+                self._note_emitted(uid, len(toks), now)
+        self._update_serve_gauges()
+        self._release_finished()
+        return emitted
+
+    def serve_step(self, temperature: float = 0.0, seed: int = 0,
+                   eos_token_id: Optional[int] = None
+                   ) -> Dict[int, List[int]]:
+        """One serving round: admit from the waiting queue, then run the
+        best step for the current mix — speculative decode (drafts
+        available), multi-token burst (steady greedy decode), or a plain
+        SplitFuse step. Returns {uid: tokens emitted this round}. The
+        open-loop SLO harness (tools/serve_bench.py) drives this."""
+        self._admit_from_queue()
+        if temperature == 0.0:
+            spec = self._try_spec_step(eos_token_id)
+            if spec is not None:
+                return spec
+            burst = self._try_decode_burst(eos_token_id)
+            if burst is not None:
+                return burst
+        emitted = self.step(temperature, seed, eos_token_id)
+        return {uid: [tok] for uid, tok in emitted.items()}
+
     def generate_all(self, temperature: float = 0.0, seed: int = 0,
                      eos_token_id: Optional[int] = None,
-                     max_steps: int = 10_000) -> Dict[int, List[int]]:
-        """Drive steps until every admitted sequence finishes; returns
-        {uid: generated tokens}. In steady greedy decode, bursts
-        ``decode_steps`` tokens per device round trip."""
+                     max_steps: int = 100_000) -> Dict[int, List[int]]:
+        """Drive serve_step until every submitted sequence finishes
+        (including requests still waiting in the admission queue);
+        returns {uid: generated tokens}. In steady greedy decode, bursts
+        ``decode_steps`` tokens (or verified speculative drafts) per
+        device round trip."""
         results: Dict[int, List[int]] = {}
         for _ in range(max_steps):
-            if not self.state.seqs:
+            if not self.state.seqs and not self._queue:
                 break
-            if temperature == 0.0:
-                burst = self._try_decode_burst(eos_token_id)
-                if burst is not None:
-                    for uid, toks in burst.items():
-                        results.setdefault(uid, []).extend(toks)
-                    continue
-            # every step makes progress: emits tokens, advances a prefill,
-            # or preempts a starved sequence — so this loop terminates
-            emitted = self.step(temperature, seed, eos_token_id)
-            for uid, tok in emitted.items():
-                results.setdefault(uid, []).append(tok)
+            # every round makes progress: emits tokens, advances a
+            # prefill, admits from the queue, or preempts a starved
+            # sequence — so this loop terminates
+            for uid, toks in self.serve_step(
+                    temperature, seed, eos_token_id).items():
+                results.setdefault(uid, []).extend(toks)
         return results
 
     def flush(self, uids: List[int]) -> None:
-        """Drop sequences + free KV (reference engine_v2.py flush)."""
+        """Drop sequences + free KV (reference engine_v2.py flush);
+        covers queued-but-unadmitted requests too."""
         for uid in uids:
-            self.state.release(uid)
+            self._release_seq(uid)
+        drop = set(uids)
+        if any(r.uid in drop for r in self._queue):
+            self._queue = deque(r for r in self._queue if r.uid not in drop)
 
     def log_summary(self) -> Dict[str, Any]:
         """Serve-path telemetry (the comms-logger log_summary analog):
@@ -499,7 +823,9 @@ class InferenceEngineV2:
             "ttft": self._ttft_hist.snapshot(),
             "decode_token_latency": self._decode_hist.snapshot(),
             "step_latency": self._step_hist.snapshot(),
+            "admission_wait": self._admission_hist.snapshot(),
             "queue_depth": len(live),
+            "queue_wait_depth": len(self._queue),
             "pending_prefill_tokens": sum(s.pending_prefill for s in live),
             "kv_free_blocks": self.kv_cache.free_blocks,
             "batch_seq_occupancy": (self.scheduler.last_scheduled_seqs
@@ -514,6 +840,12 @@ class InferenceEngineV2:
         if self._burst_capacity > 0:
             out["burst_efficiency"] = (self._burst_tokens
                                        / self._burst_capacity)
+        if self.kv_cache.prefix_cache is not None:
+            out["prefix_cache"] = self.kv_cache.prefix_cache.snapshot()
+        if self.stats["spec_proposed"] > 0:
+            out["spec_acceptance_rate"] = (self.stats["spec_accepted"]
+                                           / self.stats["spec_proposed"])
+            out["spec_accepted_len"] = self._spec_hist.snapshot()
         return out
 
 

@@ -2,6 +2,7 @@
 
 from deepspeed_tpu.inference.ragged.blocked_allocator import BlockedAllocator
 from deepspeed_tpu.inference.ragged.kv_cache import BlockedKVCache, KVCacheConfig
+from deepspeed_tpu.inference.ragged.prefix_cache import PrefixCache
 from deepspeed_tpu.inference.ragged.sequence import (
     SequenceDescriptor, StateManager)
 from deepspeed_tpu.inference.ragged.ragged_batch import RaggedBatch
@@ -10,6 +11,7 @@ __all__ = [
     "BlockedAllocator",
     "BlockedKVCache",
     "KVCacheConfig",
+    "PrefixCache",
     "SequenceDescriptor",
     "StateManager",
     "RaggedBatch",

@@ -43,11 +43,18 @@ class KVCacheConfig:
 
 class BlockedKVCache:
     """Device pool + host allocator (reference kv_cache.py:40 contract:
-    reserve/free by block count; here also owns the device buffer)."""
+    reserve/free by block count; here also owns the device buffer).
+
+    When a :class:`~deepspeed_tpu.inference.ragged.prefix_cache.PrefixCache`
+    is attached (``prefix_cache`` attr), idle cached blocks are parked
+    outside the allocator free list; :meth:`reclaim` evicts them back
+    under memory pressure, so shared-prefix reuse never shrinks the pool
+    a live sequence can reach."""
 
     def __init__(self, config: KVCacheConfig, mesh=None, tp_axis: str = "tp"):
         self.config = config
         self.allocator = BlockedAllocator(config.num_blocks)
+        self.prefix_cache = None  # Optional[PrefixCache], attached by owner
         shape = (config.num_layers, config.num_blocks, config.block_size,
                  2, config.kv_heads, config.head_dim)
         if mesh is not None and tp_axis in mesh.axis_names and (
@@ -72,3 +79,21 @@ class BlockedKVCache:
     @property
     def free_blocks(self) -> int:
         return self.allocator.free_blocks
+
+    @property
+    def available_blocks(self) -> int:
+        """Free blocks plus idle prefix-cached blocks reclaimable via
+        :meth:`reclaim` — the admission-control capacity number."""
+        extra = (self.prefix_cache.evictable_blocks
+                 if self.prefix_cache is not None else 0)
+        return self.allocator.free_blocks + extra
+
+    def reclaim(self, n: int) -> int:
+        """Evict up to ``n`` idle prefix-cached blocks back into the
+        allocator free list; returns how many were reclaimed."""
+        if n <= 0 or self.prefix_cache is None:
+            return 0
+        evicted = self.prefix_cache.evict(n)
+        if evicted:
+            self.allocator.free(np.asarray(evicted, np.int64))
+        return len(evicted)

@@ -25,11 +25,19 @@ class SplitFuseScheduler:
         self.max_tokens = max_tokens_per_step
         self.max_seqs = max_seqs_per_step
         # scheduling observability: cumulative token mix plus the last
-        # step's occupancy (exported by InferenceEngineV2.snapshot())
+        # step's occupancy (exported by InferenceEngineV2.snapshot()).
+        # prefill_starvation_steps counts steps where at least one
+        # pending-prefill sequence got no chunk because budget/slots ran
+        # out — sustained growth means the token budget is undersized
+        # for the arrival rate.
         self.stats = {"steps": 0, "decode_tokens": 0, "prefill_tokens": 0,
-                      "kv_starved_skips": 0}
+                      "kv_starved_skips": 0, "prefill_starvation_steps": 0}
         self.last_scheduled_seqs = 0
         self.last_scheduled_tokens = 0
+        # rotating start for the prefill scan: insertion order alone lets
+        # an early long prompt re-win the tail budget every step and
+        # starve later arrivals
+        self._prefill_rr = 0
 
     def schedule(self) -> List[Tuple[SequenceDescriptor, np.ndarray, int]]:
         """Pick (seq, new_tokens, start_pos) chunks for the next step.
@@ -58,14 +66,20 @@ class SplitFuseScheduler:
             slots -= 1
 
         # prefill chunks (a chunk that reaches the end of the prompt makes
-        # the engine sample that step's last-token logits)
-        for seq in self.state.seqs.values():
+        # the engine sample that step's last-token logits); the scan
+        # starts at a rotating offset so budget leftovers round-robin
+        # over waiting prompts instead of always feeding the oldest
+        pending_seqs = [s for s in self.state.seqs.values()
+                        if s.pending_prefill > 0 and not s.done]
+        if pending_seqs:
+            start = self._prefill_rr % len(pending_seqs)
+            self._prefill_rr += 1
+            pending_seqs = pending_seqs[start:] + pending_seqs[:start]
+        scheduled_prefills = 0
+        for seq in pending_seqs:
             if budget <= 0 or slots <= 0:
                 break
-            pending = seq.pending_prefill
-            if pending == 0 or seq.done:
-                continue
-            chunk = min(pending, budget)
+            chunk = min(seq.pending_prefill, budget)
             if not self.state.ensure_capacity(seq, seq.seen_tokens + chunk):
                 self.stats["kv_starved_skips"] += 1
                 continue
@@ -74,6 +88,10 @@ class SplitFuseScheduler:
             self.stats["prefill_tokens"] += chunk
             budget -= chunk
             slots -= 1
+            scheduled_prefills += 1
+        if scheduled_prefills < len(pending_seqs) and (budget <= 0
+                                                       or slots <= 0):
+            self.stats["prefill_starvation_steps"] += 1
         self.stats["steps"] += 1
         self.last_scheduled_seqs = len(out)
         self.last_scheduled_tokens = self.max_tokens - budget

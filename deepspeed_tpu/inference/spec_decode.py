@@ -1,0 +1,68 @@
+"""Speculative decoding drafters for the ragged serving engine.
+
+Decode burns one full forward per token; speculation proposes ``k``
+candidate tokens per sequence and verifies them in ONE batched forward
+through the existing ragged step (the chunk machinery built for
+SplitFuse prefill is exactly a multi-token verifier). With greedy
+sampling, acceptance keeps the longest prefix of drafts that match the
+model's own argmax chain — the emitted stream is the argmax chain
+itself, so speculative greedy is token-identical to non-speculative
+greedy regardless of draft quality; drafts only change how many tokens
+one forward yields.
+
+The default drafter is model-free prompt-lookup / n-gram matching
+(PAPERS.md: "Prompt Lookup Decoding", also shipped in vLLM and
+transformers as ``prompt_lookup_num_tokens``): the continuation of the
+longest recent n-gram that already occurred earlier in the sequence is
+proposed verbatim. On repetitive workloads (code, extraction, RAG with
+quoted context) acceptance rates are high and there is no draft model
+to host. ``Drafter`` is the hook for a real draft model: anything with
+``propose(tokens, k) -> list[int]`` plugs into the engine.
+"""
+
+from __future__ import annotations
+
+from typing import List, Protocol, Sequence, runtime_checkable
+
+
+@runtime_checkable
+class Drafter(Protocol):
+    """Draft-proposal interface (the draft-model hook).
+
+    ``tokens`` is the sequence's full token history (prompt + generated)
+    and the return value is up to ``k`` proposed next tokens. An empty
+    list means "no proposal" — the engine falls back to plain decode for
+    that sequence this step."""
+
+    def propose(self, tokens: Sequence[int], k: int) -> List[int]:
+        ...
+
+
+class PromptLookupDrafter:
+    """N-gram / prompt-lookup drafter: match the last ``n`` tokens
+    (``max_ngram`` down to ``min_ngram``) against earlier history and
+    propose the tokens that followed the most recent match."""
+
+    def __init__(self, max_ngram: int = 3, min_ngram: int = 1):
+        if not 1 <= min_ngram <= max_ngram:
+            raise ValueError(
+                f"need 1 <= min_ngram <= max_ngram, got "
+                f"({min_ngram}, {max_ngram})")
+        self.max_ngram = max_ngram
+        self.min_ngram = min_ngram
+
+    def propose(self, tokens: Sequence[int], k: int) -> List[int]:
+        toks = list(tokens)
+        L = len(toks)
+        if k <= 0 or L < self.min_ngram + 1:
+            return []
+        for n in range(min(self.max_ngram, L - 1), self.min_ngram - 1, -1):
+            pattern = toks[L - n:]
+            # most recent earlier occurrence wins (local context beats a
+            # stale match from the far prompt)
+            for i in range(L - n - 1, -1, -1):
+                if toks[i:i + n] == pattern:
+                    cont = toks[i + n:i + n + k]
+                    if cont:
+                        return cont
+        return []
