@@ -73,11 +73,15 @@ bench-hostgap:
 # KV cache and prompt-lookup speculation on, then the same workload with
 # both off (SLO_COMPARE=1). One JSON line: p50/p99 TTFT (queue wait
 # included), per-decode-token latency, goodput under SLO_DEADLINE_MS,
-# queue-depth timeline, speedup_vs_baseline. CPU-sized defaults; scale
-# with SLO_REQUESTS/SLO_RATE/SLO_PROMPT/SLO_GEN/SLO_KV_BLOCKS
+# queue-depth timeline, speedup_vs_baseline, and the per-request SLO
+# attribution (per-phase p50/p99 + dominant miss phase). SLO_TRACE=1
+# additionally asserts phase-sum closure against measured wall time,
+# dumps the trace JSONL for tools/serve_top.py, and exports per-request
+# Perfetto lanes to SLO_TRACE_DIR. CPU-sized defaults; scale with
+# SLO_REQUESTS/SLO_RATE/SLO_PROMPT/SLO_GEN/SLO_KV_BLOCKS
 # (docs/serving.md).
 serve-slo:
-	BENCH_MODE=serve_slo SLO_COMPARE=1 python bench.py
+	BENCH_MODE=serve_slo SLO_COMPARE=1 SLO_TRACE=1 python bench.py
 
 # Fault-injection drill on the 8-device CPU sim: SIGKILL a training rank
 # mid-run, let the elastic agent restart it, and assert the auto-resumed

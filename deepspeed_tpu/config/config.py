@@ -368,6 +368,41 @@ class WatchdogConfig(ConfigModel):
 
 @register_config_model
 @dataclass
+class RequestTraceConfig(ConfigModel):
+    """Per-request serving traces (observability/request_trace.py;
+    docs/serving.md "Request tracing & SLO attribution").
+
+    Every request the serving engine touches records a typed span
+    timeline; at FINISH a tail-based sampler keeps every SLO violator
+    (TTFT > ``slo_deadline_ms``) plus a ``sample_rate`` random slice of
+    the healthy rest in a ``ring_size``-bounded ring. ``slo_deadline_ms``
+    null means no deadline: only the random slice is kept. Env
+    overrides: DSTPU_REQUEST_TRACE=0 (disable),
+    DSTPU_REQ_TRACE_SAMPLE, DSTPU_REQ_TRACE_RING,
+    DSTPU_REQ_TRACE_SLO_MS."""
+
+    enabled: bool = True
+    sample_rate: float = 0.05
+    ring_size: int = 4096
+    slo_deadline_ms: Optional[float] = None
+
+    def validate(self) -> None:
+        if not 0.0 <= self.sample_rate <= 1.0:
+            raise ValueError(
+                f"observability.request_trace.sample_rate must be in "
+                f"[0, 1], got {self.sample_rate}")
+        if self.ring_size < 1:
+            raise ValueError(
+                f"observability.request_trace.ring_size must be >= 1, "
+                f"got {self.ring_size}")
+        if self.slo_deadline_ms is not None and self.slo_deadline_ms <= 0:
+            raise ValueError(
+                f"observability.request_trace.slo_deadline_ms must be "
+                f"> 0 (or null), got {self.slo_deadline_ms}")
+
+
+@register_config_model
+@dataclass
 class PerformanceConfig(ConfigModel):
     """Pipelined training loop (docs/performance.md).
 
@@ -448,7 +483,10 @@ class ObservabilityConfig(ConfigModel):
     whose heartbeat is older than ``stale_after_seconds`` is reported
     dead by the aggregator. No run dir → no shard I/O. The crash flight
     recorder keeps a ring of ``flight_events`` structured events
-    (0 disables) dumped on crash/SIGTERM/watchdog fire."""
+    (0 disables) dumped on crash/SIGTERM/watchdog fire.
+    ``request_trace`` configures the per-request serving flight paths
+    (tail-sampled span timelines + SLO attribution; see
+    RequestTraceConfig)."""
 
     enabled: bool = True
     jsonl_path: Optional[str] = None
@@ -461,8 +499,11 @@ class ObservabilityConfig(ConfigModel):
     stale_after_seconds: float = 30.0
     flight_events: int = 4096
     watchdog: WatchdogConfig = field(default_factory=WatchdogConfig)
+    request_trace: RequestTraceConfig = field(
+        default_factory=RequestTraceConfig)
 
     def validate(self) -> None:
+        self.request_trace.validate()
         if self.flight_events < 0:
             raise ValueError(
                 f"observability.flight_events must be >= 0, got "

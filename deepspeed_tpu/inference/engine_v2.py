@@ -50,6 +50,9 @@ class _QueuedRequest:
     # original put() time while TTFT is still unmeasured; None once the
     # request has emitted its first token (pre-preemption)
     admit_time: Optional[float] = None
+    # queue re-entry after a preemption (vs a fresh put()) — the trace
+    # records the round trip's requeue wait on re-admission
+    requeued: bool = False
 
 
 class InferenceEngineV2:
@@ -64,7 +67,8 @@ class InferenceEngineV2:
                  spec_decode: bool = False, spec_k: int = 4,
                  spec_ngram: int = 3, drafter: Optional[Any] = None,
                  max_queue_depth: Optional[int] = None,
-                 serving: Optional[Any] = None):
+                 serving: Optional[Any] = None,
+                 request_trace: Optional[Any] = None):
         from deepspeed_tpu.inference.engine import InferenceEngine
 
         if serving is not None:
@@ -135,6 +139,7 @@ class InferenceEngineV2:
                       "prefill_gather_fallbacks": 0,
                       "fallback_reasons": {"vmem": 0, "padding": 0},
                       "queued": 0, "admitted": 0, "preempted": 0,
+                      "preempt_reasons": {},
                       "requeued": 0, "truncated": 0,
                       "prefix_hit_tokens": 0,
                       "spec_steps": 0, "spec_proposed": 0,
@@ -172,6 +177,18 @@ class InferenceEngineV2:
         # dumps the last admits/steps the same way a training hang does
         self._flight = get_flight_recorder()
         install_crash_handlers()
+        # per-request flight paths (observability/request_trace.py):
+        # every request gets a typed span timeline, tail-sampled at
+        # FINISH (SLO violators always kept); the tracer registers the
+        # in-flight request state as crash-dump context. ``request_trace``
+        # takes the observability.request_trace config block (or a
+        # dict); env: DSTPU_REQUEST_TRACE=0, DSTPU_REQ_TRACE_SAMPLE/
+        # _RING/_SLO_MS.
+        from deepspeed_tpu.observability.request_trace import RequestTracer
+
+        self.tracer = RequestTracer.from_config(
+            request_trace, hub=self._hub, flight=self._flight)
+        self.scheduler.tracer = self.tracer
         self._admit_time: Dict[int, float] = {}
         self._last_emit_time: Dict[int, float] = {}
         self._burst_tokens = 0
@@ -275,6 +292,8 @@ class InferenceEngineV2:
                 enqueue_time=now, admit_time=now))
             self.stats["queued"] += 1
             self._hub.counter_add("serve.requests")
+            self.tracer.on_enqueue(uid, len(toks),
+                                   queue_depth=len(self._queue))
         self._admit_from_queue()
         self._hub.gauge("serve.queue_wait_depth", len(self._queue))
 
@@ -289,10 +308,13 @@ class InferenceEngineV2:
             seq = self.state.get_or_create(req.uid, req.tokens,
                                            req.max_new_tokens)
             seq.prior_generated = req.prior_generated
+            self.tracer.on_admit(req.uid, wait_s=now - req.enqueue_time,
+                                 requeued=req.requeued)
             skipped = self.state.attach_prefix(seq)
             if skipped:
                 self.stats["prefix_hit_tokens"] += skipped
                 self._hub.counter_add("serve.prefix_hit_tokens", skipped)
+                self.tracer.on_prefix_hit(req.uid, skipped)
             if req.admit_time is not None:
                 self._admit_time[req.uid] = req.admit_time
             self._admission_hist.observe(now - req.enqueue_time)
@@ -310,12 +332,15 @@ class InferenceEngineV2:
         self._last_emit_time.pop(uid, None)
         return admit
 
-    def _requeue(self, seq) -> None:
+    def _requeue(self, seq, reason: str = "pool_exhausted") -> None:
         """Preempt-and-requeue: park the victim back at the FRONT of the
         admission queue with its generated-so-far tokens folded into the
         prompt, so readmission recomputes the prefix (often straight
         from the prefix cache) and the request continues where it
-        stopped — no work is discarded and nothing is dropped."""
+        stopped — no work is discarded and nothing is dropped.
+        ``reason`` tags the preemption (today only pool_exhausted; the
+        disaggregated-router follow-ups add more) on the counter, the
+        stats dict, and the victim's trace."""
         tokens = np.concatenate(
             [np.asarray(seq.input_tokens, np.int32),
              np.asarray(seq.generated, np.int32)])
@@ -327,19 +352,25 @@ class InferenceEngineV2:
             seq.done = True
             seq.truncated = True
             self.stats["truncated"] += 1
+            self.tracer.on_finish(seq.uid, "truncated")
             self._release_seq(seq.uid)
             log_dist(f"uid={seq.uid} at per-seq KV cap on preemption: "
                      "truncated", ranks=[0])
             return
+        self.tracer.on_preempt(seq.uid, reason=reason,
+                               generated=len(seq.generated))
         prior = seq.prior_generated + len(seq.generated)
         admit = self._release_seq(seq.uid)
         self._queue.appendleft(_QueuedRequest(
             uid=seq.uid, tokens=tokens, max_new_tokens=seq.max_new_tokens,
             enqueue_time=time.perf_counter(), prior_generated=prior,
-            admit_time=admit))
+            admit_time=admit, requeued=True))
         self.stats["preempted"] += 1
+        self.stats["preempt_reasons"][reason] = \
+            self.stats["preempt_reasons"].get(reason, 0) + 1
         self.stats["requeued"] += 1
         self._hub.counter_add("serve.preempted")
+        self._hub.counter_add(f"serve.preempted_reason.{reason}")
         self._hub.gauge("serve.queue_wait_depth", len(self._queue))
 
     def step(self, temperature: float = 0.0, seed: int = 0,
@@ -375,6 +406,7 @@ class InferenceEngineV2:
                 victim.done = True
                 victim.truncated = True
                 self.stats["truncated"] += 1
+                self.tracer.on_finish(victim.uid, "truncated")
                 self._release_seq(victim.uid)
             return {}
         batch = build_ragged_batch(scheduled, self.max_tokens, self.max_seqs,
@@ -475,6 +507,17 @@ class InferenceEngineV2:
         self._flight.record("serve_step", tokens=batch.num_tokens,
                             emitted=len(emitted),
                             wall_ms=round((now - t0) * 1000.0, 3))
+        if self.tracer.enabled:
+            # one PREFILL span per prompt chunk this step advanced; the
+            # span start backdates by the step wall so prefill lanes
+            # line up with the step that computed them
+            wall_ms = (now - t0) * 1e3
+            t_start = time.time() - (now - t0)
+            for seq, new_tokens, start_pos in scheduled:
+                if start_pos < len(seq.input_tokens):
+                    self.tracer.on_prefill(seq.uid, t_start, wall_ms,
+                                           tokens=len(new_tokens),
+                                           start_pos=start_pos)
         for uid in emitted:
             self._note_emitted(uid, 1, now)
         self._update_serve_gauges()
@@ -518,14 +561,22 @@ class InferenceEngineV2:
         return jnp.asarray(toks), jnp.asarray(pos0), jnp.asarray(nreal)
 
     def _release_finished(self) -> None:
-        for uid in [s.uid for s in self.state.seqs.values() if s.done]:
-            self._release_seq(uid)
+        for seq in [s for s in self.state.seqs.values() if s.done]:
+            self.tracer.on_finish(
+                seq.uid, "truncated" if seq.truncated else "finished")
+            self._release_seq(seq.uid)
 
-    def _note_emitted(self, uid: int, n_tokens: int, now: float) -> None:
+    def _note_emitted(self, uid: int, n_tokens: int, now: float,
+                      spec_overhead_ms: float = 0.0) -> None:
         """Fold ``n_tokens`` just-emitted tokens of ``uid`` into the
         latency histograms: the first token of a request is its TTFT;
         later tokens record the gap since the previous emission (a burst
-        spreads one device round trip evenly over its tokens)."""
+        spreads one device round trip evenly over its tokens).
+        ``spec_overhead_ms`` is this request's share of a speculative
+        round's rejected-draft compute, attached to its DECODE_EMIT
+        span for the phase decomposition."""
+        self.tracer.on_emit(uid, n_tokens,
+                            spec_overhead_ms=spec_overhead_ms)
         self._hub.counter_add("serve.tokens_emitted", n_tokens)
         admit = self._admit_time.pop(uid, None)
         last = self._last_emit_time.get(uid)
@@ -714,6 +765,7 @@ class InferenceEngineV2:
             greedy = np.asarray(self._pick_greedy_all(logits))
         self.kv_cache.data = new_kv
         emitted: Dict[int, List[int]] = {}
+        wasted_rows: Dict[int, int] = {}
         cursor = 0
         for s, chunk, start_pos in sched:
             n = len(chunk)
@@ -726,6 +778,14 @@ class InferenceEngineV2:
                 emit.append(int(rows[j]))
             self.stats["spec_proposed"] += n - 1
             self.stats["spec_accepted"] += len(emit) - 1
+            # drafted/accepted COUNTERS (not just the accepted-len
+            # histogram) so the acceptance *rate* is derivable on the
+            # Prometheus page: accepted_tokens / drafted_tokens
+            self._hub.counter_add("serve.spec_drafted_tokens", n - 1)
+            self._hub.counter_add("serve.spec_accepted_tokens",
+                                  len(emit) - 1)
+            self.tracer.on_spec(s.uid, drafted=n - 1,
+                                accepted=len(emit) - 1)
             self._spec_hist.observe(len(emit) - 1)
             budget_left = s.gen_budget_left
             final: List[int] = []
@@ -740,16 +800,24 @@ class InferenceEngineV2:
             s.generated.extend(final)
             s.seen_tokens = start_pos + len(final)
             emitted[s.uid] = final
+            wasted_rows[s.uid] = n - len(final)
         self.stats["spec_steps"] += 1
         now = time.perf_counter()
         self._step_hist.observe(now - t_start)
+        round_wall_ms = (now - t_start) * 1e3
         self._flight.record("serve_step", tokens=batch.num_tokens,
                             emitted=sum(len(v) for v in emitted.values()),
                             spec=True,
-                            wall_ms=round((now - t_start) * 1000.0, 3))
+                            wall_ms=round(round_wall_ms, 3))
         for uid, toks in emitted.items():
             if toks:
-                self._note_emitted(uid, len(toks), now)
+                # this request's share of the verify round spent on
+                # rows past its accepted frontier — the spec_overhead
+                # carve-out of its decode phase
+                self._note_emitted(
+                    uid, len(toks), now,
+                    spec_overhead_ms=round_wall_ms * wasted_rows[uid]
+                    / max(1, batch.num_tokens))
         self._update_serve_gauges()
         self._release_finished()
         return emitted
@@ -797,6 +865,7 @@ class InferenceEngineV2:
         """Drop sequences + free KV (reference engine_v2.py flush);
         covers queued-but-unadmitted requests too."""
         for uid in uids:
+            self.tracer.on_finish(uid, "flushed")
             self._release_seq(uid)
         drop = set(uids)
         if any(r.uid in drop for r in self._queue):
@@ -810,8 +879,14 @@ class InferenceEngineV2:
         max_seqs_per_step/prompt chunking to restore the kernel path."""
         s = dict(self.stats)
         s["fallback_reasons"] = dict(self.stats["fallback_reasons"])
+        s["preempt_reasons"] = dict(self.stats["preempt_reasons"])
         log_dist(f"InferenceEngineV2 summary: {s}", ranks=[0])
         return s
+
+    def request_traces(self, last: int = 0):
+        """Finished (tail-sampled) request traces — the input to
+        ``slo_attribution`` and the per-request chrome-trace lanes."""
+        return self.tracer.finished(last=last)
 
     def snapshot(self) -> Dict[str, Any]:
         """Serving observability snapshot: request-latency percentiles
@@ -835,7 +910,10 @@ class InferenceEngineV2:
             "scheduler": dict(self.scheduler.stats),
             "stats": dict(self.stats,
                           fallback_reasons=dict(
-                              self.stats["fallback_reasons"])),
+                              self.stats["fallback_reasons"]),
+                          preempt_reasons=dict(
+                              self.stats["preempt_reasons"])),
+            "request_trace": self.tracer.snapshot(),
         }
         if self._burst_capacity > 0:
             out["burst_efficiency"] = (self._burst_tokens
@@ -843,9 +921,16 @@ class InferenceEngineV2:
         if self.kv_cache.prefix_cache is not None:
             out["prefix_cache"] = self.kv_cache.prefix_cache.snapshot()
         if self.stats["spec_proposed"] > 0:
+            # acceptance RATE next to the raw drafted/accepted counters
+            # (the counters alone make it derivable across processes;
+            # the line here makes it readable in one snapshot)
+            out["spec_drafted_tokens"] = self.stats["spec_proposed"]
+            out["spec_accepted_tokens"] = self.stats["spec_accepted"]
             out["spec_acceptance_rate"] = (self.stats["spec_accepted"]
                                            / self.stats["spec_proposed"])
             out["spec_accepted_len"] = self._spec_hist.snapshot()
+        if self._drafter is not None and hasattr(self._drafter, "stats"):
+            out["drafter"] = dict(self._drafter.stats)
         return out
 
 

@@ -56,6 +56,12 @@ class PrefixCache:
         self._idle: "OrderedDict[str, int]" = OrderedDict()  # LRU, ref==0
         self.stats = {"hits": 0, "hit_tokens": 0, "misses": 0,
                       "registered": 0, "evicted": 0, "conflicts": 0}
+        # hit RATIO and eviction pressure on the shared dashboard, not
+        # just serve.prefix_hit_tokens: every lookup/miss/eviction also
+        # lands as a hub counter (docs/observability.md serving metrics)
+        from deepspeed_tpu.observability.hub import get_hub
+
+        self._hub = get_hub()
 
     # -- lookup / ref lifecycle ---------------------------------------
 
@@ -82,11 +88,13 @@ class PrefixCache:
             keys.append(key)
             blocks.append(blk)
             prev = key
+        self._hub.counter_add("serve.prefix_lookups")
         if keys:
             self.stats["hits"] += 1
             self.stats["hit_tokens"] += len(keys) * bs
         else:
             self.stats["misses"] += 1
+            self._hub.counter_add("serve.prefix_misses")
         return keys, blocks
 
     def ref(self, keys: Sequence[str]) -> None:
@@ -153,6 +161,8 @@ class PrefixCache:
             del self._block_of[key]
             out.append(blk)
         self.stats["evicted"] += len(out)
+        if out:
+            self._hub.counter_add("serve.prefix_evicted_blocks", len(out))
         return out
 
     def snapshot(self) -> Dict[str, int]:

@@ -38,6 +38,11 @@ class SplitFuseScheduler:
         # an early long prompt re-win the tail budget every step and
         # starve later arrivals
         self._prefill_rr = 0
+        # per-request tracing (observability/request_trace.py): the
+        # engine attaches its RequestTracer so KV-starved skips land as
+        # markers on the starved request's own lane — a request whose
+        # TTFT is eaten by repeated skips shows it in its timeline
+        self.tracer = None
 
     def schedule(self) -> List[Tuple[SequenceDescriptor, np.ndarray, int]]:
         """Pick (seq, new_tokens, start_pos) chunks for the next step.
@@ -57,6 +62,8 @@ class SplitFuseScheduler:
                 continue
             if not self.state.ensure_capacity(seq, seq.seen_tokens + 1):
                 self.stats["kv_starved_skips"] += 1
+                if self.tracer is not None:
+                    self.tracer.note(seq.uid, "KV_STARVED", at="decode")
                 continue  # KV OOM: leave for a later step
             tok = (seq.generated[-1] if seq.generated
                    else int(seq.input_tokens[-1]))
@@ -82,6 +89,8 @@ class SplitFuseScheduler:
             chunk = min(seq.pending_prefill, budget)
             if not self.state.ensure_capacity(seq, seq.seen_tokens + chunk):
                 self.stats["kv_starved_skips"] += 1
+                if self.tracer is not None:
+                    self.tracer.note(seq.uid, "KV_STARVED", at="prefill")
                 continue
             toks = seq.input_tokens[seq.seen_tokens:seq.seen_tokens + chunk]
             out.append((seq, toks.astype(np.int32), seq.seen_tokens))

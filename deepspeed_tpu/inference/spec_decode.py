@@ -50,11 +50,19 @@ class PromptLookupDrafter:
                 f"({min_ngram}, {max_ngram})")
         self.max_ngram = max_ngram
         self.min_ngram = min_ngram
+        # drafter-side observability: how often the n-gram scan finds a
+        # proposal at all (acceptance lives in the engine's
+        # serve.spec_* counters; a low proposal rate means the workload
+        # is non-repetitive and speculation is idling, not failing)
+        self.stats = {"calls": 0, "proposals": 0, "proposed_tokens": 0,
+                      "empty": 0}
 
     def propose(self, tokens: Sequence[int], k: int) -> List[int]:
+        self.stats["calls"] += 1
         toks = list(tokens)
         L = len(toks)
         if k <= 0 or L < self.min_ngram + 1:
+            self.stats["empty"] += 1
             return []
         for n in range(min(self.max_ngram, L - 1), self.min_ngram - 1, -1):
             pattern = toks[L - n:]
@@ -64,5 +72,8 @@ class PromptLookupDrafter:
                 if toks[i:i + n] == pattern:
                     cont = toks[i + n:i + n + k]
                     if cont:
+                        self.stats["proposals"] += 1
+                        self.stats["proposed_tokens"] += len(cont)
                         return cont
+        self.stats["empty"] += 1
         return []

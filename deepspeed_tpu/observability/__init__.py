@@ -11,7 +11,8 @@ from deepspeed_tpu.observability.attribution import (REGIONS, RegionCost,
                                                      attribute_step,
                                                      attribution_markdown)
 from deepspeed_tpu.observability.chrome_trace import (
-    chrome_trace_events, export_chrome_trace, export_rank_from_run_dir)
+    chrome_trace_events, export_chrome_trace, export_rank_from_run_dir,
+    export_request_traces, request_trace_events)
 from deepspeed_tpu.observability.fleet import (FleetAggregator, FleetPublisher,
                                                format_report, resolve_run_dir)
 from deepspeed_tpu.observability.flight_recorder import (
@@ -22,6 +23,9 @@ from deepspeed_tpu.observability.hub import (MetricsHub, compile_stats,
                                              get_hub, peek_hub, reset_hub)
 from deepspeed_tpu.observability.profile_trace import (TraceCapture,
                                                        parse_trace_steps)
+from deepspeed_tpu.observability.request_trace import (
+    PHASES, SPAN_KINDS, RequestTrace, RequestTracer, check_phase_closure,
+    load_traces_jsonl, slo_attribution, slo_attribution_markdown)
 from deepspeed_tpu.observability.roofline import (HBM_GBPS, PEAK_TFLOPS,
                                                   detect_hbm_gbps,
                                                   detect_peak_tflops, mfu,
@@ -71,4 +75,14 @@ __all__ = [
     "chrome_trace_events",
     "export_chrome_trace",
     "export_rank_from_run_dir",
+    "export_request_traces",
+    "request_trace_events",
+    "PHASES",
+    "SPAN_KINDS",
+    "RequestTrace",
+    "RequestTracer",
+    "check_phase_closure",
+    "load_traces_jsonl",
+    "slo_attribution",
+    "slo_attribution_markdown",
 ]

@@ -21,6 +21,11 @@ Track layout (one Chrome "process" per rank):
     tid 4  events    everything else (compile, checkpoint, offload, ...)
                      — also "X" spans when the event has ``dur_ms``
                      (flight_recorder.span)
+    tid 100+  req …  per-request serving lanes (request_trace.py): one
+                     track per traced request, so one request's whole
+                     life — queue wait, prefill chunks, decode
+                     emissions, preemption round trips — renders as one
+                     Perfetto row (:func:`request_trace_events`)
 """
 
 from __future__ import annotations
@@ -38,19 +43,23 @@ def _us(t_seconds: float, t0: float) -> float:
 
 def chrome_trace_events(step_rows: Iterable[Dict[str, Any]] = (),
                         flight_events: Iterable[Dict[str, Any]] = (),
-                        rank: int = 0) -> List[Dict[str, Any]]:
+                        rank: int = 0,
+                        t0: Optional[float] = None
+                        ) -> List[Dict[str, Any]]:
     """Build the ``traceEvents`` list.
 
     ``step_rows``: StepTrace dicts (``to_dict()``), hub history rows, or
     fleet shard rows — needs ``step``, ``wall_ms``, ``timestamp`` (step
     *end*, wall clock). ``flight_events``: flight-recorder event dicts
-    (``ts`` + ``kind`` + fields)."""
+    (``ts`` + ``kind`` + fields). ``t0`` overrides the time base so
+    other lane builders (request_trace_events) can share it."""
     step_rows = [r for r in step_rows
                  if r.get("wall_ms") is not None
                  and r.get("timestamp") is not None]
     flight_events = [e for e in flight_events if e.get("ts") is not None]
     starts = [r["timestamp"] - r["wall_ms"] / 1e3 for r in step_rows]
-    t0 = min(starts + [e["ts"] for e in flight_events], default=0.0)
+    if t0 is None:
+        t0 = min(starts + [e["ts"] for e in flight_events], default=0.0)
 
     evs: List[Dict[str, Any]] = [
         {"name": "thread_name", "ph": "M", "pid": rank, "tid": tid,
@@ -106,6 +115,90 @@ def chrome_trace_events(step_rows: Iterable[Dict[str, Any]] = (),
                         "s": "t", "ts": _us(ts, t0), "pid": rank,
                         "tid": tid, "args": fields})
     return evs
+
+
+REQUEST_TID_BASE = 100
+
+# phase-boundary span kinds that render as slices covering the time
+# UNTIL the next boundary (the lane then reads as a phase timeline);
+# everything else on the lane is an instant marker or an explicit
+# dur_ms slice (PREFILL chunks)
+_PHASE_SLICE_KINDS = {"ENQUEUE": "queue_wait", "ADMIT": "running",
+                      "PREEMPT": "preempted"}
+
+
+def request_trace_events(traces, rank: int = 0,
+                         t0: Optional[float] = None
+                         ) -> List[Dict[str, Any]]:
+    """Per-request Perfetto lanes from finished ``RequestTrace``s
+    (observability/request_trace.py): one named track per request under
+    the rank's process. Phase boundaries (ENQUEUE/ADMIT/PREEMPT) become
+    slices spanning to the next boundary, PREFILL chunks render with
+    their measured ``dur_ms``, and DECODE_EMIT / SPEC / PREFIX_HIT /
+    FINISH land as instant markers — so one request's life reads as one
+    row. Compose with :func:`chrome_trace_events` output by passing the
+    same ``t0`` base."""
+    traces = [t for t in traces if t.spans]
+    if not traces:
+        return []
+    if t0 is None:
+        t0 = min(t.spans[0].ts for t in traces)
+    evs: List[Dict[str, Any]] = []
+    for i, t in enumerate(traces):
+        tid = REQUEST_TID_BASE + i
+        evs.append({"name": "thread_name", "ph": "M", "pid": rank,
+                    "tid": tid, "args": {"name": f"req {t.trace_id}"}})
+        spans = sorted(t.spans, key=lambda s: s.ts)
+        end_ts = t.finish_ts if t.finish_ts is not None else spans[-1].ts
+        boundaries = [s for s in spans if s.kind in _PHASE_SLICE_KINDS]
+        for j, s in enumerate(boundaries):
+            nxt = (boundaries[j + 1].ts if j + 1 < len(boundaries)
+                   else end_ts)
+            label = _PHASE_SLICE_KINDS[s.kind]
+            if s.kind == "ADMIT" and s.fields.get("requeued"):
+                label = "re-running"
+            evs.append({"name": label, "ph": "X", "cat": "request",
+                        "ts": _us(s.ts, t0),
+                        "dur": max(nxt - s.ts, 0.0) * 1e6,
+                        "pid": rank, "tid": tid,
+                        "args": dict(s.fields, kind=s.kind)})
+        for s in spans:
+            if s.kind in _PHASE_SLICE_KINDS:
+                continue
+            if s.dur_ms:
+                evs.append({"name": s.kind, "ph": "X", "cat": "request",
+                            "ts": _us(s.ts, t0),
+                            "dur": max(s.dur_ms, 0.0) * 1e3,
+                            "pid": rank, "tid": tid,
+                            "args": dict(s.fields)})
+            else:
+                evs.append({"name": s.kind, "ph": "i", "cat": "request",
+                            "s": "t", "ts": _us(s.ts, t0), "pid": rank,
+                            "tid": tid, "args": dict(s.fields)})
+    return evs
+
+
+def export_request_traces(path: str, traces,
+                          flight_events: Optional[
+                              Iterable[Dict[str, Any]]] = None,
+                          rank: int = 0) -> str:
+    """Write a Perfetto trace of per-request lanes (plus, optionally,
+    the rank's flight events on the shared lanes — both use wall-clock
+    timestamps, so they align)."""
+    flight_events = list(flight_events or ())
+    ts_floor = [e["ts"] for e in flight_events if e.get("ts") is not None]
+    ts_floor += [t.spans[0].ts for t in traces if t.spans]
+    t0 = min(ts_floor, default=0.0)
+    evs = chrome_trace_events((), flight_events, rank=rank, t0=t0) if \
+        flight_events else []
+    evs += request_trace_events(traces, rank=rank, t0=t0)
+    d = os.path.dirname(os.path.abspath(path))
+    os.makedirs(d, exist_ok=True)
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        json.dump({"traceEvents": evs, "displayTimeUnit": "ms"}, f)
+    os.replace(tmp, path)
+    return path
 
 
 def export_chrome_trace(path: str,

@@ -52,6 +52,11 @@ class FlightRecorder:
         self.run_dir = run_dir
         self._dump_lock = threading.Lock()
         self.dumps: Dict[str, str] = {}  # reason -> last written path
+        # name -> zero-arg provider whose return value is embedded in
+        # every dump (e.g. the request tracer's in-flight timelines);
+        # providers run inside dump()'s try so a failing one cannot
+        # break the post-mortem
+        self._dump_context: Dict[str, Any] = {}
 
     # -- hot path ------------------------------------------------------
     def record(self, kind: str, **fields) -> None:
@@ -68,6 +73,13 @@ class FlightRecorder:
         event timestamp is the span START so lanes line up with the step
         timeline; one append at exit, same GIL-atomic hot path."""
         return _Span(self, kind, fields)
+
+    def add_dump_context(self, name: str, provider) -> None:
+        """Register a zero-arg callable whose result is embedded under
+        ``name`` in every dump — live state (in-flight serving requests,
+        scheduler occupancy, ...) that a ring of past events cannot
+        carry. Last registration per name wins."""
+        self._dump_context[name] = provider
 
     # -- configuration -------------------------------------------------
     def configure(self, capacity: Optional[int] = None,
@@ -141,6 +153,11 @@ class FlightRecorder:
                 ],
             }
             doc.update(extra)
+            for name, provider in list(self._dump_context.items()):
+                try:
+                    doc[name] = provider()
+                except Exception as e:  # context must never kill a dump
+                    doc[name] = f"<dump context failed: {e}>"
             tmp = f"{path}.tmp.{os.getpid()}"
             with open(tmp, "w") as f:
                 json.dump(doc, f, default=str)

@@ -419,8 +419,11 @@ class TestServingSnapshot:
                 fromlist=["InferenceEngineV2"],
             ).InferenceEngineV2._note_emitted
 
+        from deepspeed_tpu.observability.request_trace import RequestTracer
+
         e = _Eng()
         e._hub = get_hub()
+        e.tracer = RequestTracer(enabled=False)  # the engine always owns one
         e._ttft_hist = Histogram("ttft")
         e._decode_hist = Histogram("decode")
         e._admit_time = {1: 100.0}

@@ -1,0 +1,471 @@
+"""Per-request tracing + SLO attribution (observability/request_trace.py).
+
+The load-bearing guarantees (docs/serving.md "Request tracing"):
+- every traced request's five-phase decomposition sums to its measured
+  e2e wall time (and the TTFT decomposition to TTFT) by construction —
+  including across a preempt→requeue→finish round trip;
+- tail-based sampling keeps EVERY SLO violator regardless of sample
+  rate, and the ring stays bounded no matter how many requests finish;
+- the engine emit points produce a complete span timeline from a real
+  serve_step run, renderable as per-request Perfetto lanes.
+"""
+
+import json
+import os
+import time
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.observability import get_hub, reset_hub
+from deepspeed_tpu.observability.chrome_trace import (REQUEST_TID_BASE,
+                                                      export_request_traces,
+                                                      request_trace_events)
+from deepspeed_tpu.observability.request_trace import (
+    PHASES, RequestTrace, RequestTracer, check_phase_closure,
+    load_traces_jsonl, slo_attribution, slo_attribution_markdown)
+from deepspeed_tpu.models.zoo import get_model
+
+
+@pytest.fixture(autouse=True)
+def _fresh_hub():
+    reset_hub()
+    yield
+    reset_hub()
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    model = get_model("tiny", dtype=jnp.float32, param_dtype=jnp.float32)
+    params = model.init(jax.random.PRNGKey(0))
+    return model, params
+
+
+def make_engine(tiny, **kw):
+    from deepspeed_tpu.inference import InferenceEngineV2
+
+    model, params = tiny
+    kw.setdefault("kv_blocks", 64)
+    kw.setdefault("kv_block_size", 8)
+    kw.setdefault("max_tokens_per_step", 32)
+    kw.setdefault("max_seqs_per_step", 4)
+    kw.setdefault("max_blocks_per_seq", 8)
+    kw.setdefault("request_trace", {"sample_rate": 1.0})
+    return InferenceEngineV2(model, params=params, dtype=jnp.float32, **kw)
+
+
+def _drive_round_trip(tr, uid=7, sleeps=(0.01, 0.004, 0.003, 0.005, 0.002)):
+    """enqueue → admit → emit → preempt → re-admit → emit → finish with
+    real wall-clock gaps between the stages."""
+    q, pre, dec, park, re_dec = sleeps
+    tr.on_enqueue(uid, 32, queue_depth=1)
+    time.sleep(q)
+    tr.on_admit(uid, wait_s=q)
+    time.sleep(pre)
+    tr.on_prefill(uid, time.time() - pre, pre * 1e3, tokens=32, start_pos=0)
+    tr.on_emit(uid, 1)
+    time.sleep(dec)
+    tr.on_preempt(uid, "pool_exhausted", generated=1)
+    time.sleep(park)
+    tr.on_admit(uid, wait_s=park, requeued=True)
+    time.sleep(re_dec)
+    tr.on_emit(uid, 2, spec_overhead_ms=1.0)
+    tr.on_finish(uid, "finished")
+    return tr.finished()[-1]
+
+
+# -- span timeline + phase math ------------------------------------------
+
+
+class TestTraceLifecycle:
+    def test_span_ordering_and_bookkeeping(self):
+        tr = RequestTracer(sample_rate=1.0)
+        t = _drive_round_trip(tr)
+        kinds = [s.kind for s in sorted(t.spans, key=lambda s: s.ts)]
+        assert kinds[0] == "ENQUEUE" and kinds[-1] == "FINISH"
+        # ADMIT precedes the first emission; the preempt round trip is
+        # PREEMPT → REQUEUE → ADMIT(requeued) in order
+        assert kinds.index("ADMIT") < kinds.index("DECODE_EMIT")
+        i = kinds.index("PREEMPT")
+        assert kinds[i + 1] == "REQUEUE"
+        readmit = [s for s in t.spans
+                   if s.kind == "ADMIT" and s.fields.get("requeued")]
+        assert len(readmit) == 1
+        assert readmit[0].ts > t.spans[i].ts
+        assert t.status == "finished"
+        assert t.generated_tokens == 3
+        assert t.preemptions == 1
+        first_emits = [s for s in t.spans if s.fields.get("first")]
+        assert len(first_emits) == 1
+
+    def test_preempt_round_trip_phases_sum_to_e2e(self):
+        tr = RequestTracer(sample_rate=1.0)
+        t = _drive_round_trip(tr)
+        ph = t.phases()
+        assert set(ph) == set(PHASES)
+        assert ph["queue_wait"] >= 0.009
+        assert ph["preempted"] >= 0.004  # park + re-decode recompute
+        assert ph["spec_overhead"] == pytest.approx(1e-3, abs=1e-6)
+        assert sum(ph.values()) == pytest.approx(t.e2e_s, abs=1e-9)
+        tph = t.ttft_phases()
+        assert sum(tph.values()) == pytest.approx(t.ttft_s, abs=1e-9)
+        assert tph["decode"] == 0.0 and tph["preempted"] == 0.0
+        assert check_phase_closure([t])["checked"] == 1
+
+    def test_closure_check_raises_on_drift(self):
+        tr = RequestTracer(sample_rate=1.0)
+        t = _drive_round_trip(tr)
+        # corrupt the measurement: e2e is measured from enqueue_ts, the
+        # walk starts at the first span — skewing one breaks closure
+        t.enqueue_ts -= 1.0
+        with pytest.raises(AssertionError, match="phases sum off"):
+            check_phase_closure([t])
+
+    def test_preempt_before_first_token_counts_as_prefill(self):
+        tr = RequestTracer(sample_rate=1.0)
+        tr.on_enqueue(1, 16)
+        tr.on_admit(1)
+        time.sleep(0.003)
+        tr.on_preempt(1, "pool_exhausted", generated=0)
+        time.sleep(0.003)
+        tr.on_admit(1, wait_s=0.003, requeued=True)
+        time.sleep(0.003)  # re-prefill with no token yet emitted
+        tr.on_emit(1, 1)
+        tr.on_finish(1)
+        ph = tr.finished()[-1].phases()
+        # the recompute after a pre-first-token preempt is prefill work
+        assert ph["prefill"] >= 0.005
+        assert ph["preempted"] >= 0.002  # the parked wait
+        assert sum(ph.values()) == pytest.approx(
+            tr.finished()[-1].e2e_s, abs=1e-9)
+
+    def test_disabled_tracer_is_inert(self):
+        tr = RequestTracer(enabled=False)
+        tr.on_enqueue(1, 8)
+        tr.on_emit(1, 1)
+        tr.on_finish(1)
+        assert tr.finished() == [] and tr.in_flight() == 0
+
+    def test_uid_reuse_supersedes_open_trace(self):
+        tr = RequestTracer(sample_rate=1.0)
+        tr.on_enqueue(5, 8)
+        tr.on_enqueue(5, 8)  # caller recycled the uid
+        tr.on_finish(5)
+        statuses = sorted(t.status for t in tr.finished())
+        assert statuses == ["finished", "superseded"]
+
+
+# -- tail sampling + ring bounds -----------------------------------------
+
+
+class TestTailSampling:
+    def test_all_slo_violators_kept_at_zero_sample_rate(self):
+        tr = RequestTracer(sample_rate=0.0, slo_deadline_ms=5.0)
+        for uid in range(20):
+            tr.on_enqueue(uid, 8)
+            if uid % 2:
+                time.sleep(0.007)  # blow the 5 ms TTFT deadline
+            tr.on_emit(uid, 1)
+            tr.on_finish(uid)
+        kept = tr.finished()
+        assert len(kept) == 10
+        assert all(t.ttft_s * 1e3 > 5.0 for t in kept)
+        assert tr.stats["slo_misses"] == 10
+        assert tr.stats["dropped"] == 10
+
+    def test_no_deadline_no_keep_at_zero_sample_rate(self):
+        tr = RequestTracer(sample_rate=0.0)
+        for uid in range(10):
+            tr.on_enqueue(uid, 8)
+            tr.on_finish(uid)
+        assert tr.finished() == []
+        assert tr.stats["finished"] == 10
+
+    def test_ring_bounded_under_10k_requests(self):
+        tr = RequestTracer(sample_rate=1.0, ring_size=256)
+        for uid in range(10_000):
+            tr.on_enqueue(uid, 4)
+            tr.on_emit(uid, 1)
+            tr.on_finish(uid)
+        assert len(tr.finished()) == 256
+        assert tr.stats["started"] == 10_000
+        assert tr.stats["finished"] == 10_000
+        assert tr.in_flight() == 0
+        # newest survive
+        assert tr.finished()[-1].uid == 9_999
+
+    def test_hub_export_and_miss_counter(self):
+        hub = get_hub()
+        tr = RequestTracer(sample_rate=1.0, slo_deadline_ms=0.01, hub=hub)
+        tr.on_enqueue(1, 8)
+        time.sleep(0.002)
+        tr.on_emit(1, 1)
+        tr.on_finish(1)
+        assert hub.counters["serve.slo_misses"] == 1
+        for p in PHASES:
+            assert f"serve.phase_{p}_seconds" in hub.histograms
+        assert hub.histograms["serve.e2e_seconds"].snapshot()["count"] == 1
+
+    def test_from_config_env_overrides(self, monkeypatch):
+        monkeypatch.setenv("DSTPU_REQUEST_TRACE", "0")
+        assert not RequestTracer.from_config(None).enabled
+        monkeypatch.delenv("DSTPU_REQUEST_TRACE")
+        monkeypatch.setenv("DSTPU_REQ_TRACE_SAMPLE", "0.5")
+        monkeypatch.setenv("DSTPU_REQ_TRACE_SLO_MS", "123")
+        tr = RequestTracer.from_config({"sample_rate": 0.9})
+        assert tr.sample_rate == 0.5  # env beats config
+        assert tr.slo_deadline_ms == 123.0
+
+    def test_config_block_round_trip(self):
+        from deepspeed_tpu.config import Config
+
+        cfg = Config.from_dict({"observability": {
+            "request_trace": {"sample_rate": 0.25, "ring_size": 128,
+                              "slo_deadline_ms": 250}}})
+        rt = cfg.observability.request_trace
+        assert rt.sample_rate == 0.25 and rt.ring_size == 128
+        tr = RequestTracer.from_config(rt)
+        assert tr.sample_rate == 0.25 and tr.slo_deadline_ms == 250
+        with pytest.raises(ValueError):
+            Config.from_dict({"observability": {
+                "request_trace": {"sample_rate": 1.5}}}).validate()
+
+
+# -- attribution report ---------------------------------------------------
+
+
+class TestAttribution:
+    def _traces(self, n=6):
+        tr = RequestTracer(sample_rate=1.0)
+        for uid in range(n):
+            _drive_round_trip(tr, uid=uid,
+                              sleeps=(0.002 * (uid + 1), 0.002, 0.002,
+                                      0.002, 0.002))
+        return tr.finished()
+
+    def test_report_schema(self):
+        traces = self._traces()
+        rep = slo_attribution(traces, deadline_s=0.012)
+        assert rep["schema"] == "slo_attribution/v1"
+        assert rep["requests"] == 6
+        assert 0 < rep["slo_misses"] < 6  # the long-queue tail misses
+        assert tuple(rep["phases"]) == PHASES
+        for p in PHASES:
+            assert set(rep["phase_seconds"][p]) == {"p50", "p99", "mean"}
+        assert sum(rep["miss_dominant_phase"].values()) == rep["slo_misses"]
+        detail = rep["requests_detail"]
+        assert len(detail) == 6
+        missed = [r for r in detail if r["slo_miss"]]
+        assert all("dominant_phase" in r for r in missed)
+        # per-request rows carry the full decomposition
+        for r in detail:
+            assert set(r["phases"]) == set(PHASES)
+            assert sum(r["phases"].values()) == pytest.approx(
+                r["e2e_s"], rel=0.05, abs=1e-4)
+
+    def test_markdown_table(self):
+        rep = slo_attribution(self._traces(), deadline_s=0.012)
+        md = slo_attribution_markdown(rep)
+        assert "| phase |" in md and "| queue_wait |" in md
+        assert "Dominant phase" in md
+        seps = [ln for ln in md.splitlines()
+                if ln and set(ln) <= {"|", "-"}]
+        assert len(seps) == 1  # exactly one table
+
+    def test_jsonl_round_trip_stamps_deadline(self, tmp_path):
+        tr = RequestTracer(sample_rate=1.0, slo_deadline_ms=7.0)
+        _drive_round_trip(tr)
+        p = tr.dump_jsonl(str(tmp_path / "traces.jsonl"))
+        with open(p) as f:
+            row = json.loads(f.readline())
+        assert row["slo_deadline_ms"] == 7.0
+        assert row["slo_miss"] is True  # the round trip takes >7 ms
+        back = load_traces_jsonl(p)
+        assert len(back) == 1
+        assert back[0].trace_id == tr.finished()[0].trace_id
+        assert back[0].phases() == pytest.approx(
+            tr.finished()[0].phases(), abs=1e-6)
+
+    def test_serve_top_report_from_jsonl(self, tmp_path):
+        tools = os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "tools")
+        import sys
+        sys.path.insert(0, tools)
+        try:
+            import serve_top
+        finally:
+            sys.path.remove(tools)
+        tr = RequestTracer(sample_rate=1.0, slo_deadline_ms=7.0)
+        _drive_round_trip(tr)
+        p = tr.dump_jsonl(str(tmp_path / "traces.jsonl"))
+        rc = serve_top.main([p, "--worst", "1"])
+        assert rc == 0
+        out = str(tmp_path / "lanes.json")
+        assert serve_top.main([p, "--chrome-trace", "--out", out]) == 0
+        assert json.load(open(out))["traceEvents"]
+
+
+# -- Perfetto lanes --------------------------------------------------------
+
+
+class TestChromeLanes:
+    def test_request_lanes_shape(self):
+        tr = RequestTracer(sample_rate=1.0)
+        _drive_round_trip(tr, uid=1)
+        _drive_round_trip(tr, uid=2)
+        evs = request_trace_events(tr.finished())
+        lanes = {e["tid"] for e in evs}
+        assert lanes == {REQUEST_TID_BASE, REQUEST_TID_BASE + 1}
+        names = {e["args"]["name"] for e in evs if e["ph"] == "M"}
+        assert all(n.startswith("req ") for n in names)
+        # phase-boundary slices cover the lane; no negative timestamps
+        assert all(e["ts"] >= 0 for e in evs if e["ph"] != "M")
+        slices = [e for e in evs if e["ph"] == "X"]
+        assert any(e["name"] == "queue_wait" for e in slices)
+        assert any(e["name"] == "preempted" for e in slices)
+        assert any(e["name"] == "re-running" for e in slices)
+
+    def test_export_file_loads(self, tmp_path):
+        tr = RequestTracer(sample_rate=1.0)
+        _drive_round_trip(tr)
+        p = export_request_traces(str(tmp_path / "lanes.json"),
+                                  tr.finished())
+        doc = json.load(open(p))
+        assert doc["traceEvents"]
+
+
+# -- engine integration (real serve_step runs) ----------------------------
+
+
+class TestEngineTracing:
+    def test_full_run_traces_every_request(self, tiny, tmp_path):
+        engine = make_engine(tiny)
+        rng = np.random.default_rng(0)
+        vocab = tiny[0].config.vocab_size
+        prompts = [rng.integers(0, vocab, (n,)).astype(np.int32)
+                   for n in (12, 20, 16, 24)]
+        engine.put(list(range(4)), prompts, max_new_tokens=8)
+        out = engine.generate_all()
+        assert all(len(v) == 8 for v in out.values())
+        traces = engine.request_traces()
+        assert len(traces) == 4
+        for t in traces:
+            kinds = [s.kind for s in t.spans]
+            for k in ("ENQUEUE", "ADMIT", "PREFILL", "DECODE_EMIT",
+                      "FINISH"):
+                assert k in kinds, (t.trace_id, k)
+            assert t.status == "finished"
+            assert t.generated_tokens == 8
+            prefill_toks = sum(s.fields["tokens"] for s in t.spans
+                               if s.kind == "PREFILL")
+            assert prefill_toks == t.prompt_tokens
+        # the acceptance bar: phase sums close against measured wall time
+        closure = check_phase_closure(traces)
+        assert closure["checked"] == 4
+        # ...and the run exports loadable per-request Perfetto lanes
+        p = export_request_traces(str(tmp_path / "lanes.json"), traces)
+        evs = json.load(open(p))["traceEvents"]
+        assert {e["tid"] for e in evs if e["tid"] >= REQUEST_TID_BASE}
+        snap = engine.snapshot()
+        assert snap["request_trace"]["finished"] == 4
+        assert snap["request_trace"]["in_flight"] == 0
+
+    def test_preemption_reason_tagged_end_to_end(self, tiny):
+        hub = get_hub()
+        engine = make_engine(tiny, kv_blocks=20, max_blocks_per_seq=16,
+                             prefix_cache=True)
+        rng = np.random.default_rng(0)
+        vocab = tiny[0].config.vocab_size
+        shared = rng.integers(0, vocab, (16,))
+        prompts = [np.concatenate(
+            [shared, rng.integers(0, vocab, (8,))]).astype(np.int32)
+            for _ in range(10)]
+        engine.put(list(range(10)), prompts, max_new_tokens=40)
+        out = engine.generate_all()
+        assert all(len(v) == 40 for v in out.values())
+        assert engine.stats["preempted"] > 0
+        assert engine.stats["preempt_reasons"] == {
+            "pool_exhausted": engine.stats["preempted"]}
+        assert hub.counters["serve.preempted_reason.pool_exhausted"] == \
+            engine.stats["preempted"]
+        preempted = [t for t in engine.request_traces() if t.preemptions]
+        assert preempted
+        for t in preempted:
+            ph = t.phases()
+            assert ph["preempted"] > 0
+            assert sum(ph.values()) == pytest.approx(t.e2e_s, abs=1e-6)
+            reasons = [s.fields["reason"] for s in t.spans
+                       if s.kind == "PREEMPT"]
+            assert set(reasons) == {"pool_exhausted"}
+        # the requeue wait of the round trip is measured end-to-end
+        h = hub.histograms["serve.requeue_wait_seconds"].snapshot()
+        assert h["count"] >= engine.stats["preempted"]
+
+    def test_spec_and_prefix_counters(self, tiny):
+        hub = get_hub()
+        engine = make_engine(tiny, prefix_cache=True, spec_decode=True,
+                             spec_k=4)
+        rng = np.random.default_rng(1)
+        vocab = tiny[0].config.vocab_size
+        shared = rng.integers(0, vocab, (16,))
+        motif = rng.integers(0, vocab, (4,))
+        # 8 requests vs 4 seq slots: the second admission wave arrives
+        # after the first wave registered the shared-prefix chains, so
+        # real PREFIX_HIT spans land on the later traces
+        prompts = [np.concatenate(
+            [shared, np.tile(motif, 4)]).astype(np.int32)
+            for _ in range(8)]
+        engine.put(list(range(8)), prompts, max_new_tokens=12)
+        engine.generate_all()
+        # satellite: spec draft/accept counters + acceptance-rate line
+        assert hub.counters.get("serve.spec_drafted_tokens", 0) > 0
+        assert hub.counters.get("serve.spec_accepted_tokens", 0) >= 0
+        snap = engine.snapshot()
+        assert snap["spec_drafted_tokens"] == engine.stats["spec_proposed"]
+        assert 0.0 <= snap["spec_acceptance_rate"] <= 1.0
+        assert snap["drafter"]["proposals"] > 0
+        # satellite: prefix-cache hit/miss/evict counters
+        assert hub.counters["serve.prefix_lookups"] >= 3
+        assert hub.counters.get("serve.prefix_misses", 0) >= 1
+        traced_spec = [t for t in engine.request_traces()
+                       if t.spec_drafted > 0]
+        assert traced_spec
+        hits = [t for t in engine.request_traces()
+                if t.prefix_hit_tokens > 0]
+        assert hits  # later arrivals reuse the shared prefix
+        # after the drain the chains are idle: eviction counter fires
+        pc = engine.kv_cache.prefix_cache
+        if pc.evictable_blocks:
+            pc.evict(pc.evictable_blocks)
+            assert hub.counters["serve.prefix_evicted_blocks"] > 0
+
+    def test_flight_dump_carries_in_flight_requests(self, tiny, tmp_path):
+        engine = make_engine(tiny)
+        rng = np.random.default_rng(2)
+        vocab = tiny[0].config.vocab_size
+        engine.put([1], [rng.integers(0, vocab, (12,)).astype(np.int32)],
+                   max_new_tokens=8)
+        engine.serve_step()  # request now mid-flight
+        p = engine._flight.dump(reason="test",
+                                path=str(tmp_path / "dump.json"))
+        doc = json.load(open(p))
+        inflight = doc["requests_in_flight"]
+        assert len(inflight) == 1 and inflight[0]["uid"] == 1
+        assert set(inflight[0]["phases"]) == set(PHASES)
+        engine.generate_all()
+
+    def test_sampling_overhead_disabled_vs_enabled(self, tiny):
+        # not a perf assertion (CI noise), just the contract that a
+        # disabled tracer records nothing while the engine still serves
+        engine = make_engine(tiny, request_trace={"enabled": False})
+        rng = np.random.default_rng(3)
+        vocab = tiny[0].config.vocab_size
+        engine.put([1], [rng.integers(0, vocab, (12,)).astype(np.int32)],
+                   max_new_tokens=6)
+        out = engine.generate_all()
+        assert len(out[1]) == 6
+        assert engine.request_traces() == []
+        assert engine.snapshot()["request_trace"]["enabled"] is False

@@ -1,0 +1,170 @@
+"""Serving trace viewer: the "why did p99 miss" report from a trace dump.
+
+The serving engine's RequestTracer (observability/request_trace.py)
+keeps a tail-sampled ring of finished request traces — every SLO
+violator plus a random slice of the healthy bulk. ``make serve-slo``
+with ``SLO_TRACE=1`` (and any embedding application via
+``tracer.dump_jsonl()``) writes that ring as a JSON-lines file; this
+tool is the read side — pure host code, no jax:
+
+  python tools/serve_top.py TRACES.jsonl                # attribution table
+  python tools/serve_top.py TRACES.jsonl --json         # raw report dict
+  python tools/serve_top.py TRACES.jsonl --deadline-ms 500
+  python tools/serve_top.py TRACES.jsonl --worst 5      # slowest requests
+  python tools/serve_top.py TRACES.jsonl --chrome-trace --out lanes.json
+                                                        # Perfetto export
+  python tools/serve_top.py --demo                      # CPU demo run
+
+The table decomposes each request's TTFT and e2e wall time into
+queue_wait / prefill / decode / preempted / spec_overhead phases and
+names the dominant phase of every missed request — the answer to "what
+do I fix first" (docs/serving.md "Request tracing & SLO attribution").
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+from deepspeed_tpu.observability.request_trace import (
+    load_traces_jsonl, slo_attribution, slo_attribution_markdown)
+
+
+def parse_args(argv=None):
+    p = argparse.ArgumentParser(prog="serve_top")
+    p.add_argument("traces", nargs="?",
+                   help="request-trace JSON-lines file "
+                        "(RequestTracer.dump_jsonl / make serve-slo "
+                        "SLO_TRACE=1 output)")
+    p.add_argument("--deadline-ms", type=float, default=None,
+                   help="TTFT SLO deadline; default: the deadline "
+                        "stamped in the trace file, if any")
+    p.add_argument("--json", action="store_true",
+                   help="emit the raw attribution report dict as JSON")
+    p.add_argument("--worst", type=int, default=0, metavar="N",
+                   help="also list the N slowest-TTFT requests with "
+                        "their phase split")
+    p.add_argument("--chrome-trace", action="store_true",
+                   help="export per-request Perfetto lanes and exit")
+    p.add_argument("--out", default="request_lanes.json",
+                   help="output path for --chrome-trace")
+    p.add_argument("--demo", action="store_true",
+                   help="run a small CPU serve_step workload through the "
+                        "v2 engine and print its attribution table")
+    return p.parse_args(argv)
+
+
+def _stamped_deadline_ms(path: str):
+    """Recover the SLO deadline dump_jsonl stamps on every line."""
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                return json.loads(line).get("slo_deadline_ms")
+            except json.JSONDecodeError:
+                return None
+    return None
+
+
+def _worst_table(traces, n: int) -> str:
+    scored = [t for t in traces if t.ttft_s is not None]
+    scored.sort(key=lambda t: -t.ttft_s)
+    lines = ["", f"### {min(n, len(scored))} slowest requests (by TTFT)", "",
+             "| trace | ttft (ms) | e2e (ms) | preempts | "
+             "dominant ttft phase | phase split (ms) |",
+             "|---|---|---|---|---|---|"]
+    for t in scored[:n]:
+        tph = t.ttft_phases()
+        dom = max(tph, key=lambda k: tph[k]) if any(tph.values()) else "-"
+        split = " ".join(f"{k}={v * 1e3:.1f}"
+                         for k, v in t.phases().items() if v > 0)
+        lines.append(f"| {t.trace_id} | {t.ttft_s * 1e3:.1f} | "
+                     f"{(t.e2e_s or 0) * 1e3:.1f} | {t.preemptions} | "
+                     f"{dom} | {split} |")
+    return "\n".join(lines)
+
+
+def _run_demo() -> int:
+    """Tiny-model serving burst on CPU: more offered load than the KV
+    pool fits, so the queue/preemption paths actually show up in the
+    table. Everything stays in-process."""
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import numpy as np
+
+    from deepspeed_tpu.inference.engine_v2 import InferenceEngineV2
+    from deepspeed_tpu.models.zoo import get_model
+
+    import jax.numpy as jnp
+
+    model = get_model("tiny", dtype=jnp.float32, param_dtype=jnp.float32)
+    deadline_ms = 200.0
+    # 20-block pool vs 10 requests growing to ~7 blocks each: the pool
+    # exhausts mid-decode, so the table shows real preempt round trips
+    engine = InferenceEngineV2(
+        model, kv_blocks=20, kv_block_size=8, max_tokens_per_step=32,
+        max_seqs_per_step=4, max_blocks_per_seq=16, prefix_cache=True,
+        spec_decode=True,
+        request_trace={"sample_rate": 1.0,
+                       "slo_deadline_ms": deadline_ms})
+    rng = np.random.default_rng(0)
+    shared = rng.integers(0, model.config.vocab_size, (16,))
+    prompts = [np.concatenate([shared, rng.integers(
+        0, model.config.vocab_size, (8,))]).astype(np.int32)
+        for _ in range(10)]
+    engine.put(list(range(len(prompts))), prompts, max_new_tokens=40)
+    engine.generate_all()
+    traces = engine.tracer.finished()
+    rep = slo_attribution(traces, deadline_s=deadline_ms / 1e3)
+    print(slo_attribution_markdown(rep))
+    print(_worst_table(traces, 3))
+    snap = engine.snapshot()
+    print(f"\n=> {rep['requests']} requests traced "
+          f"({snap['request_trace']['kept']} kept, "
+          f"{snap['stats']['preempted']} preemptions, "
+          f"prefix hits {snap['stats']['prefix_hit_tokens']} tokens)")
+    return 0
+
+
+def main(argv=None) -> int:
+    args = parse_args(argv)
+    if args.demo:
+        return _run_demo()
+    if not args.traces:
+        print("serve_top: error: no trace file (or --demo)",
+              file=sys.stderr)
+        return 2
+    traces = load_traces_jsonl(args.traces)
+    if not traces:
+        print(f"serve_top: no traces in {args.traces}", file=sys.stderr)
+        return 1
+    if args.deadline_ms is None:
+        args.deadline_ms = _stamped_deadline_ms(args.traces)
+    if args.chrome_trace:
+        from deepspeed_tpu.observability.chrome_trace import \
+            export_request_traces
+
+        export_request_traces(args.out, traces)
+        print(f"wrote {len(traces)} request lanes to {args.out} "
+              f"(open in Perfetto or chrome://tracing)")
+        return 0
+    report = slo_attribution(traces, deadline_s=(
+        args.deadline_ms / 1e3 if args.deadline_ms is not None else None))
+    if args.json:
+        print(json.dumps(report, sort_keys=True))
+        return 0
+    print(slo_attribution_markdown(report))
+    if args.worst:
+        print(_worst_table(traces, args.worst))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
