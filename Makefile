@@ -14,7 +14,7 @@ NPROC := $(shell nproc)
 XDIST ?= $(shell if [ $(NPROC) -gt 2 ] && python -c "import xdist" 2>/dev/null; then echo "-n $$(( $(NPROC) - 1 )) --dist loadfile"; fi)
 PYTEST ?= python -m pytest
 
-.PHONY: test smoke slow bench bench-real bench-proxy bench-hostgap bench-overlap bench-longctx fleet-demo chaos serve-slo
+.PHONY: test smoke slow bench bench-real bench-proxy bench-hostgap bench-overlap bench-longctx fleet-demo chaos serve-slo serve-fleet
 
 smoke:
 	$(PYTEST) tests/ -q -m "not slow" $(XDIST)
@@ -82,6 +82,21 @@ bench-hostgap:
 # (docs/serving.md).
 serve-slo:
 	BENCH_MODE=serve_slo SLO_COMPARE=1 SLO_TRACE=1 python bench.py
+
+# Multi-replica serving fleet (tools/serve_bench.py run_fleet): the SAME
+# open-loop Poisson workload served by a unified fleet (every replica
+# prefills + decodes) and a disaggregated fleet (prefill replicas hand
+# KV blocks to decode replicas — serving/disagg.py). One JSON line per
+# arm: tokens/s, TTFT p50/p99 from scheduled arrival, the decode-pool
+# per-token p99 (the disagg win: decode never waits behind a prompt),
+# handoff counts, per-replica breakdown. Each arm writes the fleet
+# snapshot for `python tools/serve_top.py --fleet <snap.json>` plus
+# per-replica Perfetto lanes into FLEET_TRACE_DIR (default
+# /tmp/dstpu_serve_fleet). Replicas are in-process threads — runs on
+# CPU CI; scale with FLEET_REPLICAS/FLEET_REQUESTS/FLEET_RATE
+# (docs/serving.md "Multi-replica fleet").
+serve-fleet:
+	BENCH_MODE=serve_fleet python bench.py
 
 # Fault-injection drill on the 8-device CPU sim: SIGKILL a training rank
 # mid-run, let the elastic agent restart it, and assert the auto-resumed

@@ -296,20 +296,27 @@ def overlap_report(model, step_ms, overlap_depth, streaming,
 
 
 def main():
-    if os.environ.get("BENCH_MODE") in ("serve", "serve_slo"):
+    if os.environ.get("BENCH_MODE") in ("serve", "serve_slo",
+                                        "serve_fleet"):
         # serving benchmarks instead of the training headline
         # (tools/serve_bench.py): "serve" is the closed-loop v2-vs-v1
         # throughput comparison (SERVE_* env knobs); "serve_slo" is the
         # open-loop Poisson-arrival SLO harness — p50/p99 TTFT, goodput
         # under deadline, queue-depth timeline (SLO_* env knobs,
-        # SLO_COMPARE=1 for the no-spec/no-prefix-cache baseline)
+        # SLO_COMPARE=1 for the no-spec/no-prefix-cache baseline);
+        # "serve_fleet" is the multi-replica router bench — unified vs
+        # disaggregated prefill/decode arms over the same open-loop
+        # workload, one JSON line per arm (FLEET_* env knobs)
         import sys
 
         sys.path.insert(0, os.path.join(os.path.dirname(
             os.path.abspath(__file__)), "tools"))
         import serve_bench
 
-        if os.environ.get("BENCH_MODE") == "serve_slo":
+        if os.environ.get("BENCH_MODE") == "serve_fleet":
+            for arm_result in serve_bench.run_fleet():
+                print(json.dumps(arm_result))
+        elif os.environ.get("BENCH_MODE") == "serve_slo":
             print(json.dumps(serve_bench.run_slo()))
         else:
             print(json.dumps(serve_bench.run()))

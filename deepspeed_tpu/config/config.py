@@ -620,6 +620,69 @@ class ResilienceConfig(ConfigModel):
 
 @register_config_model
 @dataclass
+class RouterConfig(ConfigModel):
+    """Serving-fleet router knobs (serving/router.py, docs/serving.md
+    "Multi-replica fleet").
+
+    ``replicas`` sizes the in-process fleet the harness builds; ``mode``
+    is ``"unified"`` (every replica prefills and decodes) or
+    ``"disagg"`` (``prefill_replicas`` of the fleet prefill only, the
+    rest decode only, with the KV-block handoff in between).
+    ``affinity_blocks`` is the prefix-hash session-affinity window in KV
+    blocks (0 disables affinity routing); ``stale_after_seconds`` is the
+    heartbeat staleness that declares a replica dead and triggers
+    failover. The ``autoscale_*``/``queue_*``/``slo_miss_high``/
+    ``hysteresis_rounds`` knobs parameterize the desired-replica-count
+    signal (serving/autoscale.py) — metrics only, never provisioning."""
+
+    replicas: int = 2
+    mode: str = "unified"
+    prefill_replicas: int = 1
+    affinity_blocks: int = 2
+    stale_after_seconds: float = 5.0
+    autoscale_min: int = 1
+    autoscale_max: int = 8
+    queue_high: float = 4.0
+    queue_low: float = 0.5
+    slo_miss_high: float = 0.1
+    hysteresis_rounds: int = 3
+
+    def validate(self) -> None:
+        if self.mode not in ("unified", "disagg"):
+            raise ValueError(
+                f"serving.router.mode must be 'unified' or 'disagg', "
+                f"got {self.mode!r}")
+        if self.replicas < 1:
+            raise ValueError(
+                f"serving.router.replicas must be >= 1, got "
+                f"{self.replicas}")
+        if self.mode == "disagg" and not (
+                1 <= self.prefill_replicas < self.replicas):
+            raise ValueError(
+                f"serving.router.prefill_replicas must leave at least "
+                f"one decode replica (1 <= prefill_replicas < replicas),"
+                f" got {self.prefill_replicas} of {self.replicas}")
+        if self.affinity_blocks < 0:
+            raise ValueError(
+                f"serving.router.affinity_blocks must be >= 0, got "
+                f"{self.affinity_blocks}")
+        if self.stale_after_seconds <= 0:
+            raise ValueError(
+                f"serving.router.stale_after_seconds must be > 0, got "
+                f"{self.stale_after_seconds}")
+        if not 1 <= self.autoscale_min <= self.autoscale_max:
+            raise ValueError(
+                f"serving.router needs 1 <= autoscale_min <= "
+                f"autoscale_max, got ({self.autoscale_min}, "
+                f"{self.autoscale_max})")
+        if self.hysteresis_rounds < 1:
+            raise ValueError(
+                f"serving.router.hysteresis_rounds must be >= 1, got "
+                f"{self.hysteresis_rounds}")
+
+
+@register_config_model
+@dataclass
 class ServingConfig(ConfigModel):
     """Serving-engine knobs (inference/engine_v2.py, docs/serving.md).
 
@@ -646,6 +709,7 @@ class ServingConfig(ConfigModel):
     spec_k: int = 4
     spec_ngram: int = 3
     decode_steps: int = 8
+    router: RouterConfig = field(default_factory=RouterConfig)
 
     def validate(self) -> None:
         if self.max_queue_depth is not None and self.max_queue_depth < 1:
@@ -658,6 +722,7 @@ class ServingConfig(ConfigModel):
                 raise ValueError(
                     f"serving.{name} must be >= {lo}, got "
                     f"{getattr(self, name)}")
+        self.router.validate()
 
 
 @register_config_model

@@ -6,7 +6,9 @@ v2/engine_v2.py:30 InferenceEngineV2).
 
 from deepspeed_tpu.inference.engine import InferenceEngine, init_inference
 from deepspeed_tpu.inference.engine_v2 import InferenceEngineV2
-from deepspeed_tpu.inference.spec_decode import Drafter, PromptLookupDrafter
+from deepspeed_tpu.inference.spec_decode import (Drafter,
+                                                 PromptLookupDrafter,
+                                                 TransformerDrafter)
 
 __all__ = ["Drafter", "InferenceEngine", "InferenceEngineV2",
-           "PromptLookupDrafter", "init_inference"]
+           "PromptLookupDrafter", "TransformerDrafter", "init_inference"]

@@ -55,6 +55,46 @@ class _QueuedRequest:
     requeued: bool = False
 
 
+# Process-level jit cache shared by every engine instance. A fleet of
+# replicas (serving/) builds N engines over the SAME model config, and
+# per-instance ``jax.jit(partial(...))`` wrappers would compile the
+# identical step programs N times — key the wrapped callables by
+# (config identity, kernel mesh) so replica N+1 reuses replica 0's
+# executables. Entries hold a strong ref to the config, so an id()
+# key can never alias a collected object.
+_JIT_CACHE: Dict[Any, Tuple[Any, Dict[str, Any]]] = {}
+
+
+def _shared_step_fns(cfg, kernel_mesh):
+    key = (id(cfg), kernel_mesh)
+    hit = _JIT_CACHE.get(key)
+    if hit is not None and hit[0] is cfg:
+        return hit[1]
+    fns = {
+        "step": jax.jit(partial(model_runner.ragged_forward, cfg)),
+        "decode": jax.jit(partial(
+            model_runner.ragged_decode_forward, cfg, mesh=kernel_mesh)),
+        "prefill": jax.jit(partial(
+            model_runner.ragged_prefill_forward, cfg, mesh=kernel_mesh)),
+        "multi_decode": jax.jit(partial(
+            model_runner.ragged_multi_decode, cfg, mesh=kernel_mesh),
+            static_argnames=("steps",)),
+    }
+    _JIT_CACHE[key] = (cfg, fns)
+    return fns
+
+
+# device-side token picks are config-independent — one compiled copy
+# per process, not per engine
+_PICK_GREEDY = jax.jit(lambda lg, idx: jnp.argmax(
+    lg.reshape(-1, lg.shape[-1])[idx].astype(jnp.float32),
+    axis=-1).astype(jnp.int32))
+_TAKE_ROWS = jax.jit(lambda lg, idx: lg.reshape(-1, lg.shape[-1])[idx])
+_PICK_GREEDY_ALL = jax.jit(lambda lg: jnp.argmax(
+    lg.reshape(-1, lg.shape[-1]).astype(jnp.float32),
+    axis=-1).astype(jnp.int32))
+
+
 class InferenceEngineV2:
     def __init__(self, model: TransformerLM, mesh: Optional[Mesh] = None,
                  params: Optional[Dict[str, Any]] = None,
@@ -68,7 +108,8 @@ class InferenceEngineV2:
                  spec_ngram: int = 3, drafter: Optional[Any] = None,
                  max_queue_depth: Optional[int] = None,
                  serving: Optional[Any] = None,
-                 request_trace: Optional[Any] = None):
+                 request_trace: Optional[Any] = None,
+                 metric_labels: Optional[Dict[str, str]] = None):
         from deepspeed_tpu.inference.engine import InferenceEngine
 
         if serving is not None:
@@ -105,8 +146,13 @@ class InferenceEngineV2:
         # shared-prefix KV reuse: full blocks whose content-hash chain
         # matches a cached prefix are shared by reference and skip
         # prefill (ragged/prefix_cache.py; docs/serving.md)
+        # per-replica metric labels: a fleet of engines in one process
+        # (serving/) tags every serve.* series with its replica id so
+        # aggregation never collapses replicas into one series
+        self._metric_labels = dict(metric_labels) if metric_labels else None
         if prefix_cache:
-            self.kv_cache.prefix_cache = PrefixCache(kv_block_size)
+            self.kv_cache.prefix_cache = PrefixCache(
+                kv_block_size, metric_labels=self._metric_labels)
 
         self.state = StateManager(self.kv_cache,
                                   max_tracked_sequences=4 * max_seqs_per_step,
@@ -116,7 +162,6 @@ class InferenceEngineV2:
         self.max_tokens = max_tokens_per_step
         self.max_seqs = max_seqs_per_step
         self.max_blocks_per_seq = max_blocks_per_seq
-        self._step_fn = jax.jit(partial(model_runner.ragged_forward, self.cfg))
         # decode-only steps use the Pallas paged-attention kernel (no
         # per-token context gather). On any multi-device mesh the kernel
         # runs inside a shard_map — manual over tp (q heads / KV heads
@@ -167,12 +212,17 @@ class InferenceEngineV2:
             get_flight_recorder, install_crash_handlers)
 
         self._hub = get_hub()
-        self._ttft_hist = self._hub.histogram("serve.ttft_seconds")
-        self._decode_hist = self._hub.histogram("serve.decode_token_seconds")
-        self._step_hist = self._hub.histogram("serve.step_seconds")
+        lbl = self._metric_labels
+        self._ttft_hist = self._hub.histogram("serve.ttft_seconds",
+                                              labels=lbl)
+        self._decode_hist = self._hub.histogram("serve.decode_token_seconds",
+                                                labels=lbl)
+        self._step_hist = self._hub.histogram("serve.step_seconds",
+                                              labels=lbl)
         self._admission_hist = self._hub.histogram(
-            "serve.admission_wait_seconds")
-        self._spec_hist = self._hub.histogram("serve.spec_accepted_len")
+            "serve.admission_wait_seconds", labels=lbl)
+        self._spec_hist = self._hub.histogram("serve.spec_accepted_len",
+                                              labels=lbl)
         # serving shares the crash flight recorder: a wedged serve step
         # dumps the last admits/steps the same way a training hang does
         self._flight = get_flight_recorder()
@@ -194,35 +244,29 @@ class InferenceEngineV2:
         self._burst_tokens = 0
         self._burst_capacity = 0
         kernel_mesh = None if single else self.mesh
-        self._decode_fn = jax.jit(partial(
-            model_runner.ragged_decode_forward, self.cfg,
-            mesh=kernel_mesh))
-        self._prefill_fn = jax.jit(partial(
-            model_runner.ragged_prefill_forward, self.cfg,
-            mesh=kernel_mesh))
+        # all four step programs come from the process-level cache
+        # (_shared_step_fns) so a fleet of same-config replicas compiles
+        # each program once, not once per engine
+        _fns = _shared_step_fns(self.cfg, kernel_mesh)
+        self._step_fn = _fns["step"]
+        self._decode_fn = _fns["decode"]
+        self._prefill_fn = _fns["prefill"]
         # device-side token pick: the step fetches only sampled ids (or
         # the consumed rows when temperature > 0), never the full [T, V]
         # logits buffer (see step())
-        self._pick_greedy = jax.jit(lambda lg, idx: jnp.argmax(
-            lg.reshape(-1, lg.shape[-1])[idx].astype(jnp.float32),
-            axis=-1).astype(jnp.int32))
-        self._take_rows = jax.jit(
-            lambda lg, idx: lg.reshape(-1, lg.shape[-1])[idx])
+        self._pick_greedy = _PICK_GREEDY
+        self._take_rows = _TAKE_ROWS
         # speculative verification consumes the greedy id of EVERY chunk
         # row (draft j is accepted iff it equals row j-1's argmax), so
         # fetch all T ids in one device round trip — still 4 bytes/row,
         # never the [T, V] logits
-        self._pick_greedy_all = jax.jit(lambda lg: jnp.argmax(
-            lg.reshape(-1, lg.shape[-1]).astype(jnp.float32),
-            axis=-1).astype(jnp.int32))
+        self._pick_greedy_all = _PICK_GREEDY_ALL
         # multi-step greedy decode: one device program per `decode_steps`
         # tokens when every live sequence is in steady decode
         # (model_runner.ragged_multi_decode; decode_steps=1 restores
         # strict per-token SplitFuse admission)
         self.decode_steps = max(1, int(decode_steps))
-        self._multi_decode_fn = jax.jit(partial(
-            model_runner.ragged_multi_decode, self.cfg, mesh=kernel_mesh),
-            static_argnames=("steps",))
+        self._multi_decode_fn = _fns["multi_decode"]
         log_dist(
             f"InferenceEngineV2: kv_blocks={kv_blocks}x{kv_block_size} "
             f"budget={max_tokens_per_step}tok/{max_seqs_per_step}seq",
@@ -291,11 +335,12 @@ class InferenceEngineV2:
                 uid=uid, tokens=toks, max_new_tokens=max_new_tokens,
                 enqueue_time=now, admit_time=now))
             self.stats["queued"] += 1
-            self._hub.counter_add("serve.requests")
+            self._hub.counter_add("serve.requests", labels=self._metric_labels)
             self.tracer.on_enqueue(uid, len(toks),
                                    queue_depth=len(self._queue))
         self._admit_from_queue()
-        self._hub.gauge("serve.queue_wait_depth", len(self._queue))
+        self._hub.gauge("serve.queue_wait_depth", len(self._queue),
+                        labels=self._metric_labels)
 
     def _admit_from_queue(self) -> None:
         """Admit waiting requests strictly FIFO while capacity lasts.
@@ -313,13 +358,15 @@ class InferenceEngineV2:
             skipped = self.state.attach_prefix(seq)
             if skipped:
                 self.stats["prefix_hit_tokens"] += skipped
-                self._hub.counter_add("serve.prefix_hit_tokens", skipped)
+                self._hub.counter_add("serve.prefix_hit_tokens", skipped,
+                                       labels=self._metric_labels)
                 self.tracer.on_prefix_hit(req.uid, skipped)
             if req.admit_time is not None:
                 self._admit_time[req.uid] = req.admit_time
             self._admission_hist.observe(now - req.enqueue_time)
             self.stats["admitted"] += 1
-        self._hub.gauge("serve.queue_wait_depth", len(self._queue))
+        self._hub.gauge("serve.queue_wait_depth", len(self._queue),
+                        labels=self._metric_labels)
 
     def _release_seq(self, uid: int) -> Optional[float]:
         """The ONE sequence-teardown path: frees state + KV and pops the
@@ -369,9 +416,11 @@ class InferenceEngineV2:
         self.stats["preempt_reasons"][reason] = \
             self.stats["preempt_reasons"].get(reason, 0) + 1
         self.stats["requeued"] += 1
-        self._hub.counter_add("serve.preempted")
-        self._hub.counter_add(f"serve.preempted_reason.{reason}")
-        self._hub.gauge("serve.queue_wait_depth", len(self._queue))
+        self._hub.counter_add("serve.preempted", labels=self._metric_labels)
+        self._hub.counter_add(f"serve.preempted_reason.{reason}",
+                              labels=self._metric_labels)
+        self._hub.gauge("serve.queue_wait_depth", len(self._queue),
+                        labels=self._metric_labels)
 
     def step(self, temperature: float = 0.0, seed: int = 0,
              eos_token_id: Optional[int] = None) -> Dict[int, int]:
@@ -577,7 +626,8 @@ class InferenceEngineV2:
         span for the phase decomposition."""
         self.tracer.on_emit(uid, n_tokens,
                             spec_overhead_ms=spec_overhead_ms)
-        self._hub.counter_add("serve.tokens_emitted", n_tokens)
+        self._hub.counter_add("serve.tokens_emitted", n_tokens,
+                              labels=self._metric_labels)
         admit = self._admit_time.pop(uid, None)
         last = self._last_emit_time.get(uid)
         if admit is not None:
@@ -592,23 +642,31 @@ class InferenceEngineV2:
 
     def _update_serve_gauges(self) -> None:
         live = [s for s in self.state.seqs.values() if not s.done]
-        self._hub.gauge("serve.queue_depth", len(live))
-        self._hub.gauge("serve.queue_wait_depth", len(self._queue))
+        self._hub.gauge("serve.queue_depth", len(live),
+                        labels=self._metric_labels)
+        self._hub.gauge("serve.queue_wait_depth", len(self._queue),
+                        labels=self._metric_labels)
         self._hub.gauge("serve.pending_prefill_tokens",
-                        sum(s.pending_prefill for s in live))
-        self._hub.gauge("serve.kv_free_blocks", self.kv_cache.free_blocks)
+                        sum(s.pending_prefill for s in live),
+                        labels=self._metric_labels)
+        self._hub.gauge("serve.kv_free_blocks", self.kv_cache.free_blocks,
+                        labels=self._metric_labels)
         if self.kv_cache.prefix_cache is not None:
             self._hub.gauge("serve.prefix_cached_blocks",
-                            self.kv_cache.prefix_cache.cached_blocks)
+                            self.kv_cache.prefix_cache.cached_blocks,
+                            labels=self._metric_labels)
         self._hub.gauge("serve.batch_seq_occupancy",
                         self.scheduler.last_scheduled_seqs
-                        / max(1, self.max_seqs))
+                        / max(1, self.max_seqs),
+                        labels=self._metric_labels)
         self._hub.gauge("serve.batch_token_occupancy",
                         self.scheduler.last_scheduled_tokens
-                        / max(1, self.max_tokens))
+                        / max(1, self.max_tokens),
+                        labels=self._metric_labels)
         if self._burst_capacity > 0:
             self._hub.gauge("serve.burst_efficiency",
-                            self._burst_tokens / self._burst_capacity)
+                            self._burst_tokens / self._burst_capacity,
+                            labels=self._metric_labels)
 
     def _try_decode_burst(self, eos_token_id: Optional[int]
                           ) -> Optional[Dict[int, List[int]]]:
@@ -781,9 +839,11 @@ class InferenceEngineV2:
             # drafted/accepted COUNTERS (not just the accepted-len
             # histogram) so the acceptance *rate* is derivable on the
             # Prometheus page: accepted_tokens / drafted_tokens
-            self._hub.counter_add("serve.spec_drafted_tokens", n - 1)
+            self._hub.counter_add("serve.spec_drafted_tokens", n - 1,
+                                  labels=self._metric_labels)
             self._hub.counter_add("serve.spec_accepted_tokens",
-                                  len(emit) - 1)
+                                  len(emit) - 1,
+                                  labels=self._metric_labels)
             self.tracer.on_spec(s.uid, drafted=n - 1,
                                 accepted=len(emit) - 1)
             self._spec_hist.observe(len(emit) - 1)

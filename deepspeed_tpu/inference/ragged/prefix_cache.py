@@ -49,8 +49,10 @@ class PrefixCache:
     """Content-addressed registry of full KV blocks with refcounts and
     LRU eviction of idle entries."""
 
-    def __init__(self, block_size: int):
+    def __init__(self, block_size: int,
+                 metric_labels: Optional[Dict[str, str]] = None):
         self.block_size = int(block_size)
+        self._metric_labels = dict(metric_labels) if metric_labels else None
         self._block_of: Dict[str, int] = {}      # key -> block id
         self._refs: Dict[str, int] = {}          # key -> live holders
         self._idle: "OrderedDict[str, int]" = OrderedDict()  # LRU, ref==0
@@ -88,14 +90,22 @@ class PrefixCache:
             keys.append(key)
             blocks.append(blk)
             prev = key
-        self._hub.counter_add("serve.prefix_lookups")
+        self._hub.counter_add("serve.prefix_lookups",
+                              labels=self._metric_labels)
         if keys:
             self.stats["hits"] += 1
             self.stats["hit_tokens"] += len(keys) * bs
         else:
             self.stats["misses"] += 1
-            self._hub.counter_add("serve.prefix_misses")
+            self._hub.counter_add("serve.prefix_misses",
+                                  labels=self._metric_labels)
         return keys, blocks
+
+    def get(self, key: str) -> Optional[int]:
+        """Block id cached under ``key`` (no ref taken), or None. The
+        disaggregation handoff codec (serving/disagg.py) uses this to
+        skip installing blocks the target replica already holds."""
+        return self._block_of.get(key)
 
     def ref(self, keys: Sequence[str]) -> None:
         for key in keys:
@@ -162,7 +172,8 @@ class PrefixCache:
             out.append(blk)
         self.stats["evicted"] += len(out)
         if out:
-            self._hub.counter_add("serve.prefix_evicted_blocks", len(out))
+            self._hub.counter_add("serve.prefix_evicted_blocks", len(out),
+                                  labels=self._metric_labels)
         return out
 
     def snapshot(self) -> Dict[str, int]:

@@ -22,7 +22,8 @@ to host. ``Drafter`` is the hook for a real draft model: anything with
 
 from __future__ import annotations
 
-from typing import List, Protocol, Sequence, runtime_checkable
+from typing import Any, List, Optional, Protocol, Sequence, \
+    runtime_checkable
 
 
 @runtime_checkable
@@ -77,3 +78,73 @@ class PromptLookupDrafter:
                         return cont
         self.stats["empty"] += 1
         return []
+
+
+class TransformerDrafter:
+    """Real draft model behind the ``Drafter`` protocol: a (small)
+    ``TransformerConfig`` model rolled out greedily for ``k`` tokens.
+
+    The engine's acceptance rule makes correctness independent of the
+    draft: any proposal stream yields bit-identical greedy output, so a
+    cheap model here only changes how many tokens one verify forward
+    emits. The rollout runs the drafter densely over a fixed-size
+    right-padded window (causal attention makes right padding inert for
+    the position being read), so one ``jax.jit`` compilation covers
+    every history length — no per-length retraces in the serve loop.
+    History longer than the window keeps only the trailing ``window``
+    tokens (draft quality degrades gracefully; acceptance still gates).
+    """
+
+    def __init__(self, model: Any, params: Optional[Any] = None,
+                 window: int = 64, seed: int = 0):
+        import jax
+
+        self.model = model
+        self.window = int(window)
+        if self.window < 2:
+            raise ValueError(f"window must be >= 2, got {window}")
+        if params is None:
+            params = model.init(jax.random.PRNGKey(seed))
+        self.params = params
+        self._apply = jax.jit(lambda p, t: model.apply(p, t))
+        self.stats = {"calls": 0, "proposals": 0, "proposed_tokens": 0,
+                      "empty": 0}
+
+    @classmethod
+    def small(cls, vocab_size: int, window: int = 64, hidden: int = 32,
+              layers: int = 1, heads: int = 2, seed: int = 0
+              ) -> "TransformerDrafter":
+        """A from-scratch tiny draft model sharing only the vocabulary
+        with the target (the usual deployment shape: a model an order of
+        magnitude smaller than the one being served)."""
+        from deepspeed_tpu.models.transformer import (TransformerConfig,
+                                                      TransformerLM)
+
+        cfg = TransformerConfig(
+            vocab_size=int(vocab_size), hidden_size=hidden,
+            num_layers=layers, num_heads=heads,
+            max_seq_len=max(int(window), 16), remat=False)
+        return cls(TransformerLM(cfg), window=window, seed=seed)
+
+    def propose(self, tokens: Sequence[int], k: int) -> List[int]:
+        import jax.numpy as jnp
+        import numpy as np
+
+        self.stats["calls"] += 1
+        if k <= 0 or not len(tokens):
+            self.stats["empty"] += 1
+            return []
+        ctx = [int(t) for t in tokens]
+        vocab = self.model.config.vocab_size
+        out: List[int] = []
+        for _ in range(int(k)):
+            hist = ctx[-self.window:]
+            buf = np.zeros((1, self.window), np.int32)
+            buf[0, :len(hist)] = np.asarray(hist, np.int32) % vocab
+            logits = self._apply(self.params, jnp.asarray(buf))
+            nxt = int(np.asarray(logits[0, len(hist) - 1]).argmax())
+            out.append(nxt)
+            ctx.append(nxt)
+        self.stats["proposals"] += 1
+        self.stats["proposed_tokens"] += len(out)
+        return out

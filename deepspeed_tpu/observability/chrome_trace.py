@@ -201,6 +201,30 @@ def export_request_traces(path: str, traces,
     return path
 
 
+def export_fleet_request_traces(path: str, traces_by_replica) -> str:
+    """Write one Perfetto file with a lane group (pid) per serving
+    replica: ``traces_by_replica`` maps replica id (int) -> finished
+    request traces. All replicas share one wall-clock ``t0``, so a
+    request that fails over (or hands off prefill→decode) shows its two
+    halves aligned across the replica lanes."""
+    all_spans = [t.spans[0].ts
+                 for traces in traces_by_replica.values()
+                 for t in traces if t.spans]
+    t0 = min(all_spans, default=0.0)
+    evs: List[Dict[str, Any]] = []
+    for rid in sorted(traces_by_replica):
+        evs.append({"name": "process_name", "ph": "M", "pid": rid,
+                    "args": {"name": f"replica r{rid}"}})
+        evs += request_trace_events(traces_by_replica[rid], rank=rid, t0=t0)
+    d = os.path.dirname(os.path.abspath(path))
+    os.makedirs(d, exist_ok=True)
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        json.dump({"traceEvents": evs, "displayTimeUnit": "ms"}, f)
+    os.replace(tmp, path)
+    return path
+
+
 def export_chrome_trace(path: str,
                         step_rows: Optional[Iterable[Dict[str, Any]]] = None,
                         flight_events: Optional[

@@ -43,6 +43,7 @@ from deepspeed_tpu.utils.logging import logger
 HEARTBEAT_DIR = "heartbeat"
 STEPS_DIR = "steps"
 FLIGHT_DIR = "flight"
+REPLICAS_DIR = "replicas"  # serving fleet load reports (serving/replica.py)
 
 # EWMA straggler score above which a rank is named the straggler (1.0 =
 # exactly the per-step minimum; 1.15 = persistently 15% slower than the
@@ -284,6 +285,56 @@ class FleetAggregator:
             "straggler": straggler,
             "dead_ranks": dead,
         }
+
+
+class ReplicaPublisher:
+    """Serving-replica load reports over the same run-dir discipline as
+    the rank heartbeats: one atomically rewritten JSON per replica under
+    ``<run_dir>/replicas/``. The report doc *is* the heartbeat — its
+    ``ts`` doubles as liveness, so the router's stale-heartbeat failover
+    and an external ``serve_top --fleet`` read the same file. Write
+    failures disable the publisher (serving must not die with the
+    shared filesystem)."""
+
+    def __init__(self, run_dir: str, replica_id: int):
+        self.run_dir = run_dir
+        self.replica_id = int(replica_id)
+        self._failed = False
+        try:
+            os.makedirs(os.path.join(run_dir, REPLICAS_DIR), exist_ok=True)
+            self._path = os.path.join(
+                run_dir, REPLICAS_DIR, f"replica_{self.replica_id:05d}.json")
+        except Exception as e:
+            self._failed = True
+            logger.warning(f"replica publisher disabled: {e}")
+
+    def publish(self, report: Dict[str, Any]) -> None:
+        if self._failed:
+            return
+        try:
+            _atomic_write_json(self._path, report)
+        except Exception as e:
+            self._failed = True
+            logger.warning(f"replica publisher disabled after error: {e}")
+
+
+def read_replica_reports(run_dir: str) -> Dict[int, Dict[str, Any]]:
+    """Load every replica's last published load report (read side of
+    ReplicaPublisher; tolerates mid-rewrite and foreign files)."""
+    out: Dict[int, Dict[str, Any]] = {}
+    d = os.path.join(run_dir, REPLICAS_DIR)
+    if not os.path.isdir(d):
+        return out
+    for name in sorted(os.listdir(d)):
+        if not name.endswith(".json") or ".tmp." in name:
+            continue
+        try:
+            with open(os.path.join(d, name)) as f:
+                doc = json.load(f)
+            out[int(doc["replica"])] = doc
+        except Exception:
+            continue
+    return out
 
 
 def _fmt(v, spec: str, width: int) -> str:

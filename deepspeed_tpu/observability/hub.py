@@ -31,6 +31,7 @@ from typing import Any, Dict, Optional
 
 from deepspeed_tpu.observability.histogram import Histogram
 from deepspeed_tpu.observability.sinks import (JSONLSink, PrometheusTextSink,
+                                               labeled_name,
                                                render_prometheus)
 from deepspeed_tpu.observability.step_trace import StepTrace
 from deepspeed_tpu.utils.logging import logger
@@ -127,15 +128,29 @@ class MetricsHub:
             logger.warning(f"fleet publisher unavailable: {e}")
 
     # -- primitive metrics ---------------------------------------------
-    def gauge(self, name: str, value: float) -> None:
+    # ``labels`` composes a distinct series per label set
+    # (``serve.queue_depth{replica="r0"}``) — fleet serving metrics use
+    # it so aggregation never collapses N replicas into one series; the
+    # Prometheus renderer understands the composed keys (sinks.py)
+    def gauge(self, name: str, value: float,
+              labels: Optional[Dict[str, str]] = None) -> None:
+        if labels:
+            name = labeled_name(name, labels)
         with self._lock:
             self.gauges[name] = float(value)
 
-    def counter_add(self, name: str, n: float = 1.0) -> None:
+    def counter_add(self, name: str, n: float = 1.0,
+                    labels: Optional[Dict[str, str]] = None) -> None:
+        if labels:
+            name = labeled_name(name, labels)
         with self._lock:
             self.counters[name] = self.counters.get(name, 0.0) + n
 
-    def histogram(self, name: str, **kw) -> Histogram:
+    def histogram(self, name: str,
+                  labels: Optional[Dict[str, str]] = None,
+                  **kw) -> Histogram:
+        if labels:
+            name = labeled_name(name, labels)
         with self._lock:
             h = self.histograms.get(name)
             if h is None:

@@ -60,7 +60,7 @@ from typing import Any, Callable, Dict, Iterable, List, Optional
 SPAN_KINDS = (
     "ENQUEUE", "ADMIT", "PREFILL", "DECODE_EMIT", "SPEC_DRAFT",
     "SPEC_ACCEPT", "PREFIX_HIT", "PREEMPT", "REQUEUE", "KV_STARVED",
-    "FINISH",
+    "ROUTE", "HANDOFF", "FAILOVER", "FINISH",
 )
 
 PHASES = ("queue_wait", "prefill", "decode", "preempted", "spec_overhead")

@@ -46,6 +46,30 @@ def escape_label_value(value: str) -> str:
             .replace("\n", "\\n"))
 
 
+def labeled_name(name: str, labels: Optional[Dict[str, str]]) -> str:
+    """Compose a registry key that carries Prometheus labels:
+    ``labeled_name('serve.queue_depth', {'replica': 'r0'})`` ->
+    ``serve.queue_depth{replica="r0"}``. Values are escaped here, at
+    composition time, so the renderer can paste the label part through
+    verbatim. Per-replica serving metrics use this so fleet aggregation
+    does not collapse N replicas into one series."""
+    if not labels:
+        return name
+    inner = ",".join(f'{k}="{escape_label_value(v)}"'
+                     for k, v in sorted(labels.items()))
+    return f"{name}{{{inner}}}"
+
+
+def split_labeled_name(name: str):
+    """Inverse of the composition above: ``(base, label_part)`` where
+    ``label_part`` is ``'{...}'`` or ``''``. The base goes through
+    ``prometheus_name`` (which strips braces); the label part does not."""
+    i = name.find("{")
+    if i < 0:
+        return name, ""
+    return name[:i], name[i:]
+
+
 class JSONLSink:
     """Append-and-flush JSON-lines writer (one record per call)."""
 
@@ -100,16 +124,28 @@ def render_prometheus(gauges: Dict[str, float], counters: Dict[str, float],
     the capability-fallback telemetry counters).
     """
     lines = [f"# dstpu metrics snapshot ts={time.time():.3f}"]
+    # registry keys may carry labels (``name{k="v"}``, composed by
+    # labeled_name): the base goes through prometheus_name, the label
+    # part is pasted through (values were escaped at composition time),
+    # and the TYPE line is emitted once per base
+    typed = set()
     for name in sorted(gauges):
-        m = prometheus_name(name)
-        lines.append(f"# TYPE {m} gauge")
-        lines.append(f"{m} {gauges[name]:.6g}")
+        base, label = split_labeled_name(name)
+        m = prometheus_name(base)
+        if m not in typed:
+            typed.add(m)
+            lines.append(f"# TYPE {m} gauge")
+        lines.append(f"{m}{label} {gauges[name]:.6g}")
+    typed = set()
     for name in sorted(counters):
-        m = prometheus_name(name)
+        base, label = split_labeled_name(name)
+        m = prometheus_name(base)
         if not m.endswith("_total"):
             m += "_total"
-        lines.append(f"# TYPE {m} counter")
-        lines.append(f"{m} {counters[name]:.6g}")
+        if m not in typed:
+            typed.add(m)
+            lines.append(f"# TYPE {m} counter")
+        lines.append(f"{m}{label} {counters[name]:.6g}")
     for name, per_label in sorted((labeled_counters or {}).items()):
         m = prometheus_name(name)
         if not m.endswith("_total"):
@@ -118,9 +154,31 @@ def render_prometheus(gauges: Dict[str, float], counters: Dict[str, float],
         for label, v in sorted(per_label.items()):
             lines.append(f'{m}{{name="{escape_label_value(label)}"}} '
                          f'{v:.6g}')
+    typed = set()
     for name, hist in sorted(histograms.items()):
-        lines.extend(hist.prometheus_lines(prometheus_name(name)))
+        base, label = split_labeled_name(name)
+        rendered = hist.prometheus_lines(prometheus_name(base))
+        if label:
+            rendered = [_inject_labels(ln, label[1:-1]) for ln in rendered]
+        for ln in rendered:  # one TYPE line per base across label sets
+            if ln.startswith("# TYPE"):
+                if ln in typed:
+                    continue
+                typed.add(ln)
+            lines.append(ln)
     return "\n".join(lines) + "\n"
+
+
+def _inject_labels(line: str, inner: str) -> str:
+    """Merge ``inner`` (``k="v",...``) into one exposition line: before
+    existing labels (``m_bucket{le="x"} v``) or as a fresh label set
+    (``m_sum v``). Comment lines pass through."""
+    if line.startswith("#"):
+        return line
+    if "{" in line:
+        return line.replace("{", "{" + inner + ",", 1)
+    name, _, rest = line.partition(" ")
+    return f"{name}{{{inner}}} {rest}"
 
 
 class PrometheusTextSink:

@@ -1,0 +1,25 @@
+"""Serving fleet: N engine_v2 replicas behind one router.
+
+Reference analog: DeepSpeed serves FastGen behind MII's replica router
+(``mii.serve`` with ``replica_num``); DistServe/Splitwise motivate the
+prefill/decode disaggregation. Layout:
+
+* ``replica.py`` — one engine + role + heartbeat/load report;
+* ``router.py`` — admission, affinity/least-loaded routing,
+  stale-heartbeat failover, fleet observability;
+* ``disagg.py`` — the KV-block handoff codec between prefill and
+  decode replicas;
+* ``autoscale.py`` — desired-replica-count signals (metrics only).
+
+See docs/serving.md "Multi-replica fleet".
+"""
+
+from deepspeed_tpu.serving.autoscale import AutoscaleSignal
+from deepspeed_tpu.serving.disagg import (KVHandoff, install_prefix,
+                                          serialize_prefix)
+from deepspeed_tpu.serving.replica import ServingReplica, Submission
+from deepspeed_tpu.serving.router import FleetRouter, build_fleet
+
+__all__ = ["AutoscaleSignal", "FleetRouter", "KVHandoff",
+           "ServingReplica", "Submission", "build_fleet",
+           "install_prefix", "serialize_prefix"]

@@ -1,0 +1,116 @@
+"""Autoscaling signals: desired replica count as a metric, not an act.
+
+The router knows everything an autoscaler needs — fleet queue depth,
+SLO-miss rate, goodput trend — but provisioning is an infrastructure
+concern (k8s HPA, GKE, a TPU pod reservation system). So this module
+only *derives the signal*: a desired-replica-count gauge with
+hysteresis, exported through the hub like every other metric
+(``serve.fleet.desired_replicas`` on the Prometheus page), for an
+external controller to act on. This is the same shape as
+node-exporter-style "recommendation" metrics and keeps the repo free of
+any cloud-API dependency.
+
+Inputs per evaluation (the router calls :meth:`update` from its health
+check):
+
+* per-replica queue pressure — waiting requests per alive replica;
+* SLO-miss rate — misses / finishes in the window (the tracer's
+  fleet-level counters);
+* goodput slope — EWMA of the fleet goodput delta, so a *rising* load
+  blocks scale-down even while the queue is momentarily empty.
+
+Hysteresis: a scale decision needs ``hysteresis_rounds`` *consecutive*
+evaluations on the same side of the thresholds, and any contrary
+evaluation resets the streak — the classic guard against flapping on a
+bursty arrival process.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, Optional
+
+
+class AutoscaleSignal:
+    def __init__(self, min_replicas: int = 1, max_replicas: int = 8,
+                 queue_high: float = 4.0, queue_low: float = 0.5,
+                 slo_miss_high: float = 0.1,
+                 hysteresis_rounds: int = 3,
+                 goodput_alpha: float = 0.25, hub=None):
+        if min_replicas < 1 or max_replicas < min_replicas:
+            raise ValueError(
+                f"need 1 <= min_replicas <= max_replicas, got "
+                f"({min_replicas}, {max_replicas})")
+        self.min_replicas = int(min_replicas)
+        self.max_replicas = int(max_replicas)
+        self.queue_high = float(queue_high)
+        self.queue_low = float(queue_low)
+        self.slo_miss_high = float(slo_miss_high)
+        self.hysteresis_rounds = max(1, int(hysteresis_rounds))
+        self._alpha = float(goodput_alpha)
+        self.desired: Optional[int] = None
+        self.goodput_slope = 0.0
+        self._last_goodput: Optional[float] = None
+        self._up_streak = 0
+        self._down_streak = 0
+        self._hub = hub
+        self.history = []  # (ts, desired) decision log for the report
+
+    def update(self, n_replicas: int, queue_wait_depth: float,
+               slo_miss_rate: float, goodput_tokens_per_s: float,
+               now: Optional[float] = None) -> int:
+        """One evaluation; returns the (possibly unchanged) desired
+        replica count and mirrors every signal into hub gauges."""
+        now = time.time() if now is None else now
+        n = max(1, int(n_replicas))
+        if self.desired is None:
+            self.desired = min(max(n, self.min_replicas), self.max_replicas)
+        pressure = float(queue_wait_depth) / n
+        if self._last_goodput is not None:
+            delta = float(goodput_tokens_per_s) - self._last_goodput
+            self.goodput_slope = (self._alpha * delta
+                                  + (1.0 - self._alpha) * self.goodput_slope)
+        self._last_goodput = float(goodput_tokens_per_s)
+
+        hot = (pressure > self.queue_high
+               or float(slo_miss_rate) > self.slo_miss_high)
+        # scale-down also requires non-rising goodput: a draining queue
+        # with climbing throughput means load is arriving, not leaving
+        cold = (pressure < self.queue_low
+                and float(slo_miss_rate) <= self.slo_miss_high / 4.0
+                and self.goodput_slope <= 0.0)
+        if hot:
+            self._up_streak += 1
+            self._down_streak = 0
+            if self._up_streak >= self.hysteresis_rounds:
+                self.desired = min(self.max_replicas, self.desired + 1)
+                self._up_streak = 0
+                self.history.append((now, self.desired))
+        elif cold:
+            self._down_streak += 1
+            self._up_streak = 0
+            if self._down_streak >= self.hysteresis_rounds:
+                if self.desired > self.min_replicas:
+                    self.desired = self.desired - 1
+                    self.history.append((now, self.desired))
+                self._down_streak = 0
+        else:
+            self._up_streak = 0
+            self._down_streak = 0
+
+        if self._hub is not None:
+            self._hub.gauge("serve.fleet.desired_replicas", self.desired)
+            self._hub.gauge("serve.fleet.queue_pressure", pressure)
+            self._hub.gauge("serve.fleet.slo_miss_rate",
+                            float(slo_miss_rate))
+            self._hub.gauge("serve.fleet.goodput_slope", self.goodput_slope)
+        return self.desired
+
+    def snapshot(self) -> Dict[str, Any]:
+        return {
+            "desired_replicas": self.desired,
+            "goodput_slope": round(self.goodput_slope, 3),
+            "up_streak": self._up_streak,
+            "down_streak": self._down_streak,
+            "decisions": list(self.history[-32:]),
+        }

@@ -1,0 +1,153 @@
+"""Prefill/decode disaggregation: the KV-block handoff codec.
+
+Disaggregated serving (DistServe, Splitwise; PAPERS.md) runs prefill and
+decode on different replicas so a long-prompt burst never sits in front
+of another request's next token — decode p99 is isolated by placement,
+not by scheduling heroics. The hard part is moving the prompt's KV from
+the prefill replica to the decode replica. Here the transport is the
+prefix cache's own vocabulary:
+
+* ``serialize_prefix`` — after a prefill replica finishes a request's
+  first token, its full, write-complete prompt blocks are already
+  registered in that replica's prefix cache under a content-hash chain
+  (``ragged/prefix_cache.py``). Serialization is a lookup of that chain
+  plus one host copy of the block contents — no new wire format, the
+  chain keys ARE the codec.
+* ``install_prefix`` — the decode replica allocates blocks, writes the
+  payload into its own KV pool, and registers the same chain keys as
+  *idle* cache entries. When the router then resubmits
+  ``prompt + [first_token]`` to the decode replica, the ordinary
+  ``StateManager.attach_prefix`` path revives the chain by content hash
+  and the decode replica skips re-prefilling everything the payload
+  covered — the handoff needs no special admission path at all.
+
+Greedy bit-identity is preserved by construction: KV content for a
+token depends only on the tokens before it and the (shared) params, so
+installed blocks are exactly what the decode replica would have
+computed; the partial tail block is recomputed locally like any other
+prefix-cache hit. Every degradation (no cache, geometry mismatch, pool
+too full) returns a zero-block install and the decode replica simply
+prefills from scratch — disaggregation can lose its optimization but
+never a request.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+
+@dataclasses.dataclass
+class KVHandoff:
+    """Serialized write-complete prompt blocks from one replica's pool.
+
+    ``block_data`` is host memory shaped
+    ``[num_layers, n_blocks, block_size, 2, kv_heads, head_dim]`` —
+    the pool layout of the covered blocks, in chain order. ``keys`` is
+    the content-hash chain that addresses them on any replica."""
+
+    keys: List[str]
+    block_data: np.ndarray
+    block_size: int
+
+    @property
+    def n_blocks(self) -> int:
+        return len(self.keys)
+
+    @property
+    def n_tokens(self) -> int:
+        return len(self.keys) * self.block_size
+
+
+def serialize_prefix(engine, tokens,
+                     max_blocks: Optional[int] = None
+                     ) -> Optional[KVHandoff]:
+    """Serialize the cached full-block chain covering ``tokens`` from
+    ``engine``'s KV pool. Returns None when nothing is cached (short
+    prompt, prefix cache off, or the chain was already evicted) — the
+    caller then hands off tokens only and the target recomputes.
+
+    The chain is ref'd for the duration of the device→host copy so KV
+    pressure on the source replica cannot evict-and-recycle a block
+    mid-serialization."""
+    cache = getattr(engine.kv_cache, "prefix_cache", None)
+    if cache is None:
+        return None
+    toks = np.asarray(tokens, np.int32).ravel()
+    # same cap as attach_prefix: the final prompt token stays uncached
+    # so admission still computes first-token logits
+    keys, blocks = cache.lookup(toks, max_tokens=len(toks) - 1)
+    if not keys:
+        return None
+    if max_blocks is not None:
+        keys, blocks = keys[:max_blocks], blocks[:max_blocks]
+    cache.ref(keys)
+    try:
+        data = np.asarray(engine.kv_cache.data[:, np.asarray(blocks)])
+    finally:
+        cache.unref(keys)
+    return KVHandoff(keys=keys, block_data=data,
+                     block_size=cache.block_size)
+
+
+def install_prefix(engine, handoff: Optional[KVHandoff]
+                   ) -> Tuple[int, int]:
+    """Install a handoff payload into ``engine``'s pool + prefix cache.
+
+    Returns ``(blocks_installed, tokens_attachable)`` where the token
+    count covers the whole chain the target now holds (payload blocks
+    plus any chain prefix it already cached from earlier traffic). A
+    ``(0, 0)`` return means the handoff degraded to recompute — never
+    an error.
+
+    Must run on the thread that owns ``engine`` (the replica pump): it
+    mutates the pool array and the cache registry."""
+    cache = getattr(engine.kv_cache, "prefix_cache", None)
+    if cache is None or handoff is None or not handoff.keys:
+        return (0, 0)
+    kvc = engine.kv_cache
+    if (handoff.block_size != cache.block_size
+            or handoff.block_data.shape[0] != kvc.data.shape[0]
+            or handoff.block_data.shape[2:] != kvc.data.shape[2:]):
+        return (0, 0)  # geometry mismatch: heterogeneous fleet, recompute
+    # the target may already hold a chain prefix (shared system prompt
+    # traffic): install only past the longest cached prefix — suffix
+    # keys without their predecessors would be unreachable by lookup
+    pos = 0
+    while pos < len(handoff.keys) and cache.get(handoff.keys[pos]) is not None:
+        pos += 1
+    to_install = list(range(pos, len(handoff.keys)))
+    if not to_install:
+        return (0, handoff.n_tokens)
+    need = len(to_install)
+    if kvc.free_blocks < need:
+        kvc.reclaim(need - kvc.free_blocks)
+    if kvc.free_blocks < need:
+        # pool under live pressure: installing would evict working-set
+        # blocks of running decodes — degrade to recompute instead
+        return (0, pos * handoff.block_size)
+
+    import jax.numpy as jnp
+
+    blocks = kvc.allocator.allocate(need)
+    src = jnp.asarray(handoff.block_data[:, to_install], dtype=kvc.data.dtype)
+    kvc.data = kvc.data.at[:, jnp.asarray(blocks)].set(src)
+    installed: List[str] = []
+    for idx, blk in zip(to_install, blocks):
+        if cache.register(handoff.keys[idx], int(blk)):
+            installed.append(handoff.keys[idx])
+        else:  # registered concurrently under another block: keep theirs
+            kvc.free([int(blk)])
+    # drop the registration ref: the chain parks idle-cached, exactly
+    # like a released prompt — attach_prefix revives it by content hash
+    # and KV pressure can evict it, so an unused handoff costs nothing
+    cache.unref(installed)
+    hub = getattr(engine, "_hub", None)
+    if hub is not None and installed:
+        lbl = getattr(engine, "_metric_labels", None)
+        hub.counter_add("serve.handoff_blocks", len(installed), labels=lbl)
+        hub.counter_add("serve.handoff_tokens",
+                        len(installed) * handoff.block_size, labels=lbl)
+    return (len(installed), handoff.n_tokens)

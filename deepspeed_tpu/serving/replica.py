@@ -1,0 +1,214 @@
+"""Serving replica: one engine_v2 instance wrapped for fleet duty.
+
+A replica owns exactly one :class:`InferenceEngineV2` and adds what the
+router needs to treat N of them as a fleet:
+
+* a **role** — ``unified`` (prefill + decode), ``prefill``, or
+  ``decode`` (the disaggregated pools, serving/disagg.py);
+* an **inbox** of submissions, so every engine mutation happens on the
+  replica's own pump thread (the engine is single-threaded by design;
+  the inbox is the concurrency boundary);
+* a **heartbeat** updated on every pump and a **load report** (queue
+  depth, KV-pool pressure, in-flight sequences, goodput EWMA) — the
+  router's routing and stale-heartbeat failover inputs, optionally
+  published through the PR 3 fleet machinery
+  (``observability/fleet.py`` ``ReplicaPublisher``) for external
+  ``serve_top --fleet`` consumers;
+* ``kill()`` — a simulated crash for failover tests and drills: the
+  pump stops mid-flight *without* draining, the heartbeat goes stale,
+  and the router's health check must recover the in-flight requests.
+
+The engine is constructed with ``metric_labels={"replica": "rN"}`` so
+every ``serve.*`` hub series carries the replica id — fleet dashboards
+aggregate across labels instead of collapsing N replicas into one line.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import queue
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+ROLES = ("unified", "prefill", "decode")
+
+
+@dataclasses.dataclass
+class Submission:
+    """One routed request on its way into a replica's engine. Applied
+    on the pump thread: install the handoff payload (if any), ``put``,
+    then record the routing span notes on the replica's tracer."""
+
+    uid: int
+    tokens: np.ndarray
+    max_new_tokens: int
+    span_notes: List[Tuple[str, Dict[str, Any]]] = \
+        dataclasses.field(default_factory=list)
+    handoff: Optional[Any] = None  # disagg.KVHandoff
+
+
+class ServingReplica:
+    def __init__(self, engine, replica_id: int, role: str = "unified",
+                 publisher=None, goodput_alpha: float = 0.25):
+        if role not in ROLES:
+            raise ValueError(f"role must be one of {ROLES}, got {role!r}")
+        self.engine = engine
+        self.replica_id = int(replica_id)
+        self.name = f"r{self.replica_id}"
+        self.role = role
+        self.publisher = publisher
+        self.inbox: "queue.Queue[Submission]" = queue.Queue()
+        # router wires this to its emission handler; called on the pump
+        # thread with (replica, {uid: [tokens]}) after each serve round
+        self.emit_callback: Optional[Callable] = None
+        self.last_heartbeat = time.time()
+        self.killed = False
+        self.steps = 0
+        self.goodput_ewma = 0.0
+        self._alpha = float(goodput_alpha)
+        self._thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+
+    @classmethod
+    def create(cls, model, replica_id: int, role: str = "unified",
+               run_dir: Optional[str] = None, **engine_kw
+               ) -> "ServingReplica":
+        """Build the replica AND its engine, injecting the per-replica
+        metric labels and (when a run dir is given) the fleet-layer
+        load-report publisher."""
+        from deepspeed_tpu.inference.engine_v2 import InferenceEngineV2
+
+        engine_kw.setdefault("metric_labels",
+                             {"replica": f"r{int(replica_id)}"})
+        engine = InferenceEngineV2(model, **engine_kw)
+        publisher = None
+        if run_dir:
+            from deepspeed_tpu.observability.fleet import ReplicaPublisher
+
+            publisher = ReplicaPublisher(run_dir, replica_id)
+        return cls(engine, replica_id, role=role, publisher=publisher)
+
+    # -- liveness ------------------------------------------------------
+    def alive(self, now: Optional[float] = None,
+              stale_after: float = 5.0) -> bool:
+        """Stale-heartbeat liveness — the same contract as the fleet
+        aggregator's dead-rank detection: a killed replica is not dead
+        until its heartbeat *ages out*, which is exactly what a real
+        crashed process looks like to a router that can only observe
+        published state."""
+        now = time.time() if now is None else now
+        return (now - self.last_heartbeat) < stale_after
+
+    def kill(self) -> None:
+        """Simulated crash: stop pumping (and heartbeating) immediately,
+        leaving the inbox and the engine's in-flight sequences wedged —
+        recovery is entirely the router's failover problem."""
+        self.killed = True
+        self._stop.set()
+
+    # -- the serve round ----------------------------------------------
+    def pump(self, eos_token_id: Optional[int] = None
+             ) -> Dict[int, List[int]]:
+        """One serve round: drain the inbox into the engine, run one
+        ``serve_step``, heartbeat, and hand emissions to the router.
+        The ONLY code path that touches the engine — callers on other
+        threads go through :meth:`submit`."""
+        if self.killed:
+            return {}
+        t0 = time.perf_counter()
+        while True:
+            try:
+                sub = self.inbox.get_nowait()
+            except queue.Empty:
+                break
+            self._apply(sub)
+        busy = bool(self.engine.state.seqs) or bool(self.engine._queue)
+        emitted = self.engine.serve_step(eos_token_id=eos_token_id) \
+            if busy else {}
+        self.steps += 1
+        now = time.time()
+        self.last_heartbeat = now
+        dt = max(time.perf_counter() - t0, 1e-9)
+        rate = sum(len(v) for v in emitted.values()) / dt
+        self.goodput_ewma = (self._alpha * rate
+                             + (1.0 - self._alpha) * self.goodput_ewma)
+        if self.publisher is not None:
+            self.publisher.publish(self.load_report(now))
+        if emitted and self.emit_callback is not None:
+            self.emit_callback(self, emitted)
+        return emitted
+
+    def _apply(self, sub: Submission) -> None:
+        if sub.handoff is not None:
+            from deepspeed_tpu.serving.disagg import install_prefix
+
+            blocks, tokens = install_prefix(self.engine, sub.handoff)
+            # tokens>0 with blocks==0 means the chain was already
+            # installed here by an earlier handoff — still the KV path
+            sub.span_notes.append(("HANDOFF", {
+                "blocks": blocks, "tokens": tokens,
+                "mode": "kv_blocks" if tokens else "recompute"}))
+        self.engine.put([sub.uid], [sub.tokens],
+                        max_new_tokens=sub.max_new_tokens)
+        for kind, fields in sub.span_notes:
+            self.engine.tracer.note(sub.uid, kind, **fields)
+
+    def submit(self, sub: Submission) -> None:
+        self.inbox.put(sub)
+
+    # -- load report ---------------------------------------------------
+    def load_report(self, now: Optional[float] = None) -> Dict[str, Any]:
+        e = self.engine
+        live = [s for s in e.state.seqs.values() if not s.done]
+        total = e.kv_cache.allocator.total_blocks
+        free = e.kv_cache.free_blocks
+        return {
+            "replica": self.replica_id,
+            "role": self.role,
+            "ts": self.last_heartbeat if now is None else now,
+            "steps": self.steps,
+            "queue_wait_depth": len(e._queue),
+            "live_seqs": len(live),
+            "inflight": len(live) + len(e._queue) + self.inbox.qsize(),
+            "kv_free_blocks": free,
+            "kv_free_frac": free / max(1, total),
+            "goodput_tokens_per_s": round(self.goodput_ewma, 3),
+            "killed": self.killed,
+        }
+
+    def load_score(self) -> float:
+        """Routing cost: queued + live work, plus KV-pool pressure as a
+        tiebreaker (two idle replicas: prefer the emptier pool, where a
+        new prompt is least likely to trigger evictions)."""
+        r = self.load_report()
+        return (r["queue_wait_depth"] + r["live_seqs"]
+                + self.inbox.qsize() + (1.0 - r["kv_free_frac"]))
+
+    # -- threaded mode -------------------------------------------------
+    def start(self, eos_token_id: Optional[int] = None,
+              idle_sleep_s: float = 0.001) -> None:
+        """Run the pump on a dedicated thread (the bench's in-process
+        fleet). Synchronous callers (tests) skip this and drive
+        :meth:`pump` directly."""
+        if self._thread is not None:
+            return
+        self._stop.clear()
+
+        def _loop():
+            while not self._stop.is_set():
+                emitted = self.pump(eos_token_id=eos_token_id)
+                if not emitted and self.inbox.empty():
+                    time.sleep(idle_sleep_s)
+
+        self._thread = threading.Thread(
+            target=_loop, name=f"replica-{self.name}", daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=10.0)
+            self._thread = None

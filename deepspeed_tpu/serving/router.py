@@ -1,0 +1,457 @@
+"""Fleet router: the single front door over N serving replicas.
+
+The router owns three decisions and one promise:
+
+* **Routing** — prefix-hash session affinity first (requests sharing a
+  prompt prefix land where those KV blocks are already cached, the
+  MII-replica-router / vLLM-prefix-aware-routing idea), least-loaded by
+  live load report otherwise.
+* **Disaggregation** — with ``prefill``/``decode``-role replicas, a new
+  request goes to a prefill replica with a one-token budget; when its
+  first token lands, the prompt's KV blocks are serialized from the
+  prefill replica and installed into a decode replica
+  (serving/disagg.py), and the remainder of the budget decodes there.
+  Decode p99 never waits behind another request's prompt.
+* **Failover** — a replica whose heartbeat goes stale is declared dead
+  and every one of its in-flight requests is resubmitted elsewhere with
+  the tokens generated so far folded into the prompt — PR 8's
+  zero-drop contract (preempt-and-requeue) extended across replica
+  death. Greedy decoding makes the continuation bit-identical to the
+  uninterrupted stream; tokens already handed out are never re-emitted.
+* **The promise** — every accepted request completes with its full
+  token budget, through overload, handoff, and replica death alike.
+
+Every decision lands in the observability stack: ``ROUTE``/``HANDOFF``/
+``FAILOVER`` spans on the per-request traces, fleet-level SLO
+attribution aggregated over all replicas' tracers, per-replica Perfetto
+lanes, and ``serve.fleet.*`` gauges (including the autoscaler's
+desired-replica signal, serving/autoscale.py).
+
+Threading: the router never touches an engine directly — it enqueues
+:class:`Submission` objects into replica inboxes and receives emissions
+via callbacks that run on the replica pump threads. Router state is
+lock-protected, so the same code drives both the synchronous test mode
+(``step()``/``run_until_complete()``) and the threaded bench mode
+(``start()``/``drain()``).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from deepspeed_tpu.serving.disagg import serialize_prefix
+from deepspeed_tpu.serving.replica import ServingReplica, Submission
+
+
+def build_fleet(model, router_cfg=None, engine_kw=None,
+                run_dir: Optional[str] = None,
+                eos_token_id: Optional[int] = None) -> "FleetRouter":
+    """Construct replicas + router from a ``serving.router`` config
+    block (config.RouterConfig or any object with its fields; None uses
+    the defaults). ``engine_kw`` is forwarded to every replica's
+    engine constructor — pass shared ``params`` so the fleet serves one
+    model, not N random inits."""
+    from deepspeed_tpu.config.config import RouterConfig
+    from deepspeed_tpu.serving.autoscale import AutoscaleSignal
+
+    cfg = router_cfg if router_cfg is not None else RouterConfig()
+    engine_kw = dict(engine_kw or {})
+    n = int(cfg.replicas)
+    n_prefill = int(cfg.prefill_replicas) if cfg.mode == "disagg" else 0
+    replicas = []
+    for i in range(n):
+        role = "unified" if cfg.mode == "unified" else (
+            "prefill" if i < n_prefill else "decode")
+        replicas.append(ServingReplica.create(
+            model, i, role=role, run_dir=run_dir, **engine_kw))
+    from deepspeed_tpu.observability.hub import get_hub
+
+    autoscale = AutoscaleSignal(
+        min_replicas=cfg.autoscale_min, max_replicas=cfg.autoscale_max,
+        queue_high=cfg.queue_high, queue_low=cfg.queue_low,
+        slo_miss_high=cfg.slo_miss_high,
+        hysteresis_rounds=cfg.hysteresis_rounds, hub=get_hub())
+    return FleetRouter(replicas, affinity_blocks=cfg.affinity_blocks,
+                       stale_after_s=cfg.stale_after_seconds,
+                       autoscale=autoscale, eos_token_id=eos_token_id)
+
+
+class _RequestRecord:
+    __slots__ = ("uid", "tokens", "max_new_tokens", "replica_id", "phase",
+                 "emitted", "done", "failovers", "affinity_key",
+                 "submitted_ts")
+
+    def __init__(self, uid, tokens, max_new_tokens, replica_id, phase,
+                 affinity_key):
+        self.uid = uid
+        self.tokens = tokens
+        self.max_new_tokens = max_new_tokens
+        self.replica_id = replica_id
+        self.phase = phase  # "prefill" (awaiting handoff) or "decode"
+        self.emitted: List[int] = []
+        self.done = False
+        self.failovers = 0
+        self.affinity_key = affinity_key
+        self.submitted_ts = time.time()
+
+
+class FleetRouter:
+    def __init__(self, replicas: List[ServingReplica],
+                 affinity_blocks: int = 2,
+                 stale_after_s: float = 5.0,
+                 autoscale=None,
+                 eos_token_id: Optional[int] = None):
+        if not replicas:
+            raise ValueError("FleetRouter needs at least one replica")
+        self.replicas = {r.replica_id: r for r in replicas}
+        self.prefill_pool = [r.replica_id for r in replicas
+                             if r.role == "prefill"]
+        self.decode_pool = [r.replica_id for r in replicas
+                            if r.role in ("decode", "unified")]
+        self.disagg = bool(self.prefill_pool)
+        if self.disagg and not self.decode_pool:
+            raise ValueError("disaggregated fleet needs decode replicas")
+        self.affinity_blocks = max(0, int(affinity_blocks))
+        self.stale_after_s = float(stale_after_s)
+        self.autoscale = autoscale
+        self.eos_token_id = eos_token_id
+        self._lock = threading.RLock()
+        self._requests: Dict[int, _RequestRecord] = {}
+        # (pool, prefix-hash) -> replica id that holds those KV blocks
+        self._affinity: Dict[Any, int] = {}
+        self.dead: set = set()
+        self._last_policy = "least_loaded"
+        self.stats = {"submitted": 0, "completed": 0, "handoffs": 0,
+                      "handoff_recompute": 0, "failovers": 0,
+                      "failed_over_requests": 0, "affinity_hits": 0}
+        for r in replicas:
+            r.emit_callback = self._on_emissions
+        from deepspeed_tpu.observability.hub import get_hub
+
+        self._hub = get_hub()
+
+    # -- admission + routing -------------------------------------------
+    def submit(self, uid: int, tokens, max_new_tokens: int = 64) -> int:
+        """Route one request. Returns the chosen replica id. Raises
+        ValueError (before accepting) for a prompt no replica could
+        ever schedule — the fleet-wide analog of ``put()``'s never-fit
+        contract; once accepted, completion is guaranteed."""
+        toks = np.asarray(tokens, np.int32).ravel()
+        with self._lock:
+            if uid in self._requests:
+                raise ValueError(f"uid={uid} already in flight")
+            key = self._affinity_key(toks)
+            if self.disagg:
+                target = self._pick(self.prefill_pool, key)
+                phase, budget = "prefill", 1
+            else:
+                target = self._pick(self.decode_pool, key)
+                phase, budget = "decode", int(max_new_tokens)
+            self._check_fits(target, toks, max_new_tokens)
+            rec = _RequestRecord(uid, toks, int(max_new_tokens),
+                                 target.replica_id, phase, key)
+            self._requests[uid] = rec
+            self.stats["submitted"] += 1
+        target.submit(Submission(
+            uid=uid, tokens=toks, max_new_tokens=budget,
+            span_notes=[("ROUTE", {"replica": target.replica_id,
+                                   "role": target.role,
+                                   "policy": self._last_policy})]))
+        return target.replica_id
+
+    def _affinity_key(self, toks: np.ndarray) -> Optional[str]:
+        if self.affinity_blocks <= 0:
+            return None
+        any_r = next(iter(self.replicas.values()))
+        span = self.affinity_blocks * \
+            any_r.engine.kv_cache.config.block_size
+        if len(toks) < span:
+            return None
+        return hashlib.sha1(
+            np.ascontiguousarray(toks[:span], np.int32).tobytes()
+        ).hexdigest()
+
+    def _alive(self, pool: List[int]) -> List[ServingReplica]:
+        now = time.time()
+        out = [self.replicas[rid] for rid in pool
+               if rid not in self.dead
+               and self.replicas[rid].alive(now, self.stale_after_s)]
+        if not out:  # last resort: any replica not yet declared dead
+            out = [r for rid, r in self.replicas.items()
+                   if rid not in self.dead]
+        if not out:
+            raise RuntimeError("no live replicas left in the fleet")
+        return out
+
+    def _pick(self, pool: List[int], key: Optional[str]
+              ) -> ServingReplica:
+        """Affinity if the remembered replica is still live, else
+        least-loaded. Caller holds the lock."""
+        alive = self._alive(pool)
+        pool_tag = id(pool)
+        if key is not None:
+            rid = self._affinity.get((pool_tag, key))
+            if rid is not None and any(r.replica_id == rid for r in alive):
+                self.stats["affinity_hits"] += 1
+                self._last_policy = "affinity"
+                return self.replicas[rid]
+        best = min(alive, key=lambda r: r.load_score())
+        if key is not None:
+            self._affinity[(pool_tag, key)] = best.replica_id
+        self._last_policy = "least_loaded"
+        return best
+
+    @staticmethod
+    def _check_fits(replica: ServingReplica, toks: np.ndarray,
+                    max_new: int) -> None:
+        e = replica.engine
+        blocks = e.kv_cache.blocks_needed(len(toks) + 1)
+        if (blocks > e.max_blocks_per_seq
+                or blocks > e.kv_cache.allocator.total_blocks):
+            raise ValueError(
+                f"prompt of {len(toks)} tokens needs {blocks} KV blocks "
+                f"and can never be scheduled on replica "
+                f"{replica.replica_id}")
+
+    # -- emissions (runs on replica pump threads) ----------------------
+    def _on_emissions(self, replica: ServingReplica,
+                      emitted: Dict[int, List[int]]) -> None:
+        handoffs = []
+        with self._lock:
+            for uid, toks in emitted.items():
+                rec = self._requests.get(uid)
+                if (rec is None or rec.done
+                        or rec.replica_id != replica.replica_id):
+                    continue  # stale emission from a failed-over replica
+                rec.emitted.extend(int(t) for t in toks)
+                if rec.phase == "prefill":
+                    handoffs.append(rec)  # budget-1 stage just finished
+                elif len(rec.emitted) >= rec.max_new_tokens:
+                    rec.done = True
+                    self.stats["completed"] += 1
+        for rec in handoffs:
+            self._handoff(rec, replica)
+
+    def _handoff(self, rec: _RequestRecord,
+                 prefill_replica: ServingReplica) -> None:
+        """Move a prefill-complete request to a decode replica. Runs on
+        the prefill replica's pump thread, so serializing from its KV
+        pool is race-free; the install runs later on the decode
+        replica's own thread (Submission.handoff)."""
+        with self._lock:
+            remaining = rec.max_new_tokens - len(rec.emitted)
+            if remaining <= 0:
+                rec.done = True
+                self.stats["completed"] += 1
+                return
+            target = self._pick(self.decode_pool, rec.affinity_key)
+            rec.phase = "decode"
+            rec.replica_id = target.replica_id
+            self.stats["handoffs"] += 1
+            tokens = np.concatenate(
+                [rec.tokens, np.asarray(rec.emitted, np.int32)])
+        payload = serialize_prefix(prefill_replica.engine, rec.tokens)
+        if payload is None:
+            with self._lock:
+                self.stats["handoff_recompute"] += 1
+        target.submit(Submission(
+            uid=rec.uid, tokens=tokens, max_new_tokens=remaining,
+            handoff=payload,
+            span_notes=[("ROUTE", {"replica": target.replica_id,
+                                   "role": target.role,
+                                   "policy": "disagg_handoff"})]))
+
+    # -- failover ------------------------------------------------------
+    def check_health(self, now: Optional[float] = None) -> List[int]:
+        """Declare stale-heartbeat replicas dead and re-route their
+        in-flight requests. Also feeds the autoscaler and the fleet
+        gauges. Returns replica ids newly declared dead."""
+        now = time.time() if now is None else now
+        newly_dead = []
+        for rid, r in self.replicas.items():
+            if rid not in self.dead and not r.alive(now, self.stale_after_s):
+                newly_dead.append(rid)
+        for rid in newly_dead:
+            self._failover(rid)
+        self._update_fleet_gauges()
+        return newly_dead
+
+    def _failover(self, dead_rid: int) -> None:
+        with self._lock:
+            self.dead.add(dead_rid)
+            self.stats["failovers"] += 1
+            victims = [rec for rec in self._requests.values()
+                       if rec.replica_id == dead_rid and not rec.done]
+            plans = []
+            for rec in victims:
+                remaining = rec.max_new_tokens - len(rec.emitted)
+                if remaining <= 0:
+                    rec.done = True
+                    self.stats["completed"] += 1
+                    continue
+                if rec.phase == "prefill":
+                    pool = self.prefill_pool
+                    alive = [r for r in self._alive(pool)
+                             if r.replica_id != dead_rid]
+                    if not alive:  # prefill pool gone: decode end-to-end
+                        rec.phase = "decode"
+                        pool = self.decode_pool
+                    budget = 1 if rec.phase == "prefill" else remaining
+                else:
+                    pool, budget = self.decode_pool, remaining
+                target = self._pick(pool, rec.affinity_key)
+                old = rec.replica_id
+                rec.replica_id = target.replica_id
+                rec.failovers += 1
+                self.stats["failed_over_requests"] += 1
+                tokens = np.concatenate(
+                    [rec.tokens, np.asarray(rec.emitted, np.int32)]) \
+                    if rec.emitted else rec.tokens
+                plans.append((rec.uid, tokens, budget, old, target,
+                              len(rec.emitted)))
+        for uid, tokens, budget, old, target, recovered in plans:
+            target.submit(Submission(
+                uid=uid, tokens=tokens, max_new_tokens=budget,
+                span_notes=[
+                    ("FAILOVER", {"from_replica": old,
+                                  "to_replica": target.replica_id,
+                                  "recovered_tokens": recovered}),
+                    ("ROUTE", {"replica": target.replica_id,
+                               "role": target.role,
+                               "policy": "failover"})]))
+            self._hub.counter_add("serve.fleet.failed_over_requests")
+
+    # -- driving -------------------------------------------------------
+    def step(self) -> int:
+        """Synchronous mode: pump every live replica once, then health-
+        check. Returns the number of requests still pending."""
+        for r in self.replicas.values():
+            if r.replica_id not in self.dead and not r.killed:
+                r.pump(eos_token_id=self.eos_token_id)
+        self.check_health()
+        return self.pending()
+
+    def run_until_complete(self, max_rounds: int = 100000) -> None:
+        for _ in range(max_rounds):
+            if self.step() == 0:
+                return
+        raise RuntimeError(
+            f"fleet did not drain in {max_rounds} rounds "
+            f"({self.pending()} requests pending)")
+
+    def start(self) -> None:
+        for r in self.replicas.values():
+            r.start(eos_token_id=self.eos_token_id)
+
+    def stop(self) -> None:
+        for r in self.replicas.values():
+            r.stop()
+
+    def drain(self, timeout_s: float = 120.0,
+              poll_s: float = 0.02) -> None:
+        """Threaded mode: wait (health-checking) until every accepted
+        request completed."""
+        deadline = time.time() + timeout_s
+        while time.time() < deadline:
+            self.check_health()
+            if self.pending() == 0:
+                return
+            time.sleep(poll_s)
+        raise TimeoutError(
+            f"fleet did not drain in {timeout_s}s "
+            f"({self.pending()} requests pending)")
+
+    def pending(self) -> int:
+        with self._lock:
+            return sum(1 for rec in self._requests.values()
+                       if not rec.done)
+
+    def results(self) -> Dict[int, List[int]]:
+        with self._lock:
+            return {uid: list(rec.emitted)
+                    for uid, rec in self._requests.items() if rec.done}
+
+    # -- fleet observability -------------------------------------------
+    def _update_fleet_gauges(self) -> None:
+        reports = [r.load_report() for r in self.replicas.values()
+                   if r.replica_id not in self.dead]
+        waiting = sum(r["queue_wait_depth"] for r in reports)
+        goodput = sum(r["goodput_tokens_per_s"] for r in reports)
+        self._hub.gauge("serve.fleet.replicas_alive", len(reports))
+        self._hub.gauge("serve.fleet.replicas_dead", len(self.dead))
+        self._hub.gauge("serve.fleet.queue_wait_depth", waiting)
+        self._hub.gauge("serve.fleet.pending_requests", self.pending())
+        self._hub.gauge("serve.fleet.goodput_tokens_per_s", goodput)
+        if self.autoscale is not None:
+            self.autoscale.update(
+                n_replicas=max(1, len(reports)),
+                queue_wait_depth=waiting,
+                slo_miss_rate=self._slo_miss_rate(),
+                goodput_tokens_per_s=goodput)
+
+    def _slo_miss_rate(self, last: int = 128) -> float:
+        total = misses = 0
+        for r in self.replicas.values():
+            tracer = r.engine.tracer
+            for t in tracer.finished(last=last):
+                total += 1
+                if tracer.is_slo_miss(t):
+                    misses += 1
+        return misses / total if total else 0.0
+
+    def traces_by_replica(self) -> Dict[int, List[Any]]:
+        return {rid: r.engine.tracer.finished()
+                for rid, r in self.replicas.items()}
+
+    def slo_attribution(self, deadline_s: Optional[float] = None
+                        ) -> Dict[str, Any]:
+        """Fleet-level "why did p99 miss": one attribution report over
+        every replica's finished traces, plus the per-replica counts the
+        single-replica report cannot show."""
+        from deepspeed_tpu.observability.request_trace import \
+            slo_attribution
+
+        by_replica = self.traces_by_replica()
+        all_traces = [t for ts in by_replica.values() for t in ts]
+        report = slo_attribution(all_traces, deadline_s=deadline_s)
+        report["per_replica"] = {
+            rid: {"traces": len(ts),
+                  "slo_misses": sum(
+                      1 for t in ts
+                      if self.replicas[rid].engine.tracer.is_slo_miss(t))}
+            for rid, ts in by_replica.items()}
+        return report
+
+    def export_perfetto(self, path: str) -> str:
+        """One Perfetto file, one lane group per replica (shared
+        wall-clock base, so handoffs and failovers line up)."""
+        from deepspeed_tpu.observability.chrome_trace import \
+            export_fleet_request_traces
+
+        return export_fleet_request_traces(path, self.traces_by_replica())
+
+    def fleet_snapshot(self, deadline_s: Optional[float] = None
+                       ) -> Dict[str, Any]:
+        """The ``serve_top --fleet`` document: load reports, router
+        stats, autoscale state, and fleet SLO attribution."""
+        with self._lock:
+            stats = dict(self.stats)
+            dead = sorted(self.dead)
+        snap = {
+            "schema": "serving_fleet/v1",
+            "ts": time.time(),
+            "mode": "disagg" if self.disagg else "unified",
+            "replicas": [r.load_report()
+                         for r in self.replicas.values()],
+            "dead_replicas": dead,
+            "router": stats,
+            "slo_attribution": self.slo_attribution(deadline_s),
+        }
+        if self.autoscale is not None:
+            snap["autoscale"] = self.autoscale.snapshot()
+        return snap

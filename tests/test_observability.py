@@ -423,6 +423,7 @@ class TestServingSnapshot:
 
         e = _Eng()
         e._hub = get_hub()
+        e._metric_labels = None  # the engine always sets one (fleet labels)
         e.tracer = RequestTracer(enabled=False)  # the engine always owns one
         e._ttft_hist = Histogram("ttft")
         e._decode_hist = Histogram("decode")

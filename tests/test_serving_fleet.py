@@ -1,0 +1,489 @@
+"""Serving-fleet tests: multi-replica router, disaggregated
+prefill/decode handoff, stale-heartbeat failover, autoscale signals,
+and the per-replica labeled metrics encoding.
+
+The load-bearing guarantees (docs/serving.md "Multi-replica fleet"):
+- an accepted request completes with its full token budget through
+  overload, handoff, and replica death alike — the PR 8 zero-drop
+  contract extended fleet-wide;
+- routing, disaggregation and failover are pure placement decisions:
+  greedy token streams are bit-identical to a single uncontended
+  replica serving the same workload;
+- every serve.* hub series carries a {replica="rN"} label, so N
+  replicas render as N Prometheus series, not one overwritten line.
+
+All fleet e2e tests drive the router in synchronous mode
+(``step()``/``run_until_complete()``) — deterministic on CPU CI; the
+threaded mode shares the exact same submission/emission code paths and
+is exercised by ``make serve-fleet``.
+"""
+
+import json
+import time
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.models.zoo import get_model
+from deepspeed_tpu.serving import (AutoscaleSignal, FleetRouter,
+                                   ServingReplica, install_prefix,
+                                   serialize_prefix)
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    model = get_model("tiny", dtype=jnp.float32, param_dtype=jnp.float32)
+    params = model.init(jax.random.PRNGKey(0))
+    return model, params
+
+
+ENGINE_DEFAULTS = dict(kv_blocks=64, kv_block_size=8,
+                       max_tokens_per_step=32, max_seqs_per_step=4,
+                       max_blocks_per_seq=8,
+                       request_trace={"sample_rate": 1.0})
+
+
+def make_engine(tiny, **kw):
+    from deepspeed_tpu.inference import InferenceEngineV2
+
+    model, params = tiny
+    for k, v in ENGINE_DEFAULTS.items():
+        kw.setdefault(k, v)
+    return InferenceEngineV2(model, params=params, dtype=jnp.float32, **kw)
+
+
+def make_fleet(tiny, roles=("unified", "unified"), router_kw=None,
+               **engine_kw):
+    model, params = tiny
+    for k, v in ENGINE_DEFAULTS.items():
+        engine_kw.setdefault(k, v)
+    replicas = [ServingReplica.create(model, i, role=role, params=params,
+                                      dtype=jnp.float32, **engine_kw)
+                for i, role in enumerate(roles)]
+    return FleetRouter(replicas, **(router_kw or {}))
+
+
+def shared_prompts(n, prefix_len=16, tail=4):
+    """Prompts sharing a >=1-affinity-span prefix (16 tokens at the
+    8-token block size) with per-request divergent tails — the
+    system-prompt workload the affinity router and the handoff codec
+    are built for."""
+    base = ((np.arange(prefix_len) * 5 + 3) % 97).astype(np.int32)
+    return [np.concatenate(
+        [base, ((np.arange(tail) * 7 + 11 * i) % 89).astype(np.int32)])
+        for i in range(n)]
+
+
+def reference_outputs(tiny, prompts, gen):
+    """The uncontended single-replica run every fleet arrangement must
+    reproduce token-for-token."""
+    eng = make_engine(tiny)
+    eng.put(list(range(len(prompts))), prompts, max_new_tokens=gen)
+    return {u: list(t) for u, t in eng.generate_all().items()}
+
+
+def span_kinds(replica, kind):
+    return [s for t in replica.engine.tracer.finished()
+            for s in t.spans if s.kind == kind]
+
+
+# -- KV handoff codec ----------------------------------------------------
+
+
+class TestKVHandoffCodec:
+    def test_serialize_install_roundtrip(self, tiny):
+        src = make_engine(tiny)
+        dst = make_engine(tiny)
+        prompt = ((np.arange(20) * 3 + 1) % 100).astype(np.int32)
+        src.put([1], [prompt], max_new_tokens=4)
+        out_src = src.generate_all()
+
+        h = serialize_prefix(src, prompt)
+        # 20-token prompt, 8-token blocks, final token never cached:
+        # exactly the two write-complete blocks travel
+        assert h is not None and h.n_blocks == 2 and h.n_tokens == 16
+        assert h.block_data.shape[1] == 2
+
+        blocks, tokens = install_prefix(dst, h)
+        assert (blocks, tokens) == (2, 16)
+        # the installed chain is idle-cached: the ordinary admission
+        # path revives it by content hash and skips the covered prefill
+        dst.put([1], [prompt], max_new_tokens=4)
+        out_dst = dst.generate_all()
+        assert dst.stats["prefix_hit_tokens"] == 16
+        assert dst.scheduler.stats["prefill_tokens"] == 4  # tail only
+        assert list(out_dst[1]) == list(out_src[1])  # bit-identical
+
+    def test_reinstall_is_idempotent(self, tiny):
+        src = make_engine(tiny)
+        dst = make_engine(tiny)
+        prompt = ((np.arange(20) * 3 + 1) % 100).astype(np.int32)
+        src.put([1], [prompt], max_new_tokens=2)
+        src.generate_all()
+        h = serialize_prefix(src, prompt)
+        assert install_prefix(dst, h) == (2, 16)
+        # same chain again: nothing new to write, whole chain attachable
+        assert install_prefix(dst, h) == (0, 16)
+
+    def test_degradations_return_zero_install(self, tiny):
+        # prefix cache off on the source: nothing to serialize
+        bare = make_engine(tiny, prefix_cache=False)
+        prompt = ((np.arange(20) * 3 + 1) % 100).astype(np.int32)
+        bare.put([1], [prompt], max_new_tokens=2)
+        bare.generate_all()
+        assert serialize_prefix(bare, prompt) is None
+        # short prompt: no write-complete block exists
+        src = make_engine(tiny)
+        src.put([2], [prompt[:6]], max_new_tokens=2)
+        src.generate_all()
+        assert serialize_prefix(src, prompt[:6]) is None
+        # geometry mismatch (heterogeneous fleet): recompute, not error
+        src.put([3], [prompt], max_new_tokens=2)
+        src.generate_all()
+        h = serialize_prefix(src, prompt)
+        odd = make_engine(tiny, kv_block_size=16, kv_blocks=32)
+        assert install_prefix(odd, h) == (0, 0)
+        assert install_prefix(make_engine(tiny), None) == (0, 0)
+
+
+# -- unified fleet -------------------------------------------------------
+
+
+class TestUnifiedFleet:
+    def test_overload_zero_drop_bit_identical(self, tiny):
+        """8 shared-prefix requests into 2 replicas with KV pools far
+        too small: queueing + preemption on the loaded replica, zero
+        drops, streams bit-identical to the uncontended reference."""
+        prompts = shared_prompts(8)
+        gen = 8
+        ref = reference_outputs(tiny, prompts, gen)
+        router = make_fleet(tiny, kv_blocks=13, max_blocks_per_seq=4)
+        for uid, p in enumerate(prompts):
+            router.submit(uid, p, max_new_tokens=gen)
+        router.run_until_complete()
+        out = router.results()
+        assert sorted(out) == list(range(8))
+        assert all(len(t) == gen for t in out.values())  # zero drops
+        assert out == ref  # bit-identical
+        assert router.stats["completed"] == 8
+        # shared prefix -> affinity pinned the group to one replica
+        assert router.stats["affinity_hits"] == 7
+        # every request carries its routing decision in the trace
+        route_spans = [s for r in router.replicas.values()
+                       for s in span_kinds(r, "ROUTE")]
+        assert len(route_spans) == 8
+        assert all(s.fields["policy"] in ("least_loaded", "affinity")
+                   for s in route_spans)
+
+    def test_short_prompts_spread_least_loaded(self, tiny):
+        """Prompts below the affinity span route by load, and the inbox
+        counts toward load — back-to-back submissions alternate."""
+        router = make_fleet(tiny)
+        targets = [router.submit(uid, np.asarray([7, 8, 9], np.int32),
+                                 max_new_tokens=2) for uid in range(4)]
+        assert sorted(set(targets)) == [0, 1]
+        router.run_until_complete()
+        assert all(len(t) == 2 for t in router.results().values())
+
+    def test_never_fitting_prompt_rejected_up_front(self, tiny):
+        router = make_fleet(tiny)
+        with pytest.raises(ValueError, match="never"):
+            router.submit(1, np.zeros(200, np.int32), max_new_tokens=2)
+        assert router.stats["submitted"] == 0
+
+    def test_duplicate_uid_rejected(self, tiny):
+        router = make_fleet(tiny)
+        router.submit(1, np.asarray([1, 2, 3], np.int32), max_new_tokens=2)
+        with pytest.raises(ValueError, match="in flight"):
+            router.submit(1, np.asarray([4, 5], np.int32))
+        router.run_until_complete()
+
+
+# -- disaggregated prefill/decode ----------------------------------------
+
+
+class TestDisaggFleet:
+    def test_handoff_bit_identical_with_kv_install(self, tiny):
+        prompts = shared_prompts(6)
+        gen = 8
+        ref = reference_outputs(tiny, prompts, gen)
+        router = make_fleet(tiny, roles=("prefill", "decode"))
+        assert router.disagg
+        for uid, p in enumerate(prompts):
+            router.submit(uid, p, max_new_tokens=gen)
+        router.run_until_complete()
+        out = router.results()
+        assert all(len(t) == gen for t in out.values())
+        assert out == ref  # placement changed, tokens did not
+        assert router.stats["handoffs"] == 6
+        assert router.stats["handoff_recompute"] == 0
+
+        prefill, decode = router.replicas[0], router.replicas[1]
+        # the prompt KV actually moved: the decode replica attached the
+        # shared-prefix chain instead of re-prefilling it
+        assert decode.engine.stats["prefix_hit_tokens"] > 0
+        hand = span_kinds(decode, "HANDOFF")
+        assert len(hand) == 6
+        assert all(s.fields["mode"] == "kv_blocks" for s in hand)
+        assert sum(s.fields["blocks"] for s in hand) >= 2
+        # prefill replica only ever ran the 1-token first stage
+        assert all(t.generated_tokens == 1
+                   for t in prefill.engine.tracer.finished())
+        routes = span_kinds(decode, "ROUTE")
+        assert any(s.fields["policy"] == "disagg_handoff" for s in routes)
+
+    def test_fleet_snapshot_counts_both_stages(self, tiny):
+        router = make_fleet(tiny, roles=("prefill", "decode"))
+        for uid, p in enumerate(shared_prompts(3)):
+            router.submit(uid, p, max_new_tokens=4)
+        router.run_until_complete()
+        snap = router.fleet_snapshot(deadline_s=5.0)
+        assert snap["schema"] == "serving_fleet/v1"
+        assert snap["mode"] == "disagg"
+        assert {r["role"] for r in snap["replicas"]} == \
+            {"prefill", "decode"}
+        assert snap["router"]["handoffs"] == 3
+        # both stages traced: per-replica attribution sees each request
+        # on the prefill AND the decode lane
+        per = snap["slo_attribution"]["per_replica"]
+        assert per[0]["traces"] == 3 and per[1]["traces"] == 3
+        json.dumps(snap)  # the serve_top --fleet document must be JSON
+
+
+# -- failover ------------------------------------------------------------
+
+
+class TestFailover:
+    def test_mid_run_kill_recovers_all_in_flight(self, tiny):
+        """Kill the replica holding the whole affinity group mid-decode:
+        stale-heartbeat detection re-routes every in-flight request with
+        its generated tokens folded in; all 8 finish their full budget
+        bit-identical to the uncontended reference."""
+        prompts = shared_prompts(8)
+        gen = 8
+        ref = reference_outputs(tiny, prompts, gen)
+        router = make_fleet(tiny, router_kw={"stale_after_s": 0.2})
+        victim_id = router.submit(0, prompts[0], max_new_tokens=gen)
+        for uid in range(1, 8):
+            router.submit(uid, prompts[uid], max_new_tokens=gen)
+        # let decode start so some requests hold partial outputs
+        for _ in range(3):
+            router.step()
+        with router._lock:
+            partial = sum(1 for r in router._requests.values()
+                          if r.emitted and not r.done)
+        assert router.pending() > 0
+
+        router.replicas[victim_id].kill()
+        time.sleep(0.25)  # heartbeat ages past stale_after_s
+        router.run_until_complete()
+
+        out = router.results()
+        assert all(len(t) == gen for t in out.values())  # 100% complete
+        assert out == ref  # greedy continuation is bit-identical
+        assert router.dead == {victim_id}
+        assert router.stats["failovers"] == 1
+        assert router.stats["failed_over_requests"] > 0
+        survivor = router.replicas[1 - victim_id]
+        fo = span_kinds(survivor, "FAILOVER")
+        assert len(fo) == router.stats["failed_over_requests"]
+        assert all(s.fields["from_replica"] == victim_id for s in fo)
+        if partial:  # tokens generated before the crash were recovered
+            assert any(s.fields["recovered_tokens"] > 0 for s in fo)
+        snap = router.fleet_snapshot()
+        assert snap["dead_replicas"] == [victim_id]
+
+    def test_last_replica_death_raises(self, tiny):
+        router = make_fleet(tiny, roles=("unified",),
+                            router_kw={"stale_after_s": 0.05})
+        router.submit(1, np.asarray([1, 2, 3, 4], np.int32),
+                      max_new_tokens=4)
+        router.replicas[0].kill()
+        time.sleep(0.1)
+        with pytest.raises(RuntimeError, match="no live replicas"):
+            router.check_health()
+
+
+# -- autoscale signal ----------------------------------------------------
+
+
+class TestAutoscaleSignal:
+    def test_scale_up_needs_consecutive_hot_rounds(self):
+        a = AutoscaleSignal(hysteresis_rounds=3)
+        assert a.update(2, 20, 0.0, 100.0) == 2
+        assert a.update(2, 20, 0.0, 100.0) == 2
+        assert a.update(2, 20, 0.0, 100.0) == 3  # third hot in a row
+        assert a.history and a.history[-1][1] == 3
+
+    def test_contrary_round_resets_streak(self):
+        a = AutoscaleSignal(hysteresis_rounds=2)
+        a.update(2, 20, 0.0, 100.0)
+        a.update(2, 2, 0.0, 100.0)  # neutral: between low and high
+        a.update(2, 20, 0.0, 100.0)
+        assert a.desired == 2  # streak restarted, no decision yet
+        assert a.update(2, 20, 0.0, 100.0) == 3
+
+    def test_slo_miss_rate_alone_scales_up(self):
+        a = AutoscaleSignal(hysteresis_rounds=1, slo_miss_high=0.1)
+        assert a.update(2, 0.0, 0.5, 0.0) == 3  # empty queue, missing SLO
+
+    def test_rising_goodput_blocks_scale_down(self):
+        a = AutoscaleSignal(hysteresis_rounds=2)
+        for g in (100.0, 200.0, 300.0, 400.0, 500.0):
+            a.update(4, 0.0, 0.0, g)  # cold queue but load is ARRIVING
+        assert a.desired == 4
+        # goodput falls off: slope goes negative, scale-down proceeds
+        for g in (400.0, 300.0, 200.0):
+            a.update(4, 0.0, 0.0, g)
+        assert a.desired == 3
+
+    def test_bounds_respected(self):
+        a = AutoscaleSignal(min_replicas=2, max_replicas=3,
+                            hysteresis_rounds=1)
+        for _ in range(5):
+            a.update(3, 50, 0.9, 0.0)
+        assert a.desired == 3
+        for g in (10.0, 9.0, 8.0, 7.0, 6.0, 5.0):
+            a.update(3, 0.0, 0.0, g)
+        assert a.desired == 2
+        with pytest.raises(ValueError):
+            AutoscaleSignal(min_replicas=0)
+        with pytest.raises(ValueError):
+            AutoscaleSignal(min_replicas=4, max_replicas=2)
+
+
+# -- per-replica labeled metrics -----------------------------------------
+
+
+class TestLabeledMetrics:
+    def test_labeled_name_composition(self):
+        from deepspeed_tpu.observability.sinks import (labeled_name,
+                                                       split_labeled_name)
+
+        assert labeled_name("serve.requests", {"replica": "r0"}) == \
+            'serve.requests{replica="r0"}'
+        # keys sort, values escape — one canonical key per series
+        assert labeled_name("m", {"b": "2", "a": 'x"y'}) == \
+            'm{a="x\\"y",b="2"}'
+        assert labeled_name("m", None) == "m"
+        assert split_labeled_name('serve.requests{replica="r0"}') == \
+            ("serve.requests", '{replica="r0"}')
+        assert split_labeled_name("serve.requests") == \
+            ("serve.requests", "")
+
+    def test_render_distinct_series_single_type_line(self):
+        from deepspeed_tpu.observability.histogram import Histogram
+        from deepspeed_tpu.observability.sinks import (labeled_name,
+                                                       render_prometheus)
+
+        lbl0, lbl1 = {"replica": "r0"}, {"replica": "r1"}
+        h = Histogram("serve.decode")
+        h.observe(0.25)
+        text = render_prometheus(
+            {labeled_name("serve.queue_depth", lbl0): 3.0,
+             labeled_name("serve.queue_depth", lbl1): 5.0},
+            {labeled_name("serve.requests", lbl0): 7.0,
+             labeled_name("serve.requests", lbl1): 2.0},
+            {labeled_name("serve.decode", lbl0): h}, {})
+        assert 'dstpu_serve_queue_depth{replica="r0"} 3' in text
+        assert 'dstpu_serve_queue_depth{replica="r1"} 5' in text
+        # counters keep _total on the BASE name, before the labels
+        assert 'dstpu_serve_requests_total{replica="r0"} 7' in text
+        assert 'dstpu_serve_requests_total{replica="r1"} 2' in text
+        # exposition format: one TYPE line per metric family, not per
+        # labeled series
+        assert text.count("# TYPE dstpu_serve_queue_depth gauge") == 1
+        assert text.count("# TYPE dstpu_serve_requests_total counter") == 1
+        # histogram lines get the labels merged ahead of le=
+        assert 'dstpu_serve_decode_bucket{replica="r0",le="' in text
+        assert 'dstpu_serve_decode_count{replica="r0"} 1' in text
+
+    def test_fleet_engines_emit_per_replica_series(self, tiny):
+        from deepspeed_tpu.observability.hub import get_hub, reset_hub
+
+        reset_hub()
+        try:
+            router = make_fleet(tiny)
+            for uid in range(4):
+                router.submit(uid, np.asarray([3, 1, 4, 1, 5], np.int32),
+                              max_new_tokens=2)
+            router.run_until_complete()
+            text = get_hub().to_prometheus()
+            assert 'replica="r0"' in text and 'replica="r1"' in text
+            assert "dstpu_serve_fleet_replicas_alive 2" in text
+        finally:
+            reset_hub()
+
+
+# -- Perfetto fleet export -----------------------------------------------
+
+
+class TestFleetPerfetto:
+    def test_one_lane_group_per_replica(self, tiny, tmp_path):
+        router = make_fleet(tiny, roles=("prefill", "decode"))
+        for uid, p in enumerate(shared_prompts(3)):
+            router.submit(uid, p, max_new_tokens=4)
+        router.run_until_complete()
+        path = router.export_perfetto(str(tmp_path / "lanes.json"))
+        doc = json.load(open(path))
+        names = [e for e in doc["traceEvents"]
+                 if e.get("name") == "process_name"]
+        assert {e["args"]["name"] for e in names} == \
+            {"replica r0", "replica r1"}
+        # both replicas contributed request lanes on a shared clock
+        pids = {e.get("pid") for e in doc["traceEvents"]
+                if e.get("ph") == "X"}
+        assert {0, 1} <= pids
+
+
+# -- config block --------------------------------------------------------
+
+
+class TestRouterConfig:
+    def test_defaults_and_overrides(self):
+        from deepspeed_tpu.config.config import load_config
+
+        cfg = load_config(None)
+        assert cfg.serving.router.replicas == 2
+        assert cfg.serving.router.mode == "unified"
+        cfg = load_config({"serving": {"router": {
+            "replicas": 4, "mode": "disagg", "prefill_replicas": 1,
+            "stale_after_seconds": 2.0}}})
+        assert cfg.serving.router.mode == "disagg"
+        assert cfg.serving.router.prefill_replicas == 1
+        assert cfg.serving.router.stale_after_seconds == 2.0
+
+    def test_validation_errors(self):
+        from deepspeed_tpu.config.config import load_config
+
+        with pytest.raises(ValueError, match="serving.router.mode"):
+            load_config({"serving": {"router": {"mode": "sharded"}}})
+        with pytest.raises(ValueError,
+                           match="serving.router.prefill_replicas"):
+            load_config({"serving": {"router": {
+                "mode": "disagg", "replicas": 2, "prefill_replicas": 2}}})
+        with pytest.raises(ValueError, match="serving.router.replicas"):
+            load_config({"serving": {"router": {"replicas": 0}}})
+        with pytest.raises(ValueError, match="autoscale_min"):
+            load_config({"serving": {"router": {
+                "autoscale_min": 5, "autoscale_max": 2}}})
+
+    def test_build_fleet_from_config(self, tiny):
+        from deepspeed_tpu.config.config import RouterConfig
+        from deepspeed_tpu.serving.router import build_fleet
+
+        model, params = tiny
+        router = build_fleet(
+            model, RouterConfig(replicas=3, mode="disagg",
+                                prefill_replicas=1),
+            engine_kw=dict(params=params, dtype=jnp.float32,
+                           **ENGINE_DEFAULTS))
+        assert router.disagg
+        assert router.prefill_pool == [0]
+        assert router.decode_pool == [1, 2]
+        assert router.autoscale is not None
+        assert [router.replicas[i].role for i in range(3)] == \
+            ["prefill", "decode", "decode"]

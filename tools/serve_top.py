@@ -14,6 +14,15 @@ tool is the read side — pure host code, no jax:
   python tools/serve_top.py TRACES.jsonl --chrome-trace --out lanes.json
                                                         # Perfetto export
   python tools/serve_top.py --demo                      # CPU demo run
+  python tools/serve_top.py --fleet SNAP.json           # fleet snapshot
+  python tools/serve_top.py --fleet --demo              # 2-replica demo
+
+``--fleet`` reads a ``serving_fleet/v1`` snapshot document
+(``FleetRouter.fleet_snapshot()``; ``make serve-fleet`` writes one per
+arm into FLEET_TRACE_DIR) and prints the per-replica load-report table,
+the router counters (handoffs, failovers, affinity hits), the autoscale
+state, and the fleet-level SLO attribution with per-replica miss
+counts.
 
 The table decomposes each request's TTFT and e2e wall time into
 queue_wait / prefill / decode / preempted / spec_overhead phases and
@@ -57,6 +66,12 @@ def parse_args(argv=None):
     p.add_argument("--demo", action="store_true",
                    help="run a small CPU serve_step workload through the "
                         "v2 engine and print its attribution table")
+    p.add_argument("--fleet", action="store_true",
+                   help="treat the positional file as a serving_fleet/v1 "
+                        "snapshot (FleetRouter.fleet_snapshot / make "
+                        "serve-fleet) and print the per-replica fleet "
+                        "view; with --demo, run a 2-replica in-process "
+                        "fleet first")
     return p.parse_args(argv)
 
 
@@ -133,8 +148,92 @@ def _run_demo() -> int:
     return 0
 
 
+def _fleet_table(snap: dict) -> str:
+    """Render a serving_fleet/v1 snapshot as the fleet dashboard."""
+    lines = [f"## serving fleet ({snap.get('mode', '?')} mode)", "",
+             "| replica | role | steps | queue | live | inflight | "
+             "kv free | goodput tok/s | state |",
+             "|---|---|---|---|---|---|---|---|---|"]
+    dead = set(snap.get("dead_replicas", []))
+    for r in snap.get("replicas", []):
+        state = ("DEAD" if r["replica"] in dead
+                 else "killed" if r.get("killed") else "up")
+        lines.append(
+            f"| r{r['replica']} | {r['role']} | {r['steps']} | "
+            f"{r['queue_wait_depth']} | {r['live_seqs']} | "
+            f"{r['inflight']} | {r['kv_free_frac'] * 100:.0f}% | "
+            f"{r['goodput_tokens_per_s']} | {state} |")
+    st = snap.get("router", {})
+    lines += ["", "router: " + "  ".join(
+        f"{k}={st[k]}" for k in ("submitted", "completed", "handoffs",
+                                 "handoff_recompute", "failovers",
+                                 "failed_over_requests", "affinity_hits")
+        if k in st)]
+    auto = snap.get("autoscale")
+    if auto:
+        lines += ["autoscale: desired_replicas="
+                  f"{auto.get('desired_replicas')} "
+                  f"goodput_slope={auto.get('goodput_slope')} "
+                  f"decisions={len(auto.get('decisions', []))}"]
+    attr = snap.get("slo_attribution") or {}
+    per = attr.get("per_replica") or {}
+    if per:
+        lines += ["", "### fleet SLO attribution", "",
+                  "| replica | traces | slo misses |", "|---|---|---|"]
+        for rid in sorted(per, key=lambda x: int(x)):
+            row = per[rid]
+            lines.append(f"| r{rid} | {row['traces']} | "
+                         f"{row['slo_misses']} |")
+        if attr.get("miss_dominant_phase"):
+            lines.append(f"\ndominant miss phase: "
+                         f"{attr['miss_dominant_phase']}")
+    return "\n".join(lines)
+
+
+def _run_fleet_demo() -> int:
+    """Two in-process unified replicas over a shared-prefix burst, then
+    the fleet dashboard — the multi-replica analog of --demo."""
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import numpy as np
+
+    from deepspeed_tpu.config.config import RouterConfig
+    from deepspeed_tpu.serving.router import build_fleet
+    from deepspeed_tpu.models.zoo import get_model
+
+    model = get_model("tiny")
+    router = build_fleet(model, RouterConfig(replicas=2), engine_kw=dict(
+        kv_blocks=24, kv_block_size=8, max_tokens_per_step=32,
+        max_seqs_per_step=4, max_blocks_per_seq=8, prefix_cache=True,
+        request_trace={"sample_rate": 1.0, "slo_deadline_ms": 200.0}))
+    rng = np.random.default_rng(0)
+    shared = rng.integers(0, model.config.vocab_size, (16,))
+    for uid in range(8):
+        tail = rng.integers(0, model.config.vocab_size, (8,))
+        router.submit(uid, np.concatenate([shared, tail]).astype(np.int32),
+                      max_new_tokens=12)
+    router.run_until_complete()
+    print(_fleet_table(router.fleet_snapshot(deadline_s=0.2)))
+    return 0
+
+
 def main(argv=None) -> int:
     args = parse_args(argv)
+    if args.fleet:
+        if args.demo:
+            return _run_fleet_demo()
+        if not args.traces:
+            print("serve_top: error: --fleet needs a snapshot file "
+                  "(or --demo)", file=sys.stderr)
+            return 2
+        with open(args.traces) as f:
+            snap = json.load(f)
+        if snap.get("schema") != "serving_fleet/v1":
+            print(f"serve_top: {args.traces} is not a serving_fleet/v1 "
+                  f"snapshot (schema={snap.get('schema')!r})",
+                  file=sys.stderr)
+            return 1
+        print(_fleet_table(snap))
+        return 0
     if args.demo:
         return _run_demo()
     if not args.traces:
