@@ -14,7 +14,7 @@ NPROC := $(shell nproc)
 XDIST ?= $(shell if [ $(NPROC) -gt 2 ] && python -c "import xdist" 2>/dev/null; then echo "-n $$(( $(NPROC) - 1 )) --dist loadfile"; fi)
 PYTEST ?= python -m pytest
 
-.PHONY: test smoke slow bench bench-real bench-proxy bench-hostgap bench-overlap bench-longctx fleet-demo chaos serve-slo serve-fleet
+.PHONY: test smoke slow bench bench-real bench-proxy bench-hostgap bench-overlap bench-longctx bench-quant bench-diff quant-sweep fleet-demo chaos serve-slo serve-fleet
 
 smoke:
 	$(PYTEST) tests/ -q -m "not slow" $(XDIST)
@@ -54,6 +54,30 @@ bench-overlap:
 bench-longctx:
 	BENCH_LONGCTX=1 python bench.py
 	BENCH_LONGCTX=1 BENCH_SEQ=1048576 BENCH_SP=8 python bench.py
+
+# Quantization acceptance gates (observability/quant_stats.py
+# run_quant_bench): measures the ZeRO++ trio's error on real tensors —
+# qwZ int8 param-fetch SNR, qgZ two-level int8+int4 grad-reduce SNR,
+# fp8 e4m3 MLP — against the DEFAULT_GATES bounds, verifies the
+# all-knobs-off path is bit-exact, and exits nonzero on any violation.
+# BENCH_QUANT_INJECT=corrupt_scale demonstrates the trip. CPU-safe
+# (docs/quantized_comm.md "Measuring the trade").
+bench-quant:
+	BENCH_QUANT=1 python bench.py
+
+# Fail-loud regression sentinel over the BENCH_r*.json trajectory:
+# newest vs previous round per headline metric (throughput, mfu,
+# hidden_comm_frac, host_gap_ms, quant gates); exits nonzero past the
+# thresholds (tools/bench_diff.py).
+bench-diff:
+	python tools/bench_diff.py
+
+# The {qwZ x qgZ x hpZ} before/after attribution sweep on the real
+# 8L · 131k-vocab shape (analytic, CPU-safe). --persist writes the
+# winning mode into the autotuner's real-shape defaults file, which
+# bench.py reads back as quant_mode (tools/quant_sweep.py).
+quant-sweep:
+	python tools/quant_sweep.py --persist docs/autotuned/real_shape.json
 
 # Two-process CPU demo of the fleet observability layer: both ranks
 # publish shards into a temp run dir, then the aggregated report (skew,

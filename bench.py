@@ -155,6 +155,12 @@ def resolve_bench_defaults(env=None, on_tpu=True, n_chips=1):
                      else (int(od_tuned) if od_tuned is not None
                            else (4 if real else None)))
     fp8_mlp = bool(int(env.get("BENCH_FP8_MLP", "0")))
+    # ZeRO++ quantization mode (parse_quant_mode grammar: off |
+    # qwz+qgz+hpz<k>): env > tuned file (the quant_modes autotuner axis
+    # / tools/quant_sweep.py --persist write the same key) > off
+    qm_env = env.get("BENCH_QUANT_MODE")
+    quant_mode = (str(qm_env) if qm_env is not None
+                  else str(tuned.get("quant_mode", "off")))
     # the full step at the real shape is host-Adam-bound on a 1-core
     # rig; the chip-side MFU question is answered by the device fwd+bwd
     # program (tools/device_step_bench.py) — that is the headline there
@@ -170,6 +176,7 @@ def resolve_bench_defaults(env=None, on_tpu=True, n_chips=1):
         "zero_stage": zero_stage,
         "param_prefetch_depth": param_prefetch,
         "overlap_depth": overlap_depth, "fp8_mlp": fp8_mlp,
+        "quant_mode": quant_mode,
         "measure": measure,
         "config_source": ("autotuned-file" if tuned
                           else "measured-defaults"),
@@ -329,6 +336,23 @@ def main():
         table, payload = longctx_bench_report()
         print(table)
         print(json.dumps(payload))
+        return
+
+    if int(os.environ.get("BENCH_QUANT", "0")):
+        # quantization acceptance gates (make bench-quant): per-region
+        # SNR / max-rel-error on real params+grads, the bit-exact
+        # off-switch, fail-loud exit on violation. CPU-safe — the
+        # quantizer math is measured directly (observability/
+        # quant_stats.py run_quant_bench); BENCH_QUANT_INJECT=
+        # corrupt_scale demonstrates the nonzero exit.
+        from deepspeed_tpu.observability.quant_stats import \
+            run_quant_bench
+
+        table, payload, ok = run_quant_bench()
+        print(table)
+        print(json.dumps(payload))
+        if not ok:
+            raise SystemExit(1)
         return
 
     import jax
@@ -495,6 +519,16 @@ def main():
         "performance": performance,
         "steps_per_print": 1_000_000,
     }
+    quant_mode = knobs.get("quant_mode", "off")
+    if quant_mode != "off" and (n_chips > 1 or int(os.environ.get(
+            "BENCH_QUANT_FORCE", "0"))):
+        # ZeRO++ quantized collectives per the tuned/env quant_mode. On
+        # a 1-chip rig the paths are inert (fsdp=1: nothing to gather
+        # or reduce) and the flags only produce wiring warnings, so the
+        # mode is applied when a real mesh exists (or forced for A/B).
+        from deepspeed_tpu.autotuning.autotuner import parse_quant_mode
+
+        config["zero_optimization"].update(parse_quant_mode(quant_mode))
     if offload:
         # ZeRO-Offload mode: fp32 master + Adam state live in host RAM,
         # the chip keeps bf16 params only (capacity benchmark — the
@@ -720,6 +754,7 @@ def main():
         "hidden_comm_frac": hidden_comm_frac,
         "exposed_param_fetch_ms": exposed_param_fetch_ms,
         "fp8_mlp": knobs["fp8_mlp"],
+        "quant_mode": quant_mode,
         "loss": round(float(loss), 4),
         "chips": n_chips,
     }))

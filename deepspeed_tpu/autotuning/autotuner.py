@@ -32,6 +32,47 @@ METRIC_THROUGHPUT = "throughput"  # samples/sec (reference autotuning_metric)
 METRIC_LATENCY = "latency"
 
 
+def parse_quant_mode(mode: str) -> Dict[str, Any]:
+    """Decode a ZeRO++ quantization-mode label into the
+    ``zero_optimization`` keys it stands for.
+
+    Grammar: ``"off"`` or ``"+"``-joined tokens from {``qwz``, ``qgz``,
+    ``hpz<k>``} — e.g. ``"qwz+qgz+hpz8"``. This is the shared vocabulary
+    of the ``quant_modes`` tuning axis, ``tools/quant_sweep.py`` rows,
+    and the ``quant_mode`` key bench.py reads back from the persisted
+    real-shape defaults."""
+    out = {"zero_quantized_weights": False,
+           "zero_quantized_gradients": False,
+           "zero_hpz_partition_size": 1}
+    mode = str(mode).strip().lower()
+    if mode in ("off", "", "none"):
+        return out
+    for tok in mode.split("+"):
+        tok = tok.strip()
+        if tok == "qwz":
+            out["zero_quantized_weights"] = True
+        elif tok == "qgz":
+            out["zero_quantized_gradients"] = True
+        elif tok.startswith("hpz"):
+            try:
+                out["zero_hpz_partition_size"] = int(tok[3:])
+            except ValueError:
+                raise ValueError(f"bad hpz token {tok!r} in quant mode "
+                                 f"{mode!r} (want e.g. hpz8)") from None
+        else:
+            raise ValueError(f"unknown quant-mode token {tok!r} in "
+                             f"{mode!r} (grammar: off | qwz+qgz+hpz<k>)")
+    return out
+
+
+def format_quant_mode(qwz: bool, qgz: bool, hpz: int = 1) -> str:
+    """Inverse of :func:`parse_quant_mode`."""
+    toks = ([] if not qwz else ["qwz"]) + ([] if not qgz else ["qgz"])
+    if int(hpz) > 1:
+        toks.append(f"hpz{int(hpz)}")
+    return "+".join(toks) or "off"
+
+
 @dataclasses.dataclass
 class AutotunerResult:
     config: Dict[str, Any]
@@ -107,6 +148,10 @@ class Autotuner:
         # for models running sequence-parallel; None = keep the model's
         # own sp_mode (or whatever the planner composed at init)
         self.sp_modes = list(space.get("sp_modes", [None]))
+        # ZeRO++ quantization modes (ISSUE 11): parse_quant_mode labels
+        # ("off", "qwz+qgz+hpz8", ...) expanded into zero_optimization
+        # keys per candidate; None = keep the base config's flags
+        self.quant_modes = list(space.get("quant_modes", [None]))
         self.hbm_budget = hbm_budget_bytes or self._detect_hbm()
         self.results_dir = results_dir
         self.persist_path = persist_path
@@ -130,11 +175,12 @@ class Autotuner:
     # -- candidate enumeration (reference tune_space) -------------------
     def candidates(self) -> List[Dict[str, Any]]:
         out = []
-        for (mb, stage, remat, policy, tl, ac, pd, od,
-             sm) in itertools.product(
+        for (mb, stage, remat, policy, tl, ac, pd, od, sm,
+             qm) in itertools.product(
                 self.micro_batch_sizes, self.zero_stages, self.remat,
                 self.remat_policies, self.tiled_logits, self.attn_chunks,
-                self.prefetch_depths, self.overlap_depths, self.sp_modes):
+                self.prefetch_depths, self.overlap_depths, self.sp_modes,
+                self.quant_modes):
             cfg = json.loads(json.dumps(self.base_config))  # deep copy
             cfg["train_micro_batch_size_per_chip"] = int(mb)
             cfg.pop("train_batch_size", None)  # re-derived from micro×gas×dp
@@ -154,6 +200,12 @@ class Autotuner:
                 cfg["_overlap_depth"] = int(od)
             if sm is not None:
                 cfg["_sp_mode"] = str(sm)
+            if qm is not None:
+                # expand the label into real zero_optimization keys so
+                # the trial engine actually runs the mode; keep the
+                # label as a private key for tuned_defaults/persist
+                cfg["zero_optimization"].update(parse_quant_mode(qm))
+                cfg["_quant_mode"] = str(qm)
             out.append(cfg)
         return out
 
@@ -164,6 +216,7 @@ class Autotuner:
         cfg = dict(cfg)
         remat = cfg.pop("_remat", False)
         policy = cfg.pop("_remat_policy", None)
+        cfg.pop("_quant_mode", None)  # label only; flags already applied
         model_axes = {name: cfg.pop(key)
                       for key, name in (("_tiled_logits", "tiled_logits"),
                                         ("_attn_chunks", "attn_chunks"),
@@ -355,6 +408,8 @@ class Autotuner:
                 int(out.pop("_overlap_depth"))
         if "_sp_mode" in out:
             out["sp_mode"] = str(out.pop("_sp_mode"))
+        if "_quant_mode" in out:
+            out["quant_mode"] = str(out.pop("_quant_mode"))
         return out
 
     def _persist_best(self, cfg: Dict[str, Any],
@@ -421,6 +476,10 @@ def main(argv=None) -> int:
                     help="overlap-engine depths to try (0 = unstaged "
                          "schedule; k pins the k newest in-flight "
                          "transfers into the issuing layer's stage)")
+    ap.add_argument("--quant-modes", nargs="+", default=None,
+                    help="ZeRO++ quantization modes to try (grammar: "
+                         "off | qwz+qgz+hpz<k>, e.g. off qwz qwz+qgz "
+                         "qwz+qgz+hpz8)")
     ap.add_argument("--fast", action="store_true",
                     help="rank by compiled memory only (no timed runs)")
     ap.add_argument("--steps", type=int, default=3)
@@ -471,6 +530,11 @@ def main(argv=None) -> int:
         space["overlap_depths"] = args.overlap_depths
     if args.sp_modes is not None:
         space["sp_modes"] = args.sp_modes
+    if args.quant_modes is not None:
+        # validate the labels up front (fail before any trial compiles)
+        for qm in args.quant_modes:
+            parse_quant_mode(qm)
+        space["quant_modes"] = args.quant_modes
     tuner = Autotuner(model_factory, base, batch_fn,
                       tuning_space=space or None,
                       results_dir=args.results_dir,

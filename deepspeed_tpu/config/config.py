@@ -486,9 +486,15 @@ class ObservabilityConfig(ConfigModel):
     (0 disables) dumped on crash/SIGTERM/watchdog fire.
     ``request_trace`` configures the per-request serving flight paths
     (tail-sampled span timelines + SLO attribution; see
-    RequestTraceConfig)."""
+    RequestTraceConfig). ``quant_stats`` opts into the ZeRO++
+    quantization-error telemetry (observability/quant_stats.py):
+    ``quant.*`` hub metrics — per-region SNR dB, max relative error,
+    wire-vs-logical bytes — sampled at engine init when qwZ/qgZ run
+    (env override DSTPU_QUANT_STATS=1); off by default because the
+    init-time sample quantizes a capped slice of the real params."""
 
     enabled: bool = True
+    quant_stats: bool = False
     jsonl_path: Optional[str] = None
     prometheus_path: Optional[str] = None
     prometheus_every_steps: int = 10

@@ -54,8 +54,10 @@ LONGCTX_REGIONS = ("attn", "sp_comm", "host_kv_stream")
 
 # Transfer (DMA) regions: their roofline time is bytes/bandwidth on the
 # link they ride, not flops/bytes against HBM. sp_comm rides ICI; the
-# host streams ride the host link.
-DMA_REGIONS = frozenset({"param_fetch", "sp_comm", "host_kv_stream"})
+# host streams ride the host link; grad_reduce (the qgZ region,
+# attribute_quant_step) rides ICI/DCN per its level structure.
+DMA_REGIONS = frozenset({"param_fetch", "sp_comm", "host_kv_stream",
+                         "grad_reduce"})
 
 # measured sustained H2D on the tunnel-attached v5e (docs/roofline.md);
 # a pod's per-layer bf16 all-gather over ICI is ≥20x this
@@ -64,6 +66,10 @@ _DEFAULT_FETCH_GBPS = 3.3
 # one v5e ICI link direction (sustained, docs/roofline.md); override
 # with DSTPU_ICI_GBPS for other topologies
 _DEFAULT_ICI_GBPS = 45.0
+
+# inter-slice data-center network per chip (the link hpZ keeps gathers
+# off); override with DSTPU_DCN_GBPS
+_DEFAULT_DCN_GBPS = 6.25
 
 
 def _dma_gbps(region: str, fetch_gbps: Optional[float] = None,
@@ -86,6 +92,12 @@ class RegionCost:
     bytes_accessed: float
     note: str = ""
     overlapped: bool = False  # traffic hidden behind compute when true
+    # DMA regions only: pin the link this region's bytes divide by
+    # (attribute_quant_step sets these — e.g. grad_reduce's effective
+    # bandwidth over its ICI+DCN level mix). None falls back to the
+    # region-name default in _dma_gbps.
+    gbps: Optional[float] = None
+    link: Optional[str] = None
 
     @property
     def intensity(self) -> float:
@@ -353,6 +365,111 @@ def attribute_longctx_step(*, seq_len: int, hidden_size: int,
 
 
 # ---------------------------------------------------------------------------
+# Quantized-comm attribution (ZeRO++ trio: qwZ / qgZ / hpZ)
+# ---------------------------------------------------------------------------
+# The before/after table ROADMAP item 1 asks for: what do the quantized
+# wire formats do to the two collective regions on a pod projection?
+# Wire bytes come from the same closed form observability/quant_stats.py
+# measures (int payload + one fp32 scale per block); links come from the
+# mesh factorization hpZ controls. Analytic on purpose — it runs on CPU
+# CI and extrapolates to chip counts the rig doesn't have, exactly like
+# attribute_longctx_step.
+
+def _wire_ratio(bits: int, block: int, full_bytes: float) -> float:
+    """(int payload + fp32 scale per block) / full-precision bytes."""
+    return (bits / 8.0 + 4.0 / block) / full_bytes
+
+
+def attribute_quant_step(cfg, *, qwz: bool = False, qgz: bool = False,
+                         hpz: int = 1, n_chips: int = 16,
+                         slice_size: int = 8,
+                         ici_gbps: Optional[float] = None,
+                         dcn_gbps: Optional[float] = None
+                         ) -> List[RegionCost]:
+    """Per-chip analytic costs of the two quantized-collective regions
+    for one fwd+bwd step of ``cfg`` on ``n_chips`` arranged in slices of
+    ``slice_size`` (intra-slice ICI, inter-slice DCN):
+
+    - **param_fetch** — the stage-3 per-layer param all-gather: each
+      chip receives (g-1)/g of every layer's params, fwd + bwd, where
+      g is the gather group (hpZ partition k when set, else all
+      chips). qwZ turns the bf16 wire into int8 payload + one fp32
+      scale per QWZ_BLOCK ((1+4/128)/2 ≈ 0.52×); hpZ keeps the group
+      intra-slice so the bytes ride ICI instead of DCN.
+    - **grad_reduce** — the qgZ reduction: level 1 moves every
+      gradient element once over the fsdp group ((g1-1)/g1 of the fp32
+      wire); when hpZ splits the mesh a second level reduces partial
+      sums over the dp axis across slices. qgZ quantizes level 1 to
+      int8 and the inter-slice level to int4, each + fp32 scales per
+      QGZ_BLOCK.
+
+    Each region's ``gbps``/``link`` pin the byte-weighted effective
+    bandwidth of its level mix, so the roofline ms reflects the link
+    flip, not just the byte shrink."""
+    from deepspeed_tpu.runtime.qgz import QGZ_BLOCK
+    from deepspeed_tpu.runtime.sharding import QWZ_BLOCK
+
+    ici = (ici_gbps if ici_gbps is not None
+           else float(os.environ.get("DSTPU_ICI_GBPS", _DEFAULT_ICI_GBPS)))
+    dcn = (dcn_gbps if dcn_gbps is not None
+           else float(os.environ.get("DSTPU_DCN_GBPS", _DEFAULT_DCN_GBPS)))
+    N = max(int(n_chips), 1)
+    S = max(min(int(slice_size), N), 1)
+    k = max(int(hpz), 1)
+    L = cfg.num_layers
+
+    params = _abstract_params(cfg)
+    lp = _per_layer_shapes(params["layers"])
+    layer_elems = sum(int(jnp.prod(jnp.asarray(s.shape)))
+                      for s in jax.tree.leaves(lp))
+    n_params = cfg.num_params()
+
+    # -- param_fetch: per-layer all-gather, fwd + bwd -------------------
+    g = k if k > 1 else N
+    frac = (g - 1) / g if g > 1 else 0.0
+    fetch_full = 2.0 * layer_elems * frac * L * 2     # bf16 wire
+    w_ratio = _wire_ratio(8, QWZ_BLOCK, 2.0) if qwz else 1.0
+    fetch_bytes = fetch_full * w_ratio
+    fetch_link = "ici" if (k > 1 and k <= S) or N <= S else "dcn"
+    fetch_gbps_eff = ici if fetch_link == "ici" else dcn
+    fetch_note = (
+        ("int8+scales all-gather" if qwz else "bf16 all-gather")
+        + f" over g={g} ({fetch_link.upper()})"
+        + (f", hpZ k={k} keeps it intra-slice" if k > 1 else ""))
+
+    # -- grad_reduce: qgZ level structure -------------------------------
+    g1 = k if k > 1 else N
+    dp = N // g1 if k > 1 else 1
+    l1_link = "ici" if g1 <= S else "dcn"
+    l1_frac = (g1 - 1) / g1 if g1 > 1 else 0.0
+    l1_ratio = _wire_ratio(8, QGZ_BLOCK, 4.0) if qgz else 1.0
+    l1_bytes = 4.0 * n_params * l1_frac * l1_ratio
+    l2_frac = (dp - 1) / dp if dp > 1 else 0.0
+    l2_ratio = _wire_ratio(4, QGZ_BLOCK, 4.0) if qgz else 1.0
+    l2_bytes = 4.0 * n_params * l2_frac * l2_ratio
+    l1_ms = l1_bytes / ((ici if l1_link == "ici" else dcn) * 1e9) * 1e3
+    l2_ms = l2_bytes / (dcn * 1e9) * 1e3
+    red_bytes = l1_bytes + l2_bytes
+    red_ms = l1_ms + l2_ms
+    red_gbps = (red_bytes / (red_ms * 1e6)) if red_ms > 0 else ici
+    red_link = (l1_link if dp <= 1
+                else f"{l1_link}+dcn")
+    red_note = (
+        (f"int8 level1 over fsdp={g1} ({l1_link.upper()})" if qgz
+         else f"fp32 reduce over fsdp={g1} ({l1_link.upper()})")
+        + ((f" + {'int4' if qgz else 'fp32'} level2 over dp={dp} (DCN)")
+           if dp > 1 else ""))
+
+    return [
+        RegionCost("param_fetch", 0.0, fetch_bytes, note=fetch_note,
+                   overlapped=True, gbps=fetch_gbps_eff,
+                   link=fetch_link),
+        RegionCost("grad_reduce", 0.0, red_bytes, note=red_note,
+                   overlapped=False, gbps=red_gbps, link=red_link),
+    ]
+
+
+# ---------------------------------------------------------------------------
 # Exposed-vs-hidden split (ISSUE 6 overlap engine)
 # ---------------------------------------------------------------------------
 # The overlap engine (runtime/param_stream.py pin_stage) stages each
@@ -399,7 +516,7 @@ def split_exposed_hidden(regions: List[RegionCost], *,
     ms: Dict[str, float] = {}
     for r in regions:
         if r.region in DMA_REGIONS:
-            bw = _dma_gbps(r.region, fetch_gbps)
+            bw = r.gbps or _dma_gbps(r.region, fetch_gbps)
             ms[r.region] = r.bytes_accessed / (bw * 1e9) * 1e3
         else:
             compute_ms = r.flops / (peak_tflops * 1e12) * 1e3
@@ -448,8 +565,10 @@ def attribution_markdown(regions: List[RegionCost], peak_tflops: float,
              f"|---|---|---|---|---|---|{extra_sep}---|"]
     for r in regions:
         if r.region in DMA_REGIONS:
-            ms = r.bytes_accessed / (_dma_gbps(r.region, fetch) * 1e9) * 1e3
-            bound = "ici" if r.region == "sp_comm" else "host-link"
+            bw = r.gbps or _dma_gbps(r.region, fetch)
+            ms = r.bytes_accessed / (bw * 1e9) * 1e3
+            bound = r.link or ("ici" if r.region == "sp_comm"
+                               else "host-link")
         else:
             summ = roofline_summary(
                 {"flops": r.flops, "bytes_accessed": r.bytes_accessed},

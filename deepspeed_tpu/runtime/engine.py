@@ -572,6 +572,32 @@ class Engine:
             f"{config.zero_optimization.stage}, dp={self.dp_world_size}, "
             f"micro={self.micro_batch_size}, gas="
             f"{self.gradient_accumulation_steps}", ranks=[0])
+
+        # -- quantization telemetry (observability/quant_stats.py) --------
+        # Quantized collectives without error measurement are the failure
+        # mode ROADMAP item 1 names: warn once when qwZ/qgZ run blind,
+        # collect quant.* metrics (init-time param-side sample + flight
+        # dump context) when collection is configured.
+        zq_flags = config.zero_optimization
+        if (zq_flags.zero_quantized_weights
+                or zq_flags.zero_quantized_gradients):
+            try:
+                from deepspeed_tpu.observability import quant_stats as _qs
+
+                if _qs.collection_configured(self._obs_cfg):
+                    _qs.install_engine_collector(self)
+                else:
+                    from deepspeed_tpu.utils.logging import warning_once
+
+                    warning_once(
+                        "ZeRO++ quantization (zero_quantized_weights/"
+                        "zero_quantized_gradients) is enabled but no "
+                        "quant.* collection is configured — quantization "
+                        "error and wire bytes are unmeasured. Set "
+                        "observability.quant_stats=true or "
+                        "DSTPU_QUANT_STATS=1 (docs/quantized_comm.md).")
+            except Exception as e:
+                logger.warning(f"quant telemetry unavailable: {e}")
         mem_util.see_memory_usage("engine init: ready")
 
     # ------------------------------------------------------------------

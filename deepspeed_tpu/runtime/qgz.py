@@ -166,10 +166,23 @@ def _reduce_leaf(g, out_sharding: NamedSharding, mesh, dp: int, fsdp: int,
             ent = _with(ent, a)
         to_payload[target_dim] = ent
         to_spec = _blocked_spec(to_groups + to_payload, block_axis)
+        from deepspeed_tpu.comm import comm as _comm
+
         q = lax.with_sharding_constraint(q, NamedSharding(mesh, P(*from_spec)))
         s = lax.with_sharding_constraint(s, NamedSharding(mesh, P(*from_spec)))
-        q = lax.with_sharding_constraint(q, NamedSharding(mesh, P(*to_spec)))
-        s = lax.with_sharding_constraint(s, NamedSharding(mesh, P(*to_spec)))
+        # the to_spec constraints ARE the a2a wire (GSPMD lowers the
+        # axis move to all-to-all); traced_span accounts the int8/int4
+        # payload + fp32 scale bytes — wire, not logical — in the
+        # comms logger, flight ring, and Perfetto comm lanes
+        tag = "+".join(names)
+        with _comm.traced_span("all_to_all", q, tuple(names),
+                               f"qgz_{tag}_int{bits}"):
+            q = lax.with_sharding_constraint(
+                q, NamedSharding(mesh, P(*to_spec)))
+        with _comm.traced_span("all_to_all", s, tuple(names),
+                               f"qgz_{tag}_scales"):
+            s = lax.with_sharding_constraint(
+                s, NamedSharding(mesh, P(*to_spec)))
         idxs = tuple(i for i, a in enumerate(groups) if a in names)
         out = (q.astype(jnp.float32) * s).sum(axis=idxs)
         payload[target_dim] = to_payload[target_dim]

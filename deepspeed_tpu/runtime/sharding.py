@@ -502,6 +502,8 @@ def quantized_param_fetch(x, logical_axes: Sequence[Optional[str]],
     blocked_shape = shape[:ax] + (n // block, block) + shape[ax + 1:]
 
     def qdq(p):
+        from deepspeed_tpu.comm import comm as _comm
+
         f = p.reshape(blocked_shape).astype(jnp.float32)
         s = jnp.max(jnp.abs(f), axis=ax + 1, keepdims=True) / 127.0
         s = jnp.where(s == 0.0, 1.0, s)
@@ -511,13 +513,19 @@ def quantized_param_fetch(x, logical_axes: Sequence[Optional[str]],
         # pair — XLA CPU's in-process communicator deadlocks on too many
         # concurrent all-gathers, and one-outstanding-per-weight is also
         # the right schedule on TPU (scales ride along, payload follows).
+        # Both gathers ride comm.traced_span so the flight ring and
+        # Perfetto comm lanes account WIRE bytes (int8 payload + fp32
+        # scales), not the logical bf16 tensor.
         s = jax.lax.with_sharding_constraint(s, sh_blocked)
-        s_g = jax.lax.with_sharding_constraint(s, sh_gathered)
+        with _comm.traced_span("all_gather", s, "fsdp", "qwz_scales"):
+            s_g = jax.lax.with_sharding_constraint(s, sh_gathered)
         s_local = jax.lax.with_sharding_constraint(s_g, sh_blocked)
         q = jnp.round(f / s_local).astype(jnp.int8)
         # quantize on the shard, gather the int8 payload over fsdp
         q = jax.lax.with_sharding_constraint(q, sh_blocked)
-        q = jax.lax.with_sharding_constraint(q, sh_gathered)
+        with _comm.traced_span("all_gather", q, "fsdp",
+                               "qwz_param_fetch"):
+            q = jax.lax.with_sharding_constraint(q, sh_gathered)
         return (q.astype(jnp.float32) * s_g).reshape(shape).astype(p.dtype)
 
     return _straight_through(qdq)(x)
