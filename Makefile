@@ -14,7 +14,7 @@ NPROC := $(shell nproc)
 XDIST ?= $(shell if [ $(NPROC) -gt 2 ] && python -c "import xdist" 2>/dev/null; then echo "-n $$(( $(NPROC) - 1 )) --dist loadfile"; fi)
 PYTEST ?= python -m pytest
 
-.PHONY: test smoke slow bench bench-real bench-proxy bench-hostgap bench-overlap bench-longctx bench-quant bench-diff quant-sweep fleet-demo chaos serve-slo serve-fleet
+.PHONY: test smoke slow bench bench-real bench-proxy bench-hostgap bench-overlap bench-longctx bench-quant bench-diff quant-sweep fleet-demo chaos serve-slo serve-fleet serve-quant
 
 smoke:
 	$(PYTEST) tests/ -q -m "not slow" $(XDIST)
@@ -121,6 +121,15 @@ serve-slo:
 # (docs/serving.md "Multi-replica fleet").
 serve-fleet:
 	BENCH_MODE=serve_fleet python bench.py
+
+# int8-KV serving capacity arm: concurrent sessions per fixed HBM byte
+# budget (int8 pool vs bf16 pool, same budget — must hold >= 1.8x) and
+# the disagg handoff wire bytes raw vs int4-packed (must ship <= 0.35x).
+# Violations ride the payload's ok/violations keys, so bench_diff fails
+# the round on a regression (QUANT_SERVE_* env knobs; docs/serving.md
+# "Quantized KV cache & handoff wire").
+serve-quant:
+	BENCH_MODE=serve_quant python bench.py
 
 # Fault-injection drill on the 8-device CPU sim: SIGKILL a training rank
 # mid-run, let the elastic agent restart it, and assert the auto-resumed

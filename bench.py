@@ -304,7 +304,7 @@ def overlap_report(model, step_ms, overlap_depth, streaming,
 
 def main():
     if os.environ.get("BENCH_MODE") in ("serve", "serve_slo",
-                                        "serve_fleet"):
+                                        "serve_fleet", "serve_quant"):
         # serving benchmarks instead of the training headline
         # (tools/serve_bench.py): "serve" is the closed-loop v2-vs-v1
         # throughput comparison (SERVE_* env knobs); "serve_slo" is the
@@ -313,7 +313,10 @@ def main():
         # SLO_COMPARE=1 for the no-spec/no-prefix-cache baseline);
         # "serve_fleet" is the multi-replica router bench — unified vs
         # disaggregated prefill/decode arms over the same open-loop
-        # workload, one JSON line per arm (FLEET_* env knobs)
+        # workload, one JSON line per arm (FLEET_* env knobs);
+        # "serve_quant" is the int8-KV capacity arm — concurrent
+        # sessions per fixed HBM budget (int8 vs bf16 pool) plus the
+        # raw-vs-int4 handoff wire bytes (QUANT_SERVE_* env knobs)
         import sys
 
         sys.path.insert(0, os.path.join(os.path.dirname(
@@ -325,6 +328,11 @@ def main():
                 print(json.dumps(arm_result))
         elif os.environ.get("BENCH_MODE") == "serve_slo":
             print(json.dumps(serve_bench.run_slo()))
+        elif os.environ.get("BENCH_MODE") == "serve_quant":
+            quant_payload = serve_bench.run_quant()
+            print(json.dumps(quant_payload))
+            if not quant_payload.get("ok", True):
+                sys.exit(1)  # same fail-loud contract as BENCH_QUANT
         else:
             print(json.dumps(serve_bench.run()))
         return

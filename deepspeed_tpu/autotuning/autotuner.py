@@ -37,12 +37,16 @@ def parse_quant_mode(mode: str) -> Dict[str, Any]:
     ``zero_optimization`` keys it stands for.
 
     Grammar: ``"off"`` or ``"+"``-joined tokens from {``qwz``, ``qgz``,
-    ``hpz<k>``} — e.g. ``"qwz+qgz+hpz8"``. This is the shared vocabulary
+    ``qar``, ``hpz<k>``} — e.g. ``"qwz+qgz+hpz8"`` or ``"qar"``. ``qar``
+    (EQuARX-style quantized all-reduce) and ``qgz`` are mutually
+    exclusive: both own the gradient wire (ZeroConfig.validate rejects
+    the pair, and so does this parser). This is the shared vocabulary
     of the ``quant_modes`` tuning axis, ``tools/quant_sweep.py`` rows,
     and the ``quant_mode`` key bench.py reads back from the persisted
     real-shape defaults."""
     out = {"zero_quantized_weights": False,
            "zero_quantized_gradients": False,
+           "zero_quantized_allreduce": False,
            "zero_hpz_partition_size": 1}
     mode = str(mode).strip().lower()
     if mode in ("off", "", "none"):
@@ -53,6 +57,8 @@ def parse_quant_mode(mode: str) -> Dict[str, Any]:
             out["zero_quantized_weights"] = True
         elif tok == "qgz":
             out["zero_quantized_gradients"] = True
+        elif tok == "qar":
+            out["zero_quantized_allreduce"] = True
         elif tok.startswith("hpz"):
             try:
                 out["zero_hpz_partition_size"] = int(tok[3:])
@@ -61,13 +67,19 @@ def parse_quant_mode(mode: str) -> Dict[str, Any]:
                                  f"{mode!r} (want e.g. hpz8)") from None
         else:
             raise ValueError(f"unknown quant-mode token {tok!r} in "
-                             f"{mode!r} (grammar: off | qwz+qgz+hpz<k>)")
+                             f"{mode!r} (grammar: off | "
+                             f"qwz+[qgz|qar]+hpz<k>)")
+    if out["zero_quantized_gradients"] and out["zero_quantized_allreduce"]:
+        raise ValueError(f"quant mode {mode!r} combines qgz and qar — "
+                         f"both own the gradient wire, pick one")
     return out
 
 
-def format_quant_mode(qwz: bool, qgz: bool, hpz: int = 1) -> str:
+def format_quant_mode(qwz: bool, qgz: bool, hpz: int = 1,
+                      qar: bool = False) -> str:
     """Inverse of :func:`parse_quant_mode`."""
-    toks = ([] if not qwz else ["qwz"]) + ([] if not qgz else ["qgz"])
+    toks = (([] if not qwz else ["qwz"]) + ([] if not qgz else ["qgz"])
+            + ([] if not qar else ["qar"]))
     if int(hpz) > 1:
         toks.append(f"hpz{int(hpz)}")
     return "+".join(toks) or "off"
@@ -152,6 +164,12 @@ class Autotuner:
         # ("off", "qwz+qgz+hpz8", ...) expanded into zero_optimization
         # keys per candidate; None = keep the base config's flags
         self.quant_modes = list(space.get("quant_modes", [None]))
+        # serving KV-quant axes (ISSUE 12): KV-pool storage bits (0 =
+        # bf16 pool) × disagg handoff wire codec. These ride into
+        # cfg["serving"] so serving benches / engines built from the
+        # winning config pick them up; the train-step probe ignores them
+        self.kv_quant_bits = list(space.get("kv_quant_bits", [None]))
+        self.handoff_wires = list(space.get("handoff_wires", [None]))
         self.hbm_budget = hbm_budget_bytes or self._detect_hbm()
         self.results_dir = results_dir
         self.persist_path = persist_path
@@ -175,12 +193,12 @@ class Autotuner:
     # -- candidate enumeration (reference tune_space) -------------------
     def candidates(self) -> List[Dict[str, Any]]:
         out = []
-        for (mb, stage, remat, policy, tl, ac, pd, od, sm,
-             qm) in itertools.product(
+        for (mb, stage, remat, policy, tl, ac, pd, od, sm, qm, kvb,
+             hw) in itertools.product(
                 self.micro_batch_sizes, self.zero_stages, self.remat,
                 self.remat_policies, self.tiled_logits, self.attn_chunks,
                 self.prefetch_depths, self.overlap_depths, self.sp_modes,
-                self.quant_modes):
+                self.quant_modes, self.kv_quant_bits, self.handoff_wires):
             cfg = json.loads(json.dumps(self.base_config))  # deep copy
             cfg["train_micro_batch_size_per_chip"] = int(mb)
             cfg.pop("train_batch_size", None)  # re-derived from micro×gas×dp
@@ -206,6 +224,12 @@ class Autotuner:
                 # label as a private key for tuned_defaults/persist
                 cfg["zero_optimization"].update(parse_quant_mode(qm))
                 cfg["_quant_mode"] = str(qm)
+            if kvb is not None:
+                # 0 = explicit bf16 pool (vs None = keep base config)
+                cfg.setdefault("serving", {})["kv_quant_bits"] = (
+                    None if int(kvb) == 0 else int(kvb))
+            if hw is not None:
+                cfg.setdefault("serving", {})["handoff_wire"] = str(hw)
             out.append(cfg)
         return out
 
@@ -478,8 +502,14 @@ def main(argv=None) -> int:
                          "transfers into the issuing layer's stage)")
     ap.add_argument("--quant-modes", nargs="+", default=None,
                     help="ZeRO++ quantization modes to try (grammar: "
-                         "off | qwz+qgz+hpz<k>, e.g. off qwz qwz+qgz "
-                         "qwz+qgz+hpz8)")
+                         "off | qwz+[qgz|qar]+hpz<k>, e.g. off qwz "
+                         "qwz+qgz qar qwz+qgz+hpz8)")
+    ap.add_argument("--kv-quant-bits", type=int, nargs="+", default=None,
+                    help="serving KV-pool storage bits to try "
+                         "(0 = bf16 pool, 8 = int8 blocks + scales)")
+    ap.add_argument("--handoff-wires", nargs="+", default=None,
+                    help="disagg KV-handoff wire codecs to try "
+                         "(auto/raw/int8/int4)")
     ap.add_argument("--fast", action="store_true",
                     help="rank by compiled memory only (no timed runs)")
     ap.add_argument("--steps", type=int, default=3)
@@ -535,6 +565,17 @@ def main(argv=None) -> int:
         for qm in args.quant_modes:
             parse_quant_mode(qm)
         space["quant_modes"] = args.quant_modes
+    if args.kv_quant_bits is not None:
+        for b in args.kv_quant_bits:
+            if b not in (0, 8):
+                ap.error(f"--kv-quant-bits values must be 0 or 8, got {b}")
+        space["kv_quant_bits"] = args.kv_quant_bits
+    if args.handoff_wires is not None:
+        for w in args.handoff_wires:
+            if w not in ("auto", "raw", "int8", "int4"):
+                ap.error(f"--handoff-wires values must be auto/raw/int8/"
+                         f"int4, got {w!r}")
+        space["handoff_wires"] = args.handoff_wires
     tuner = Autotuner(model_factory, base, batch_fn,
                       tuning_space=space or None,
                       results_dir=args.results_dir,

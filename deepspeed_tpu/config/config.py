@@ -183,6 +183,12 @@ class ZeroConfig(ConfigModel):
     zero_hpz_partition_size: int = 1  # 1 = off; >1 = shard within ICI slice
     zero_quantized_weights: bool = False  # qwZ: int8 all-gather of params
     zero_quantized_gradients: bool = False  # qgZ: quantized grad reduce
+    # qar: EQuARX-style quantized all-reduce of gradients (quantize →
+    # int8 reduce-scatter with fp32 accumulation → int8 all-gather →
+    # dequant; ops/pallas/quantization.py quantized_all_reduce). Replaces
+    # the stage-1/2 gradient reduce; mutually exclusive with qgZ (both
+    # own the same wire).
+    zero_quantized_allreduce: bool = False
     # MiCS (runtime/zero/mics.py): sub-world shard groups.
     mics_shard_size: int = -1
     mics_hierarchical_params_gather: bool = False
@@ -195,6 +201,11 @@ class ZeroConfig(ConfigModel):
             raise ValueError(f"zero_optimization.stage must be 0-3, got {self.stage}")
         if self.zero_hpz_partition_size < 1:
             raise ValueError("zero_hpz_partition_size must be >= 1")
+        if self.zero_quantized_allreduce and self.zero_quantized_gradients:
+            raise ValueError(
+                "zero_quantized_allreduce (qar) and "
+                "zero_quantized_gradients (qgZ) both own the gradient "
+                "wire — enable at most one")
 
 
 @register_config_model
@@ -707,7 +718,15 @@ class ServingConfig(ConfigModel):
     ragged forward, n-gram match length up to ``spec_ngram``. Greedy
     output is token-identical with speculation on or off.
     ``decode_steps`` is the steady-state multi-token decode burst length
-    (1 restores strict per-token SplitFuse admission)."""
+    (1 restores strict per-token SplitFuse admission).
+
+    ``kv_quant_bits`` stores KV-cache blocks as int8 payloads with one
+    fp32 scale per head_dim vector (None keeps today's bf16 pool
+    bit-exactly — the quantized pytree never enters the traced
+    program). ``handoff_wire`` picks the disaggregated-prefill KV
+    handoff codec: "auto" ships the pool's native format, "raw" forces
+    full precision, "int8"/"int4" quantize bf16 pools for the wire
+    (int4 packs two values per byte; dequantized on install)."""
 
     max_queue_depth: Optional[int] = None
     prefix_cache: bool = True
@@ -715,6 +734,8 @@ class ServingConfig(ConfigModel):
     spec_k: int = 4
     spec_ngram: int = 3
     decode_steps: int = 8
+    kv_quant_bits: Optional[int] = None
+    handoff_wire: str = "auto"
     router: RouterConfig = field(default_factory=RouterConfig)
 
     def validate(self) -> None:
@@ -728,6 +749,14 @@ class ServingConfig(ConfigModel):
                 raise ValueError(
                     f"serving.{name} must be >= {lo}, got "
                     f"{getattr(self, name)}")
+        if self.kv_quant_bits not in (None, 8):
+            raise ValueError(
+                f"serving.kv_quant_bits must be null or 8, got "
+                f"{self.kv_quant_bits}")
+        if self.handoff_wire not in ("auto", "raw", "int8", "int4"):
+            raise ValueError(
+                f"serving.handoff_wire must be one of auto/raw/int8/"
+                f"int4, got {self.handoff_wire!r}")
         self.router.validate()
 
 

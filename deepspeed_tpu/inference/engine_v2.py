@@ -107,6 +107,8 @@ class InferenceEngineV2:
                  spec_decode: bool = False, spec_k: int = 4,
                  spec_ngram: int = 3, drafter: Optional[Any] = None,
                  max_queue_depth: Optional[int] = None,
+                 kv_quant_bits: Optional[int] = None,
+                 handoff_wire: str = "auto",
                  serving: Optional[Any] = None,
                  request_trace: Optional[Any] = None,
                  metric_labels: Optional[Dict[str, str]] = None):
@@ -123,6 +125,8 @@ class InferenceEngineV2:
             spec_ngram = serving.spec_ngram
             decode_steps = serving.decode_steps
             max_queue_depth = serving.max_queue_depth
+            kv_quant_bits = getattr(serving, "kv_quant_bits", None)
+            handoff_wire = getattr(serving, "handoff_wire", "auto")
 
         # reuse v1's TP placement logic for params/mesh
         self._v1 = InferenceEngine(model, mesh=mesh, params=params,
@@ -134,8 +138,11 @@ class InferenceEngineV2:
         kv_cfg = KVCacheConfig(
             num_layers=self.cfg.num_layers, kv_heads=self.cfg.kv_heads,
             head_dim=self.cfg.head_dim, block_size=kv_block_size,
-            num_blocks=kv_blocks, dtype=dtype)
+            num_blocks=kv_blocks, dtype=dtype, quant_bits=kv_quant_bits)
         self.kv_cache = BlockedKVCache(kv_cfg, mesh=self.mesh)
+        # disagg handoff wire codec mode ("auto"/"raw"/"int8"/"int4");
+        # consumed by serving/disagg.py serialize_prefix
+        self._handoff_wire = handoff_wire
         # the last block is the padding-token scratch target
         # (model_runner.ragged_forward routes padded writes there): shrink
         # the allocator so it is never handed out
@@ -484,7 +491,7 @@ class InferenceEngineV2:
             if seg_plan is not None:
                 n_segs = seg_plan[0].shape[0]
                 logits, new_kv = self._prefill_fn(
-                    self.params, self.kv_cache.data, *seg_plan,
+                    self.params, self.kv_cache.kv_state, *seg_plan,
                     jnp.asarray(batch.block_table[:n_segs]))
             elif decode_only:
                 # compact per-slot arrays: token i belongs to slot i; pad
@@ -496,17 +503,17 @@ class InferenceEngineV2:
                 d_tok[:n] = batch.token_ids[:n]
                 d_pos[:n] = batch.token_pos[:n]
                 logits, new_kv = self._decode_fn(
-                    self.params, self.kv_cache.data,
+                    self.params, self.kv_cache.kv_state,
                     jnp.asarray(d_tok), jnp.asarray(d_pos),
                     jnp.asarray(batch.block_table),
                     jnp.asarray(batch.ctx_lens))
             else:
                 logits, new_kv = self._step_fn(
-                    self.params, self.kv_cache.data,
+                    self.params, self.kv_cache.kv_state,
                     jnp.asarray(batch.token_ids), jnp.asarray(batch.token_seq),
                     jnp.asarray(batch.token_pos), jnp.asarray(batch.block_table),
                     jnp.asarray(batch.num_tokens, jnp.int32))
-        self.kv_cache.data = new_kv
+        self.kv_cache.set_kv_state(new_kv)
 
         # Sample ON DEVICE and fetch only token ids (greedy) or just the
         # consumed rows (stochastic). Materializing the full [T, V]
@@ -717,11 +724,11 @@ class InferenceEngineV2:
             bt[i, :len(s.kv_blocks)] = s.kv_blocks
         with self.mesh:
             toks, new_kv = self._multi_decode_fn(
-                self.params, self.kv_cache.data, jnp.asarray(d_tok),
+                self.params, self.kv_cache.kv_state, jnp.asarray(d_tok),
                 jnp.asarray(d_pos), jnp.asarray(bt), jnp.asarray(ctx),
                 steps=K)
             toks_np = np.asarray(toks)  # [K, S] — one fetch per K tokens
-        self.kv_cache.data = new_kv
+        self.kv_cache.set_kv_state(new_kv)
         self.stats["decode_kernel_steps"] += K
         self.stats["burst_steps"] = self.stats.get("burst_steps", 0) + 1
         emitted: Dict[int, List[int]] = {}
@@ -816,12 +823,12 @@ class InferenceEngineV2:
                                    self.max_blocks_per_seq)
         with self.mesh:
             logits, new_kv = self._step_fn(
-                self.params, self.kv_cache.data,
+                self.params, self.kv_cache.kv_state,
                 jnp.asarray(batch.token_ids), jnp.asarray(batch.token_seq),
                 jnp.asarray(batch.token_pos), jnp.asarray(batch.block_table),
                 jnp.asarray(batch.num_tokens, jnp.int32))
             greedy = np.asarray(self._pick_greedy_all(logits))
-        self.kv_cache.data = new_kv
+        self.kv_cache.set_kv_state(new_kv)
         emitted: Dict[int, List[int]] = {}
         wasted_rows: Dict[int, int] = {}
         cursor = 0
@@ -963,6 +970,8 @@ class InferenceEngineV2:
             "queue_wait_depth": len(self._queue),
             "pending_prefill_tokens": sum(s.pending_prefill for s in live),
             "kv_free_blocks": self.kv_cache.free_blocks,
+            "kv_quant_bits": self.kv_cache.quant_bits,
+            "handoff_wire": self._handoff_wire,
             "batch_seq_occupancy": (self.scheduler.last_scheduled_seqs
                                     / max(1, self.max_seqs)),
             "batch_token_occupancy": (self.scheduler.last_scheduled_tokens

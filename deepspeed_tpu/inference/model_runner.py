@@ -27,9 +27,20 @@ from jax import lax
 
 from deepspeed_tpu.models.transformer import (
     TransformerConfig, _norm, _rope, act_fn)
+from deepspeed_tpu.ops.pallas.quantization import kv_dequantize, kv_quantize
 from deepspeed_tpu.runtime.sharding import (effective_dtype,
                                             vocab_parallel_lookup)
 from deepspeed_tpu.utils import jaxcompat
+
+
+def _kv_parts(kv_state):
+    """Split the ragged KV pool pytree: a bare array (bf16 pool — today's
+    program, traced verbatim) yields (data, None); an (int8 payload, fp32
+    scales) pair yields both. The quantized branch is chosen at trace
+    time, so the unquantized lowering carries no quant ops at all."""
+    if isinstance(kv_state, (tuple, list)):
+        return kv_state[0], kv_state[1]
+    return kv_state, None
 
 
 def _qkv(cfg: TransformerConfig, layer_params, y, positions):
@@ -176,7 +187,9 @@ def ragged_forward(cfg: TransformerConfig, params, kv_data: jax.Array,
                    num_tokens) -> Tuple[jax.Array, jax.Array]:
     """One ragged step over flat tokens.
 
-    kv_data     [L, num_blocks, bs, 2, nkv, hd]
+    kv_data     [L, num_blocks, bs, 2, nkv, hd] — or, for a quantized
+                pool, the (int8 payload, fp32 scales [L, nb, bs, 2, nkv])
+                pair from ``BlockedKVCache.kv_state``
     token_ids   [T] int32 (padded); token_seq [T] slot ids; token_pos [T]
     block_table [S, Bm]; num_tokens scalar (true T, rest is padding)
 
@@ -187,6 +200,7 @@ def ragged_forward(cfg: TransformerConfig, params, kv_data: jax.Array,
     routed to write into the reserved scratch block (last block id) so
     they never corrupt live pages.
     """
+    kv_data, kv_scales = _kv_parts(kv_data)
     T = token_ids.shape[0]
     Smax, Bm = block_table.shape
     bs = kv_data.shape[2]
@@ -213,13 +227,30 @@ def ragged_forward(cfg: TransformerConfig, params, kv_data: jax.Array,
     key_pos = jnp.arange(max_ctx)  # [Lmax]
 
     def layer_body(x, inputs):
-        layer_params, kv_layer = inputs  # [num_blocks, bs, 2, nkv, hd]
+        if kv_scales is None:
+            layer_params, kv_layer = inputs  # [num_blocks, bs, 2, nkv, hd]
+            kv_sc = None
+        else:
+            layer_params, kv_layer, kv_sc = inputs
         y = _norm(x, layer_params["ln1"], cfg.norm, cfg.norm_eps)
         q, k, v = _qkv(cfg, layer_params, y, token_pos)  # q [T,nh,hd] k/v [T,nkv,hd]
-        kv_layer = kv_layer.at[page, offset, 0].set(k.astype(kv_layer.dtype))
-        kv_layer = kv_layer.at[page, offset, 1].set(v.astype(kv_layer.dtype))
+        if kv_sc is None:
+            kv_layer = kv_layer.at[page, offset, 0].set(
+                k.astype(kv_layer.dtype))
+            kv_layer = kv_layer.at[page, offset, 1].set(
+                v.astype(kv_layer.dtype))
+        else:
+            qk, sk = kv_quantize(k)  # quantize-on-append, per head vector
+            qv, sv = kv_quantize(v)
+            kv_layer = kv_layer.at[page, offset, 0].set(qk)
+            kv_layer = kv_layer.at[page, offset, 1].set(qv)
+            kv_sc = kv_sc.at[page, offset, 0].set(sk)
+            kv_sc = kv_sc.at[page, offset, 1].set(sv)
         # gather each slot's pages into dense [S, Lmax, nkv, hd]
         gathered = kv_layer[block_table]  # [S, Bm, bs, 2, nkv, hd]
+        if kv_sc is not None:
+            # dequant-on-read: only the gathered pages, never the pool
+            gathered = kv_dequantize(gathered, kv_sc[block_table], dtype=dt)
         gathered = gathered.reshape(Smax, max_ctx, 2, cfg.kv_heads,
                                     cfg.head_dim)
         k_seq = gathered[:, :, 0][token_seq]  # [T, Lmax, nkv, hd]
@@ -237,12 +268,15 @@ def ragged_forward(cfg: TransformerConfig, params, kv_data: jax.Array,
                           layer_params["attn"]["wo"].astype(dt))
         if cfg.use_biases:
             attn = attn + layer_params["attn"]["bo"].astype(dt)
+        kv_out = kv_layer if kv_sc is None else (kv_layer, kv_sc)
         if cfg.parallel_block:  # Falcon: both branches read pre-attn x
-            return _mlp(cfg, layer_params, x) + attn, kv_layer
+            return _mlp(cfg, layer_params, x) + attn, kv_out
         x = x + attn
-        return _mlp(cfg, layer_params, x), kv_layer
+        return _mlp(cfg, layer_params, x), kv_out
 
-    x, new_kv = lax.scan(layer_body, x, (params["layers"], kv_data))
+    xs = ((params["layers"], kv_data) if kv_scales is None
+          else (params["layers"], kv_data, kv_scales))
+    x, new_kv = lax.scan(layer_body, x, xs)
     x = _norm(x, params["final_norm"], cfg.norm, cfg.norm_eps)
     return _unembed(cfg, params, x), new_kv
 
@@ -319,6 +353,7 @@ def ragged_prefill_forward(cfg: TransformerConfig, params,
     seg_tokens [S, Tq] int32; seg_pos0/seg_nreal [S]; block_table [S, Bm]
     Returns (logits [S, Tq, V] fp32, kv_data').
     """
+    kv_data, kv_scales = _kv_parts(kv_data)
     S, Tq = seg_tokens.shape
     bs = kv_data.shape[2]
     dt = effective_dtype(cfg.dtype)
@@ -339,23 +374,45 @@ def ragged_prefill_forward(cfg: TransformerConfig, params,
     offset = jnp.where(real, pos % bs, bs - 1)
 
     def layer_body(x, inputs):
-        layer_params, kv_layer = inputs
+        if kv_scales is None:
+            layer_params, kv_layer = inputs
+            kv_sc = None
+        else:
+            layer_params, kv_layer, kv_sc = inputs
         y = _norm(x, layer_params["ln1"], cfg.norm, cfg.norm_eps)
         q, k, v = _qkv(cfg, layer_params, y, pos)  # q [S,Tq,nh,hd]
-        kv_layer = kv_layer.at[page, offset, 0].set(k.astype(kv_layer.dtype))
-        kv_layer = kv_layer.at[page, offset, 1].set(v.astype(kv_layer.dtype))
-        attn = _paged_prefill(mesh, q.astype(dt), kv_layer, block_table,
+        if kv_sc is None:
+            kv_layer = kv_layer.at[page, offset, 0].set(
+                k.astype(kv_layer.dtype))
+            kv_layer = kv_layer.at[page, offset, 1].set(
+                v.astype(kv_layer.dtype))
+            kv_read = kv_layer
+        else:
+            qk, sk = kv_quantize(k)
+            qv, sv = kv_quantize(v)
+            kv_layer = kv_layer.at[page, offset, 0].set(qk)
+            kv_layer = kv_layer.at[page, offset, 1].set(qv)
+            kv_sc = kv_sc.at[page, offset, 0].set(sk)
+            kv_sc = kv_sc.at[page, offset, 1].set(sv)
+            # the Pallas kernel reads a dense layer pool; dequantize the
+            # per-layer slice (transient, 1/L of the bf16 pool) — the
+            # persistent pool stays int8
+            kv_read = kv_dequantize(kv_layer, kv_sc, dtype=dt)
+        attn = _paged_prefill(mesh, q.astype(dt), kv_read, block_table,
                               seg_pos0, ctx_lens)
         attn = jnp.einsum("stnd,ndh->sth", attn.astype(dt),
                           layer_params["attn"]["wo"].astype(dt))
         if cfg.use_biases:
             attn = attn + layer_params["attn"]["bo"].astype(dt)
+        kv_out = kv_layer if kv_sc is None else (kv_layer, kv_sc)
         if cfg.parallel_block:  # Falcon: both branches read pre-attn x
-            return _mlp(cfg, layer_params, x) + attn, kv_layer
+            return _mlp(cfg, layer_params, x) + attn, kv_out
         x = x + attn
-        return _mlp(cfg, layer_params, x), kv_layer
+        return _mlp(cfg, layer_params, x), kv_out
 
-    x, new_kv = lax.scan(layer_body, x, (params["layers"], kv_data))
+    xs = ((params["layers"], kv_data) if kv_scales is None
+          else (params["layers"], kv_data, kv_scales))
+    x, new_kv = lax.scan(layer_body, x, xs)
     x = _norm(x, params["final_norm"], cfg.norm, cfg.norm_eps)
     return _unembed(cfg, params, x), new_kv
 
@@ -384,6 +441,7 @@ def ragged_decode_forward(cfg: TransformerConfig, params, kv_data: jax.Array,
 
     Returns (logits [S, V] fp32, kv_data').
     """
+    kv_data, kv_scales = _kv_parts(kv_data)
     S = token_ids.shape[0]
     bs = kv_data.shape[2]
     dt = effective_dtype(cfg.dtype)
@@ -400,23 +458,42 @@ def ragged_decode_forward(cfg: TransformerConfig, params, kv_data: jax.Array,
     offset = jnp.where(alive, token_pos % bs, bs - 1)
 
     def layer_body(x, inputs):
-        layer_params, kv_layer = inputs
+        if kv_scales is None:
+            layer_params, kv_layer = inputs
+            kv_sc = None
+        else:
+            layer_params, kv_layer, kv_sc = inputs
         y = _norm(x, layer_params["ln1"], cfg.norm, cfg.norm_eps)
         q, k, v = _qkv(cfg, layer_params, y, token_pos)  # q [S,nh,hd]
-        kv_layer = kv_layer.at[page, offset, 0].set(k.astype(kv_layer.dtype))
-        kv_layer = kv_layer.at[page, offset, 1].set(v.astype(kv_layer.dtype))
-        attn = _paged_decode(mesh, q.astype(dt), kv_layer, block_table,
+        if kv_sc is None:
+            kv_layer = kv_layer.at[page, offset, 0].set(
+                k.astype(kv_layer.dtype))
+            kv_layer = kv_layer.at[page, offset, 1].set(
+                v.astype(kv_layer.dtype))
+            kv_read = kv_layer
+        else:
+            qk, sk = kv_quantize(k)
+            qv, sv = kv_quantize(v)
+            kv_layer = kv_layer.at[page, offset, 0].set(qk)
+            kv_layer = kv_layer.at[page, offset, 1].set(qv)
+            kv_sc = kv_sc.at[page, offset, 0].set(sk)
+            kv_sc = kv_sc.at[page, offset, 1].set(sv)
+            kv_read = kv_dequantize(kv_layer, kv_sc, dtype=dt)
+        attn = _paged_decode(mesh, q.astype(dt), kv_read, block_table,
                              context_lens)
         attn = jnp.einsum("snd,ndh->sh", attn.astype(dt),
                           layer_params["attn"]["wo"].astype(dt))
         if cfg.use_biases:
             attn = attn + layer_params["attn"]["bo"].astype(dt)
+        kv_out = kv_layer if kv_sc is None else (kv_layer, kv_sc)
         if cfg.parallel_block:  # Falcon: both branches read pre-attn x
-            return _mlp(cfg, layer_params, x) + attn, kv_layer
+            return _mlp(cfg, layer_params, x) + attn, kv_out
         x = x + attn
-        return _mlp(cfg, layer_params, x), kv_layer
+        return _mlp(cfg, layer_params, x), kv_out
 
-    x, new_kv = lax.scan(layer_body, x, (params["layers"], kv_data))
+    xs = ((params["layers"], kv_data) if kv_scales is None
+          else (params["layers"], kv_data, kv_scales))
+    x, new_kv = lax.scan(layer_body, x, xs)
     x = _norm(x, params["final_norm"], cfg.norm, cfg.norm_eps)
     return _unembed(cfg, params, x), new_kv
 

@@ -33,12 +33,23 @@ class KVCacheConfig:
     block_size: int = 16
     num_blocks: int = 256
     dtype: object = jnp.bfloat16
+    # None = bf16 pool (bit-exact legacy program); 8 = int8 payload with one
+    # fp32 scale per (layer, block, row, k/v, head) vector.
+    quant_bits: Optional[int] = None
+
+    def __post_init__(self):
+        if self.quant_bits not in (None, 8):
+            raise ValueError(
+                f"kv quant_bits must be None or 8, got {self.quant_bits}")
 
     @property
     def bytes_per_block(self) -> int:
+        vecs = self.num_layers * self.block_size * 2 * self.kv_heads
+        if self.quant_bits is not None:
+            # int8 payload + fp32 scale per head vector
+            return vecs * (self.head_dim + 4)
         itemsize = jnp.dtype(self.dtype).itemsize
-        return (self.num_layers * self.block_size * 2 * self.kv_heads
-                * self.head_dim * itemsize)
+        return vecs * self.head_dim * itemsize
 
 
 class BlockedKVCache:
@@ -57,16 +68,46 @@ class BlockedKVCache:
         self.prefix_cache = None  # Optional[PrefixCache], attached by owner
         shape = (config.num_layers, config.num_blocks, config.block_size,
                  2, config.kv_heads, config.head_dim)
+        quantized = config.quant_bits is not None
+        pool_dtype = jnp.int8 if quantized else config.dtype
+        self.scales = None
         if mesh is not None and tp_axis in mesh.axis_names and (
                 mesh.shape[tp_axis] > 1):
             from jax.sharding import NamedSharding, PartitionSpec as P
 
             sharding = NamedSharding(
                 mesh, P(None, None, None, None, tp_axis, None))
-            self.data = jax.device_put(
-                jnp.zeros(shape, config.dtype), sharding)
+            self.data = jax.device_put(jnp.zeros(shape, pool_dtype), sharding)
+            if quantized:
+                s_sharding = NamedSharding(
+                    mesh, P(None, None, None, None, tp_axis))
+                self.scales = jax.device_put(
+                    jnp.ones(shape[:-1], jnp.float32), s_sharding)
         else:
-            self.data = jnp.zeros(shape, config.dtype)
+            self.data = jnp.zeros(shape, pool_dtype)
+            if quantized:
+                self.scales = jnp.ones(shape[:-1], jnp.float32)
+
+    @property
+    def quant_bits(self) -> Optional[int]:
+        return self.config.quant_bits
+
+    @property
+    def kv_state(self):
+        """Device pool as the pytree the ragged forwards consume: the bare
+        bf16 array when unquantized (today's program, verbatim), or an
+        (int8 payload, fp32 scales) pair when ``quant_bits`` is set."""
+        if self.scales is None:
+            return self.data
+        return (self.data, self.scales)
+
+    def set_kv_state(self, state) -> None:
+        """Store the pool returned by a compiled step (inverse of
+        :attr:`kv_state`)."""
+        if self.scales is None:
+            self.data = state
+        else:
+            self.data, self.scales = state
 
     def blocks_needed(self, num_tokens: int) -> int:
         bs = self.config.block_size

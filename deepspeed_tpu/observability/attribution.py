@@ -381,8 +381,8 @@ def _wire_ratio(bits: int, block: int, full_bytes: float) -> float:
 
 
 def attribute_quant_step(cfg, *, qwz: bool = False, qgz: bool = False,
-                         hpz: int = 1, n_chips: int = 16,
-                         slice_size: int = 8,
+                         qar: bool = False, hpz: int = 1,
+                         n_chips: int = 16, slice_size: int = 8,
                          ici_gbps: Optional[float] = None,
                          dcn_gbps: Optional[float] = None
                          ) -> List[RegionCost]:
@@ -401,7 +401,11 @@ def attribute_quant_step(cfg, *, qwz: bool = False, qgz: bool = False,
       wire); when hpZ splits the mesh a second level reduces partial
       sums over the dp axis across slices. qgZ quantizes level 1 to
       int8 and the inter-slice level to int4, each + fp32 scales per
-      QGZ_BLOCK.
+      QGZ_BLOCK. ``qar`` replaces the reduce entirely with the
+      EQuARX-style quantized all-reduce: an int8 reduce-scatter plus an
+      int8 all-gather over the full dp axis, each hop moving (N-1)/N of
+      the gradient wire + fp32 scales per QUANT_BLOCK (qar and qgZ are
+      mutually exclusive, mirroring ZeroConfig.validate).
 
     Each region's ``gbps``/``link`` pin the byte-weighted effective
     bandwidth of its level mix, so the roofline ms reflects the link
@@ -437,6 +441,10 @@ def attribute_quant_step(cfg, *, qwz: bool = False, qgz: bool = False,
         + f" over g={g} ({fetch_link.upper()})"
         + (f", hpZ k={k} keeps it intra-slice" if k > 1 else ""))
 
+    if qar and qgz:
+        raise ValueError("qar and qgz are mutually exclusive (both own "
+                         "the gradient wire)")
+
     # -- grad_reduce: qgZ level structure -------------------------------
     g1 = k if k > 1 else N
     dp = N // g1 if k > 1 else 1
@@ -459,6 +467,21 @@ def attribute_quant_step(cfg, *, qwz: bool = False, qgz: bool = False,
          else f"fp32 reduce over fsdp={g1} ({l1_link.upper()})")
         + ((f" + {'int4' if qgz else 'fp32'} level2 over dp={dp} (DCN)")
            if dp > 1 else ""))
+
+    if qar:
+        # qar overrides the level structure: one flat int8 all-reduce
+        # (reduce-scatter + all-gather) over the full dp axis; fp32
+        # scales per QUANT_BLOCK on both hops
+        from deepspeed_tpu.runtime.zeropp import QUANT_BLOCK
+        ar_frac = (N - 1) / N if N > 1 else 0.0
+        ar_ratio = _wire_ratio(8, QUANT_BLOCK, 4.0)
+        red_link = "ici" if N <= S else "dcn"
+        ar_gbps = ici if red_link == "ici" else dcn
+        red_bytes = 2.0 * 4.0 * n_params * ar_frac * ar_ratio
+        red_ms = red_bytes / (ar_gbps * 1e9) * 1e3
+        red_gbps = ar_gbps
+        red_note = (f"qar: int8 reduce-scatter + int8 all-gather over "
+                    f"dp={N} ({red_link.upper()})")
 
     return [
         RegionCost("param_fetch", 0.0, fetch_bytes, note=fetch_note,

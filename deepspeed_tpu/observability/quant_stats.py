@@ -48,7 +48,7 @@ import jax.numpy as jnp
 
 # the regions every quantized path reports under (quant.<region>.*)
 QUANT_REGIONS = ("qwz_param_fetch", "qgz_grad_reduce", "hpz_partition",
-                 "fp8_mlp")
+                 "fp8_mlp", "kv_cache", "kv_wire", "qar")
 
 # int8 blockwise RTN peak-rel-error bound is 0.5/127 ~= 0.00394; int4 is
 # 0.5/7 ~= 0.0714; fp8 e4m3 has 3 mantissa bits -> rel step 2^-4 with
@@ -62,6 +62,16 @@ DEFAULT_GATES: Dict[str, Dict[str, float]] = {
     "fp8_mlp": {"min_snr_db": 18.0, "max_rel_err": 0.05},
     # hpZ changes which link the gather rides, never the values
     "hpz_partition": {"bit_exact": True},
+    # int8 KV blocks: one scale per head_dim vector, so the RTN bound is
+    # the plain int8 one (0.5/127)
+    "kv_cache": {"min_snr_db": 30.0, "max_rel_err": 0.005},
+    # the handoff wire may run int4 (0.5/7 ~= 0.0714 bound)
+    "kv_wire": {"min_snr_db": 18.0, "max_rel_err": 0.08},
+    # quantized all-reduce stacks two int8 hops (scatter + gather);
+    # real grad tensors carry many near-zero blocks whose clamped
+    # scales dominate the worst-case element, so the rel-err bound is
+    # looser than the single-hop paths
+    "qar": {"min_snr_db": 25.0, "max_rel_err": 0.03},
 }
 
 # -- fault injection (the gate-trip demo) -----------------------------------
@@ -429,6 +439,113 @@ def hpz_partition_stats(n_params: int, partition_size: int
               else "k=1: gather spans the full fsdp group"))
 
 
+def measure_kv_cache(kv_tensors: Sequence[Any], head_dim: int, *,
+                     bits: int = 8, cap_elements: int = 1 << 22
+                     ) -> QuantRegionStats:
+    """kv_cache region: int8 per-head-vector error on REAL K/V tensors
+    (one fp32 scale per head_dim vector — the pool layout of
+    ``BlockedKVCache`` with ``quant_bits=8``)."""
+    st = measure_region(
+        "kv_cache", kv_tensors, block=int(head_dim), bits=bits,
+        full_bytes_per_elem=2, cap_elements=cap_elements,
+        note=f"int{bits} KV blocks, scale per head_dim={head_dim} vector "
+             "(vs bf16 pool)")
+    return st
+
+
+def measure_kv_wire(block_data, head_dim: int, *, bits: int = 4,
+                    cap_elements: int = 1 << 22) -> QuantRegionStats:
+    """kv_wire region: error + byte accounting of quantizing bf16 handoff
+    blocks for the disagg wire at ``bits`` (int4 packs two values per
+    byte, the <=0.35x-of-bf16 mode)."""
+    st = measure_region(
+        "kv_wire", [block_data], block=int(head_dim), bits=bits,
+        full_bytes_per_elem=2, cap_elements=cap_elements,
+        note=f"int{bits} handoff wire, scale per head_dim={head_dim} "
+             "vector (vs bf16 block payload)")
+    return st
+
+
+def measure_qar(grad_groups: Sequence[Any], *, bits: int = 8,
+                block: int = 256, cap_elements: int = 1 << 22
+                ) -> QuantRegionStats:
+    """qar region: EQuARX-style quantized all-reduce error on REAL
+    per-rank gradients — each rank's contribution quantizes at ``bits``
+    for the reduce-scatter hop, the fp32-accumulated mean re-quantizes
+    for the all-gather hop, and the result compares against the exact
+    fp32 mean (mirrors ``quantized_all_reduce``'s two wire hops without
+    needing a multi-device mesh)."""
+    groups = list(grad_groups)
+    if not groups:
+        raise ValueError("measure_qar needs >= 1 gradient group")
+    flats = [jax.tree.leaves(g) for g in groups]
+    sig = noise = 0.0
+    worst_rel = 0.0
+    n_elems = 0
+    all_scales: List[Any] = []
+    budget = int(cap_elements)
+    for i in range(len(flats[0])):
+        if budget <= 0:
+            break
+        if jnp.asarray(flats[0][i]).ndim < 2:
+            continue  # 1-D leaves ride the exact path in the runtime
+        leaves = [jnp.asarray(f[i], jnp.float32).reshape(-1)
+                  for f in flats]
+        budget -= int(leaves[0].size)
+        exact = sum(leaves) / len(leaves)
+        # hop 1: per-rank quantize, fp32 accumulate (reduce-scatter wire)
+        acc = jnp.zeros_like(leaves[0])
+        for leaf in leaves:
+            deq, s = qdq_blockwise(leaf, block, bits)
+            acc = acc + deq
+            if s.size:
+                all_scales.append(s)
+        mean = acc / len(leaves)
+        # hop 2: the reduced shard re-quantizes for the all-gather wire
+        approx, s2 = qdq_blockwise(mean, block, bits)
+        if s2.size:
+            all_scales.append(s2)
+        err = approx - exact
+        sig += float(jnp.sum(exact * exact))
+        noise += float(jnp.sum(err * err))
+        worst_rel = max(worst_rel, max_rel_error(exact, approx, block))
+        n_elems += int(leaves[0].size)
+    if noise == 0.0:
+        snr = float("inf")
+    elif sig == 0.0:
+        snr = float("-inf")
+    else:
+        snr = 10.0 * math.log10(sig / noise)
+    scales = (scale_summary(jnp.concatenate(all_scales))
+              if all_scales else scale_summary(jnp.zeros((0,))))
+    # wire per chip: one int payload + scales out (scatter) and the
+    # world's reduced shards back in (gather) — 2x one tensor's wire
+    return QuantRegionStats(
+        region="qar", snr_db=snr, max_rel_err=worst_rel,
+        logical_bytes=2 * n_elems * 4,
+        wire_bytes=2 * wire_bytes(n_elems, bits, block),
+        n_elements=n_elems, bits=bits, block=block, scales=scales,
+        note=(f"int{bits} all-reduce (scatter+gather hops) over "
+              f"{len(groups)} ranks (vs fp32 all-reduce)"))
+
+
+# -- warn-once ----------------------------------------------------------------
+
+_WARNED: set = set()
+
+
+def warn_once(key: str, msg: str) -> None:
+    """Log ``msg`` at WARNING level once per process per ``key`` — the
+    shared warn-once used by the serving quant paths (e.g. a handoff
+    shipping full-precision blocks into a quantized cache)."""
+    if key in _WARNED:
+        return
+    _WARNED.add(key)
+    from deepspeed_tpu.utils.logging import logger
+
+    logger.warning(msg)
+
+
 # -- export: hub gauges/counters, JSONL event, flight-recorder context ------
 
 _LAST_SNAPSHOT: Dict[str, Any] = {}
@@ -603,6 +720,45 @@ def off_switch_bitexact(steps: int = 2, env=None) -> bool:
                for a, b in zip(p_off, p_bare))
 
 
+def kv_off_switch_structural(cfg=None, params=None) -> bool:
+    """``quant_bits=None`` must lower TODAY's serving program verbatim:
+    the unquantized ragged step's HLO carries no int8 ops at all, while
+    the quantized pytree's lowering does. Structural (lowered-text)
+    check, mirroring test_param_prefetch_ring's no-barrier assertion."""
+    from functools import partial
+
+    import numpy as np
+
+    from deepspeed_tpu.inference.model_runner import ragged_forward
+    from deepspeed_tpu.models.transformer import (TransformerConfig,
+                                                  init_params)
+
+    if cfg is None:
+        cfg = TransformerConfig(
+            vocab_size=64, hidden_size=32, num_layers=2, num_heads=4,
+            num_kv_heads=2, ffn_size=64, max_seq_len=32)
+        params = init_params(cfg, jax.random.PRNGKey(0))
+    L, nb, bs = cfg.num_layers, 4, 4
+    kv = jnp.zeros((L, nb, bs, 2, cfg.kv_heads, cfg.head_dim),
+                   jnp.bfloat16)
+    kvq = (jnp.zeros(kv.shape, jnp.int8),
+           jnp.ones(kv.shape[:-1], jnp.float32))
+    T = 4
+    a = (jnp.zeros(T, jnp.int32), jnp.zeros(T, jnp.int32),
+         jnp.arange(T, dtype=jnp.int32),
+         jnp.zeros((1, 2), jnp.int32), jnp.int32(T))
+    fn = jax.jit(partial(ragged_forward, cfg))
+    off = fn.lower(params, kv, *a).as_text()
+    on = fn.lower(params, kvq, *a).as_text()
+
+    def has_int8(txt: str) -> bool:
+        # StableHLO spells int8 tensors "xi8>"/"tensor<i8>"; HLO text
+        # (older jax as_text) spells them "s8[" — accept either
+        return "s8[" in txt or "i8>" in txt
+
+    return (not has_int8(off)) and has_int8(on)
+
+
 def gate_markdown(stats: Sequence[QuantRegionStats],
                   gates: Optional[Dict[str, Dict[str, float]]] = None
                   ) -> str:
@@ -665,21 +821,44 @@ def run_quant_bench(env=None):
 
         hpz_k = int(env.get("BENCH_QUANT_HPZ", "4"))
         n_params = sum(int(x.size) for x in jax.tree.leaves(params))
+
+        # REAL K/V for the serving regions: run a short prefill through
+        # the dense-cache forward and measure the cache it actually wrote
+        from deepspeed_tpu.inference.model_runner import (
+            forward_with_cache, init_dense_cache)
+
+        kv_len = min(64, cfg.max_seq_len)
+        toks = jnp.asarray(rng.integers(
+            0, cfg.vocab_size, (2, kv_len)).astype(np.int32))
+        cache = init_dense_cache(cfg, 2, kv_len, dtype=jnp.bfloat16)
+        _, cache = forward_with_cache(cfg, params, toks, cache, 0)
+
         stats = [
             measure_param_fetch(params),
             measure_grad_reduce(groups),
             measure_fp8_mlp(params),
             hpz_partition_stats(n_params, hpz_k),
+            measure_kv_cache([cache], cfg.head_dim),
+            measure_kv_wire(cache, cfg.head_dim,
+                            bits=int(env.get("BENCH_KV_WIRE_BITS", "4"))),
+            measure_qar(groups),
         ]
         publish(stats)
         ok, violations = evaluate_gates(stats)
 
         bit_exact = None
+        kv_off = None
         if not int(env.get("BENCH_QUANT_SKIP_EXACT", "0")):
             bit_exact = off_switch_bitexact(env=env)
             if not bit_exact:
                 ok = False
                 violations.append({"region": "off_switch",
+                                   "gate": "bit_exact", "limit": True,
+                                   "observed": False})
+            kv_off = kv_off_switch_structural()
+            if not kv_off:
+                ok = False
+                violations.append({"region": "kv_off_switch",
                                    "gate": "bit_exact", "limit": True,
                                    "observed": False})
 
@@ -693,6 +872,7 @@ def run_quant_bench(env=None):
             "ok": ok,
             "injection": _INJECT,
             "bit_exact_off": bit_exact,
+            "kv_off_struct": kv_off,
             "regions": [st.to_dict() for st in stats],
             "gates": {k: dict(v) for k, v in DEFAULT_GATES.items()},
             "violations": violations,

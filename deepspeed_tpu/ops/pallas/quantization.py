@@ -145,6 +145,32 @@ def unpack_int4(p: jax.Array) -> jax.Array:
 
 
 # ---------------------------------------------------------------------------
+# KV-cache helpers (per-head_dim-vector scale granularity)
+# ---------------------------------------------------------------------------
+
+
+def kv_quantize(x: jax.Array, bits: int = 8) -> Tuple[jax.Array, jax.Array]:
+    """Quantize a KV tensor [..., head_dim] with one fp32 scale per head
+    vector: (int8 payload [..., head_dim], fp32 scales [...]).
+
+    Block = head_dim so every (layer, block, row, k/v, head) vector carries
+    its own scale — the granularity the paged cache stores alongside the
+    int8 payload. Reuses the blockwise dispatch (Pallas on TPU when the
+    tiling constraints hold, jnp reference on CPU CI).
+    """
+    hd = x.shape[-1]
+    q, s = quantize_blockwise(x, bits=bits, block=hd)
+    return q, s[..., 0]
+
+
+def kv_dequantize(q: jax.Array, scale: jax.Array, bits: int = 8,
+                  dtype=jnp.bfloat16) -> jax.Array:
+    """Inverse of kv_quantize: (int8 [..., head_dim], fp32 [...]) → dtype."""
+    return (q.astype(jnp.float32) * scale[..., None].astype(jnp.float32)
+            ).astype(dtype)
+
+
+# ---------------------------------------------------------------------------
 # quantized collectives (shard_map bodies)
 # ---------------------------------------------------------------------------
 
@@ -187,3 +213,25 @@ def quantized_psum_scatter(x: jax.Array, axis: str, bits: int = 8,
         qt, st, bits, block if x.shape[-1] % block == 0 else min(block, x.shape[-1]),
         jnp.float32)
     return (vals.sum(axis=0) / n).astype(x.dtype)
+
+
+def quantized_all_reduce(x: jax.Array, axis: str, bits: int = 8,
+                         block: int = DEFAULT_BLOCK) -> jax.Array:
+    """EQuARX-style quantized all-reduce (arXiv:2506.17615): quantize
+    shard-local → int8 reduce-scatter with fp32 accumulation → int8
+    all-gather of the reduced shards → dequant. Composes
+    quantized_psum_scatter + quantized_all_gather so both wire phases move
+    int8/int4 instead of bf16/fp32. Inside shard_map; reduces over `axis`
+    and returns the full mean-reduced tensor on every rank.
+
+    Pads dim 0 to a multiple of the axis size so arbitrary leading shapes
+    reduce-scatter cleanly; padding is stripped after the gather.
+    """
+    n = jaxcompat.axis_size(axis)
+    d0 = x.shape[0]
+    pad = (-d0) % n
+    xp = x if pad == 0 else jnp.concatenate(
+        [x, jnp.zeros((pad, *x.shape[1:]), x.dtype)], axis=0)
+    shard = quantized_psum_scatter(xp, axis, bits=bits, block=block)
+    full = quantized_all_gather(shard, axis, bits=bits, block=block)
+    return full[:d0] if pad else full

@@ -715,7 +715,8 @@ class Engine:
                 grad_clip=self.config.gradient_clipping,
                 qg_enabled=z.zero_quantized_gradients, qg_bits=8,
                 qw_enabled=z.zero_quantized_weights, qw_bits=8,
-                compute_dtype=cdt, param_shardings=param_sh)
+                compute_dtype=cdt, param_shardings=param_sh,
+                qar_enabled=z.zero_quantized_allreduce, qar_bits=8)
             self._zeropp_step_fn = step_fn
             rep = NamedSharding(mesh, P())
             sh = NamedSharding(mesh, P("dp"))

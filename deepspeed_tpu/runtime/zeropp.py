@@ -67,10 +67,11 @@ def build_zeropp_step(model, mesh, gas: int, base_lr: float,
                       lr_schedule: Optional[Callable], betas, eps: float,
                       weight_decay: float, grad_clip: float,
                       qg_enabled: bool, qg_bits: int, qw_enabled: bool,
-                      qw_bits: int, compute_dtype, param_shardings):
+                      qw_bits: int, compute_dtype, param_shardings,
+                      qar_enabled: bool = False, qar_bits: int = 8):
     """Returns (init_fn(rng) → (params, state), jit step_fn)."""
     from deepspeed_tpu.ops.pallas.quantization import (
-        quantized_all_gather, quantized_psum_scatter)
+        quantized_all_gather, quantized_all_reduce, quantized_psum_scatter)
 
     for ax in ("fsdp", "sp", "ep", "pp"):
         if mesh.shape.get(ax, 1) > 1:
@@ -138,7 +139,19 @@ def build_zeropp_step(model, mesh, gas: int, base_lr: float,
         g_shards = []
         for g, n, n_pad in zip(jax.tree.leaves(grads), sizes, pads):
             flat = _flat_pad(g, n, n_pad).reshape(-1, QUANT_BLOCK)
-            if qg_enabled:
+            if qar_enabled:
+                # qar: EQuARX-style quantized all-reduce (int8
+                # reduce-scatter + int8 all-gather with fp32 accumulation)
+                # yields the full mean everywhere; this rank then slices
+                # its ZeRO partition for the sharded Adam below. Rows are
+                # divisible by dp by construction of _pad_len, so the
+                # collective's internal padding never triggers.
+                full = quantized_all_reduce(flat, "dp", bits=qar_bits,
+                                            block=QUANT_BLOCK)
+                rows = flat.shape[0] // jaxcompat.axis_size("dp")
+                red = lax.dynamic_slice_in_dim(
+                    full, lax.axis_index("dp") * rows, rows, axis=0)
+            elif qg_enabled:
                 red = quantized_psum_scatter(flat, "dp", bits=qg_bits,
                                              block=QUANT_BLOCK)
             else:  # qwZ-only config: exact (unquantized) grad reduce
@@ -212,7 +225,8 @@ def build_zeropp_step(model, mesh, gas: int, base_lr: float,
 
     log_dist(
         f"ZeRO++ step: dp={dp}, "
-        + (f"qgZ=int{qg_bits}" if qg_enabled else "qgZ=off")
+        + (f"qar=int{qar_bits}" if qar_enabled
+           else (f"qgZ=int{qg_bits}" if qg_enabled else "qgZ=off"))
         + (f", qwZ=int{qw_bits}" if qw_enabled else ", qwZ=off"),
         ranks=[0])
     return init_fn, step_fn
@@ -236,4 +250,5 @@ def reseed_state_from_params(params, state: ZeroppState, dp: int
 def zeropp_enabled(config) -> bool:
     z = config.zero_optimization
     return (z.stage in (1, 2)
-            and (z.zero_quantized_gradients or z.zero_quantized_weights))
+            and (z.zero_quantized_gradients or z.zero_quantized_weights
+                 or getattr(z, "zero_quantized_allreduce", False)))

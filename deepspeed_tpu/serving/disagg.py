@@ -38,6 +38,15 @@ from typing import List, Optional, Tuple
 
 import numpy as np
 
+# handoff wire codec modes (engine `handoff_wire` knob / autotuner axis):
+#   auto — ship the source pool's native format (quantized pool: int8
+#          payload + scales as-is; bf16 pool: raw)
+#   raw  — full-precision bf16 blocks (pre-quant wire format)
+#   int8 — bf16 pools quantize per head vector for the wire (~0.53x)
+#   int4 — as int8, then two nibbles pack per byte (~0.28x — the
+#          <=0.35x-of-bf16 acceptance mode)
+WIRE_MODES = ("auto", "raw", "int8", "int4")
+
 
 @dataclasses.dataclass
 class KVHandoff:
@@ -45,12 +54,23 @@ class KVHandoff:
 
     ``block_data`` is host memory shaped
     ``[num_layers, n_blocks, block_size, 2, kv_heads, head_dim]`` —
-    the pool layout of the covered blocks, in chain order. ``keys`` is
-    the content-hash chain that addresses them on any replica."""
+    the pool layout of the covered blocks, in chain order (for the int4
+    wire the last dim is ``head_dim/2`` packed bytes and ``packed`` is
+    set). ``keys`` is the content-hash chain that addresses them on any
+    replica. ``scales`` rides along for quantized wires: one fp32 per
+    (layer, block, row, k/v, head) vector. ``src_quant_bits`` records
+    the SOURCE pool's storage mode so the installer can warn on a
+    fleet-wide precision mismatch (quantized pool feeding a bf16 pool
+    or vice versa — silent double conversion)."""
 
     keys: List[str]
     block_data: np.ndarray
     block_size: int
+    scales: Optional[np.ndarray] = None
+    wire_bits: Optional[int] = None   # None = full-precision payload
+    packed: bool = False              # int4 nibble packing along head_dim
+    src_quant_bits: Optional[int] = None
+    wire_snr_db: Optional[float] = None  # measured at wire-quantize time
 
     @property
     def n_blocks(self) -> int:
@@ -60,14 +80,83 @@ class KVHandoff:
     def n_tokens(self) -> int:
         return len(self.keys) * self.block_size
 
+    @property
+    def head_dim(self) -> int:
+        hd = self.block_data.shape[-1]
+        return hd * 2 if self.packed else hd
+
+    @property
+    def wire_nbytes(self) -> int:
+        """Bytes this payload actually puts on the wire."""
+        n = int(self.block_data.nbytes)
+        if self.scales is not None:
+            n += int(self.scales.nbytes)
+        return n
+
+    @property
+    def logical_nbytes(self) -> int:
+        """Full-precision bytes of the same blocks — the pre-quant wire
+        format (a raw handoff IS full precision; quantized wires compare
+        against the bf16 serving pool)."""
+        if self.wire_bits is None:
+            return int(self.block_data.nbytes)
+        return int(np.prod(self.block_data.shape[:-1])) * self.head_dim * 2
+
+
+def _record_wire(engine, handoff: KVHandoff, where: str) -> None:
+    """Wire-vs-logical byte accounting for one handoff: hub counters,
+    a comm traced_span (flight ring + Perfetto comm lane), and — when
+    quant.* collection is configured — a published kv_wire region."""
+    from deepspeed_tpu.comm import comm
+    from deepspeed_tpu.observability import quant_stats
+
+    with comm.traced_span("kv_handoff", handoff.block_data, "host",
+                          f"kv_handoff_{where}"):
+        pass
+    # per-engine accumulators feed replica.load_report → fleet snapshot
+    engine._handoff_wire_bytes = (
+        getattr(engine, "_handoff_wire_bytes", 0) + handoff.wire_nbytes)
+    engine._handoff_logical_bytes = (
+        getattr(engine, "_handoff_logical_bytes", 0)
+        + handoff.logical_nbytes)
+    if handoff.wire_snr_db is not None:
+        engine._last_kv_wire_snr_db = handoff.wire_snr_db
+    hub = getattr(engine, "_hub", None)
+    if hub is not None:
+        lbl = getattr(engine, "_metric_labels", None)
+        hub.counter_add("serve.handoff_wire_bytes", handoff.wire_nbytes,
+                        labels=lbl)
+        hub.counter_add("serve.handoff_logical_bytes",
+                        handoff.logical_nbytes, labels=lbl)
+        if handoff.wire_bits is not None:
+            hub.gauge("quant.kv_wire.compression",
+                      handoff.logical_nbytes / max(1, handoff.wire_nbytes),
+                      labels=lbl)
+    if quant_stats.collection_configured() and handoff.wire_bits is not None:
+        st = quant_stats.QuantRegionStats(
+            region="kv_wire", snr_db=handoff.wire_snr_db, max_rel_err=0.0,
+            logical_bytes=handoff.logical_nbytes,
+            wire_bytes=handoff.wire_nbytes,
+            n_elements=int(np.prod(handoff.block_data.shape[:-1]))
+            * handoff.head_dim,
+            bits=handoff.wire_bits, block=handoff.head_dim,
+            note=f"disagg handoff {where}: {handoff.n_blocks} blocks")
+        quant_stats.publish([st], hub=hub)
+
 
 def serialize_prefix(engine, tokens,
-                     max_blocks: Optional[int] = None
+                     max_blocks: Optional[int] = None,
+                     wire: Optional[str] = None
                      ) -> Optional[KVHandoff]:
     """Serialize the cached full-block chain covering ``tokens`` from
     ``engine``'s KV pool. Returns None when nothing is cached (short
     prompt, prefix cache off, or the chain was already evicted) — the
     caller then hands off tokens only and the target recomputes.
+
+    ``wire`` picks the codec (:data:`WIRE_MODES`; default the engine's
+    ``handoff_wire`` knob). A quantized pool always ships its native
+    int8 payload + scales as-is — its bf16 original no longer exists —
+    so ``wire`` only selects a conversion for bf16 pools.
 
     The chain is ref'd for the duration of the device→host copy so KV
     pressure on the source replica cannot evict-and-recycle a block
@@ -75,6 +164,10 @@ def serialize_prefix(engine, tokens,
     cache = getattr(engine.kv_cache, "prefix_cache", None)
     if cache is None:
         return None
+    wire = wire or getattr(engine, "_handoff_wire", "auto") or "auto"
+    if wire not in WIRE_MODES:
+        raise ValueError(f"handoff wire mode {wire!r} "
+                         f"(choose from {WIRE_MODES})")
     toks = np.asarray(tokens, np.int32).ravel()
     # same cap as attach_prefix: the final prompt token stays uncached
     # so admission still computes first-token logits
@@ -83,13 +176,46 @@ def serialize_prefix(engine, tokens,
         return None
     if max_blocks is not None:
         keys, blocks = keys[:max_blocks], blocks[:max_blocks]
+    kvc = engine.kv_cache
+    src_bits = getattr(kvc, "quant_bits", None)
     cache.ref(keys)
     try:
-        data = np.asarray(engine.kv_cache.data[:, np.asarray(blocks)])
+        idx = np.asarray(blocks)
+        data = np.asarray(kvc.data[:, idx])
+        scales = (np.asarray(kvc.scales[:, idx])
+                  if getattr(kvc, "scales", None) is not None else None)
     finally:
         cache.unref(keys)
-    return KVHandoff(keys=keys, block_data=data,
-                     block_size=cache.block_size)
+    wire_bits, packed, wire_snr = src_bits, False, None
+    if src_bits is None and wire in ("int8", "int4"):
+        import jax.numpy as jnp
+
+        from deepspeed_tpu.ops.pallas.quantization import (kv_dequantize,
+                                                           kv_quantize,
+                                                           pack_int4)
+
+        bits = 8 if wire == "int8" else 4
+        if bits == 4 and data.shape[-1] % 2:
+            bits = 8  # nibble packing needs an even head_dim
+        q, s = kv_quantize(jnp.asarray(data), bits=bits)
+        # this is the one place both the bf16 original and the wire
+        # payload coexist — measure the wire SNR here, report later
+        err = (np.asarray(kv_dequantize(q, s, dtype=jnp.float32),
+                          np.float32) - np.asarray(data, np.float32))
+        sig = float(np.sum(np.asarray(data, np.float32) ** 2))
+        noise = float(np.sum(err ** 2))
+        wire_snr = (float("inf") if noise == 0.0
+                    else 10.0 * float(np.log10(max(sig, 1e-30) / noise)))
+        if bits == 4:
+            q = pack_int4(q)
+            packed = True
+        data, scales, wire_bits = np.asarray(q), np.asarray(s), bits
+    handoff = KVHandoff(keys=keys, block_data=data,
+                        block_size=cache.block_size, scales=scales,
+                        wire_bits=wire_bits, packed=packed,
+                        src_quant_bits=src_bits, wire_snr_db=wire_snr)
+    _record_wire(engine, handoff, "serialize")
+    return handoff
 
 
 def install_prefix(engine, handoff: Optional[KVHandoff]
@@ -108,10 +234,25 @@ def install_prefix(engine, handoff: Optional[KVHandoff]
     if cache is None or handoff is None or not handoff.keys:
         return (0, 0)
     kvc = engine.kv_cache
+    dst_bits = getattr(kvc, "quant_bits", None)
+    # geometry on the LOGICAL layout — an int4-packed payload halves the
+    # stored head_dim, a quantized destination pool is int8 either way
     if (handoff.block_size != cache.block_size
             or handoff.block_data.shape[0] != kvc.data.shape[0]
-            or handoff.block_data.shape[2:] != kvc.data.shape[2:]):
+            or handoff.block_data.shape[2:5] != kvc.data.shape[2:5]
+            or handoff.head_dim != kvc.config.head_dim):
         return (0, 0)  # geometry mismatch: heterogeneous fleet, recompute
+    if handoff.src_quant_bits != dst_bits:
+        from deepspeed_tpu.observability.quant_stats import warn_once
+
+        warn_once(
+            f"handoff_precision:{handoff.src_quant_bits}->{dst_bits}",
+            "disagg handoff precision mismatch: source pool "
+            f"quant_bits={handoff.src_quant_bits} feeding destination "
+            f"quant_bits={dst_bits} — every transfer pays a "
+            "quantize/dequantize conversion on install; align "
+            "kv_quant_bits across the fleet (or set handoff_wire) to "
+            "make the wire format match the pools")
     # the target may already hold a chain prefix (shared system prompt
     # traffic): install only past the longest cached prefix — suffix
     # keys without their predecessors would be unreachable by lookup
@@ -131,9 +272,34 @@ def install_prefix(engine, handoff: Optional[KVHandoff]
 
     import jax.numpy as jnp
 
+    from deepspeed_tpu.ops.pallas.quantization import (kv_dequantize,
+                                                       kv_quantize,
+                                                       unpack_int4)
+
     blocks = kvc.allocator.allocate(need)
-    src = jnp.asarray(handoff.block_data[:, to_install], dtype=kvc.data.dtype)
-    kvc.data = kvc.data.at[:, jnp.asarray(blocks)].set(src)
+    bidx = jnp.asarray(blocks)
+    sel = handoff.block_data[:, to_install]
+    ssel = (None if handoff.scales is None
+            else jnp.asarray(handoff.scales[:, to_install], jnp.float32))
+    payload = jnp.asarray(sel)
+    if handoff.packed:
+        payload = unpack_int4(payload)
+    if dst_bits is not None:
+        if handoff.wire_bits is None:
+            # raw bf16 wire into a quantized pool: quantize-on-install
+            q, s = kv_quantize(payload)
+        else:
+            # int8/int4 values install directly — dequant is q*s either
+            # way, int4 just lands on a coarser grid
+            q, s = payload.astype(jnp.int8), ssel
+        kvc.data = kvc.data.at[:, bidx].set(q)
+        kvc.scales = kvc.scales.at[:, bidx].set(s)
+    else:
+        if handoff.wire_bits is None:
+            src = payload.astype(kvc.data.dtype)
+        else:
+            src = kv_dequantize(payload, ssel, dtype=kvc.data.dtype)
+        kvc.data = kvc.data.at[:, bidx].set(src)
     installed: List[str] = []
     for idx, blk in zip(to_install, blocks):
         if cache.register(handoff.keys[idx], int(blk)):
@@ -150,4 +316,6 @@ def install_prefix(engine, handoff: Optional[KVHandoff]
         hub.counter_add("serve.handoff_blocks", len(installed), labels=lbl)
         hub.counter_add("serve.handoff_tokens",
                         len(installed) * handoff.block_size, labels=lbl)
+    if installed:
+        _record_wire(engine, handoff, "install")
     return (len(installed), handoff.n_tokens)

@@ -177,6 +177,16 @@ class ServingReplica:
             "kv_free_frac": free / max(1, total),
             "goodput_tokens_per_s": round(self.goodput_ewma, 3),
             "killed": self.killed,
+            # serving-quant data plane (ISSUE 12): pool storage mode,
+            # handoff codec, cumulative wire-vs-logical handoff bytes,
+            # and the last measured wire SNR (None until a quantized
+            # handoff leaves/enters this replica)
+            "kv_quant_bits": getattr(e.kv_cache, "quant_bits", None),
+            "handoff_wire": getattr(e, "_handoff_wire", "auto"),
+            "handoff_wire_bytes": getattr(e, "_handoff_wire_bytes", 0),
+            "handoff_logical_bytes": getattr(
+                e, "_handoff_logical_bytes", 0),
+            "kv_wire_snr_db": getattr(e, "_last_kv_wire_snr_db", None),
         }
 
     def load_score(self) -> float:

@@ -1,0 +1,247 @@
+"""Quantized serving data plane tests: int8 KV-cache blocks, the
+int8/int4 KV-handoff wire, and the quantized-region acceptance gates.
+
+The load-bearing guarantees (docs/serving.md "Quantized KV cache &
+handoff wire", docs/quantized_comm.md "KV cache & wire"):
+- ``kv_quant_bits=None`` is a bit-exact off-switch: the unquantized
+  serving program lowers with no int8 ops at all — quantization is
+  structurally absent, not merely numerically small;
+- the prefix cache's refcount / copy-on-write / LRU-eviction machinery
+  operates over the quantized (payload, scales) pair exactly as it does
+  over bf16 blocks — sharing quantized blocks is a pure optimization
+  relative to a quantized cache-off engine;
+- the handoff codec round-trips quantized pools natively (the int8
+  payload + scales ship as-is), reinstalls idempotently, and warns once
+  when wire precision mismatches the destination pool;
+- every quantized region (kv_cache, kv_wire, qar) is measured against
+  the DEFAULT_GATES bounds and a corrupted scale trips the gate.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.inference.ragged.kv_cache import KVCacheConfig
+from deepspeed_tpu.models.zoo import get_model
+from deepspeed_tpu.observability import quant_stats as qs
+from deepspeed_tpu.serving import install_prefix, serialize_prefix
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    model = get_model("tiny", dtype=jnp.float32, param_dtype=jnp.float32)
+    params = model.init(jax.random.PRNGKey(0))
+    return model, params
+
+
+def make_engine(tiny, **kw):
+    from deepspeed_tpu.inference import InferenceEngineV2
+
+    model, params = tiny
+    kw.setdefault("kv_blocks", 64)
+    kw.setdefault("kv_block_size", 8)
+    kw.setdefault("max_tokens_per_step", 32)
+    kw.setdefault("max_seqs_per_step", 4)
+    kw.setdefault("max_blocks_per_seq", 8)
+    return InferenceEngineV2(model, params=params, dtype=jnp.float32, **kw)
+
+
+# -- the off-switch and the pool layout ----------------------------------
+
+
+class TestQuantizedPool:
+    def test_off_switch_is_structural(self):
+        # quant_bits=None lowers TODAY's program: zero int8 ops in the
+        # unquantized lowering, int8 present in the quantized one
+        assert qs.kv_off_switch_structural() is True
+
+    def test_bytes_per_block_capacity_math(self):
+        base = dict(num_layers=2, kv_heads=2, head_dim=128,
+                    block_size=16, num_blocks=4)
+        bf16 = KVCacheConfig(**base, quant_bits=None)
+        int8 = KVCacheConfig(**base, quant_bits=8)
+        # int8 payload + one fp32 scale per head vector vs 2-byte bf16:
+        # the capacity ratio is 2*head_dim/(head_dim+4)
+        ratio = bf16.bytes_per_block / int8.bytes_per_block
+        assert ratio == pytest.approx(2 * 128 / (128 + 4))
+        assert ratio > 1.8  # the serve-quant acceptance floor
+
+    def test_quantized_engine_matches_bf16_greedy(self, tiny):
+        prompts = [((np.arange(20) * 3 + 7 * i) % 100).astype(np.int32)
+                   for i in range(2)]
+        ref = make_engine(tiny)
+        ref.put([1, 2], prompts, max_new_tokens=6)
+        out_ref = ref.generate_all()
+        q = make_engine(tiny, kv_quant_bits=8)
+        assert q.kv_cache.quant_bits == 8
+        q.put([1, 2], prompts, max_new_tokens=6)
+        out_q = q.generate_all()
+        # full token budgets either way; at this scale the int8 grid is
+        # fine enough that greedy argmaxes agree token-for-token
+        assert all(len(t) == 6 for t in out_q.values())
+        assert out_q == out_ref
+
+
+# -- prefix cache over (payload, scales) pairs ---------------------------
+
+
+class TestQuantizedPrefixReuse:
+    def test_cache_hit_is_bit_identical(self, tiny):
+        eng = make_engine(tiny, kv_quant_bits=8)
+        prompt = np.arange(20, dtype=np.int32) % 100
+        eng.put([1], [prompt], max_new_tokens=4)
+        first = eng.generate_all()
+        cold_prefill = eng.scheduler.stats["prefill_tokens"]
+        eng.put([2], [prompt], max_new_tokens=4)
+        second = eng.generate_all()
+        # two full 8-token blocks of int8 payload + scales revived from
+        # the cache; only the prompt tail re-prefilled
+        assert eng.stats["prefix_hit_tokens"] == 16
+        assert eng.scheduler.stats["prefill_tokens"] - cold_prefill == 4
+        assert second[2] == first[1]
+
+    def test_divergent_tail_copy_on_write(self, tiny):
+        base = np.arange(16, dtype=np.int32)
+        a = np.concatenate([base, [50, 51, 52, 53]]).astype(np.int32)
+        b = np.concatenate([base, [60, 61, 62, 63]]).astype(np.int32)
+        # reference: the SAME quantized pool with sharing disabled —
+        # CoW over quantized pairs must be a pure optimization
+        ref_eng = make_engine(tiny, kv_quant_bits=8, prefix_cache=False)
+        ref_eng.put([1, 2], [a, b], max_new_tokens=6)
+        ref = ref_eng.generate_all()
+        eng = make_engine(tiny, kv_quant_bits=8)
+        eng.put([1], [a], max_new_tokens=6)
+        out = eng.generate_all()
+        eng.put([2], [b], max_new_tokens=6)
+        out.update(eng.generate_all())
+        assert eng.stats["prefix_hit_tokens"] == 16
+        assert out == ref
+
+    def test_eviction_reclaims_quantized_blocks(self, tiny):
+        eng = make_engine(tiny, kv_quant_bits=8, kv_blocks=9,
+                          max_blocks_per_seq=8)
+        eng.put([1], [np.arange(20, dtype=np.int32)], max_new_tokens=2)
+        eng.generate_all()
+        cache = eng.kv_cache.prefix_cache
+        assert cache.evictable_blocks == 2
+        eng.put([2], [(np.arange(52, dtype=np.int32) + 37) % 100],
+                max_new_tokens=2)
+        out = eng.generate_all()
+        assert len(out[2]) == 2
+        assert cache.stats["evicted"] >= 1
+
+
+# -- the handoff wire ----------------------------------------------------
+
+
+class TestQuantizedHandoff:
+    PROMPT = ((np.arange(20) * 3 + 1) % 100).astype(np.int32)
+
+    def test_native_int8_reinstall_idempotent(self, tiny):
+        src = make_engine(tiny, kv_quant_bits=8)
+        dst = make_engine(tiny, kv_quant_bits=8)
+        src.put([1], [self.PROMPT], max_new_tokens=4)
+        out_src = src.generate_all()
+        h = serialize_prefix(src, self.PROMPT)
+        # a quantized pool ships its native representation: int8
+        # payload + the per-vector scales, no re-encode
+        assert h is not None and h.wire_bits == 8 and not h.packed
+        assert h.block_data.dtype == np.int8 and h.scales is not None
+        assert install_prefix(dst, h) == (2, 16)
+        # same chain again: nothing new to write, whole chain attachable
+        assert install_prefix(dst, h) == (0, 16)
+        dst.put([1], [self.PROMPT], max_new_tokens=4)
+        out_dst = dst.generate_all()
+        assert dst.stats["prefix_hit_tokens"] == 16
+        assert list(out_dst[1]) == list(out_src[1])
+
+    def test_bf16_pool_int4_wire(self, tiny):
+        src = make_engine(tiny)
+        dst = make_engine(tiny)
+        src.put([1], [self.PROMPT], max_new_tokens=2)
+        src.generate_all()
+        raw = serialize_prefix(src, self.PROMPT, wire="raw")
+        q = serialize_prefix(src, self.PROMPT, wire="int4")
+        assert raw.wire_bits is None and q.wire_bits == 4 and q.packed
+        # the acceptance bound: int4 wire ships <= 0.35x the raw bytes,
+        # and the SNR measured at quantize time rides the handoff
+        assert q.wire_nbytes <= 0.35 * raw.wire_nbytes
+        # logical bytes are defined against the bf16 serving pool (2
+        # bytes/elem) whatever the wire or the test pool's dtype holds
+        n_elems = int(np.prod(raw.block_data.shape[:-1])) * raw.head_dim
+        assert q.logical_nbytes == n_elems * 2
+        assert q.wire_snr_db is not None and q.wire_snr_db > 10.0
+        assert install_prefix(dst, q) == (2, 16)
+        dst.put([1], [self.PROMPT], max_new_tokens=2)
+        out = dst.generate_all()
+        assert len(out[1]) == 2  # full budget off the dequantized chain
+
+    def test_precision_mismatch_warns_once(self, tiny):
+        from unittest import mock
+
+        src = make_engine(tiny)  # bf16 pool, raw wire
+        dst = make_engine(tiny, kv_quant_bits=8)
+        src.put([1], [self.PROMPT], max_new_tokens=2)
+        src.generate_all()
+        h = serialize_prefix(src, self.PROMPT, wire="raw")
+        qs._WARNED.discard("handoff_precision:None->8")
+        from deepspeed_tpu.utils.logging import logger
+        with mock.patch.object(logger, "warning") as warn:
+            assert install_prefix(dst, h) == (2, 16)  # quantize-on-install
+            install_prefix(dst, h)  # second install: no second warning
+        mismatch = [c for c in warn.call_args_list
+                    if "precision mismatch" in str(c)]
+        assert len(mismatch) == 1
+
+
+# -- acceptance gates over the new regions -------------------------------
+
+
+class TestServingQuantGates:
+    def _kv(self, head_dim=32, seed=0):
+        rng = np.random.default_rng(seed)
+        return jnp.asarray(rng.normal(0, 0.02, (4, 16, 2, head_dim))
+                           .astype(np.float32))
+
+    def test_kv_cache_region_within_gate(self):
+        st = qs.measure_kv_cache([self._kv()], head_dim=32)
+        ok, viol = qs.evaluate_gates([st])
+        assert ok, viol
+        assert st.region == "kv_cache" and st.bits == 8
+
+    def test_kv_wire_int4_within_gate_and_bound(self):
+        st = qs.measure_kv_wire(self._kv(), head_dim=32, bits=4)
+        ok, viol = qs.evaluate_gates([st])
+        assert ok, viol
+        # packed int4 + fp32 scales vs bf16: (0.5 + 4/hd)/2 of the bytes
+        assert st.wire_bytes / st.logical_bytes == \
+            pytest.approx((0.5 + 4 / 32) / 2)
+        assert st.wire_bytes / st.logical_bytes <= 0.35
+
+    def test_qar_region_two_hop_error(self):
+        rng = np.random.default_rng(3)
+        groups = [{"w": rng.normal(0, 0.1, (64, 64)).astype(np.float32)}
+                  for _ in range(4)]
+        st = qs.measure_qar(groups)
+        ok, viol = qs.evaluate_gates([st])
+        assert ok, viol
+        # two int8 hops: strictly noisier than one-hop kv_cache on the
+        # same kind of data, but bounded by the qar gate
+        assert st.region == "qar"
+        assert st.wire_bytes < st.logical_bytes * 0.3
+
+    def test_corrupt_scale_trips_each_region(self):
+        rng = np.random.default_rng(5)
+        groups = [{"w": rng.normal(0, 0.1, (64, 64)).astype(np.float32)}
+                  for _ in range(4)]
+        try:
+            qs.set_injection("corrupt_scale")
+            bad_cache = qs.measure_kv_cache([self._kv()], head_dim=32)
+            bad_qar = qs.measure_qar(groups)
+        finally:
+            qs.set_injection(None)
+        ok, viol = qs.evaluate_gates([bad_cache, bad_qar])
+        assert not ok
+        assert {v["region"] for v in viol} >= {"kv_cache", "qar"}

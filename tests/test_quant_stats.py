@@ -301,25 +301,29 @@ class TestAttribution:
 
 class TestQuantModes:
     @pytest.mark.parametrize("mode,expect", [
-        ("off", (False, False, 1)),
-        ("", (False, False, 1)),
-        ("qwz", (True, False, 1)),
-        ("qgz", (False, True, 1)),
-        ("qwz+qgz", (True, True, 1)),
-        ("qwz+qgz+hpz8", (True, True, 8)),
-        ("hpz16", (False, False, 16)),
+        ("off", (False, False, 1, False)),
+        ("", (False, False, 1, False)),
+        ("qwz", (True, False, 1, False)),
+        ("qgz", (False, True, 1, False)),
+        ("qar", (False, False, 1, True)),
+        ("qwz+qgz", (True, True, 1, False)),
+        ("qwz+qar", (True, False, 1, True)),
+        ("qwz+qgz+hpz8", (True, True, 8, False)),
+        ("hpz16", (False, False, 16, False)),
     ])
     def test_parse_roundtrip(self, mode, expect):
         out = parse_quant_mode(mode)
-        qwz, qgz, hpz = expect
+        qwz, qgz, hpz, qar = expect
         assert out == {"zero_quantized_weights": qwz,
                        "zero_quantized_gradients": qgz,
+                       "zero_quantized_allreduce": qar,
                        "zero_hpz_partition_size": hpz}
         if mode not in ("",):
             assert parse_quant_mode(
-                format_quant_mode(qwz, qgz, hpz)) == out
+                format_quant_mode(qwz, qgz, hpz, qar)) == out
 
-    @pytest.mark.parametrize("bad", ["int8", "qwz+int4", "hpzx", "hpz"])
+    @pytest.mark.parametrize("bad", ["int8", "qwz+int4", "hpzx", "hpz",
+                                     "qgz+qar"])
     def test_parse_rejects_junk(self, bad):
         with pytest.raises(ValueError):
             parse_quant_mode(bad)
@@ -564,7 +568,8 @@ class TestBenchArm:
         assert payload["injection"] is None
         regions = {r["region"] for r in payload["regions"]}
         assert regions == {"qwz_param_fetch", "qgz_grad_reduce",
-                           "fp8_mlp", "hpz_partition"}
+                           "fp8_mlp", "hpz_partition",
+                           "kv_cache", "kv_wire", "qar"}
         assert "PASS" in md and "FAIL" not in md
         # metrics landed on the hub for the sinks to export
         assert "dstpu_quant_qgz_grad_reduce_snr_db" in \

@@ -69,6 +69,42 @@ def test_qgz_qwz_tracks_exact_path(devices):
     assert lb[-1] < lb[0] - 0.2
 
 
+def test_qar_trains(devices):
+    # qar: EQuARX-style int8 all-reduce replacing the fp32 grad
+    # reduce-scatter — same default-mesh contract as qgZ
+    engine = make_engine({"zero_optimization": {
+        "stage": 1, "zero_quantized_allreduce": True}})
+    assert engine._zeropp
+    assert engine.mesh.shape["dp"] == 8
+    it = data_iter(engine.micro_batch_size * engine.dp_world_size)
+    losses = [float(engine.train_batch(it)) for _ in range(8)]
+    assert losses[-1] < losses[0] - 0.3, losses
+    assert int(engine._zeropp_state.step) == 8
+
+
+def test_qar_tracks_exact_path(devices):
+    """The quantized all-reduce's two int8 hops (scatter + gather) must
+    track the exact step within blockwise quantization noise."""
+    exact = make_engine({"zero_optimization": {"stage": 1}}, topology=TOPO)
+    qar = make_engine({"zero_optimization": {
+        "stage": 1, "zero_quantized_allreduce": True}}, topology=TOPO)
+    it_a = data_iter(exact.micro_batch_size * exact.dp_world_size, seed=7)
+    it_b = data_iter(qar.micro_batch_size * qar.dp_world_size, seed=7)
+    la = [float(exact.train_batch(it_a)) for _ in range(6)]
+    lb = [float(qar.train_batch(it_b)) for _ in range(6)]
+    np.testing.assert_allclose(lb, la, rtol=0.05)
+    assert lb[-1] < lb[0] - 0.2
+
+
+def test_qar_qgz_mutually_exclusive(devices):
+    # both knobs own the gradient wire: the config layer rejects the
+    # combination before any mesh work happens
+    with pytest.raises(ValueError, match="gradient wire"):
+        make_engine({"zero_optimization": {
+            "stage": 1, "zero_quantized_allreduce": True,
+            "zero_quantized_gradients": True}}, topology=TOPO)
+
+
 def test_zeropp_checkpoint_roundtrip(devices, tmp_path):
     engine = make_engine({"zero_optimization": {
         "stage": 2, "zero_quantized_gradients": True}}, topology=TOPO)

@@ -57,6 +57,13 @@ DEFAULT_THRESHOLDS: Dict[str, Tuple[str, float]] = {
     "engine_mfu": ("min_ratio", 0.85),
     "hidden_comm_frac": ("max_drop", 0.15),
     "host_gap_ms": ("max_ratio", 1.5),
+    # serving-quant arm (BENCH_MODE=serve_quant): wire compression must
+    # not erode >10% between rounds, the measured wire SNR must not drop
+    # >3 dB, and each arm's concurrent-session capacity holds like any
+    # other throughput headline
+    "handoff_wire_frac": ("max_ratio", 1.1),
+    "handoff_wire_snr_db": ("max_drop", 3.0),
+    "sessions_capacity": ("min_ratio", 0.85),
 }
 
 # units where a larger headline value is worse
@@ -145,6 +152,34 @@ def diff_reports(old: Dict[str, Any], new: Dict[str, Any],
             ratio = nv / ov
             check("host_gap_ms", rule, limit, ov, nv, ratio,
                   ratio <= limit)
+        # serving-quant sentinels (serve_quant payloads): handoff wire
+        # compression, wire SNR, and per-arm concurrent-session capacity
+        ov, nv = old.get("handoff_wire_frac"), new.get("handoff_wire_frac")
+        if isinstance(ov, (int, float)) and isinstance(nv, (int, float)) \
+                and ov > 0:
+            rule, limit = th["handoff_wire_frac"]
+            ratio = nv / ov
+            check("handoff_wire_frac", rule, limit, ov, nv, ratio,
+                  ratio <= limit)
+        ov = old.get("handoff_wire_snr_db")
+        nv = new.get("handoff_wire_snr_db")
+        if isinstance(ov, (int, float)) and isinstance(nv, (int, float)):
+            rule, limit = th["handoff_wire_snr_db"]
+            drop = ov - nv
+            check("handoff_wire_snr_db", rule, limit, ov, nv, drop,
+                  drop <= limit)
+        for arm in ("bf16", "int8"):
+            o_arm = old.get(arm) if isinstance(old.get(arm), dict) else {}
+            n_arm = new.get(arm) if isinstance(new.get(arm), dict) else {}
+            ov = o_arm.get("peak_concurrent_sessions")
+            nv = n_arm.get("peak_concurrent_sessions")
+            if isinstance(ov, (int, float)) and \
+                    isinstance(nv, (int, float)) and ov > 0:
+                rule, limit = th["sessions_capacity"]
+                ratio = nv / ov
+                check(f"{arm}.peak_concurrent_sessions", rule,
+                      limit * loosen, ov, nv, ratio,
+                      ratio >= limit * loosen)
 
     # quant acceptance gates ride every payload that carries them —
     # comparable or not, a failing gate in the NEW round always fails

@@ -631,10 +631,170 @@ def run_fleet() -> list:
     return results
 
 
+def run_quant() -> dict:
+    """Serving-quant capacity bench (``BENCH_MODE=serve_quant``,
+    ``make serve-quant``): the int8 KV pool's two acceptance numbers on
+    ONE fixed HBM byte budget.
+
+    - **sessions per HBM budget** — both arms get the same pool byte
+      budget; blocks come from the quant-aware
+      ``KVCacheConfig.bytes_per_block`` (int8 payload + fp32 scale per
+      head vector vs bf16), so the int8 arm fits
+      ``2*head_dim/(head_dim+4)``x the blocks. Each arm then actually
+      SERVES its capacity worth of concurrent sessions and reports the
+      measured peak live count — the ratio must hold >=
+      ``QUANT_SERVE_MIN_SESSIONS_RATIO`` (default 1.8).
+    - **handoff wire bytes** — the same cached prompt chain serialized
+      raw vs int4-packed (serving/disagg.py); the quantized wire must
+      ship <= ``QUANT_SERVE_MAX_WIRE_FRAC`` (default 0.35) of the raw
+      bytes.
+
+    Violations ride the payload's ``ok``/``violations`` keys, the same
+    contract as ``make bench-quant`` — ``tools/bench_diff.py`` fails the
+    run on any violation without needing a sentinel per number."""
+    import jax
+    import numpy as np
+
+    from deepspeed_tpu.inference.engine_v2 import InferenceEngineV2
+    from deepspeed_tpu.inference.ragged.kv_cache import KVCacheConfig
+    from deepspeed_tpu.models.zoo import get_model
+    from deepspeed_tpu.serving import disagg
+
+    on_tpu = jax.default_backend() == "tpu"
+    model_name = os.environ.get("QUANT_SERVE_MODEL", "llama3-8b")
+    layers = int(os.environ.get("QUANT_SERVE_LAYERS", 3 if on_tpu else 2))
+    vocab = int(os.environ.get("QUANT_SERVE_VOCAB",
+                               0 if on_tpu else 4096))
+    prompt_len = int(os.environ.get("QUANT_SERVE_PROMPT",
+                                    256 if on_tpu else 48))
+    gen = int(os.environ.get("QUANT_SERVE_GEN", 64 if on_tpu else 8))
+    # >= 6 sessions keeps the capacity ratio's floor-division
+    # granularity below the 1.8x gate's slack (at 3 the int8 arm's
+    # 1.94x byte advantage floors to 5/3 sessions)
+    base_sessions = int(os.environ.get("QUANT_SERVE_SESSIONS",
+                                       16 if on_tpu else 6))
+    min_ratio = float(os.environ.get("QUANT_SERVE_MIN_SESSIONS_RATIO", 1.8))
+    max_wire = float(os.environ.get("QUANT_SERVE_MAX_WIRE_FRAC", 0.35))
+    block = 16
+    max_seq_len = 1 << (prompt_len + gen + 1).bit_length()
+
+    overrides = dict(num_layers=layers, max_seq_len=max_seq_len,
+                     remat=False)
+    if vocab:
+        overrides["vocab_size"] = vocab  # CPU arm: shrink the embed table
+    model = get_model(model_name, **overrides)
+    cfg = model.config
+    import jax.numpy as jnp
+
+    params = jax.jit(model.init)(jax.random.PRNGKey(0))
+    params = jax.tree.map(lambda x: x.astype(jnp.bfloat16), params)
+
+    rng = np.random.default_rng(0)
+    blocks_per_seq = (prompt_len + gen) // block + 2
+
+    def kv_cfg(bits, num_blocks=1):
+        return KVCacheConfig(num_layers=layers, kv_heads=cfg.kv_heads,
+                             head_dim=cfg.head_dim, block_size=block,
+                             num_blocks=num_blocks, quant_bits=bits)
+
+    # ONE byte budget for both arms: exactly base_sessions worth of bf16
+    # blocks — the int8 arm's extra capacity is the headline
+    hbm_budget = kv_cfg(None).bytes_per_block * blocks_per_seq * base_sessions
+
+    def drive_arm(bits):
+        kv_blocks = hbm_budget // kv_cfg(bits).bytes_per_block
+        capacity = int(kv_blocks) // blocks_per_seq
+        n_req = capacity
+        prompts = [rng.integers(0, cfg.vocab_size, (prompt_len,))
+                   .astype(np.int32) for _ in range(n_req)]
+        engine = InferenceEngineV2(
+            model, params=params, kv_blocks=int(kv_blocks),
+            kv_block_size=block, max_tokens_per_step=max(64, prompt_len),
+            max_seqs_per_step=max(4, n_req),
+            max_blocks_per_seq=blocks_per_seq, prefix_cache=True,
+            kv_quant_bits=bits)
+        engine.put(list(range(n_req)), prompts, max_new_tokens=gen)
+        peak_live = 0
+        emitted = {}
+        t0 = time.perf_counter()
+        while engine.state.seqs or engine._queue:
+            out = engine.serve_step()
+            live = sum(1 for s in engine.state.seqs.values() if not s.done)
+            peak_live = max(peak_live, live)
+            for uid, toks in out.items():
+                emitted.setdefault(uid, []).extend(toks)
+        wall = time.perf_counter() - t0
+        total = sum(len(t) for t in emitted.values())
+        return engine, prompts, {
+            "kv_quant_bits": bits,
+            "kv_blocks": int(kv_blocks),
+            "bytes_per_block": kv_cfg(bits).bytes_per_block,
+            "pool_bytes": int(kv_blocks) * kv_cfg(bits).bytes_per_block,
+            "sessions_capacity": capacity,
+            "peak_concurrent_sessions": peak_live,
+            "requests": n_req,
+            "tokens": total,
+            "tokens_per_s": round(total / max(wall, 1e-9), 1),
+        }
+
+    bf16_engine, bf16_prompts, bf16_arm = drive_arm(None)
+    _, _, int8_arm = drive_arm(8)
+    ratio = (int8_arm["peak_concurrent_sessions"]
+             / max(bf16_arm["peak_concurrent_sessions"], 1))
+
+    # handoff wire: the SAME cached chain raw vs int4-packed
+    raw_h = disagg.serialize_prefix(bf16_engine, bf16_prompts[0],
+                                    wire="raw")
+    q_h = disagg.serialize_prefix(bf16_engine, bf16_prompts[0],
+                                  wire="int4")
+    wire_frac = (q_h.wire_nbytes / max(raw_h.wire_nbytes, 1)
+                 if raw_h is not None and q_h is not None else None)
+
+    violations = []
+    if ratio < min_ratio:
+        violations.append({
+            "region": "kv_capacity", "gate": "min_sessions_ratio",
+            "limit": min_ratio, "got": round(ratio, 3)})
+    if wire_frac is None:
+        violations.append({
+            "region": "kv_wire", "gate": "serialized",
+            "limit": "chain cached", "got": "no cached chain"})
+    elif wire_frac > max_wire:
+        violations.append({
+            "region": "kv_wire", "gate": "max_wire_frac",
+            "limit": max_wire, "got": round(wire_frac, 3)})
+    return {
+        "metric": f"{model_name}-geometry({layers}L) serve_quant "
+                  f"sessions-per-HBM-budget ratio (int8/bf16, "
+                  f"{'tpu' if on_tpu else 'cpu'})",
+        "value": round(ratio, 3),
+        "unit": "x",
+        "hbm_budget_bytes": int(hbm_budget),
+        "bf16": bf16_arm,
+        "int8": int8_arm,
+        "handoff_wire_bytes_raw": (raw_h.wire_nbytes
+                                   if raw_h is not None else None),
+        "handoff_wire_bytes_int4": (q_h.wire_nbytes
+                                    if q_h is not None else None),
+        "handoff_wire_frac": (round(wire_frac, 4)
+                              if wire_frac is not None else None),
+        "handoff_wire_snr_db": (round(q_h.wire_snr_db, 2)
+                                if q_h is not None
+                                and q_h.wire_snr_db is not None else None),
+        "ok": not violations,
+        "violations": violations,
+    }
+
+
 if __name__ == "__main__":
     mode = os.environ.get("BENCH_MODE", "serve")
     if mode == "serve_fleet":
         for arm_result in run_fleet():
             print(json.dumps(arm_result))
+    elif mode == "serve_quant":
+        _qp = run_quant()
+        print(json.dumps(_qp))
+        if not _qp.get("ok", True):
+            raise SystemExit(1)
     else:
         print(json.dumps(run_slo() if mode == "serve_slo" else run()))

@@ -152,17 +152,27 @@ def _fleet_table(snap: dict) -> str:
     """Render a serving_fleet/v1 snapshot as the fleet dashboard."""
     lines = [f"## serving fleet ({snap.get('mode', '?')} mode)", "",
              "| replica | role | steps | queue | live | inflight | "
-             "kv free | goodput tok/s | state |",
-             "|---|---|---|---|---|---|---|---|---|"]
+             "kv free | goodput tok/s | kv quant | wire | "
+             "handoff wire/logical | kv SNR dB | state |",
+             "|---|---|---|---|---|---|---|---|---|---|---|---|---|"]
     dead = set(snap.get("dead_replicas", []))
     for r in snap.get("replicas", []):
         state = ("DEAD" if r["replica"] in dead
                  else "killed" if r.get("killed") else "up")
+        bits = r.get("kv_quant_bits")
+        quant = "bf16" if bits is None else f"int{bits}"
+        wire = r.get("handoff_wire", "auto")
+        wb, lb = (r.get("handoff_wire_bytes", 0),
+                  r.get("handoff_logical_bytes", 0))
+        hand = f"{wb}/{lb}" if lb else "-"
+        snr = r.get("kv_wire_snr_db")
+        snr_s = "-" if snr is None else f"{snr:.1f}"
         lines.append(
             f"| r{r['replica']} | {r['role']} | {r['steps']} | "
             f"{r['queue_wait_depth']} | {r['live_seqs']} | "
             f"{r['inflight']} | {r['kv_free_frac'] * 100:.0f}% | "
-            f"{r['goodput_tokens_per_s']} | {state} |")
+            f"{r['goodput_tokens_per_s']} | {quant} | {wire} | "
+            f"{hand} | {snr_s} | {state} |")
     st = snap.get("router", {})
     lines += ["", "router: " + "  ".join(
         f"{k}={st[k]}" for k in ("submitted", "completed", "handoffs",
