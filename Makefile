@@ -14,7 +14,7 @@ NPROC := $(shell nproc)
 XDIST ?= $(shell if [ $(NPROC) -gt 2 ] && python -c "import xdist" 2>/dev/null; then echo "-n $$(( $(NPROC) - 1 )) --dist loadfile"; fi)
 PYTEST ?= python -m pytest
 
-.PHONY: test smoke slow bench bench-real bench-proxy bench-hostgap bench-overlap bench-longctx bench-quant bench-diff quant-sweep fleet-demo chaos serve-slo serve-fleet serve-quant
+.PHONY: test smoke slow bench bench-real bench-proxy bench-hostgap bench-overlap bench-longctx bench-quant bench-diff quant-sweep fleet-demo chaos serve-slo serve-fleet serve-quant serve-procs
 
 smoke:
 	$(PYTEST) tests/ -q -m "not slow" $(XDIST)
@@ -130,6 +130,21 @@ serve-fleet:
 # "Quantized KV cache & handoff wire").
 serve-quant:
 	BENCH_MODE=serve_quant python bench.py
+
+# Cross-process fleet (tools/serve_bench.py run_procs): real worker
+# SUBPROCESSES behind the length-prefixed CRC socket transport
+# (serving/transport/), one diurnal+bursty open-loop workload through
+# four arms — least_loaded vs predictive routing on a fleet with one
+# degraded worker (the routing A/B: predictive must beat p99 TTFT),
+# chaos (mid-run SIGKILL via DSTPU_CHAOS kill_rank + a scripted
+# autoscale swing: zero drops, restart + spawn/drain acts recorded,
+# p99.9 TTFT), and disagg (prefill->decode KV handoffs over the int4
+# wire across real sockets, kv_wire_ratio gate). One JSON line;
+# violations ride ok/violations so bench_diff fails the round. CPU
+# defaults; scale with PROCS_REQUESTS/PROCS_RATE/PROCS_REPLICAS
+# (docs/serving.md "Cross-process fleet").
+serve-procs:
+	BENCH_MODE=serve_procs python bench.py
 
 # Fault-injection drill on the 8-device CPU sim: SIGKILL a training rank
 # mid-run, let the elastic agent restart it, and assert the auto-resumed

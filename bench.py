@@ -304,7 +304,8 @@ def overlap_report(model, step_ms, overlap_depth, streaming,
 
 def main():
     if os.environ.get("BENCH_MODE") in ("serve", "serve_slo",
-                                        "serve_fleet", "serve_quant"):
+                                        "serve_fleet", "serve_quant",
+                                        "serve_procs"):
         # serving benchmarks instead of the training headline
         # (tools/serve_bench.py): "serve" is the closed-loop v2-vs-v1
         # throughput comparison (SERVE_* env knobs); "serve_slo" is the
@@ -316,7 +317,10 @@ def main():
         # workload, one JSON line per arm (FLEET_* env knobs);
         # "serve_quant" is the int8-KV capacity arm — concurrent
         # sessions per fixed HBM budget (int8 vs bf16 pool) plus the
-        # raw-vs-int4 handoff wire bytes (QUANT_SERVE_* env knobs)
+        # raw-vs-int4 handoff wire bytes (QUANT_SERVE_* env knobs);
+        # "serve_procs" is the cross-process fleet — worker subprocesses
+        # behind the socket transport, routing A/B + chaos + disagg
+        # arms over one diurnal/bursty schedule (PROCS_* env knobs)
         import sys
 
         sys.path.insert(0, os.path.join(os.path.dirname(
@@ -333,6 +337,11 @@ def main():
             print(json.dumps(quant_payload))
             if not quant_payload.get("ok", True):
                 sys.exit(1)  # same fail-loud contract as BENCH_QUANT
+        elif os.environ.get("BENCH_MODE") == "serve_procs":
+            procs_payload = serve_bench.run_procs()
+            print(json.dumps(procs_payload))
+            if not procs_payload.get("ok", True):
+                sys.exit(1)  # gates: routing A/B, zero drops, wire ratio
         else:
             print(json.dumps(serve_bench.run()))
         return

@@ -650,7 +650,19 @@ class RouterConfig(ConfigModel):
     heartbeat staleness that declares a replica dead and triggers
     failover. The ``autoscale_*``/``queue_*``/``slo_miss_high``/
     ``hysteresis_rounds`` knobs parameterize the desired-replica-count
-    signal (serving/autoscale.py) — metrics only, never provisioning."""
+    signal (serving/autoscale.py) — metrics only in-process; the
+    cross-process supervisor (serving/supervisor.py) is the controller
+    that acts on it.
+
+    ``routing`` picks the placement policy behind the affinity check:
+    ``least_loaded`` (live load report) or ``predictive`` (lowest
+    predicted TTFT from the queue-depth x service-EWMA + prefill-rate
+    model). ``transport`` selects how a process fleet connects its
+    replicas — ``inproc`` (threads, no processes), ``socket``
+    (localhost TCP, the primary), or ``file`` (spool-dir frames, the
+    socketless fallback; docs/serving.md degraded-mode matrix) — with
+    ``max_frame_mb``/``connect_retries``/``connect_backoff_seconds``
+    bounding the frame size and the dial-with-backoff schedule."""
 
     replicas: int = 2
     mode: str = "unified"
@@ -663,6 +675,11 @@ class RouterConfig(ConfigModel):
     queue_low: float = 0.5
     slo_miss_high: float = 0.1
     hysteresis_rounds: int = 3
+    routing: str = "least_loaded"
+    transport: str = "inproc"
+    max_frame_mb: int = 64
+    connect_retries: int = 40
+    connect_backoff_seconds: float = 0.05
 
     def validate(self) -> None:
         if self.mode not in ("unified", "disagg"):
@@ -696,6 +713,24 @@ class RouterConfig(ConfigModel):
             raise ValueError(
                 f"serving.router.hysteresis_rounds must be >= 1, got "
                 f"{self.hysteresis_rounds}")
+        if self.routing not in ("least_loaded", "predictive"):
+            raise ValueError(
+                f"serving.router.routing must be least_loaded|"
+                f"predictive, got {self.routing!r}")
+        if self.transport not in ("inproc", "socket", "file"):
+            raise ValueError(
+                f"serving.router.transport must be inproc|socket|file, "
+                f"got {self.transport!r}")
+        if self.max_frame_mb < 1:
+            raise ValueError(
+                f"serving.router.max_frame_mb must be >= 1, got "
+                f"{self.max_frame_mb}")
+        if self.connect_retries < 1 or self.connect_backoff_seconds <= 0:
+            raise ValueError(
+                f"serving.router needs connect_retries >= 1 and "
+                f"connect_backoff_seconds > 0, got "
+                f"({self.connect_retries}, "
+                f"{self.connect_backoff_seconds})")
 
 
 @register_config_model

@@ -485,6 +485,15 @@ class InferenceEngineV2:
                         "log_summary()", ranks=[0])
             else:
                 self.stats["prefill_kernel_steps"] += 1
+            # fraction of mixed prefill steps that lost the Pallas
+            # kernel to the gather path — per-replica on the Prometheus
+            # page, so a fleet shows WHICH replica degraded, not a blur
+            attempts = (self.stats["prefill_gather_fallbacks"]
+                        + self.stats["prefill_kernel_steps"])
+            self._hub.gauge(
+                "serve.paged_fallback_ratio",
+                self.stats["prefill_gather_fallbacks"] / max(1, attempts),
+                labels=self._metric_labels)
         elif decode_only:
             self.stats["decode_kernel_steps"] += 1
         with self.mesh:

@@ -5,13 +5,18 @@ Reference analog: DeepSpeed serves FastGen behind MII's replica router
 prefill/decode disaggregation. Layout:
 
 * ``replica.py`` — one engine + role + heartbeat/load report;
-* ``router.py`` — admission, affinity/least-loaded routing,
+* ``router.py`` — admission, affinity/least-loaded/predictive routing,
   stale-heartbeat failover, fleet observability;
 * ``disagg.py`` — the KV-block handoff codec between prefill and
   decode replicas;
-* ``autoscale.py`` — desired-replica-count signals (metrics only).
+* ``autoscale.py`` — desired-replica-count signals (+ the supervisor's
+  act log);
+* ``transport/`` — framed socket/spool-file channels for cross-process
+  fleets;
+* ``proc_worker.py`` / ``supervisor.py`` — one replica per OS process
+  behind the same router: spawn, restart, autoscale spin-up/drain.
 
-See docs/serving.md "Multi-replica fleet".
+See docs/serving.md "Multi-replica fleet" and "Cross-process fleet".
 """
 
 from deepspeed_tpu.serving.autoscale import AutoscaleSignal
@@ -19,7 +24,10 @@ from deepspeed_tpu.serving.disagg import (KVHandoff, install_prefix,
                                           serialize_prefix)
 from deepspeed_tpu.serving.replica import ServingReplica, Submission
 from deepspeed_tpu.serving.router import FleetRouter, build_fleet
+from deepspeed_tpu.serving.supervisor import (RemoteReplica,
+                                              ReplicaSupervisor)
 
 __all__ = ["AutoscaleSignal", "FleetRouter", "KVHandoff",
-           "ServingReplica", "Submission", "build_fleet",
-           "install_prefix", "serialize_prefix"]
+           "RemoteReplica", "ReplicaSupervisor", "ServingReplica",
+           "Submission", "build_fleet", "install_prefix",
+           "serialize_prefix"]

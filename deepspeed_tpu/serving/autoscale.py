@@ -106,6 +106,17 @@ class AutoscaleSignal:
             self._hub.gauge("serve.fleet.goodput_slope", self.goodput_slope)
         return self.desired
 
+    def record_action(self, action: str, replica_id: int,
+                      now: Optional[float] = None) -> None:
+        """Log an *act* on the signal into the decision history — the
+        process supervisor is the first in-repo controller that actually
+        provisions (spawn/drain/restart), and its acts belong on the
+        same timeline as the desires that caused them. Action entries
+        are ``(ts, desired, "action:rN")`` 3-tuples next to the
+        ``(ts, desired)`` decision 2-tuples."""
+        now = time.time() if now is None else now
+        self.history.append((now, self.desired, f"{action}:r{replica_id}"))
+
     def snapshot(self) -> Dict[str, Any]:
         return {
             "desired_replicas": self.desired,

@@ -154,10 +154,26 @@ class ServingReplica:
         self.engine.put([sub.uid], [sub.tokens],
                         max_new_tokens=sub.max_new_tokens)
         for kind, fields in sub.span_notes:
+            # stamp which replica actually applied the span: routers and
+            # supervisors attach notes from their own process, and the
+            # cross-process trace merge needs the executing replica id
+            fields.setdefault("replica_id", self.replica_id)
             self.engine.tracer.note(sub.uid, kind, **fields)
 
     def submit(self, sub: Submission) -> None:
         self.inbox.put(sub)
+
+    def serialize_handoff(self, tokens: np.ndarray,
+                          cb: Callable[[Optional[Any]], None]) -> None:
+        """Serialize this replica's KV prefix for ``tokens`` and hand
+        the payload to ``cb`` (None = degrade to recompute). Local
+        replicas run it synchronously — _handoff is called on THIS
+        replica's pump thread, so reading its KV pool here is race-free,
+        the pre-transport semantics. RemoteReplica overrides this with a
+        serialize RPC whose reply invokes ``cb`` later."""
+        from deepspeed_tpu.serving.disagg import serialize_prefix
+
+        cb(serialize_prefix(self.engine, tokens))
 
     # -- load report ---------------------------------------------------
     def load_report(self, now: Optional[float] = None) -> Dict[str, Any]:

@@ -4,8 +4,15 @@ The router owns three decisions and one promise:
 
 * **Routing** — prefix-hash session affinity first (requests sharing a
   prompt prefix land where those KV blocks are already cached, the
-  MII-replica-router / vLLM-prefix-aware-routing idea), least-loaded by
-  live load report otherwise.
+  MII-replica-router / vLLM-prefix-aware-routing idea), then one of two
+  policies: ``least_loaded`` by live load report, or ``predictive`` —
+  route by predicted TTFT per replica from the five-phase model's
+  decomposition (queue-wait estimate = reported queue depth x the
+  observed per-request service-time EWMA, plus a prefill estimate from
+  prompt length over the replica's observed prefill token rate). The
+  predictive policy is what lets a degraded replica shed load *before*
+  its queue builds: its service EWMA rises, so its predicted TTFT does
+  too.
 * **Disaggregation** — with ``prefill``/``decode``-role replicas, a new
   request goes to a prefill replica with a one-token budget; when its
   first token lands, the prompt's KV blocks are serialized from the
@@ -33,6 +40,13 @@ via callbacks that run on the replica pump threads. Router state is
 lock-protected, so the same code drives both the synchronous test mode
 (``step()``/``run_until_complete()``) and the threaded bench mode
 (``start()``/``drain()``).
+
+Process fleets (serving/supervisor.py) reuse this router unchanged: a
+``RemoteReplica`` satisfies the same surface (``submit``,
+``load_report``, ``alive``, ``serialize_handoff``), emissions arrive on
+the supervisor's receive threads instead of pump threads, and
+``add_replica``/``remove_replica`` let the supervisor act on the
+autoscale signal with real spin-up and drain.
 """
 
 from __future__ import annotations
@@ -44,7 +58,6 @@ from typing import Any, Dict, List, Optional
 
 import numpy as np
 
-from deepspeed_tpu.serving.disagg import serialize_prefix
 from deepspeed_tpu.serving.replica import ServingReplica, Submission
 
 
@@ -78,13 +91,14 @@ def build_fleet(model, router_cfg=None, engine_kw=None,
         hysteresis_rounds=cfg.hysteresis_rounds, hub=get_hub())
     return FleetRouter(replicas, affinity_blocks=cfg.affinity_blocks,
                        stale_after_s=cfg.stale_after_seconds,
-                       autoscale=autoscale, eos_token_id=eos_token_id)
+                       autoscale=autoscale, eos_token_id=eos_token_id,
+                       routing=getattr(cfg, "routing", "least_loaded"))
 
 
 class _RequestRecord:
     __slots__ = ("uid", "tokens", "max_new_tokens", "replica_id", "phase",
                  "emitted", "done", "failovers", "affinity_key",
-                 "submitted_ts")
+                 "submitted_ts", "first_emit_ts", "last_emit_ts")
 
     def __init__(self, uid, tokens, max_new_tokens, replica_id, phase,
                  affinity_key):
@@ -98,6 +112,11 @@ class _RequestRecord:
         self.failovers = 0
         self.affinity_key = affinity_key
         self.submitted_ts = time.time()
+        self.first_emit_ts = 0.0
+        self.last_emit_ts = 0.0
+
+
+ROUTING_POLICIES = ("least_loaded", "predictive")
 
 
 class FleetRouter:
@@ -105,9 +124,14 @@ class FleetRouter:
                  affinity_blocks: int = 2,
                  stale_after_s: float = 5.0,
                  autoscale=None,
-                 eos_token_id: Optional[int] = None):
+                 eos_token_id: Optional[int] = None,
+                 routing: str = "least_loaded",
+                 service_ewma_alpha: float = 0.3):
         if not replicas:
             raise ValueError("FleetRouter needs at least one replica")
+        if routing not in ROUTING_POLICIES:
+            raise ValueError(f"routing must be one of {ROUTING_POLICIES},"
+                             f" got {routing!r}")
         self.replicas = {r.replica_id: r for r in replicas}
         self.prefill_pool = [r.replica_id for r in replicas
                              if r.role == "prefill"]
@@ -120,12 +144,34 @@ class FleetRouter:
         self.stale_after_s = float(stale_after_s)
         self.autoscale = autoscale
         self.eos_token_id = eos_token_id
+        self.routing = routing
         self._lock = threading.RLock()
         self._requests: Dict[int, _RequestRecord] = {}
         # (pool, prefix-hash) -> replica id that holds those KV blocks
         self._affinity: Dict[Any, int] = {}
         self.dead: set = set()
+        self.draining: set = set()
         self._last_policy = "least_loaded"
+        self._last_predicted_ms: Optional[float] = None
+        # per-replica observations feeding the predictive policy:
+        # service EWMA in seconds per completed request, and the
+        # observed prefill token rate from first-token latencies
+        self._svc_ewma: Dict[int, float] = {}
+        self._prefill_rate: Dict[int, float] = {}
+        # decode seconds-per-token from emission gaps: learned within a
+        # couple of rounds of a replica's FIRST request, long before
+        # any completion feeds _svc_ewma — the predictor's cold-start
+        # service estimate (spt x typical budget)
+        self._spt_ewma: Dict[int, float] = {}
+        self._avg_budget = 0.0
+        self._ewma_alpha = float(service_ewma_alpha)
+        # a fresh replica's FIRST request pays the one-time JIT compile
+        # (seconds, vs milliseconds steady-state); folding that sample
+        # into the EWMAs would make a fast replica look 100x slower for
+        # the first dozen requests, so each signal discards its first
+        # per-replica observation as the compile-warming round
+        self._prefill_seen: Dict[int, int] = {}
+        self._svc_seen: Dict[int, int] = {}
         self.stats = {"submitted": 0, "completed": 0, "handoffs": 0,
                       "handoff_recompute": 0, "failovers": 0,
                       "failed_over_requests": 0, "affinity_hits": 0}
@@ -134,6 +180,36 @@ class FleetRouter:
         from deepspeed_tpu.observability.hub import get_hub
 
         self._hub = get_hub()
+
+    # -- fleet membership (supervisor spin-up / drain) -----------------
+    def add_replica(self, replica: ServingReplica) -> None:
+        """Wire a freshly spun-up replica into the pools (supervisor
+        scale-up / crash-restart path)."""
+        with self._lock:
+            rid = replica.replica_id
+            if rid in self.replicas and rid not in self.dead:
+                raise ValueError(f"replica id {rid} already in the fleet")
+            self.replicas[rid] = replica
+            self.dead.discard(rid)
+            self.draining.discard(rid)
+            if replica.role == "prefill":
+                if rid not in self.prefill_pool:
+                    self.prefill_pool.append(rid)
+            elif rid not in self.decode_pool:
+                self.decode_pool.append(rid)
+            replica.emit_callback = self._on_emissions
+
+    def remove_replica(self, replica_id: int) -> None:
+        """Stop routing NEW work to a replica (supervisor drain). The
+        replica stays in ``self.replicas`` so its in-flight requests
+        finish through the normal emission path — drain means 'no new
+        admissions', never 'drop what you hold'."""
+        with self._lock:
+            self.draining.add(replica_id)
+            if replica_id in self.prefill_pool:
+                self.prefill_pool.remove(replica_id)
+            if replica_id in self.decode_pool:
+                self.decode_pool.remove(replica_id)
 
     # -- admission + routing -------------------------------------------
     def submit(self, uid: int, tokens, max_new_tokens: int = 64) -> int:
@@ -147,22 +223,44 @@ class FleetRouter:
                 raise ValueError(f"uid={uid} already in flight")
             key = self._affinity_key(toks)
             if self.disagg:
-                target = self._pick(self.prefill_pool, key)
+                target = self._pick(self.prefill_pool, key, len(toks))
                 phase, budget = "prefill", 1
             else:
-                target = self._pick(self.decode_pool, key)
+                target = self._pick(self.decode_pool, key, len(toks))
                 phase, budget = "decode", int(max_new_tokens)
             self._check_fits(target, toks, max_new_tokens)
             rec = _RequestRecord(uid, toks, int(max_new_tokens),
                                  target.replica_id, phase, key)
             self._requests[uid] = rec
             self.stats["submitted"] += 1
+            self._avg_budget = float(max_new_tokens) \
+                if self._avg_budget <= 0.0 else (
+                    self._ewma_alpha * float(max_new_tokens)
+                    + (1.0 - self._ewma_alpha) * self._avg_budget)
+            route = self._route_fields(target, self._last_policy,
+                                       self._last_predicted_ms)
         target.submit(Submission(
             uid=uid, tokens=toks, max_new_tokens=budget,
-            span_notes=[("ROUTE", {"replica": target.replica_id,
-                                   "role": target.role,
-                                   "policy": self._last_policy})]))
+            span_notes=[("ROUTE", route)]))
         return target.replica_id
+
+    def _route_fields(self, target: ServingReplica, policy: str,
+                      predicted_ms: Optional[float] = None
+                      ) -> Dict[str, Any]:
+        """ROUTE span fields: placement decision + the transport byte
+        counters at decision time, so cross-process lanes show what each
+        hop had already paid on the wire (replica_id itself is stamped
+        by the replica applying the submission — in ITS process)."""
+        fields: Dict[str, Any] = {"replica": target.replica_id,
+                                  "role": target.role, "policy": policy}
+        tx = getattr(target, "transport_bytes", None)
+        if tx is not None:
+            sent, received = tx()
+            fields["wire_tx_bytes"] = int(sent)
+            fields["wire_rx_bytes"] = int(received)
+        if predicted_ms is not None:
+            fields["predicted_ttft_ms"] = round(predicted_ms, 3)
+        return fields
 
     def _affinity_key(self, toks: np.ndarray) -> Optional[str]:
         if self.affinity_blocks <= 0:
@@ -188,23 +286,67 @@ class FleetRouter:
             raise RuntimeError("no live replicas left in the fleet")
         return out
 
-    def _pick(self, pool: List[int], key: Optional[str]
-              ) -> ServingReplica:
-        """Affinity if the remembered replica is still live, else
-        least-loaded. Caller holds the lock."""
+    def _pick(self, pool: List[int], key: Optional[str],
+              n_tokens: int = 0) -> ServingReplica:
+        """Affinity if the remembered replica is still live, else the
+        configured policy (least-loaded or predicted-TTFT). Caller
+        holds the lock."""
         alive = self._alive(pool)
         pool_tag = id(pool)
+        self._last_predicted_ms = None
         if key is not None:
             rid = self._affinity.get((pool_tag, key))
             if rid is not None and any(r.replica_id == rid for r in alive):
                 self.stats["affinity_hits"] += 1
                 self._last_policy = "affinity"
                 return self.replicas[rid]
-        best = min(alive, key=lambda r: r.load_score())
+        if self.routing == "predictive":
+            # ties (no observations yet) fall back to load score, so a
+            # cold fleet degrades to exactly the least-loaded policy
+            best = min(alive, key=lambda r: (
+                self.predict_ttft(r, n_tokens), r.load_score()))
+            self._last_policy = "predictive"
+            self._last_predicted_ms = \
+                self.predict_ttft(best, n_tokens) * 1e3
+        else:
+            best = min(alive, key=lambda r: r.load_score())
+            self._last_policy = "least_loaded"
         if key is not None:
             self._affinity[(pool_tag, key)] = best.replica_id
-        self._last_policy = "least_loaded"
         return best
+
+    def predict_ttft(self, replica: ServingReplica,
+                     n_tokens: int = 0) -> float:
+        """Predicted TTFT in seconds for a new ``n_tokens`` prompt on
+        ``replica`` — the five-phase model's first two phases estimated
+        from fleet observables: queue_wait ~= (everything already
+        queued or running there) x the replica's observed per-request
+        service EWMA, prefill ~= prompt length over its observed
+        prefill token rate. Both EWMAs are router-side observations, so
+        the estimate works identically for thread and process replicas."""
+        rid = replica.replica_id
+        rep = replica.load_report()
+        depth = rep.get("inflight",
+                        rep.get("queue_wait_depth", 0)
+                        + rep.get("live_seqs", 0))
+        svc = self._svc_ewma.get(rid, 0.0)
+        if svc <= 0.0:
+            # no completion observed yet: estimate service time from
+            # the replica's decode cadence x the typical budget (learned
+            # within rounds, not requests), else borrow the fleet's
+            # observed service time, else a 1s prior — a zero here
+            # would erase the queue term entirely and leave the ranking
+            # to prefill-rate noise
+            spt = self._spt_ewma.get(rid, 0.0)
+            if spt > 0.0 and self._avg_budget > 0.0:
+                svc = spt * self._avg_budget
+            else:
+                known = [v for v in self._svc_ewma.values() if v > 0.0]
+                svc = (sum(known) / len(known)) if known else 1.0
+        queue_wait = float(depth) * svc
+        rate = self._prefill_rate.get(rid, 0.0)
+        prefill = (float(n_tokens) / rate) if rate > 0.0 else 0.0
+        return queue_wait + prefill
 
     @staticmethod
     def _check_fits(replica: ServingReplica, toks: np.ndarray,
@@ -222,49 +364,102 @@ class FleetRouter:
     def _on_emissions(self, replica: ServingReplica,
                       emitted: Dict[int, List[int]]) -> None:
         handoffs = []
+        now = time.time()
         with self._lock:
             for uid, toks in emitted.items():
                 rec = self._requests.get(uid)
                 if (rec is None or rec.done
                         or rec.replica_id != replica.replica_id):
                     continue  # stale emission from a failed-over replica
+                if not rec.emitted and toks:
+                    self._observe_first_token(replica.replica_id, rec, now)
+                elif toks and rec.last_emit_ts > 0.0:
+                    # decode cadence: gap since the last batch over the
+                    # tokens it produced -> seconds-per-token EWMA
+                    spt = max(now - rec.last_emit_ts, 1e-6) / len(toks)
+                    prev = self._spt_ewma.get(replica.replica_id)
+                    self._spt_ewma[replica.replica_id] = \
+                        spt if prev is None else (
+                            self._ewma_alpha * spt
+                            + (1.0 - self._ewma_alpha) * prev)
+                if toks:
+                    rec.last_emit_ts = now
                 rec.emitted.extend(int(t) for t in toks)
                 if rec.phase == "prefill":
                     handoffs.append(rec)  # budget-1 stage just finished
                 elif len(rec.emitted) >= rec.max_new_tokens:
                     rec.done = True
                     self.stats["completed"] += 1
+                    self._observe_completion(replica.replica_id, rec, now)
         for rec in handoffs:
             self._handoff(rec, replica)
 
+    def _observe_first_token(self, rid: int, rec: _RequestRecord,
+                             now: float) -> None:
+        """Feed the predictive policy's prefill-rate EWMA: prompt
+        tokens over observed first-token latency (queue wait included —
+        an *effective* rate, which is the one a new arrival will see).
+        Caller holds the lock."""
+        rec.first_emit_ts = now
+        seen = self._prefill_seen.get(rid, 0)
+        self._prefill_seen[rid] = seen + 1
+        if seen == 0:
+            return  # compile-warming round (see __init__)
+        ttft = max(now - rec.submitted_ts, 1e-6)
+        rate = len(rec.tokens) / ttft
+        prev = self._prefill_rate.get(rid)
+        self._prefill_rate[rid] = rate if prev is None else (
+            self._ewma_alpha * rate + (1.0 - self._ewma_alpha) * prev)
+
+    def _observe_completion(self, rid: int, rec: _RequestRecord,
+                            now: float) -> None:
+        """Feed the per-request service-time EWMA (first token -> full
+        budget, queue wait excluded: the ``depth x svc`` queue term of
+        predict_ttft models waiting separately, and folding a backlog
+        into svc would make a busy-but-fast replica look slower than a
+        genuinely slow one). Caller holds the lock."""
+        seen = self._svc_seen.get(rid, 0)
+        self._svc_seen[rid] = seen + 1
+        if seen == 0:
+            return  # compile-warming round (see __init__)
+        svc = max(now - (rec.first_emit_ts or rec.submitted_ts), 1e-6)
+        prev = self._svc_ewma.get(rid)
+        self._svc_ewma[rid] = svc if prev is None else (
+            self._ewma_alpha * svc + (1.0 - self._ewma_alpha) * prev)
+
     def _handoff(self, rec: _RequestRecord,
                  prefill_replica: ServingReplica) -> None:
-        """Move a prefill-complete request to a decode replica. Runs on
-        the prefill replica's pump thread, so serializing from its KV
-        pool is race-free; the install runs later on the decode
-        replica's own thread (Submission.handoff)."""
+        """Move a prefill-complete request to a decode replica. The
+        prefill replica serializes its own KV pool — on its pump thread
+        for local replicas, in its own process for remote ones — and
+        the completion callback submits to the decode target (local
+        replicas invoke it synchronously; remote ones when the payload
+        message arrives). The install then runs on the decode replica's
+        own thread (Submission.handoff)."""
         with self._lock:
             remaining = rec.max_new_tokens - len(rec.emitted)
             if remaining <= 0:
                 rec.done = True
                 self.stats["completed"] += 1
                 return
-            target = self._pick(self.decode_pool, rec.affinity_key)
+            target = self._pick(self.decode_pool, rec.affinity_key,
+                                len(rec.tokens))
             rec.phase = "decode"
             rec.replica_id = target.replica_id
             self.stats["handoffs"] += 1
             tokens = np.concatenate(
                 [rec.tokens, np.asarray(rec.emitted, np.int32)])
-        payload = serialize_prefix(prefill_replica.engine, rec.tokens)
-        if payload is None:
-            with self._lock:
-                self.stats["handoff_recompute"] += 1
-        target.submit(Submission(
-            uid=rec.uid, tokens=tokens, max_new_tokens=remaining,
-            handoff=payload,
-            span_notes=[("ROUTE", {"replica": target.replica_id,
-                                   "role": target.role,
-                                   "policy": "disagg_handoff"})]))
+
+        def _complete(payload) -> None:
+            if payload is None:
+                with self._lock:
+                    self.stats["handoff_recompute"] += 1
+            route = self._route_fields(target, "disagg_handoff")
+            target.submit(Submission(
+                uid=rec.uid, tokens=tokens, max_new_tokens=remaining,
+                handoff=payload, span_notes=[("ROUTE", route)]))
+
+        prefill_replica.serialize_handoff(rec.tokens, _complete)
 
     # -- failover ------------------------------------------------------
     def check_health(self, now: Optional[float] = None) -> List[int]:
@@ -304,7 +499,8 @@ class FleetRouter:
                     budget = 1 if rec.phase == "prefill" else remaining
                 else:
                     pool, budget = self.decode_pool, remaining
-                target = self._pick(pool, rec.affinity_key)
+                target = self._pick(pool, rec.affinity_key,
+                                    len(rec.tokens))
                 old = rec.replica_id
                 rec.replica_id = target.replica_id
                 rec.failovers += 1
@@ -313,17 +509,16 @@ class FleetRouter:
                     [rec.tokens, np.asarray(rec.emitted, np.int32)]) \
                     if rec.emitted else rec.tokens
                 plans.append((rec.uid, tokens, budget, old, target,
-                              len(rec.emitted)))
-        for uid, tokens, budget, old, target, recovered in plans:
+                              len(rec.emitted),
+                              self._route_fields(target, "failover")))
+        for uid, tokens, budget, old, target, recovered, route in plans:
             target.submit(Submission(
                 uid=uid, tokens=tokens, max_new_tokens=budget,
                 span_notes=[
                     ("FAILOVER", {"from_replica": old,
                                   "to_replica": target.replica_id,
                                   "recovered_tokens": recovered}),
-                    ("ROUTE", {"replica": target.replica_id,
-                               "role": target.role,
-                               "policy": "failover"})]))
+                    ("ROUTE", route)]))
             self._hub.counter_add("serve.fleet.failed_over_requests")
 
     # -- driving -------------------------------------------------------

@@ -1,0 +1,582 @@
+"""Process supervisor: real replica processes behind the same router.
+
+:class:`RemoteReplica` is the router-side stub for a replica living in
+another process — it satisfies the exact surface the in-process
+:class:`ServingReplica` exposes (``submit``/``load_report``/
+``load_score``/``alive``/``serialize_handoff``/``engine.tracer``), so
+:class:`FleetRouter` routes, hands off, and fails over without knowing
+which side of a socket each replica is on. What changes is *where*
+things run: emissions arrive on the supervisor's per-replica receive
+threads instead of pump threads, and the KV-serialize step of a
+disaggregated handoff becomes an async request/reply (the continuation
+passed to ``serialize_handoff`` fires when the payload message lands).
+
+:class:`ReplicaSupervisor` owns the process lifecycle:
+
+* **spawn** — write a worker spec, fork ``python -m
+  deepspeed_tpu.serving.proc_worker``, wait for the ready file, connect
+  (with backoff — the connect races worker startup), start the receive
+  thread, and hand the ``RemoteReplica`` to the router;
+* **restart** — a worker that exits without being asked to is a crash:
+  the stub is marked failed (so the router's next health check declares
+  it dead and resubmits its in-flight requests — the zero-drop failover
+  path, unchanged), and a replacement spawns under a *new* replica id;
+* **autoscale acts** — the PR 10 signal stops being metrics-only: when
+  ``desired`` exceeds the live count the supervisor spins up, when it
+  drops below it picks a victim, stops new admissions
+  (``router.remove_replica``), and sends ``drain`` — the worker
+  finishes its in-flight work and exits 0. Every act is recorded into
+  the autoscale decision history next to the desires that caused it.
+
+Every worker publishes its load report both over the channel (routing)
+and through ``ReplicaPublisher`` into ``<run_dir>/replicas/`` —
+:meth:`write_fleet_snapshot` merges channel-side state into
+``<run_dir>/fleet_snapshot.json`` for ``serve_top --fleet``.
+
+Clock note: predicted-TTFT routing and trace spans compare
+``time.time()`` across processes. Localhost fleets share one clock, so
+this is exact; a multi-host port would need send-time deltas instead.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from deepspeed_tpu.serving.replica import Submission
+from deepspeed_tpu.serving.transport import (ChannelError, FileChannel,
+                                             connect_with_backoff,
+                                             decode_handoff, encode_handoff)
+
+
+def _atomic_write_json(path: str, doc: Dict[str, Any]) -> None:
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        json.dump(doc, f, indent=1)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+
+
+class _KVConfigView:
+    def __init__(self, block_size: int):
+        self.block_size = int(block_size)
+
+
+class _KVAllocatorView:
+    def __init__(self, total_blocks: int):
+        self.total_blocks = int(total_blocks)
+
+
+class _KVCacheView:
+    """Just enough KV-cache geometry for the router's admission math
+    (``_check_fits``/``_affinity_key``) — numbers from the worker's
+    first report, never the blocks themselves."""
+
+    def __init__(self, block_size: int, total_blocks: int):
+        self.config = _KVConfigView(block_size)
+        self.allocator = _KVAllocatorView(total_blocks)
+
+    def blocks_needed(self, n_tokens: int) -> int:
+        bs = self.config.block_size
+        return (int(n_tokens) + bs - 1) // bs
+
+
+class RemoteEngineView:
+    """The router touches ``replica.engine`` for exactly two things:
+    KV geometry and the tracer. This view provides both — the tracer is
+    a real :class:`RequestTracer` fed from the worker's shipped trace
+    dicts, so fleet SLO attribution and Perfetto export work unchanged
+    across the process boundary."""
+
+    def __init__(self, block_size: int, total_blocks: int,
+                 max_blocks_per_seq: int):
+        from deepspeed_tpu.observability.request_trace import RequestTracer
+
+        self.kv_cache = _KVCacheView(block_size, total_blocks)
+        self.max_blocks_per_seq = int(max_blocks_per_seq)
+        self.tracer = RequestTracer(enabled=True, sample_rate=1.0)
+
+    def update_geometry(self, geo: Dict[str, Any]) -> None:
+        self.kv_cache.config.block_size = int(geo["block_size"])
+        self.kv_cache.allocator.total_blocks = int(geo["total_blocks"])
+        self.max_blocks_per_seq = int(geo["max_blocks_per_seq"])
+
+    def ingest_traces(self, docs: List[Dict[str, Any]]) -> None:
+        from deepspeed_tpu.observability.request_trace import RequestTrace
+
+        t = self.tracer
+        with t._lock:
+            for d in docs:
+                t._ring.append(RequestTrace.from_dict(d))
+                t.stats["finished"] += 1
+                t.stats["kept"] += 1
+
+
+def _empty_report(replica_id: int, role: str) -> Dict[str, Any]:
+    return {"replica": replica_id, "role": role, "ts": 0.0, "steps": 0,
+            "queue_wait_depth": 0, "live_seqs": 0, "inflight": 0,
+            "kv_free_blocks": 0, "kv_free_frac": 1.0,
+            "goodput_tokens_per_s": 0.0, "killed": False,
+            "kv_quant_bits": None, "handoff_wire": "auto",
+            "handoff_wire_bytes": 0, "handoff_logical_bytes": 0,
+            "kv_wire_snr_db": None}
+
+
+class RemoteReplica:
+    """Router-side stub for one worker process."""
+
+    def __init__(self, replica_id: int, role: str, channel,
+                 block_size: int, total_blocks: int,
+                 max_blocks_per_seq: int,
+                 handoff_timeout_s: float = 15.0):
+        self.replica_id = int(replica_id)
+        self.name = f"r{self.replica_id}"
+        self.role = role
+        self.channel = channel
+        self.engine = RemoteEngineView(block_size, total_blocks,
+                                       max_blocks_per_seq)
+        self.emit_callback: Optional[Callable] = None
+        self.killed = False
+        self.draining = False
+        self.exited = False  # worker announced a clean drain-exit
+        self._send_failed = False
+        self._report = _empty_report(self.replica_id, role)
+        self._report_ts = time.time()  # grace until the first heartbeat
+        self._sent_submits = 0  # vs the report's received_submits
+        self._lock = threading.Lock()
+        self._handoff_timeout_s = float(handoff_timeout_s)
+        self._handoff_cbs: Dict[int, Tuple[Callable, float]] = {}
+        self._next_req = 0
+
+    # -- the ServingReplica surface ------------------------------------
+    def alive(self, now: Optional[float] = None,
+              stale_after: float = 5.0) -> bool:
+        """Liveness = recent heartbeat over a working channel. A dead
+        worker stops reporting; a broken channel flips ``_send_failed``
+        immediately — either way the router's health check fails the
+        replica over without waiting on process state."""
+        if self._send_failed:
+            return False
+        now = time.time() if now is None else now
+        return (now - self._report_ts) < stale_after
+
+    def _unacked(self, r: Dict[str, Any]) -> int:
+        """Submissions on the wire the worker's report can't see yet.
+        Monotone counters on both sides (sent here, received in the
+        report) — a report generated *before* a submission landed
+        cannot erase the pending window the way a reset-on-report
+        scheme would. Caller holds the lock."""
+        return max(0, self._sent_submits
+                   - int(r.get("received_submits", 0)))
+
+    def load_report(self, now: Optional[float] = None) -> Dict[str, Any]:
+        """Last heartbeat report, with ``inflight`` bumped by the
+        unacked sends — the worker can't see them yet, but the router's
+        TTFT predictor must, or every submit inside one heartbeat
+        window reads the same stale depth and piles onto a single
+        worker."""
+        with self._lock:
+            r = dict(self._report)
+            r["inflight"] = int(r.get("inflight", 0)) + self._unacked(r)
+            return r
+
+    def load_score(self) -> float:
+        """Same cost shape as the local replica, plus the unacked
+        in-flight window."""
+        with self._lock:
+            r = self._report
+            return (r["queue_wait_depth"] + r["live_seqs"]
+                    + self._unacked(r) + (1.0 - r["kv_free_frac"]))
+
+    def submit(self, sub: Submission) -> None:
+        msg = {"type": "submit", "uid": int(sub.uid),
+               "tokens": np.asarray(sub.tokens, np.int32),
+               "max_new_tokens": int(sub.max_new_tokens),
+               "span_notes": [[k, dict(f)] for k, f in sub.span_notes],
+               "handoff": encode_handoff(sub.handoff)}
+        try:
+            self.channel.send(msg)
+        except ChannelError:
+            # the stale-heartbeat path will resubmit this request
+            # elsewhere; losing the send is exactly a replica crash
+            self._send_failed = True
+            return
+        with self._lock:
+            self._sent_submits += 1
+
+    def serialize_handoff(self, tokens: np.ndarray,
+                          cb: Callable[[Optional[Any]], None]) -> None:
+        """Async serialize RPC: the reply (``handoff_payload``) invokes
+        ``cb`` on the receive thread; a dead channel or an expired wait
+        degrades to ``cb(None)`` — the install side's recompute path."""
+        with self._lock:
+            req = self._next_req
+            self._next_req += 1
+            self._handoff_cbs[req] = (
+                cb, time.time() + self._handoff_timeout_s)
+        try:
+            self.channel.send({"type": "serialize", "req": req,
+                               "tokens": np.asarray(tokens, np.int32)})
+        except ChannelError:
+            self._send_failed = True
+            with self._lock:
+                self._handoff_cbs.pop(req, None)
+            cb(None)
+
+    def transport_bytes(self) -> Tuple[int, int]:
+        return (int(self.channel.bytes_sent),
+                int(self.channel.bytes_received))
+
+    def kill(self) -> None:
+        self.killed = True
+
+    def pump(self, eos_token_id=None) -> Dict[int, List[int]]:
+        return {}  # the worker pumps itself
+
+    def start(self, **kw) -> None:
+        pass
+
+    def stop(self) -> None:
+        pass
+
+    # -- receive path (supervisor rx thread) ---------------------------
+    def handle_message(self, msg: Dict[str, Any]) -> None:
+        kind = msg.get("type")
+        if kind == "emit":
+            with self._lock:
+                self._report = dict(msg.get("report") or self._report)
+                self._report_ts = time.time()
+            geo = msg.get("geometry")
+            if geo:
+                self.engine.update_geometry(geo)
+            traces = msg.get("traces")
+            if traces:
+                self.engine.ingest_traces(traces)
+            emitted = {int(u): [int(t) for t in toks]
+                       for u, toks in (msg.get("emitted") or {}).items()}
+            if emitted and self.emit_callback is not None:
+                self.emit_callback(self, emitted)
+        elif kind == "handoff_payload":
+            with self._lock:
+                entry = self._handoff_cbs.pop(int(msg["req"]), None)
+            if entry is not None:
+                entry[0](decode_handoff(msg.get("handoff")))
+        elif kind == "exiting":
+            self.exited = True
+
+    def expire_handoffs(self, now: Optional[float] = None) -> int:
+        """Time out serialize RPCs whose worker died mid-reply: each
+        orphaned continuation fires with None (recompute). Returns how
+        many expired."""
+        now = time.time() if now is None else now
+        expired = []
+        with self._lock:
+            for req, (cb, deadline) in list(self._handoff_cbs.items()):
+                if now >= deadline:
+                    expired.append(cb)
+                    del self._handoff_cbs[req]
+        for cb in expired:
+            cb(None)
+        return len(expired)
+
+
+class ReplicaSupervisor:
+    """Spawns, connects, restarts, and scales worker processes.
+
+    Construction fixes the fleet-wide spec (model, engine keywords,
+    channel kind, seed); :meth:`spawn` instantiates workers from it.
+    Attach the router after building it from the spawned stubs —
+    :meth:`maintain` needs it for add/remove and the autoscale signal.
+    """
+
+    def __init__(self, run_dir: str,
+                 model: Optional[Dict[str, Any]] = None,
+                 engine: Optional[Dict[str, Any]] = None,
+                 channel: str = "socket",
+                 seed: int = 0,
+                 eos_token_id: Optional[int] = None,
+                 heartbeat_s: float = 0.05,
+                 max_frame_mb: int = 64,
+                 connect_retries: int = 40,
+                 connect_backoff_s: float = 0.05,
+                 spawn_timeout_s: float = 60.0,
+                 default_role: str = "unified",
+                 jax_platform: str = "cpu",
+                 python: Optional[str] = None):
+        if channel not in ("socket", "file"):
+            raise ValueError(
+                f"channel must be socket|file, got {channel!r}")
+        self.run_dir = run_dir
+        self.model = dict(model or {"name": "tiny"})
+        self.engine = dict(engine or {})
+        self.channel_kind = channel
+        self.seed = int(seed)
+        self.eos_token_id = eos_token_id
+        self.heartbeat_s = float(heartbeat_s)
+        self.max_frame_mb = int(max_frame_mb)
+        self.connect_retries = int(connect_retries)
+        self.connect_backoff_s = float(connect_backoff_s)
+        self.spawn_timeout_s = float(spawn_timeout_s)
+        self.default_role = default_role
+        self.jax_platform = jax_platform
+        self.python = python or sys.executable
+        self.router = None  # attach after building FleetRouter
+        self.replicas: Dict[int, RemoteReplica] = {}
+        self._procs: Dict[int, subprocess.Popen] = {}
+        self._rx_threads: Dict[int, threading.Thread] = {}
+        self._rx_stop: Dict[int, threading.Event] = {}
+        self._next_id = 0
+        # (ts, action, replica_id) — spawn | restart | drain
+        self.actions: List[Tuple[float, str, int]] = []
+        for sub in ("specs", "ready", "logs", "spool", "replicas"):
+            os.makedirs(os.path.join(run_dir, sub), exist_ok=True)
+
+    # -- geometry defaults (valid before the first worker report) ------
+    def _engine_geometry(self) -> Tuple[int, int, int]:
+        block_size = int(self.engine.get("kv_block_size", 16))
+        total = int(self.engine.get("kv_blocks", 256))
+        max_per_seq = int(self.engine.get("max_blocks_per_seq",
+                                          total))
+        return block_size, total, max_per_seq
+
+    # -- spawn ---------------------------------------------------------
+    def spawn(self, role: Optional[str] = None,
+              replica_id: Optional[int] = None,
+              step_delay_ms: float = 0.0,
+              env_extra: Optional[Dict[str, str]] = None,
+              action: str = "spawn") -> RemoteReplica:
+        rid = self._next_id if replica_id is None else int(replica_id)
+        self._next_id = max(self._next_id, rid + 1)
+        role = role or self.default_role
+        spool = os.path.join(self.run_dir, "spool", f"replica_{rid}")
+        ready = os.path.join(self.run_dir, "ready",
+                             f"replica_{rid}.json")
+        if os.path.exists(ready):
+            os.unlink(ready)
+        spec = {
+            "replica_id": rid, "role": role, "run_dir": self.run_dir,
+            "ready_path": ready, "channel": self.channel_kind,
+            "spool_dir": spool, "max_frame_mb": self.max_frame_mb,
+            "model": self.model, "engine": self.engine,
+            "seed": self.seed, "eos_token_id": self.eos_token_id,
+            "step_delay_ms": float(step_delay_ms),
+            "heartbeat_s": self.heartbeat_s,
+            "jax_platform": self.jax_platform,
+        }
+        spec_path = os.path.join(self.run_dir, "specs",
+                                 f"replica_{rid}.json")
+        _atomic_write_json(spec_path, spec)
+        env = dict(os.environ)
+        env.update(env_extra or {})
+        log_path = os.path.join(self.run_dir, "logs",
+                                f"replica_{rid}.log")
+        log = open(log_path, "ab")
+        proc = subprocess.Popen(
+            [self.python, "-m", "deepspeed_tpu.serving.proc_worker",
+             spec_path],
+            stdout=log, stderr=subprocess.STDOUT, env=env)
+        log.close()
+        try:
+            chan = self._connect(proc, ready, spool)
+        except Exception:
+            proc.kill()
+            raise
+        bs, total, mps = self._engine_geometry()
+        remote = RemoteReplica(rid, role, chan, bs, total, mps)
+        self.replicas[rid] = remote
+        self._procs[rid] = proc
+        self._start_rx(remote)
+        self.actions.append((time.time(), action, rid))
+        return remote
+
+    def _connect(self, proc: subprocess.Popen, ready_path: str,
+                 spool: str):
+        deadline = time.time() + self.spawn_timeout_s
+        while not os.path.exists(ready_path):
+            if proc.poll() is not None:
+                raise ChannelError(
+                    f"worker exited with {proc.returncode} before "
+                    f"publishing its ready file (see logs/)")
+            if time.time() >= deadline:
+                raise ChannelError(
+                    f"worker not ready within {self.spawn_timeout_s}s")
+            time.sleep(0.01)
+        with open(ready_path) as f:
+            ready = json.load(f)
+        max_frame = self.max_frame_mb << 20
+        if ready.get("channel") == "socket":
+            return connect_with_backoff(
+                "127.0.0.1", int(ready["port"]),
+                retries=self.connect_retries,
+                backoff_s=self.connect_backoff_s,
+                max_frame_bytes=max_frame)
+        return FileChannel(spool, side="a", max_frame_bytes=max_frame)
+
+    def _start_rx(self, remote: RemoteReplica) -> None:
+        stop = threading.Event()
+
+        def _loop():
+            while not stop.is_set():
+                try:
+                    msg = remote.channel.recv(timeout=0.1)
+                except ChannelError:
+                    remote._send_failed = True
+                    return
+                if msg is not None:
+                    remote.handle_message(msg)
+
+        t = threading.Thread(target=_loop, daemon=True,
+                             name=f"rx-{remote.name}")
+        t.start()
+        self._rx_threads[remote.replica_id] = t
+        self._rx_stop[remote.replica_id] = stop
+
+    # -- lifecycle -----------------------------------------------------
+    def _live_ids(self) -> List[int]:
+        return [rid for rid, r in self.replicas.items()
+                if not r.draining and not r.exited
+                and self._procs[rid].poll() is None]
+
+    def maintain(self, now: Optional[float] = None) -> Dict[str, int]:
+        """One supervision round: restart crashes, act on the autoscale
+        signal, expire orphaned handoff RPCs, refresh the merged fleet
+        snapshot. Call it from the serving loop at health-check cadence.
+        Returns counts of the actions taken."""
+        now = time.time() if now is None else now
+        acted = {"restarted": 0, "spawned": 0, "drained": 0,
+                 "handoffs_expired": 0}
+        autoscale = getattr(self.router, "autoscale", None) \
+            if self.router is not None else None
+
+        for rid in list(self.replicas):
+            remote = self.replicas[rid]
+            proc = self._procs[rid]
+            if proc.poll() is None:
+                acted["handoffs_expired"] += remote.expire_handoffs(now)
+                continue
+            if remote.draining or remote.exited:
+                continue  # asked to leave; clean exit, nothing to heal
+            # crash: fail the stub now (fast failover), replace under a
+            # fresh id — the dead id stays dead, its in-flight work is
+            # the router's resubmit problem, not the new worker's
+            remote._send_failed = True
+            remote.draining = True
+            replacement = self.spawn(role=remote.role, action="restart")
+            if self.router is not None:
+                self.router.check_health(now)  # declares rid dead
+                self.router.add_replica(replacement)
+            if autoscale is not None:
+                autoscale.record_action("restart", replacement.replica_id,
+                                        now)
+            acted["restarted"] += 1
+
+        if autoscale is not None and autoscale.desired is not None:
+            live = self._live_ids()
+            if autoscale.desired > len(live):
+                replacement = self.spawn(action="spawn")
+                self.router.add_replica(replacement)
+                autoscale.record_action("spawn",
+                                        replacement.replica_id, now)
+                acted["spawned"] += 1
+            elif autoscale.desired < len(live) and len(live) > 1:
+                victim = self.replicas[max(live)]
+                self.drain(victim.replica_id)
+                autoscale.record_action("drain", victim.replica_id, now)
+                acted["drained"] += 1
+        self.write_fleet_snapshot()
+        return acted
+
+    def drain(self, replica_id: int) -> None:
+        """Graceful scale-down: no new admissions, worker finishes its
+        in-flight requests and exits 0."""
+        remote = self.replicas[replica_id]
+        remote.draining = True
+        if self.router is not None:
+            self.router.remove_replica(replica_id)
+        try:
+            remote.channel.send({"type": "drain"})
+        except ChannelError:
+            remote._send_failed = True
+        self.actions.append((time.time(), "drain", replica_id))
+
+    def kill(self, replica_id: int,
+             sig: int = signal.SIGKILL) -> None:
+        """Hard-kill a worker (chaos drills / tests)."""
+        proc = self._procs.get(replica_id)
+        if proc is not None and proc.poll() is None:
+            proc.send_signal(sig)
+
+    def run_until_drained(self, timeout_s: float = 120.0,
+                          poll_s: float = 0.02) -> None:
+        """Drive the attached router to completion with supervision:
+        the process-fleet analog of ``FleetRouter.drain``."""
+        deadline = time.time() + timeout_s
+        while time.time() < deadline:
+            self.maintain()
+            self.router.check_health()
+            if self.router.pending() == 0:
+                return
+            time.sleep(poll_s)
+        raise TimeoutError(
+            f"process fleet did not drain in {timeout_s}s "
+            f"({self.router.pending()} requests pending)")
+
+    def shutdown(self, timeout_s: float = 10.0) -> None:
+        """SIGTERM everyone, wait, SIGKILL stragglers, stop rx threads."""
+        for rid, proc in self._procs.items():
+            if proc.poll() is None:
+                proc.terminate()
+        deadline = time.time() + timeout_s
+        for proc in self._procs.values():
+            left = max(deadline - time.time(), 0.1)
+            try:
+                proc.wait(timeout=left)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+                proc.wait(timeout=5.0)
+        for stop in self._rx_stop.values():
+            stop.set()
+        for t in self._rx_threads.values():
+            t.join(timeout=2.0)
+        for r in self.replicas.values():
+            try:
+                r.channel.close()
+            except Exception:
+                pass
+
+    # -- fleet snapshot (serve_top --fleet) ----------------------------
+    def write_fleet_snapshot(self) -> str:
+        """Merge channel-side fleet state into one document the
+        cross-process ``serve_top --fleet`` can read without importing
+        jax or joining any socket."""
+        path = os.path.join(self.run_dir, "fleet_snapshot.json")
+        if self.router is not None:
+            snap = self.router.fleet_snapshot()
+        else:
+            snap = {"schema": "serving_fleet/v1", "ts": time.time(),
+                    "replicas": [r.load_report()
+                                 for r in self.replicas.values()]}
+        snap["supervisor"] = {
+            "actions": [{"ts": ts, "action": act, "replica": rid}
+                        for ts, act, rid in self.actions[-64:]],
+            "procs": {str(rid): {
+                "pid": p.pid,
+                "running": p.poll() is None,
+                "returncode": p.poll(),
+            } for rid, p in self._procs.items()},
+            "transport": {str(rid): {
+                "tx_bytes": r.channel.bytes_sent,
+                "rx_bytes": r.channel.bytes_received,
+            } for rid, r in self.replicas.items()},
+        }
+        _atomic_write_json(path, snap)
+        return path

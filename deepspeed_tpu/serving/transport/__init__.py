@@ -1,0 +1,42 @@
+"""Cross-process serving transport: framed messages over sockets.
+
+The fleet's wire layer, bottom-up:
+
+* :mod:`framing` — length-prefixed, CRC-checked binary frames with a
+  stateful reader that survives torn TCP reads and fails loud on
+  corruption (``FrameError``);
+* :mod:`messages` — the message codec: one JSON header for structure
+  plus raw concatenated ndarray bytes for bulk payloads, so a quantized
+  ``KVHandoff`` crosses the wire byte-identically with no base64 tax;
+* :mod:`channel` — the two channel implementations behind one API:
+  ``SocketChannel`` (localhost TCP, the primary) and ``FileChannel``
+  (spool-dir frames via atomic renames — the ``ReplicaPublisher``-style
+  degraded fallback when sockets are unavailable), both counting the
+  bytes they actually put on the wire.
+
+The process runtime on top lives in ``serving/proc_worker.py`` (the
+subprocess replica entrypoint) and ``serving/supervisor.py``
+(``ReplicaSupervisor`` + ``RemoteReplica``). docs/serving.md
+"Cross-process fleet" has the topology diagram and degraded-mode
+matrix.
+"""
+
+from deepspeed_tpu.serving.transport.channel import (ChannelError,
+                                                     FileChannel,
+                                                     SocketChannel,
+                                                     SocketServer,
+                                                     connect_with_backoff)
+from deepspeed_tpu.serving.transport.framing import (DEFAULT_MAX_FRAME_BYTES,
+                                                     FrameError, FrameReader,
+                                                     encode_frame)
+from deepspeed_tpu.serving.transport.messages import (decode_handoff,
+                                                      decode_message,
+                                                      encode_handoff,
+                                                      encode_message)
+
+__all__ = [
+    "ChannelError", "DEFAULT_MAX_FRAME_BYTES", "FileChannel", "FrameError",
+    "FrameReader", "SocketChannel", "SocketServer", "connect_with_backoff",
+    "decode_handoff", "decode_message", "encode_frame", "encode_handoff",
+    "encode_message",
+]

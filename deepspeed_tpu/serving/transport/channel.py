@@ -1,0 +1,255 @@
+"""Fleet channels: one send/recv API over TCP sockets or a spool dir.
+
+Both implementations move :mod:`messages`-encoded dicts inside
+:mod:`framing` frames and count the bytes they actually put on the
+wire (``bytes_sent``/``bytes_received`` include the frame header — the
+real transport cost, which is what ``quant.kv_wire`` accounting wants).
+
+* :class:`SocketChannel` — localhost TCP, the primary channel. ``recv``
+  is poll-style (returns None on timeout); ``send`` is locked so the
+  router and a handoff completion can share one channel.
+  :func:`connect_with_backoff` retries a refused/dropped connection on
+  an exponential schedule — worker spin-up and supervisor restart both
+  race the connect.
+* :class:`FileChannel` — the degraded fallback when sockets are
+  unavailable (restricted container, no loopback): the same frames as
+  numbered files in a spool directory, written atomically (tmp +
+  rename, the ``observability/fleet.py`` discipline) so a reader never
+  sees a torn frame. Ordering comes from the sequence number in the
+  file name. Strictly slower than TCP — the degraded-mode matrix in
+  docs/serving.md says when each channel is the right one.
+
+A :class:`ChannelError` means the peer is gone or the stream is corrupt
+(framing errors surface here too): callers drop the channel and either
+reconnect with backoff or let the stale heartbeat drive failover.
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import threading
+import time
+from collections import deque
+from typing import Any, Dict, Optional
+
+from deepspeed_tpu.serving.transport.framing import (DEFAULT_MAX_FRAME_BYTES,
+                                                     FrameError, FrameReader,
+                                                     encode_frame)
+from deepspeed_tpu.serving.transport.messages import (decode_message,
+                                                      encode_message)
+
+_RECV_CHUNK = 1 << 16
+
+
+class ChannelError(RuntimeError):
+    """Peer gone or stream corrupt — drop the channel."""
+
+
+class SocketChannel:
+    def __init__(self, sock: socket.socket,
+                 max_frame_bytes: int = DEFAULT_MAX_FRAME_BYTES):
+        self._sock = sock
+        self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self._reader = FrameReader(max_frame_bytes)
+        self._inbox: deque = deque()
+        self._send_lock = threading.Lock()
+        self.max_frame_bytes = int(max_frame_bytes)
+        self.bytes_sent = 0
+        self.bytes_received = 0
+        self.closed = False
+
+    def send(self, msg: Dict[str, Any]) -> int:
+        """Frame + write one message; returns the bytes put on the
+        wire. Raises ChannelError when the peer is gone."""
+        frame = encode_frame(encode_message(msg), self.max_frame_bytes)
+        with self._send_lock:
+            if self.closed:
+                raise ChannelError("channel closed")
+            try:
+                self._sock.sendall(frame)
+            except OSError as e:
+                self.close()
+                raise ChannelError(f"send failed: {e}") from e
+            self.bytes_sent += len(frame)
+        return len(frame)
+
+    def recv(self, timeout: Optional[float] = 0.0
+             ) -> Optional[Dict[str, Any]]:
+        """Next message, or None when nothing arrives within
+        ``timeout``. Raises ChannelError on peer close / corruption."""
+        if self._inbox:
+            return self._inbox.popleft()
+        if self.closed:
+            raise ChannelError("channel closed")
+        deadline = None if timeout is None else time.time() + timeout
+        while not self._inbox:
+            self._sock.settimeout(
+                None if deadline is None
+                else max(deadline - time.time(), 1e-4))
+            try:
+                chunk = self._sock.recv(_RECV_CHUNK)
+            except socket.timeout:
+                return None
+            except OSError as e:
+                self.close()
+                raise ChannelError(f"recv failed: {e}") from e
+            if not chunk:
+                self.close()
+                raise ChannelError("peer closed the connection")
+            self.bytes_received += len(chunk)
+            try:
+                for payload in self._reader.feed(chunk):
+                    self._inbox.append(decode_message(payload))
+            except FrameError as e:
+                self.close()
+                raise ChannelError(str(e)) from e
+            if not self._inbox and deadline is not None \
+                    and time.time() >= deadline:
+                return None
+        return self._inbox.popleft()
+
+    def close(self) -> None:
+        self.closed = True
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+
+class SocketServer:
+    """Listening side: bind 127.0.0.1:0 (or a given port), publish
+    ``.port``, accept one peer at a time."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 max_frame_bytes: int = DEFAULT_MAX_FRAME_BYTES):
+        self._srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._srv.bind((host, port))
+        self._srv.listen(8)
+        self.host, self.port = self._srv.getsockname()
+        self.max_frame_bytes = int(max_frame_bytes)
+
+    def accept(self, timeout: Optional[float] = None) -> SocketChannel:
+        self._srv.settimeout(timeout)
+        try:
+            sock, _ = self._srv.accept()
+        except socket.timeout as e:
+            raise ChannelError(
+                f"no peer connected within {timeout}s") from e
+        return SocketChannel(sock, self.max_frame_bytes)
+
+    def close(self) -> None:
+        try:
+            self._srv.close()
+        except OSError:
+            pass
+
+
+def connect_with_backoff(host: str, port: int, retries: int = 20,
+                         backoff_s: float = 0.05,
+                         backoff_max_s: float = 1.0,
+                         max_frame_bytes: int = DEFAULT_MAX_FRAME_BYTES
+                         ) -> SocketChannel:
+    """Dial the peer, retrying refused/reset connects on an exponential
+    schedule (worker startup and supervisor restart both race this).
+    Raises ChannelError once the budget is spent."""
+    delay = float(backoff_s)
+    last: Optional[Exception] = None
+    for _ in range(max(1, int(retries))):
+        try:
+            sock = socket.create_connection((host, port), timeout=5.0)
+            return SocketChannel(sock, max_frame_bytes)
+        except OSError as e:
+            last = e
+            time.sleep(delay)
+            delay = min(delay * 2.0, float(backoff_max_s))
+    raise ChannelError(
+        f"could not connect to {host}:{port} after {retries} attempts: "
+        f"{last}")
+
+
+class FileChannel:
+    """Spool-dir frames: the socketless degraded fallback.
+
+    One spool directory holds two one-way lanes (``a2b``/``b2a``); each
+    endpoint sends into its outbound lane and polls the other. A
+    message is one frame in one file named by a monotonically
+    increasing sequence number, written tmp+rename so readers only ever
+    see complete files; the reader consumes in sequence order and
+    unlinks. CRC validation still applies — a corrupt spool file raises
+    ChannelError exactly like a corrupt socket stream."""
+
+    def __init__(self, spool_dir: str, side: str,
+                 max_frame_bytes: int = DEFAULT_MAX_FRAME_BYTES):
+        if side not in ("a", "b"):
+            raise ValueError(f"side must be 'a' or 'b', got {side!r}")
+        self.spool_dir = spool_dir
+        self._tx = os.path.join(spool_dir,
+                                "a2b" if side == "a" else "b2a")
+        self._rx = os.path.join(spool_dir,
+                                "b2a" if side == "a" else "a2b")
+        os.makedirs(self._tx, exist_ok=True)
+        os.makedirs(self._rx, exist_ok=True)
+        self.max_frame_bytes = int(max_frame_bytes)
+        self._seq = 0
+        self._lock = threading.Lock()
+        self.bytes_sent = 0
+        self.bytes_received = 0
+        self.closed = False
+
+    def send(self, msg: Dict[str, Any]) -> int:
+        frame = encode_frame(encode_message(msg), self.max_frame_bytes)
+        with self._lock:
+            if self.closed:
+                raise ChannelError("channel closed")
+            path = os.path.join(self._tx, f"{self._seq:012d}.frame")
+            self._seq += 1
+        tmp = path + f".tmp.{os.getpid()}"
+        with open(tmp, "wb") as f:
+            f.write(frame)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+        with self._lock:
+            self.bytes_sent += len(frame)
+        return len(frame)
+
+    def _next_file(self) -> Optional[str]:
+        try:
+            names = [n for n in os.listdir(self._rx)
+                     if n.endswith(".frame")]
+        except FileNotFoundError as e:
+            raise ChannelError(f"spool dir vanished: {e}") from e
+        return os.path.join(self._rx, min(names)) if names else None
+
+    def recv(self, timeout: Optional[float] = 0.0,
+             poll_s: float = 0.005) -> Optional[Dict[str, Any]]:
+        deadline = None if timeout is None else time.time() + timeout
+        while True:
+            if self.closed:
+                raise ChannelError("channel closed")
+            path = self._next_file()
+            if path is not None:
+                with open(path, "rb") as f:
+                    frame = f.read()
+                os.unlink(path)
+                self.bytes_received += len(frame)
+                reader = FrameReader(self.max_frame_bytes)
+                try:
+                    payloads = reader.feed(frame)
+                except FrameError as e:
+                    raise ChannelError(str(e)) from e
+                if len(payloads) != 1 or reader.pending_bytes:
+                    raise ChannelError(
+                        f"spool file {os.path.basename(path)} held "
+                        f"{len(payloads)} frames + "
+                        f"{reader.pending_bytes} stray bytes "
+                        "(expected exactly one)")
+                return decode_message(payloads[0])
+            if deadline is not None and time.time() >= deadline:
+                return None
+            time.sleep(poll_s)
+
+    def close(self) -> None:
+        self.closed = True

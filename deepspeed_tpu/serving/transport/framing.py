@@ -1,0 +1,87 @@
+"""Length-prefixed, CRC-checked frames for the fleet socket transport.
+
+A frame is ``MAGIC(4) | payload_len(u32 BE) | crc32(u32 BE) | payload``.
+The CRC covers the payload only; the length field is bounded by
+``max_frame_bytes`` *before* any buffering so a corrupted length cannot
+make the reader allocate gigabytes. TCP gives a byte stream, not
+messages — :class:`FrameReader` is the stateful reassembler that turns
+arbitrary read chunks (including frames torn across reads) back into
+complete payloads, and raises :class:`FrameError` the moment the stream
+desynchronizes (bad magic, oversized length, CRC mismatch). A framing
+error is never recoverable in-stream: the caller must drop the
+connection and reconnect, which is exactly what the channel layer's
+backoff path does.
+"""
+
+from __future__ import annotations
+
+import struct
+import zlib
+from typing import List
+
+MAGIC = b"DSTF"
+_HEADER = struct.Struct(">4sII")  # magic, payload length, crc32(payload)
+HEADER_BYTES = _HEADER.size
+
+# Big enough for a real-shape KV handoff (layers x blocks x block x
+# 2 x heads x head_dim at int8), small enough that a corrupted length
+# field cannot balloon the reassembly buffer.
+DEFAULT_MAX_FRAME_BYTES = 256 << 20
+
+
+class FrameError(RuntimeError):
+    """Stream desynchronized: bad magic, oversized frame, or CRC
+    mismatch. The connection is unusable past this point."""
+
+
+def encode_frame(payload: bytes,
+                 max_frame_bytes: int = DEFAULT_MAX_FRAME_BYTES) -> bytes:
+    if len(payload) > max_frame_bytes:
+        raise FrameError(
+            f"frame payload of {len(payload)} bytes exceeds the "
+            f"{max_frame_bytes}-byte frame limit")
+    return _HEADER.pack(MAGIC, len(payload),
+                        zlib.crc32(payload) & 0xFFFFFFFF) + payload
+
+
+class FrameReader:
+    """Stateful frame reassembler over an arbitrary chunk stream.
+
+    ``feed(chunk)`` returns every payload completed by that chunk (zero
+    or more); partial frames stay buffered for the next feed. All
+    validation happens here — magic and length as soon as a header is
+    complete, CRC once the payload is."""
+
+    def __init__(self,
+                 max_frame_bytes: int = DEFAULT_MAX_FRAME_BYTES):
+        self.max_frame_bytes = int(max_frame_bytes)
+        self._buf = bytearray()
+
+    @property
+    def pending_bytes(self) -> int:
+        return len(self._buf)
+
+    def feed(self, chunk: bytes) -> List[bytes]:
+        self._buf.extend(chunk)
+        out: List[bytes] = []
+        while True:
+            if len(self._buf) < HEADER_BYTES:
+                return out
+            magic, length, crc = _HEADER.unpack_from(self._buf)
+            if magic != MAGIC:
+                raise FrameError(
+                    f"bad frame magic {bytes(magic)!r} (expected "
+                    f"{MAGIC!r}) — stream desynchronized")
+            if length > self.max_frame_bytes:
+                raise FrameError(
+                    f"frame of {length} bytes exceeds the "
+                    f"{self.max_frame_bytes}-byte limit — corrupt "
+                    "length or oversized message")
+            if len(self._buf) < HEADER_BYTES + length:
+                return out
+            payload = bytes(self._buf[HEADER_BYTES:HEADER_BYTES + length])
+            if (zlib.crc32(payload) & 0xFFFFFFFF) != crc:
+                raise FrameError(
+                    f"frame CRC mismatch over {length} payload bytes")
+            del self._buf[:HEADER_BYTES + length]
+            out.append(payload)

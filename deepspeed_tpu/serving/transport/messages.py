@@ -1,0 +1,149 @@
+"""Fleet message codec: JSON structure + raw ndarray bytes.
+
+Every message is a plain dict (``{"type": ..., ...}``) whose ndarray
+values — prompt tokens, KV block payloads, quantization scales — are
+lifted out into a binary section so the wire cost of a quantized
+handoff is its actual byte size, not a base64-inflated JSON string.
+Payload layout::
+
+    u32 header_len | JSON header | array 0 bytes | array 1 bytes | ...
+
+In the JSON header each lifted array is replaced by
+``{"__nd__": i, "dtype": ..., "shape": [...]}``; decode walks the same
+structure and rebuilds each array with ``np.frombuffer`` — bit-exact
+round-trips by construction, including bfloat16 (via ml_dtypes) and
+the int4-packed handoff payloads.
+
+``encode_handoff``/``decode_handoff`` map :class:`serving.disagg.
+KVHandoff` onto that dict form field-for-field, so the PR 12 wire codec
+serializes as-is: the bytes a quantized handoff puts on the socket ARE
+``wire_nbytes`` plus the fixed header overhead.
+"""
+
+from __future__ import annotations
+
+import json
+import struct
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+_LEN = struct.Struct(">I")
+
+
+def _np_dtype(name: str) -> np.dtype:
+    try:
+        return np.dtype(name)
+    except TypeError:
+        # bfloat16 & friends register through ml_dtypes (a jax dep)
+        import ml_dtypes
+
+        return np.dtype(getattr(ml_dtypes, name))
+
+
+def encode_message(msg: Dict[str, Any]) -> bytes:
+    arrays: List[np.ndarray] = []
+
+    def lift(obj):
+        if isinstance(obj, np.ndarray):
+            arr = np.ascontiguousarray(obj)
+            arrays.append(arr)
+            return {"__nd__": len(arrays) - 1,
+                    "dtype": str(arr.dtype), "shape": list(arr.shape)}
+        if isinstance(obj, dict):
+            return {str(k): lift(v) for k, v in obj.items()}
+        if isinstance(obj, (list, tuple)):
+            return [lift(v) for v in obj]
+        if isinstance(obj, (np.integer,)):
+            return int(obj)
+        if isinstance(obj, (np.floating,)):
+            return float(obj)
+        if isinstance(obj, (np.bool_,)):
+            return bool(obj)
+        return obj
+
+    header = json.dumps(lift(msg)).encode("utf-8")
+    parts = [_LEN.pack(len(header)), header]
+    parts.extend(arr.tobytes() for arr in arrays)
+    return b"".join(parts)
+
+
+def decode_message(payload: bytes) -> Dict[str, Any]:
+    (hlen,) = _LEN.unpack_from(payload)
+    doc = json.loads(payload[_LEN.size:_LEN.size + hlen].decode("utf-8"))
+
+    # first pass: placeholder metadata in __nd__ order fixes each
+    # array's offset into the binary section
+    placeholders: Dict[int, Tuple[np.dtype, tuple]] = {}
+
+    def scan(obj):
+        if isinstance(obj, dict):
+            if "__nd__" in obj and set(obj) == {"__nd__", "dtype", "shape"}:
+                placeholders[int(obj["__nd__"])] = (
+                    _np_dtype(obj["dtype"]), tuple(obj["shape"]))
+                return
+            for v in obj.values():
+                scan(v)
+        elif isinstance(obj, list):
+            for v in obj:
+                scan(v)
+
+    scan(doc)
+    offsets: Dict[int, int] = {}
+    off = _LEN.size + hlen
+    for i in sorted(placeholders):
+        dt, shape = placeholders[i]
+        offsets[i] = off
+        off += dt.itemsize * int(np.prod(shape, dtype=np.int64))
+    if off > len(payload):
+        raise ValueError(
+            f"message binary section truncated: arrays need {off} bytes, "
+            f"payload has {len(payload)}")
+
+    def rebuild(obj):
+        if isinstance(obj, dict):
+            if "__nd__" in obj and set(obj) == {"__nd__", "dtype", "shape"}:
+                i = int(obj["__nd__"])
+                dt, shape = placeholders[i]
+                n = int(np.prod(shape, dtype=np.int64))
+                return np.frombuffer(payload, dtype=dt, count=n,
+                                     offset=offsets[i]).reshape(shape).copy()
+            return {k: rebuild(v) for k, v in obj.items()}
+        if isinstance(obj, list):
+            return [rebuild(v) for v in obj]
+        return obj
+
+    return rebuild(doc)
+
+
+# -- KVHandoff mapping ---------------------------------------------------
+
+
+def encode_handoff(handoff) -> Optional[Dict[str, Any]]:
+    """KVHandoff -> message-dict form (None passes through: a
+    tokens-only handoff that degraded to recompute)."""
+    if handoff is None:
+        return None
+    return {
+        "keys": list(handoff.keys),
+        "block_data": handoff.block_data,
+        "block_size": int(handoff.block_size),
+        "scales": handoff.scales,
+        "wire_bits": handoff.wire_bits,
+        "packed": bool(handoff.packed),
+        "src_quant_bits": handoff.src_quant_bits,
+        "wire_snr_db": handoff.wire_snr_db,
+    }
+
+
+def decode_handoff(doc: Optional[Dict[str, Any]]):
+    if doc is None:
+        return None
+    from deepspeed_tpu.serving.disagg import KVHandoff
+
+    return KVHandoff(
+        keys=list(doc["keys"]), block_data=doc["block_data"],
+        block_size=int(doc["block_size"]), scales=doc.get("scales"),
+        wire_bits=doc.get("wire_bits"), packed=bool(doc.get("packed")),
+        src_quant_bits=doc.get("src_quant_bits"),
+        wire_snr_db=doc.get("wire_snr_db"))

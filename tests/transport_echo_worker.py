@@ -1,0 +1,40 @@
+"""Echo worker for the transport subprocess tests — deliberately
+jax-free so its startup is milliseconds, keeping the two-subprocess
+echo test in the smoke tier.
+
+Usage: ``python tests/transport_echo_worker.py <port>``. Connects to
+the test's listening socket, then echoes every message back with
+``type`` rewritten to ``"echo"`` and an ``"echoed_by"`` pid stamp —
+each ndarray is decoded from the wire and re-encoded, so a byte-equal
+reply proves the codec round-trips bit-exactly across a real process
+boundary (the KVHandoff payload's int8 blocks and fp16 scales
+included). Exits on a ``{"type": "quit"}`` message or peer close.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from deepspeed_tpu.serving.transport import (ChannelError,  # noqa: E402
+                                             connect_with_backoff)
+
+
+def main() -> int:
+    port = int(sys.argv[1])
+    chan = connect_with_backoff("127.0.0.1", port)
+    while True:
+        try:
+            msg = chan.recv(timeout=10.0)
+        except ChannelError:
+            return 0
+        if msg is None or msg.get("type") == "quit":
+            return 0
+        msg["type"] = "echo"
+        msg["echoed_by"] = os.getpid()
+        chan.send(msg)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

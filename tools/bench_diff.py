@@ -64,6 +64,13 @@ DEFAULT_THRESHOLDS: Dict[str, Tuple[str, float]] = {
     "handoff_wire_frac": ("max_ratio", 1.1),
     "handoff_wire_snr_db": ("max_drop", 3.0),
     "sessions_capacity": ("min_ratio", 0.85),
+    # cross-process fleet (BENCH_MODE=serve_procs): the int4 KV wire
+    # must stay compressed round-over-round, and the chaos arm's tail
+    # latency under a mid-run SIGKILL gets a loose leash — p99.9 of a
+    # small open-loop run is one request's failover, so only a >1.5x
+    # blowup (a broken failover path, not scheduling noise) fails
+    "kv_wire_ratio": ("max_ratio", 1.15),
+    "ttft_p999_ms": ("max_ratio", 1.5),
 }
 
 # units where a larger headline value is worse
@@ -168,6 +175,15 @@ def diff_reports(old: Dict[str, Any], new: Dict[str, Any],
             drop = ov - nv
             check("handoff_wire_snr_db", rule, limit, ov, nv, drop,
                   drop <= limit)
+        # cross-process fleet sentinels (serve_procs payloads): KV wire
+        # compression and the chaos arm's p99.9 failover tail
+        for key in ("kv_wire_ratio", "ttft_p999_ms"):
+            ov, nv = old.get(key), new.get(key)
+            if isinstance(ov, (int, float)) and \
+                    isinstance(nv, (int, float)) and ov > 0:
+                rule, limit = th[key]
+                ratio = nv / ov
+                check(key, rule, limit, ov, nv, ratio, ratio <= limit)
         for arm in ("bf16", "int8"):
             o_arm = old.get(arm) if isinstance(old.get(arm), dict) else {}
             n_arm = new.get(arm) if isinstance(new.get(arm), dict) else {}

@@ -786,11 +786,435 @@ def run_quant() -> dict:
     }
 
 
+def _nhpp_arrivals(n, rate, period_s, burst_factor, burst_frac, rng):
+    """Nonhomogeneous Poisson arrivals by thinning: a diurnal sinusoid
+    (the day/night cycle compressed to ``period_s``) with a burst window
+    at ``burst_factor``x the base rate in the first ``burst_frac`` of
+    each period — the two arrival shapes a router's tail latency has to
+    survive (slow swell and sudden spike)."""
+    import math
+
+    import numpy as np
+
+    lam_max = rate * (1.5 + burst_factor)
+    out = []
+    t = 0.0
+    while len(out) < n:
+        t += rng.exponential(1.0 / lam_max)
+        diurnal = 1.0 + 0.5 * math.sin(2.0 * math.pi * t / period_s)
+        in_burst = (t % period_s) / period_s < burst_frac
+        lam = rate * diurnal * (burst_factor if in_burst else 1.0)
+        if rng.random() < lam / lam_max:
+            out.append(t)
+    return np.asarray(out)
+
+
+def _percentiles_ms(ttfts):
+    import numpy as np
+
+    if not len(ttfts):
+        return {"ttft_p50_ms": None, "ttft_p99_ms": None,
+                "ttft_p999_ms": None}
+    a = np.asarray(sorted(ttfts), np.float64) * 1e3
+    return {"ttft_p50_ms": round(float(np.percentile(a, 50)), 2),
+            "ttft_p99_ms": round(float(np.percentile(a, 99)), 2),
+            "ttft_p999_ms": round(float(np.percentile(a, 99.9)), 2)}
+
+
+def _drive_procs_arm(arm, base_dir, model_spec, engine_spec, prompts,
+                     arrivals, gen, deadline_s, knobs):
+    """One process-fleet arm over the SAME workload and schedule.
+
+    ``least_loaded`` / ``predictive``: N unified workers, the last one
+    degraded by ``slow_step_ms`` of per-round delay — the A/B that
+    predictive routing must win on TTFT p99. ``chaos``: healthy workers
+    plus a ``DSTPU_CHAOS`` self-kill on one of them mid-run (the
+    training-side kill_rank spec, reused verbatim) and a scripted
+    autoscale swing — measures p99.9 TTFT and zero drops through
+    SIGKILL + restart + scale-up/drain. ``disagg``: prefill->decode over
+    the socket with the int4 wire codec.
+    """
+    import threading
+
+    import numpy as np
+
+    from deepspeed_tpu.serving import (AutoscaleSignal, FleetRouter,
+                                       ReplicaSupervisor)
+
+    run_dir = os.path.join(base_dir, arm)
+    engine = dict(engine_spec)
+    if arm == "disagg":
+        engine["handoff_wire"] = knobs["wire"]
+    sup = ReplicaSupervisor(run_dir, model=model_spec, engine=engine,
+                            seed=knobs["seed"])
+    n_rep = knobs["replicas"]
+    chaos_victim = None
+    if arm == "disagg":
+        remotes = [sup.spawn(role="prefill")]
+        remotes += [sup.spawn(role="decode")
+                    for _ in range(max(1, n_rep - 1))]
+    elif arm == "chaos":
+        remotes = [sup.spawn(role="unified")]
+        # the victim self-kills via the training-side chaos spec after
+        # kill_step busy serve rounds — no test scaffolding, the worker
+        # dies exactly the way a chaos drill kills a training rank
+        chaos_victim = sup.spawn(role="unified", env_extra={
+            "DSTPU_CHAOS": (f"kill_rank=1,kill_step={knobs['kill_step']},"
+                            f"kill_signal=SIGKILL")})
+        remotes.append(chaos_victim)
+        remotes += [sup.spawn(role="unified")
+                    for _ in range(max(0, n_rep - 2))]
+    else:
+        remotes = [sup.spawn(role="unified")
+                   for _ in range(max(1, n_rep - 1))]
+        remotes.append(sup.spawn(role="unified",
+                                 step_delay_ms=knobs["slow_step_ms"]))
+    # chaos arm only: a signal whose organic thresholds can never fire
+    # (queue_low < 0, queue_high huge), so the victim is not drained
+    # out from under the chaos kill — the scripted desired swing and
+    # the restart act are what land in its decision history
+    autoscale = AutoscaleSignal(
+        min_replicas=n_rep, max_replicas=n_rep + 2,
+        queue_low=-1.0, queue_high=1e9) if arm == "chaos" else None
+    router = FleetRouter(
+        remotes, stale_after_s=knobs["stale_after_s"],
+        affinity_blocks=0,
+        routing="predictive" if arm in ("predictive", "chaos") else
+        "least_loaded", autoscale=autoscale)
+    sup.router = router
+
+    n = len(prompts)
+    first_tok = {}
+    tlock = threading.Lock()
+    t0_box = [None]
+
+    def _wrap_new():
+        for r in router.replicas.values():
+            if getattr(r, "_bench_wrapped", False):
+                continue
+            orig_cb = r.emit_callback
+
+            def cb(replica, emitted, _orig=orig_cb):
+                if t0_box[0] is not None:
+                    tnow = time.perf_counter() - t0_box[0]
+                    with tlock:
+                        for uid in emitted:
+                            if uid not in first_tok:
+                                first_tok[uid] = tnow
+                _orig(replica, emitted)
+
+            r.emit_callback = cb
+            r._bench_wrapped = True
+
+    _wrap_new()
+    # compile warm-up OUTSIDE the timed window (run_slo's warm-pass
+    # idiom): one request per worker. Routed THROUGH the router — cold
+    # predictions tie, so load-score round-robins the warmups across
+    # the workers — which doubles as a canary probe: by the time the
+    # clock starts, the predictor has a measured service EWMA and
+    # prefill rate for every replica instead of a cold-start guess
+    # (a cold replica with no observed prefill rate predicts
+    # optimistically and would swallow a whole burst). The chaos arm
+    # warms via the stubs instead and skips the victim: its busy-round
+    # budget belongs to the mid-run kill, and the predictor's cold
+    # optimism toward the unprobed victim is exactly what feeds it
+    # work before the kill fires.
+    from deepspeed_tpu.serving.replica import Submission
+    if arm == "chaos":
+        warm = [r for r in remotes if r is not chaos_victim]
+        for j, r in enumerate(warm):
+            r.submit(Submission(uid=1_000_000 + j, tokens=prompts[0],
+                                max_new_tokens=gen))
+
+        def _warm_done():
+            return all(r.load_report().get("inflight", 0) == 0
+                       for r in warm)
+    else:
+        # TWO sequential rounds: round 1 pays the one-time JIT compile
+        # (the router discards each signal's first per-replica sample
+        # as exactly that), round 2 measures steady-state — its rates
+        # are the first samples the EWMAs keep. Within a round the cold
+        # predictions tie at zero, so the load-score tiebreak spreads
+        # the probes one per replica.
+        for wround in range(2):
+            for j in range(len(remotes)):
+                router.submit(1_000_000 + wround * len(remotes) + j,
+                              prompts[0], max_new_tokens=gen)
+            round_deadline = time.time() + 120.0
+            while time.time() < round_deadline and router.pending() > 0:
+                sup.maintain()
+                router.check_health()
+                time.sleep(0.05)
+
+        def _warm_done():
+            return router.pending() == 0
+
+    warm_deadline = time.time() + 120.0
+    while time.time() < warm_deadline and not _warm_done():
+        sup.maintain()
+        router.check_health()
+        time.sleep(0.05)
+    t0 = time.perf_counter()
+    t0_box[0] = t0
+    i = 0
+    scaled_up = scaled_down = False
+    last_maint = 0.0
+    while i < n:
+        now = time.perf_counter() - t0
+        if arrivals[i] <= now:
+            router.submit(i, prompts[i], max_new_tokens=gen)
+            i += 1
+            if autoscale is not None:
+                # scripted swing: the signal demands one more replica
+                # mid-burst, then releases it — maintain() does the
+                # spin-up and the drain, both recorded in the history
+                # fixed targets, not live-count deltas: a crash in the
+                # same burst would make `live+1` collapse back to the
+                # fleet size and the swing would never move the needle
+                if not scaled_up and i >= int(0.5 * n):
+                    autoscale.desired = n_rep + 1
+                    scaled_up = True
+                    sup.maintain()  # act now: a burst can starve the
+                    _wrap_new()     # cadenced maintain past the swing
+                elif scaled_up and not scaled_down and i >= int(0.85 * n):
+                    autoscale.desired = max(1, n_rep)
+                    scaled_down = True
+                    sup.maintain()
+            continue
+        if now - last_maint >= knobs["maintain_s"]:
+            sup.maintain()
+            router.check_health()
+            _wrap_new()
+            last_maint = now
+        time.sleep(min(max(arrivals[i] - now, 0.0), 0.01))
+    deadline = time.time() + knobs["drain_timeout_s"]
+    while time.time() < deadline:
+        sup.maintain()
+        router.check_health()
+        _wrap_new()
+        if router.pending() == 0:
+            break
+        time.sleep(0.02)
+    wall = time.perf_counter() - t0
+    snapshot_path = sup.write_fleet_snapshot()
+    results = router.results()
+    reports = [r.load_report() for r in sup.replicas.values()]
+    transport = {r.name: dict(zip(("tx_bytes", "rx_bytes"),
+                                  r.transport_bytes()))
+                 for r in sup.replicas.values()}
+    sup.shutdown()
+
+    # uids >= 1e6 are router-routed warm-up probes, not workload
+    results = {uid: t for uid, t in results.items() if uid < n}
+    completed = sum(1 for t in results.values() if len(t) >= gen)
+    total_tokens = sum(len(t) for t in results.values())
+    ttfts = {uid: t - arrivals[uid] for uid, t in first_tok.items()
+             if uid < n}
+    good = sum(len(results.get(uid, [])) for uid, t in ttfts.items()
+               if t <= deadline_s)
+    wire = sum(r["handoff_wire_bytes"] for r in reports)
+    logical = sum(r["handoff_logical_bytes"] for r in reports)
+    out = {
+        "arm": arm,
+        "routing": router.routing,
+        "requests": n,
+        "completed": completed,
+        "dropped": n - completed,
+        "wall_s": round(wall, 3),
+        "tokens_per_s": round(total_tokens / max(wall, 1e-9), 1),
+        "goodput_tokens_per_s": round(good / max(wall, 1e-9), 1),
+        **_percentiles_ms(list(ttfts.values())),
+        "handoffs": router.stats["handoffs"],
+        "handoff_recompute": router.stats["handoff_recompute"],
+        "failed_over_requests": router.stats["failed_over_requests"],
+        "handoff_wire_bytes": wire,
+        "handoff_logical_bytes": logical,
+        "kv_wire_ratio": (round(wire / logical, 4) if logical else None),
+        "transport": transport,
+        "supervisor_actions": [[round(ts - t0, 3), act, rid]
+                               for ts, act, rid in sup.actions],
+        "fleet_snapshot": snapshot_path,
+    }
+    if autoscale is not None:
+        out["autoscale_history"] = [
+            list(h[1:]) for h in autoscale.history]
+    return out
+
+
+def run_procs() -> dict:
+    """Cross-process fleet bench (``BENCH_MODE=serve_procs``,
+    ``make serve-procs``): real worker subprocesses behind the socket
+    transport, serving one diurnal + bursty open-loop workload through
+    four arms — ``least_loaded`` vs ``predictive`` (same fleet with one
+    degraded worker: the routing A/B), ``chaos`` (mid-run SIGKILL via
+    the DSTPU_CHAOS kill_rank spec + a scripted autoscale swing: p99.9
+    TTFT and the zero-drop guarantee), and ``disagg`` (prefill->decode
+    KV handoffs over the int4 wire). One JSON line; violations ride the
+    ``ok``/``violations`` keys, so ``tools/bench_diff.py`` fails the
+    round on any broken gate.
+
+    Gates: predictive TTFT p99 < least_loaded TTFT p99; chaos arm
+    drops == 0 with a restart recorded and both scale acts in the
+    autoscale decision history; disagg ships >=1 handoff with
+    ``kv_wire_ratio`` <= PROCS_MAX_WIRE_RATIO (default 0.5 — int4 wire
+    bytes vs the logical pool bytes) whose payloads crossed a real
+    socket (the prefill channel's rx byte counter bounds them below).
+
+    Env knobs (CPU defaults in parens): PROCS_REQUESTS (20) — a
+    10k-session sweep on real accelerators is PROCS_REQUESTS=10000
+    PROCS_RATE=200 PROCS_PERIOD_S=50 PROCS_GEN=32 PROCS_REPLICAS=8
+    with PROCS_DRAIN_TIMEOUT_S raised to ~3600; PROCS_PROMPT (48),
+    PROCS_SHARED_PREFIX (3/4 of prompt), PROCS_GEN (12), PROCS_RATE
+    (1.5/s — ~1.2-1.5x one CPU worker's service rate, see
+    _drive_procs_arm), PROCS_PERIOD_S (6) diurnal period,
+    PROCS_BURST_FACTOR (3), PROCS_BURST_FRAC (0.2); PROCS_REPLICAS (2),
+    PROCS_SLOW_STEP_MS (2000) — the degraded worker's per-round delay;
+    PROCS_KILL_STEP (3) busy rounds before the chaos self-kill (a
+    round emits decode_steps tokens per sequence, so one request is
+    only a handful of busy rounds);
+    PROCS_WIRE (int4), PROCS_MAX_WIRE_RATIO (0.5);
+    PROCS_DEADLINE_MS (6000), PROCS_ARMS, PROCS_RUN_DIR, PROCS_SEED.
+    """
+    import numpy as np
+
+    base_dir = os.environ.get("PROCS_RUN_DIR", "/tmp/dstpu_serve_procs")
+    model_name = os.environ.get("PROCS_MODEL", "tiny")
+    n_req = int(os.environ.get("PROCS_REQUESTS", 20))
+    prompt_len = int(os.environ.get("PROCS_PROMPT", 48))
+    shared_len = int(os.environ.get("PROCS_SHARED_PREFIX",
+                                    prompt_len * 3 // 4))
+    gen = int(os.environ.get("PROCS_GEN", 12))
+    # ~1.2-1.5x the fast worker's CPU service rate: enough contention
+    # that least-loaded overflows onto the degraded worker while the
+    # predictor can still win by queueing on the fast one — full
+    # saturation would make every policy equally bad
+    rate = float(os.environ.get("PROCS_RATE", 1.5))
+    period_s = float(os.environ.get("PROCS_PERIOD_S", 6.0))
+    burst_factor = float(os.environ.get("PROCS_BURST_FACTOR", 3.0))
+    burst_frac = float(os.environ.get("PROCS_BURST_FRAC", 0.2))
+    deadline_s = float(os.environ.get("PROCS_DEADLINE_MS", 6000)) / 1e3
+    seed = int(os.environ.get("PROCS_SEED", 0))
+    arms = os.environ.get(
+        "PROCS_ARMS", "least_loaded,predictive,chaos,disagg").split(",")
+    block = 8
+    blocks_per_seq = (prompt_len + gen) // block + 3
+
+    model_spec = {"name": model_name,
+                  "overrides": {"dtype": "float32",
+                                "param_dtype": "float32"}}
+    engine_spec = dict(
+        kv_blocks=blocks_per_seq * max(4, n_req // 2) + 2,
+        kv_block_size=block,
+        max_tokens_per_step=int(os.environ.get("PROCS_BUDGET", 64)),
+        max_seqs_per_step=8, max_blocks_per_seq=blocks_per_seq,
+        dtype="float32", request_trace={"sample_rate": 1.0})
+
+    rng = np.random.default_rng(seed)
+    vocab = 256
+    shared = rng.integers(0, vocab, (shared_len,))
+    prompts = []
+    for _ in range(n_req):
+        motif = rng.integers(0, vocab, (4,))
+        tail = np.tile(motif, (prompt_len - shared_len) // 4 + 1)
+        prompts.append(np.concatenate(
+            [shared, tail])[:prompt_len].astype(np.int32))
+    arrivals = _nhpp_arrivals(n_req, rate, period_s, burst_factor,
+                              burst_frac, rng)
+
+    knobs = {
+        "replicas": int(os.environ.get("PROCS_REPLICAS", 2)),
+        "slow_step_ms": float(os.environ.get("PROCS_SLOW_STEP_MS", 2000.0)),
+        # busy PUMP ROUNDS, not tokens: a round emits decode_steps
+        # tokens per sequence, so one request is only ~4-5 busy rounds —
+        # 3 lands the kill mid-first-request on the victim
+        "kill_step": int(os.environ.get("PROCS_KILL_STEP", 3)),
+        "wire": os.environ.get("PROCS_WIRE", "int4"),
+        "stale_after_s": float(os.environ.get("PROCS_STALE_AFTER_S", 5.0)),
+        "maintain_s": 0.05,
+        "drain_timeout_s": float(os.environ.get("PROCS_DRAIN_TIMEOUT_S",
+                                                300.0)),
+        "seed": seed,
+        "max_wire_ratio": float(os.environ.get("PROCS_MAX_WIRE_RATIO",
+                                               0.5)),
+    }
+    results = {}
+    for arm in arms:
+        arm = arm.strip()
+        results[arm] = _drive_procs_arm(
+            arm, base_dir, model_spec, engine_spec, prompts, arrivals,
+            gen, deadline_s, knobs)
+
+    violations = []
+    ll, pred = results.get("least_loaded"), results.get("predictive")
+    if ll and pred and ll["ttft_p99_ms"] and pred["ttft_p99_ms"]:
+        if pred["ttft_p99_ms"] >= ll["ttft_p99_ms"]:
+            violations.append({
+                "region": "routing", "gate": "predictive_beats_p99",
+                "limit": ll["ttft_p99_ms"], "got": pred["ttft_p99_ms"]})
+    chaos = results.get("chaos")
+    if chaos:
+        if chaos["dropped"] > 0:
+            violations.append({
+                "region": "chaos", "gate": "zero_drops",
+                "limit": 0, "got": chaos["dropped"]})
+        acts = [a[1] for a in chaos["supervisor_actions"]]
+        if "restart" not in acts:
+            violations.append({
+                "region": "chaos", "gate": "restart_recorded",
+                "limit": ">=1 restart", "got": acts})
+        hist_acts = [h[1] for h in chaos.get("autoscale_history", [])
+                     if len(h) == 2]
+        if not any(a.startswith("spawn:") for a in hist_acts) or \
+                not any(a.startswith("drain:") for a in hist_acts):
+            violations.append({
+                "region": "autoscale", "gate": "acts_in_history",
+                "limit": "spawn + drain", "got": hist_acts})
+    dis = results.get("disagg")
+    if dis:
+        if dis["handoffs"] < 1:
+            violations.append({
+                "region": "disagg", "gate": "handoffs",
+                "limit": ">=1", "got": dis["handoffs"]})
+        ratio = dis["kv_wire_ratio"]
+        if ratio is None or ratio > knobs["max_wire_ratio"]:
+            violations.append({
+                "region": "disagg", "gate": "kv_wire_ratio",
+                "limit": knobs["max_wire_ratio"], "got": ratio})
+        prefill_rx = max((t["rx_bytes"]
+                          for t in dis["transport"].values()), default=0)
+        if dis["handoff_wire_bytes"] > 0 and \
+                prefill_rx < dis["handoff_wire_bytes"]:
+            violations.append({
+                "region": "disagg", "gate": "wire_over_socket",
+                "limit": dis["handoff_wire_bytes"], "got": prefill_rx})
+
+    headline = pred or ll or chaos or dis
+    return {
+        "metric": f"{model_name} serve_procs tokens/s "
+                  f"({knobs['replicas']} worker procs, {n_req} req, "
+                  f"nhpp {rate}/s x{burst_factor} bursts, "
+                  f"prompt {prompt_len}, gen {gen}, socket transport)",
+        "value": headline["tokens_per_s"] if headline else None,
+        "unit": "tokens/s",
+        "ttft_p999_ms": (chaos or headline or {}).get("ttft_p999_ms"),
+        "kv_wire_ratio": (dis or {}).get("kv_wire_ratio"),
+        "deadline_ms": deadline_s * 1e3,
+        "arms": results,
+        "ok": not violations,
+        "violations": violations,
+    }
+
+
 if __name__ == "__main__":
     mode = os.environ.get("BENCH_MODE", "serve")
     if mode == "serve_fleet":
         for arm_result in run_fleet():
             print(json.dumps(arm_result))
+    elif mode == "serve_procs":
+        _pp = run_procs()
+        print(json.dumps(_pp))
+        if not _pp.get("ok", True):
+            raise SystemExit(1)
     elif mode == "serve_quant":
         _qp = run_quant()
         print(json.dumps(_qp))

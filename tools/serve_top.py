@@ -15,6 +15,7 @@ tool is the read side — pure host code, no jax:
                                                         # Perfetto export
   python tools/serve_top.py --demo                      # CPU demo run
   python tools/serve_top.py --fleet SNAP.json           # fleet snapshot
+  python tools/serve_top.py --fleet RUN_DIR             # cross-process run
   python tools/serve_top.py --fleet --demo              # 2-replica demo
 
 ``--fleet`` reads a ``serving_fleet/v1`` snapshot document
@@ -22,7 +23,10 @@ tool is the read side — pure host code, no jax:
 arm into FLEET_TRACE_DIR) and prints the per-replica load-report table,
 the router counters (handoffs, failovers, affinity hits), the autoscale
 state, and the fleet-level SLO attribution with per-replica miss
-counts.
+counts. Given a *directory* (a ``make serve-procs`` run dir), it loads
+the supervisor's merged ``fleet_snapshot.json`` — falling back to the
+raw per-worker reports under ``<run_dir>/replicas/`` — so a
+cross-process fleet is observable mid-run from a second terminal.
 
 The table decomposes each request's TTFT and e2e wall time into
 queue_wait / prefill / decode / preempted / spec_overhead phases and
@@ -67,9 +71,10 @@ def parse_args(argv=None):
                    help="run a small CPU serve_step workload through the "
                         "v2 engine and print its attribution table")
     p.add_argument("--fleet", action="store_true",
-                   help="treat the positional file as a serving_fleet/v1 "
+                   help="treat the positional arg as a serving_fleet/v1 "
                         "snapshot (FleetRouter.fleet_snapshot / make "
-                        "serve-fleet) and print the per-replica fleet "
+                        "serve-fleet) or a cross-process run dir (make "
+                        "serve-procs) and print the per-replica fleet "
                         "view; with --demo, run a 2-replica in-process "
                         "fleet first")
     return p.parse_args(argv)
@@ -185,6 +190,21 @@ def _fleet_table(snap: dict) -> str:
                   f"{auto.get('desired_replicas')} "
                   f"goodput_slope={auto.get('goodput_slope')} "
                   f"decisions={len(auto.get('decisions', []))}"]
+    sup = snap.get("supervisor")
+    if sup:
+        procs = sup.get("procs", {})
+        up = sum(1 for p in procs.values() if p.get("running"))
+        acts = sup.get("actions", [])
+        tail = "  ".join(f"{a['action']}:r{a['replica']}"
+                         for a in acts[-6:])
+        lines += [f"supervisor: {up}/{len(procs)} worker processes up  "
+                  f"actions={len(acts)}" + (f"  [{tail}]" if tail else "")]
+        wire = sup.get("transport", {})
+        if wire:
+            lines += ["transport: " + "  ".join(
+                f"r{rid}:tx={w['tx_bytes']}:rx={w['rx_bytes']}"
+                for rid, w in sorted(wire.items(),
+                                     key=lambda kv: int(kv[0])))]
     attr = snap.get("slo_attribution") or {}
     per = attr.get("per_replica") or {}
     if per:
@@ -226,17 +246,45 @@ def _run_fleet_demo() -> int:
     return 0
 
 
+def _load_run_dir_snapshot(run_dir: str):
+    """Cross-process fleets: prefer the supervisor's merged
+    ``fleet_snapshot.json``; fall back to assembling a minimal snapshot
+    from the per-replica load reports the workers publish under
+    ``<run_dir>/replicas/`` — readable mid-run with no socket to join
+    and no jax import."""
+    path = os.path.join(run_dir, "fleet_snapshot.json")
+    if os.path.exists(path):
+        with open(path) as f:
+            return json.load(f)
+    from deepspeed_tpu.observability.fleet import read_replica_reports
+
+    reports = read_replica_reports(run_dir)
+    if not reports:
+        return None
+    roles = {r.get("role") for r in reports.values()}
+    return {"schema": "serving_fleet/v1",
+            "mode": "disagg" if "prefill" in roles else "unified",
+            "replicas": [reports[k] for k in sorted(reports)]}
+
+
 def main(argv=None) -> int:
     args = parse_args(argv)
     if args.fleet:
         if args.demo:
             return _run_fleet_demo()
         if not args.traces:
-            print("serve_top: error: --fleet needs a snapshot file "
-                  "(or --demo)", file=sys.stderr)
+            print("serve_top: error: --fleet needs a snapshot file or "
+                  "run dir (or --demo)", file=sys.stderr)
             return 2
-        with open(args.traces) as f:
-            snap = json.load(f)
+        if os.path.isdir(args.traces):
+            snap = _load_run_dir_snapshot(args.traces)
+            if snap is None:
+                print(f"serve_top: no fleet_snapshot.json or replicas/ "
+                      f"reports under {args.traces}", file=sys.stderr)
+                return 1
+        else:
+            with open(args.traces) as f:
+                snap = json.load(f)
         if snap.get("schema") != "serving_fleet/v1":
             print(f"serve_top: {args.traces} is not a serving_fleet/v1 "
                   f"snapshot (schema={snap.get('schema')!r})",
