@@ -85,6 +85,40 @@ def format_quant_mode(qwz: bool, qgz: bool, hpz: int = 1,
     return "+".join(toks) or "off"
 
 
+def parse_blocks(label: str, n: int) -> List[int]:
+    """Parse an ``x``-joined block-geometry label (``"512x512"``,
+    ``"512x1024x512"``) into ``n`` ints, validating each is a positive
+    power of two. Shared by the ``flash_blocks`` / ``gmm_tiles`` tuning
+    axes and their CLI flags."""
+    parts = str(label).lower().split("x")
+    if len(parts) != n:
+        raise ValueError(f"block label {label!r}: want {n} 'x'-joined "
+                         f"ints (e.g. {'x'.join(['512'] * n)})")
+    vals = []
+    for p in parts:
+        v = int(p)
+        if v <= 0 or v & (v - 1):
+            raise ValueError(f"block label {label!r}: {v} is not a "
+                             f"positive power of two")
+        vals.append(v)
+    return vals
+
+
+def legal_flash_blocks(seq: int, lo: int = 128,
+                       hi: int = 1024) -> List[str]:
+    """Shape-legal flash block candidates for a sequence length: square
+    power-of-two blocks that tile ``seq`` exactly (the kernel clamps
+    others, so off-divisor candidates would silently measure a
+    different geometry). The ``--flash-blocks auto`` axis family."""
+    out = []
+    b = lo
+    while b <= min(hi, seq):
+        if seq % b == 0:
+            out.append(f"{b}x{b}")
+        b *= 2
+    return out or [f"{min(lo, seq)}x{min(lo, seq)}"]
+
+
 @dataclasses.dataclass
 class AutotunerResult:
     config: Dict[str, Any]
@@ -170,6 +204,16 @@ class Autotuner:
         # winning config pick them up; the train-step probe ignores them
         self.kv_quant_bits = list(space.get("kv_quant_bits", [None]))
         self.handoff_wires = list(space.get("handoff_wires", [None]))
+        # kernel-geometry axis family (ISSUE 14): flash block_q x block_k
+        # ("512x512" labels, shape-legal divisors only — see
+        # legal_flash_blocks), grouped-matmul m x n x k tiles, and the
+        # paged-attention pages-per-compute-block fan-in. They ride as
+        # real cfg["kernels"] keys (the engine consumes that block
+        # directly, so trials genuinely run the geometry) and the winner
+        # persists to docs/autotuned/ with the rest of the config
+        self.flash_blocks = list(space.get("flash_blocks", [None]))
+        self.gmm_tiles = list(space.get("gmm_tiles", [None]))
+        self.pages_per_block = list(space.get("pages_per_block", [None]))
         self.hbm_budget = hbm_budget_bytes or self._detect_hbm()
         self.results_dir = results_dir
         self.persist_path = persist_path
@@ -194,11 +238,12 @@ class Autotuner:
     def candidates(self) -> List[Dict[str, Any]]:
         out = []
         for (mb, stage, remat, policy, tl, ac, pd, od, sm, qm, kvb,
-             hw) in itertools.product(
+             hw, fb, gt, pb) in itertools.product(
                 self.micro_batch_sizes, self.zero_stages, self.remat,
                 self.remat_policies, self.tiled_logits, self.attn_chunks,
                 self.prefetch_depths, self.overlap_depths, self.sp_modes,
-                self.quant_modes, self.kv_quant_bits, self.handoff_wires):
+                self.quant_modes, self.kv_quant_bits, self.handoff_wires,
+                self.flash_blocks, self.gmm_tiles, self.pages_per_block):
             cfg = json.loads(json.dumps(self.base_config))  # deep copy
             cfg["train_micro_batch_size_per_chip"] = int(mb)
             cfg.pop("train_batch_size", None)  # re-derived from micro×gas×dp
@@ -230,6 +275,19 @@ class Autotuner:
                     None if int(kvb) == 0 else int(kvb))
             if hw is not None:
                 cfg.setdefault("serving", {})["handoff_wire"] = str(hw)
+            if fb is not None:
+                bq, bk = parse_blocks(fb, 2)
+                kcfg = cfg.setdefault("kernels", {})
+                kcfg["flash_block_q"], kcfg["flash_block_k"] = bq, bk
+            if gt is not None:
+                bm, bn, bkk = parse_blocks(gt, 3)
+                kcfg = cfg.setdefault("kernels", {})
+                kcfg["gmm_block_m"] = bm
+                kcfg["gmm_block_n"] = bn
+                kcfg["gmm_block_k"] = bkk
+            if pb is not None:
+                cfg.setdefault("kernels", {})[
+                    "pages_per_compute_block"] = int(pb)
             out.append(cfg)
         return out
 
@@ -505,8 +563,21 @@ def main(argv=None) -> int:
                          "off | qwz+[qgz|qar]+hpz<k>, e.g. off qwz "
                          "qwz+qgz qar qwz+qgz+hpz8)")
     ap.add_argument("--kv-quant-bits", type=int, nargs="+", default=None,
-                    help="serving KV-pool storage bits to try "
-                         "(0 = bf16 pool, 8 = int8 blocks + scales)")
+                    help="serving KV-pool storage bits to try (0 = bf16 "
+                         "pool, 8 = int8 blocks + scales, 4 = packed-"
+                         "nibble uint8 blocks + scales)")
+    ap.add_argument("--flash-blocks", nargs="+", default=None,
+                    help="flash block_q x block_k candidates to try "
+                         "('512x512' labels; 'auto' = all shape-legal "
+                         "power-of-two divisors of --seq)")
+    ap.add_argument("--gmm-tiles", nargs="+", default=None,
+                    help="grouped-matmul m x n x k tile candidates "
+                         "('512x1024x512' labels; power-of-two entries, "
+                         "the kernel snaps to legal divisors per shape)")
+    ap.add_argument("--pages-per-block", type=int, nargs="+", default=None,
+                    help="paged-attention KV pages folded per compute "
+                         "block (>=1; bit-identical output for every "
+                         "value, only the grid geometry changes)")
     ap.add_argument("--handoff-wires", nargs="+", default=None,
                     help="disagg KV-handoff wire codecs to try "
                          "(auto/raw/int8/int4)")
@@ -567,8 +638,9 @@ def main(argv=None) -> int:
         space["quant_modes"] = args.quant_modes
     if args.kv_quant_bits is not None:
         for b in args.kv_quant_bits:
-            if b not in (0, 8):
-                ap.error(f"--kv-quant-bits values must be 0 or 8, got {b}")
+            if b not in (0, 4, 8):
+                ap.error(f"--kv-quant-bits values must be 0, 4 or 8, "
+                         f"got {b}")
         space["kv_quant_bits"] = args.kv_quant_bits
     if args.handoff_wires is not None:
         for w in args.handoff_wires:
@@ -576,6 +648,30 @@ def main(argv=None) -> int:
                 ap.error(f"--handoff-wires values must be auto/raw/int8/"
                          f"int4, got {w!r}")
         space["handoff_wires"] = args.handoff_wires
+    if args.flash_blocks is not None:
+        labels = []
+        for fb in args.flash_blocks:
+            if fb == "auto":
+                labels.extend(legal_flash_blocks(args.seq))
+                continue
+            try:
+                parse_blocks(fb, 2)
+            except ValueError as e:
+                ap.error(str(e))
+            labels.append(fb)
+        space["flash_blocks"] = labels
+    if args.gmm_tiles is not None:
+        for gt in args.gmm_tiles:
+            try:
+                parse_blocks(gt, 3)
+            except ValueError as e:
+                ap.error(str(e))
+        space["gmm_tiles"] = args.gmm_tiles
+    if args.pages_per_block is not None:
+        for p in args.pages_per_block:
+            if p < 1:
+                ap.error(f"--pages-per-block values must be >= 1, got {p}")
+        space["pages_per_block"] = args.pages_per_block
     tuner = Autotuner(model_factory, base, batch_fn,
                       tuning_space=space or None,
                       results_dir=args.results_dir,

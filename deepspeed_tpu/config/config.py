@@ -755,10 +755,12 @@ class ServingConfig(ConfigModel):
     ``decode_steps`` is the steady-state multi-token decode burst length
     (1 restores strict per-token SplitFuse admission).
 
-    ``kv_quant_bits`` stores KV-cache blocks as int8 payloads with one
-    fp32 scale per head_dim vector (None keeps today's bf16 pool
+    ``kv_quant_bits`` stores KV-cache blocks as quantized payloads with
+    one fp32 scale per head_dim vector: 8 keeps int8 storage, 4 packs
+    two nibbles per byte (~1.9x more sessions at head_dim 128; decode
+    SNR gated in ``make serve-quant``). None keeps today's bf16 pool
     bit-exactly — the quantized pytree never enters the traced
-    program). ``handoff_wire`` picks the disaggregated-prefill KV
+    program. ``handoff_wire`` picks the disaggregated-prefill KV
     handoff codec: "auto" ships the pool's native format, "raw" forces
     full precision, "int8"/"int4" quantize bf16 pools for the wire
     (int4 packs two values per byte; dequantized on install)."""
@@ -784,9 +786,9 @@ class ServingConfig(ConfigModel):
                 raise ValueError(
                     f"serving.{name} must be >= {lo}, got "
                     f"{getattr(self, name)}")
-        if self.kv_quant_bits not in (None, 8):
+        if self.kv_quant_bits not in (None, 4, 8):
             raise ValueError(
-                f"serving.kv_quant_bits must be null or 8, got "
+                f"serving.kv_quant_bits must be null, 4 or 8, got "
                 f"{self.kv_quant_bits}")
         if self.handoff_wire not in ("auto", "raw", "int8", "int4"):
             raise ValueError(
@@ -804,6 +806,55 @@ class CompileConfig(ConfigModel):
     enabled: bool = True
     donate_params: bool = True
     scan_layers: bool = True
+
+
+@register_config_model
+@dataclass
+class KernelsConfig(ConfigModel):
+    """Pallas kernel geometry + dispatch policy (docs/kernels.md).
+
+    Block sizes were hardcoded in the kernels; they are config knobs
+    and autotuner axes now (kernel-geometry axis family — candidates
+    are shape-legal divisors only, ``autotuning/autotuner.py``). 0
+    means "auto": the kernel's seq-derived default for flash, the
+    measured v5e tiles for the grouped matmul, one page per compute
+    block for paged attention.
+
+    ``dispatch`` picks how ``ops/attention.py`` chooses flash vs XLA:
+    "auto" consults the per-(kernel, shape-bucket) win/loss table
+    (``ops/kernel_table.py``; measured by ``make bench-kernels``) with
+    the legacy seq-length heuristic covering unmeasured buckets;
+    "heuristic" ignores the table (pre-round-14 behavior).
+    ``table_path`` overrides the table location (None → the
+    ``DSTPU_KERNEL_TABLE`` env var, then
+    ``docs/autotuned/kernel_table.json``)."""
+
+    flash_block_q: int = 0  # 0 = auto (1024 at seq>=8k else min(512, S))
+    flash_block_k: int = 0
+    pages_per_compute_block: int = 1  # KV pages folded per paged-attn grid step
+    gmm_block_m: int = 512
+    gmm_block_n: int = 1024
+    gmm_block_k: int = 512
+    blocksparse_block: int = 0  # 0 = follow sparse_attention.block
+    dispatch: str = "auto"  # auto (win/loss table) | heuristic
+    table_path: Optional[str] = None
+
+    def validate(self) -> None:
+        for name in ("flash_block_q", "flash_block_k", "gmm_block_m",
+                     "gmm_block_n", "gmm_block_k", "blocksparse_block"):
+            v = getattr(self, name)
+            if v < 0 or (v and v & (v - 1)):
+                raise ValueError(
+                    f"kernels.{name} must be 0 (auto) or a power of "
+                    f"two, got {v}")
+        if self.pages_per_compute_block < 1:
+            raise ValueError(
+                f"kernels.pages_per_compute_block must be >= 1, got "
+                f"{self.pages_per_compute_block}")
+        if self.dispatch not in ("auto", "heuristic"):
+            raise ValueError(
+                f"kernels.dispatch must be auto|heuristic, got "
+                f"{self.dispatch!r}")
 
 
 @register_config_model
@@ -881,6 +932,7 @@ class Config(ConfigModel):
     # resharded-restore path re-checks the batch math for the new world
     elasticity: Optional[Dict[str, Any]] = None
     data_efficiency: DataEfficiencyConfig = field(default_factory=DataEfficiencyConfig)
+    kernels: KernelsConfig = field(default_factory=KernelsConfig)
 
     # monitor blocks may also appear top-level in reference configs
     tensorboard: Optional[MonitorBackendConfig] = None
@@ -902,6 +954,7 @@ class Config(ConfigModel):
             "checkpoint": CheckpointConfig, "serving": ServingConfig,
             "resilience": ResilienceConfig, "compile": CompileConfig,
             "data_efficiency": DataEfficiencyConfig,
+            "kernels": KernelsConfig,
         }
         # sparse_attention stays None unless configured (Optional block:
         # "not present" must be distinguishable from "defaults")

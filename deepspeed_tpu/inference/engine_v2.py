@@ -187,6 +187,7 @@ class InferenceEngineV2:
         # serve-path telemetry (VERDICT r2: the gather fallback is a perf
         # cliff users can't see — count it; reference analog: the comms
         # logger's op counts, utils/comms_logging.py)
+        self._last_fallback_reason = "unknown"
         self.stats = {"decode_kernel_steps": 0, "prefill_kernel_steps": 0,
                       "prefill_gather_fallbacks": 0,
                       "fallback_reasons": {"vmem": 0, "padding": 0},
@@ -475,14 +476,18 @@ class InferenceEngineV2:
         if self._use_paged_kernel and not decode_only:
             seg_plan = self._plan_prefill_segments(scheduled)
             if seg_plan is None:
-                n = self.stats["prefill_gather_fallbacks"] = \
-                    self.stats["prefill_gather_fallbacks"] + 1
-                if n == 1 or n % 100 == 0:
-                    log_dist(
-                        f"paged prefill fell back to the gather path "
-                        f"({n}x: {self.stats['fallback_reasons']}) — "
-                        "flat-layout serve step, no Pallas kernel; see "
-                        "log_summary()", ranks=[0])
+                self.stats["prefill_gather_fallbacks"] += 1
+                # warn ONCE per reason (vmem/padding), then count
+                # silently: the re-log-every-100 version flooded tier-1
+                # output on CPU runs. Counts stay queryable in
+                # log_summary() / telemetry.get().
+                from deepspeed_tpu.utils import telemetry
+
+                telemetry.count(
+                    "serve.prefill_gather_fallback",
+                    f"{self._last_fallback_reason}: paged prefill fell "
+                    "back to the gather path — flat-layout serve step, "
+                    "no Pallas kernel; see log_summary()")
             else:
                 self.stats["prefill_kernel_steps"] += 1
             # fraction of mixed prefill steps that lost the Pallas
@@ -606,6 +611,7 @@ class InferenceEngineV2:
                          * (256 + self.cfg.head_dim) * 4)
         if scratch_bytes > 4 * 1024 * 1024:
             self.stats["fallback_reasons"]["vmem"] += 1
+            self._last_fallback_reason = "vmem"
             return None
         S = 1  # segment-count bucket: slots are ordered, so the forward
         while S < len(scheduled):  # runs on the leading S rows only
@@ -615,6 +621,7 @@ class InferenceEngineV2:
         # fp32 logits); cap the blowup over the flat token budget
         if S * tq > 2 * self.max_tokens:
             self.stats["fallback_reasons"]["padding"] += 1
+            self._last_fallback_reason = "padding"
             return None
         toks = np.zeros((S, tq), np.int32)
         pos0 = np.zeros(S, np.int32)

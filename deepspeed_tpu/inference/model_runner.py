@@ -27,7 +27,9 @@ from jax import lax
 
 from deepspeed_tpu.models.transformer import (
     TransformerConfig, _norm, _rope, act_fn)
-from deepspeed_tpu.ops.pallas.quantization import kv_dequantize, kv_quantize
+from deepspeed_tpu.ops.pallas.quantization import (kv_dequantize,
+                                                   kv_pack, kv_quantize,
+                                                   kv_unpack)
 from deepspeed_tpu.runtime.sharding import (effective_dtype,
                                             vocab_parallel_lookup)
 from deepspeed_tpu.utils import jaxcompat
@@ -35,12 +37,31 @@ from deepspeed_tpu.utils import jaxcompat
 
 def _kv_parts(kv_state):
     """Split the ragged KV pool pytree: a bare array (bf16 pool — today's
-    program, traced verbatim) yields (data, None); an (int8 payload, fp32
+    program, traced verbatim) yields (data, None); a (payload, fp32
     scales) pair yields both. The quantized branch is chosen at trace
     time, so the unquantized lowering carries no quant ops at all."""
     if isinstance(kv_state, (tuple, list)):
         return kv_state[0], kv_state[1]
     return kv_state, None
+
+
+def _kv_bits(kv_layer) -> int:
+    """Storage width of a quantized pool, inferred at trace time from
+    the payload dtype: int8 holds one value per byte; uint8 is the
+    packed-nibble int4 pool (two values per byte, last dim head_dim//2
+    — the codec PR 12 ships for the handoff wire, applied to storage)."""
+    return 4 if kv_layer.dtype == jnp.uint8 else 8
+
+
+def _kernel_pages() -> int:
+    """``kernels.pages_per_compute_block`` from the installed kernel
+    config (ops.attention.set_kernel_config), resolved at trace time —
+    same contract as the DSTPU_* env-at-construction knobs."""
+    from deepspeed_tpu.ops import attention as attn_ops
+
+    kcfg = attn_ops._KERNEL_CONFIG
+    return int(getattr(kcfg, "pages_per_compute_block", 1) or 1) \
+        if kcfg is not None else 1
 
 
 def _qkv(cfg: TransformerConfig, layer_params, y, positions):
@@ -240,17 +261,20 @@ def ragged_forward(cfg: TransformerConfig, params, kv_data: jax.Array,
             kv_layer = kv_layer.at[page, offset, 1].set(
                 v.astype(kv_layer.dtype))
         else:
-            qk, sk = kv_quantize(k)  # quantize-on-append, per head vector
-            qv, sv = kv_quantize(v)
-            kv_layer = kv_layer.at[page, offset, 0].set(qk)
-            kv_layer = kv_layer.at[page, offset, 1].set(qv)
+            bits = _kv_bits(kv_layer)
+            qk, sk = kv_quantize(k, bits=bits)  # quantize-on-append
+            qv, sv = kv_quantize(v, bits=bits)  # per head vector
+            kv_layer = kv_layer.at[page, offset, 0].set(kv_pack(qk, bits))
+            kv_layer = kv_layer.at[page, offset, 1].set(kv_pack(qv, bits))
             kv_sc = kv_sc.at[page, offset, 0].set(sk)
             kv_sc = kv_sc.at[page, offset, 1].set(sv)
         # gather each slot's pages into dense [S, Lmax, nkv, hd]
-        gathered = kv_layer[block_table]  # [S, Bm, bs, 2, nkv, hd]
+        gathered = kv_layer[block_table]  # [S, Bm, bs, 2, nkv, hd(/2)]
         if kv_sc is not None:
             # dequant-on-read: only the gathered pages, never the pool
-            gathered = kv_dequantize(gathered, kv_sc[block_table], dtype=dt)
+            gathered = kv_dequantize(
+                kv_unpack(gathered, _kv_bits(kv_layer)),
+                kv_sc[block_table], dtype=dt)
         gathered = gathered.reshape(Smax, max_ctx, 2, cfg.kv_heads,
                                     cfg.head_dim)
         k_seq = gathered[:, :, 0][token_seq]  # [T, Lmax, nkv, hd]
@@ -313,13 +337,13 @@ def _paged_decode(mesh, q, kv_layer, block_table, context_lens):
     from deepspeed_tpu.ops.pallas.paged_attention import \
         paged_decode_attention
 
+    kernel = partial(paged_decode_attention,
+                     pages_per_compute_block=_kernel_pages())
     if mesh is None:
-        return paged_decode_attention(q, kv_layer, block_table,
-                                      context_lens)
+        return kernel(q, kv_layer, block_table, context_lens)
     from jax.sharding import PartitionSpec as PS
 
-    fn = _tp_shard_map(paged_decode_attention, mesh,
-                       PS(None, "tp", None), 2)
+    fn = _tp_shard_map(kernel, mesh, PS(None, "tp", None), 2)
     return fn(q, kv_layer, block_table, context_lens)
 
 
@@ -327,13 +351,13 @@ def _paged_prefill(mesh, q, kv_layer, block_table, seg_pos0, ctx_lens):
     from deepspeed_tpu.ops.pallas.paged_attention import \
         paged_prefill_attention
 
+    kernel = partial(paged_prefill_attention,
+                     pages_per_compute_block=_kernel_pages())
     if mesh is None:
-        return paged_prefill_attention(q, kv_layer, block_table,
-                                       seg_pos0, ctx_lens)
+        return kernel(q, kv_layer, block_table, seg_pos0, ctx_lens)
     from jax.sharding import PartitionSpec as PS
 
-    fn = _tp_shard_map(paged_prefill_attention, mesh,
-                       PS(None, None, "tp", None), 3)
+    fn = _tp_shard_map(kernel, mesh, PS(None, None, "tp", None), 3)
     return fn(q, kv_layer, block_table, seg_pos0, ctx_lens)
 
 
@@ -388,16 +412,18 @@ def ragged_prefill_forward(cfg: TransformerConfig, params,
                 v.astype(kv_layer.dtype))
             kv_read = kv_layer
         else:
-            qk, sk = kv_quantize(k)
-            qv, sv = kv_quantize(v)
-            kv_layer = kv_layer.at[page, offset, 0].set(qk)
-            kv_layer = kv_layer.at[page, offset, 1].set(qv)
+            bits = _kv_bits(kv_layer)
+            qk, sk = kv_quantize(k, bits=bits)
+            qv, sv = kv_quantize(v, bits=bits)
+            kv_layer = kv_layer.at[page, offset, 0].set(kv_pack(qk, bits))
+            kv_layer = kv_layer.at[page, offset, 1].set(kv_pack(qv, bits))
             kv_sc = kv_sc.at[page, offset, 0].set(sk)
             kv_sc = kv_sc.at[page, offset, 1].set(sv)
             # the Pallas kernel reads a dense layer pool; dequantize the
             # per-layer slice (transient, 1/L of the bf16 pool) — the
-            # persistent pool stays int8
-            kv_read = kv_dequantize(kv_layer, kv_sc, dtype=dt)
+            # persistent pool stays int8/packed-int4
+            kv_read = kv_dequantize(kv_unpack(kv_layer, bits), kv_sc,
+                                    dtype=dt)
         attn = _paged_prefill(mesh, q.astype(dt), kv_read, block_table,
                               seg_pos0, ctx_lens)
         attn = jnp.einsum("stnd,ndh->sth", attn.astype(dt),
@@ -472,13 +498,15 @@ def ragged_decode_forward(cfg: TransformerConfig, params, kv_data: jax.Array,
                 v.astype(kv_layer.dtype))
             kv_read = kv_layer
         else:
-            qk, sk = kv_quantize(k)
-            qv, sv = kv_quantize(v)
-            kv_layer = kv_layer.at[page, offset, 0].set(qk)
-            kv_layer = kv_layer.at[page, offset, 1].set(qv)
+            bits = _kv_bits(kv_layer)
+            qk, sk = kv_quantize(k, bits=bits)
+            qv, sv = kv_quantize(v, bits=bits)
+            kv_layer = kv_layer.at[page, offset, 0].set(kv_pack(qk, bits))
+            kv_layer = kv_layer.at[page, offset, 1].set(kv_pack(qv, bits))
             kv_sc = kv_sc.at[page, offset, 0].set(sk)
             kv_sc = kv_sc.at[page, offset, 1].set(sv)
-            kv_read = kv_dequantize(kv_layer, kv_sc, dtype=dt)
+            kv_read = kv_dequantize(kv_unpack(kv_layer, bits), kv_sc,
+                                    dtype=dt)
         attn = _paged_decode(mesh, q.astype(dt), kv_read, block_table,
                              context_lens)
         attn = jnp.einsum("snd,ndh->sh", attn.astype(dt),

@@ -34,20 +34,32 @@ class KVCacheConfig:
     num_blocks: int = 256
     dtype: object = jnp.bfloat16
     # None = bf16 pool (bit-exact legacy program); 8 = int8 payload with one
-    # fp32 scale per (layer, block, row, k/v, head) vector.
+    # fp32 scale per (layer, block, row, k/v, head) vector; 4 = packed-nibble
+    # uint8 payload (two values per byte, ~1.9x more sessions at head_dim
+    # 128) with the same per-vector fp32 scale.
     quant_bits: Optional[int] = None
 
     def __post_init__(self):
-        if self.quant_bits not in (None, 8):
+        if self.quant_bits not in (None, 4, 8):
             raise ValueError(
-                f"kv quant_bits must be None or 8, got {self.quant_bits}")
+                f"kv quant_bits must be None, 4 or 8, got {self.quant_bits}")
+        if self.quant_bits == 4 and self.head_dim % 2:
+            raise ValueError(
+                f"int4 KV storage packs two values per byte and needs an "
+                f"even head_dim, got {self.head_dim}")
+
+    @property
+    def payload_width(self) -> int:
+        """Last-dim extent of the pool payload: head_dim values, packed
+        two-per-byte under int4."""
+        return self.head_dim // 2 if self.quant_bits == 4 else self.head_dim
 
     @property
     def bytes_per_block(self) -> int:
         vecs = self.num_layers * self.block_size * 2 * self.kv_heads
         if self.quant_bits is not None:
-            # int8 payload + fp32 scale per head vector
-            return vecs * (self.head_dim + 4)
+            # int8/packed-int4 payload + fp32 scale per head vector
+            return vecs * (self.payload_width + 4)
         itemsize = jnp.dtype(self.dtype).itemsize
         return vecs * self.head_dim * itemsize
 
@@ -67,9 +79,12 @@ class BlockedKVCache:
         self.allocator = BlockedAllocator(config.num_blocks)
         self.prefix_cache = None  # Optional[PrefixCache], attached by owner
         shape = (config.num_layers, config.num_blocks, config.block_size,
-                 2, config.kv_heads, config.head_dim)
+                 2, config.kv_heads, config.payload_width)
         quantized = config.quant_bits is not None
-        pool_dtype = jnp.int8 if quantized else config.dtype
+        # int4 packs nibbles into uint8 (the runner infers the width from
+        # the pool dtype at trace time: int8 → 8, uint8 → 4)
+        pool_dtype = (jnp.uint8 if config.quant_bits == 4
+                      else jnp.int8 if quantized else config.dtype)
         self.scales = None
         if mesh is not None and tp_axis in mesh.axis_names and (
                 mesh.shape[tp_axis] > 1):
@@ -95,8 +110,9 @@ class BlockedKVCache:
     @property
     def kv_state(self):
         """Device pool as the pytree the ragged forwards consume: the bare
-        bf16 array when unquantized (today's program, verbatim), or an
-        (int8 payload, fp32 scales) pair when ``quant_bits`` is set."""
+        bf16 array when unquantized (today's program, verbatim), or a
+        (payload, fp32 scales) pair when ``quant_bits`` is set (int8
+        payload, or packed-nibble uint8 for 4-bit storage)."""
         if self.scales is None:
             return self.data
         return (self.data, self.scales)

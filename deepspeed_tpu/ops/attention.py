@@ -21,15 +21,22 @@ NEG_INF = -1e30
 
 
 @functools.lru_cache(None)
-def _flash_available() -> bool:
-    if jax.default_backend() != "tpu":
-        return False
+def _flash_importable() -> bool:
     try:
         from deepspeed_tpu.ops.pallas import flash_attention  # noqa: F401
 
         return True
     except Exception:
         return False
+
+
+@functools.lru_cache(None)
+def _flash_available() -> bool:
+    """Legacy heuristic availability: TPU backend + importable kernel.
+    (The win/loss table can still route to the kernel off-TPU — e.g. a
+    CPU-measured table entry in tests — interpreter mode is
+    numerics-equivalent, just slow.)"""
+    return jax.default_backend() == "tpu" and _flash_importable()
 
 
 def repeat_kv_heads(q, k, v):
@@ -71,16 +78,30 @@ def xla_attention(q, k, v, causal: bool = True,
     return jnp.einsum("bnqk,bknd->bqnd", probs, v)
 
 
-# Below this sequence length XLA's fused attention beats the Pallas kernel
-# on-chip; above it flash wins AND avoids the [S,S] fp32 score transient.
-# Measured on v5e (B=32,N=12,D=64, fwd+bwd, block 512): seq 1024 → flash
-# 1.5x over XLA; block 128 (old default) was 0.6x — block size dominates.
+# Legacy crossover heuristic — covers buckets the win/loss table hasn't
+# measured yet. Below this sequence length XLA's fused attention beats
+# the Pallas kernel on-chip; above it flash wins AND avoids the [S,S]
+# fp32 score transient. Measured on v5e (B=32,N=12,D=64, fwd+bwd, block
+# 512): seq 1024 → flash 1.5x over XLA; block 128 (old default) was
+# 0.6x — block size dominates. Measured buckets override this entirely
+# (ops/kernel_table.py; `make bench-kernels` re-measures).
 FLASH_MIN_SEQ = 1024
 
 
 # engine-configured block-sparse layout (config.sparse_attention →
 # set_sparse_config at engine init); used when impl == "blocksparse"
 _SPARSE_CONFIG = None
+
+# engine-configured kernel geometry + dispatch policy (config.kernels →
+# set_kernel_config at engine init); None = defaults (table dispatch,
+# seq-derived blocks)
+_KERNEL_CONFIG = None
+
+# trace-time dispatch outcomes: pallas/xla picks plus the
+# wanted-flash-but-unavailable fallbacks (the perf cliff the bare
+# telemetry counter used to hide; published as a hub ratio like
+# serve.paged_fallback_ratio)
+_DISPATCH_STATS = {"pallas": 0, "xla": 0, "flash_fallbacks": 0}
 
 
 def set_sparse_config(sparsity) -> None:
@@ -90,9 +111,91 @@ def set_sparse_config(sparsity) -> None:
     _SPARSE_CONFIG = sparsity
 
 
+def set_kernel_config(kernels) -> None:
+    """Install the ds_config ``kernels`` block (engine init): block
+    geometry overrides and the table-vs-heuristic dispatch switch."""
+    global _KERNEL_CONFIG
+    _KERNEL_CONFIG = kernels
+
+
+def dispatch_stats() -> dict:
+    """Copy of the trace-time dispatch counters (tests + bench)."""
+    return dict(_DISPATCH_STATS)
+
+
+def flash_fallback_ratio() -> float:
+    """Fraction of flash-worthy dispatches that lost the kernel —
+    the train-path analog of ``serve.paged_fallback_ratio``."""
+    fb = _DISPATCH_STATS["flash_fallbacks"]
+    return fb / max(1, _DISPATCH_STATS["pallas"] + fb)
+
+
+def _reset_dispatch_stats() -> None:
+    for key in _DISPATCH_STATS:
+        _DISPATCH_STATS[key] = 0
+
+
+def kernel_gmm_tiles() -> dict:
+    """Grouped-matmul tile overrides from the installed ``kernels``
+    config block (kernels.gmm_block_{m,n,k}); empty dict when no engine
+    has installed a config → ``gmm`` keeps its own defaults."""
+    kcfg = _KERNEL_CONFIG
+    if kcfg is None:
+        return {}
+    return {"block_m": int(getattr(kcfg, "gmm_block_m", 512)),
+            "block_n": int(getattr(kcfg, "gmm_block_n", 1024)),
+            "block_k": int(getattr(kcfg, "gmm_block_k", 512))}
+
+
+def _auto_block(seq: int) -> int:
+    # v5e measurements (docs/roofline.md): 512 best at short seq;
+    # 1024 wins from ~8K up (fewer grid steps amortize the packed
+    # triangle's per-step overhead — 128K fwd 124 vs 52 TF/s)
+    return 1024 if seq >= 8192 else min(512, seq)
+
+
+def _pick_blocks(seq: int, measured: Optional[dict]) -> tuple:
+    """Flash block geometry: measured winning blocks (table) > config
+    knobs (kernels.flash_block_q/_k, 0 = auto) > seq-derived default."""
+    bq = bk = _auto_block(seq)
+    kcfg = _KERNEL_CONFIG
+    if kcfg is not None:
+        bq = getattr(kcfg, "flash_block_q", 0) or bq
+        bk = getattr(kcfg, "flash_block_k", 0) or bk
+    if measured:
+        bq = int(measured.get("block_q", bq))
+        bk = int(measured.get("block_k", bk))
+    return bq, bk
+
+
+def _export_dispatch(region: str, source: str, reason: str,
+                     bucket: str) -> None:
+    """Publish the chosen source per region to the observability hub.
+    Runs at trace time (once per compiled program, not per step); never
+    instantiates a hub of its own."""
+    try:
+        from deepspeed_tpu.observability.hub import peek_hub
+
+        hub = peek_hub()
+    except Exception:
+        hub = None
+    if hub is None:
+        return
+    hub.gauge(f"kernel.{region}.pallas", 1.0 if source == "pallas" else 0.0)
+    hub.gauge("kernel.flash_fallback_ratio", flash_fallback_ratio())
+    hub.record_event("kernel_dispatch", region=region, source=source,
+                     reason=reason, bucket=bucket)
+
+
 def multi_head_attention(q, k, v, causal: bool = True, impl: str = "auto",
                          segment_ids: Optional[jax.Array] = None) -> jax.Array:
-    """Dispatching entry point used by the model zoo."""
+    """Dispatching entry point used by the model zoo.
+
+    ``impl='auto'`` is cost-driven: the registry consults the measured
+    per-(kernel, shape-bucket) win/loss table (compat probing as the
+    outer guard); unmeasured buckets fall back to the FLASH_MIN_SEQ
+    heuristic. Explicit ``impl='flash'``/``'xla'`` bypass the table.
+    """
     seq = q.shape[1]
     if impl == "blocksparse":
         if _SPARSE_CONFIG is None:
@@ -107,26 +210,50 @@ def multi_head_attention(q, k, v, causal: bool = True, impl: str = "auto",
 
         k, v = repeat_kv_heads(q, k, v)  # blocksparse kernel is MHA-only
         return blocksparse_attention(q, k, v, _SPARSE_CONFIG, causal=causal)
-    want_flash = (
-        impl == "flash"
-        or (impl == "auto" and _flash_available() and seq >= FLASH_MIN_SEQ)
-    )
-    if (impl == "auto" and seq >= FLASH_MIN_SEQ and not want_flash
-            and jax.default_backend() == "tpu"):
+    if impl == "flash":
+        from deepspeed_tpu.ops.pallas.flash_attention import flash_attention
+
+        bq, bk = _pick_blocks(seq, None)
+        return flash_attention(q, k, v, causal=causal,
+                               segment_ids=segment_ids,
+                               block_q=bq, block_k=bk)
+    if impl != "auto":
+        return xla_attention(q, k, v, causal=causal, segment_ids=segment_ids)
+
+    from deepspeed_tpu.ops import kernel_table, registry
+
+    kcfg = _KERNEL_CONFIG
+    bucket = kernel_table.attention_bucket(seq, q.shape[-1], causal)
+    heuristic = _flash_available() and seq >= FLASH_MIN_SEQ
+    if kcfg is not None and getattr(kcfg, "dispatch", "auto") == "heuristic":
+        decision = registry.DispatchDecision(
+            op_name=("flash_attention" if heuristic else "xla_attention"),
+            source=("pallas" if heuristic else "xla"),
+            reason="kernels.dispatch=heuristic")
+    else:
+        decision = registry.dispatch_op(
+            "flash_attention", bucket, "xla_attention",
+            default_use=heuristic,
+            table_path=getattr(kcfg, "table_path", None))
+    if decision.source == "pallas" and not _flash_importable():
         # the flash kernel should have dispatched here but can't load —
         # the O(S^2)-memory XLA path is a real perf downgrade on TPU
         from deepspeed_tpu.utils import telemetry
 
         telemetry.count("attention.flash_to_xla_fallback",
-                        "pallas flash kernel unavailable on tpu backend")
-    if want_flash:
+                        "pallas flash kernel unavailable "
+                        f"(backend={jax.default_backend()})")
+        _DISPATCH_STATS["flash_fallbacks"] += 1
+        decision = registry.DispatchDecision(
+            op_name="xla_attention", source="xla",
+            reason=f"flash unavailable; was: {decision.reason}")
+    _DISPATCH_STATS[decision.source] += 1
+    _export_dispatch("attention", decision.source, decision.reason, bucket)
+    if decision.source == "pallas":
         from deepspeed_tpu.ops.pallas.flash_attention import flash_attention
 
-        # v5e measurements (docs/roofline.md): 512 best at short seq;
-        # 1024 wins from ~8K up (fewer grid steps amortize the packed
-        # triangle's per-step overhead — 128K fwd 124 vs 52 TF/s)
-        block = 1024 if seq >= 8192 else min(512, seq)
+        bq, bk = _pick_blocks(seq, decision.blocks)
         return flash_attention(q, k, v, causal=causal,
                                segment_ids=segment_ids,
-                               block_q=block, block_k=block)
+                               block_q=bq, block_k=bk)
     return xla_attention(q, k, v, causal=causal, segment_ids=segment_ids)

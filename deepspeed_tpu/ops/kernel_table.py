@@ -1,0 +1,195 @@
+"""Per-(kernel, shape-bucket) win/loss table driving Pallas-vs-XLA dispatch.
+
+The reference ships ~60k LoC of hand-written kernels and trusts them
+unconditionally; this repo makes every Pallas kernel justify its place
+with a measurement. ``tools/kernel_bench.py`` times each kernel against
+its XLA fallback per shape bucket (fwd+bwd where the kernel is
+differentiable) and persists the result here, next to the autotuned
+real-shape record (``docs/autotuned/kernel_table.json``).
+``ops/registry.py`` consults the table at dispatch time — compat
+probing stays the outer guard; a kernel runs on a bucket only when its
+measured win ratio (xla_ms / kernel_ms) is >= 1.0 there.
+
+Schema (kernel_table/v1)::
+
+    {"_meta": {"schema": "kernel_table/v1", "backend": "tpu", ...},
+     "entries": {
+       "flash_attention": {
+         "s8192_d64_causal": {"kernel_ms": 1.9, "xla_ms": 4.1,
+                              "ratio": 2.16, "backend": "tpu",
+                              "blocks": {"block_q": 1024,
+                                         "block_k": 1024}}}}}
+
+Entries are backend-scoped: a v5e measurement never drives a CPU run
+(there the interpreter-mode kernel always loses, and the legacy
+heuristic already answers "xla"). ``DSTPU_KERNEL_TABLE`` overrides the
+table path — tests use it to flip a bucket to losing and assert the
+registry routes that bucket to XLA bit-identically.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import threading
+from pathlib import Path
+from typing import Any, Dict, Optional
+
+SCHEMA = "kernel_table/v1"
+
+# docs/autotuned/kernel_table.json at the repo root, resolved relative
+# to this file so in-tree checkouts find it without an env var
+DEFAULT_TABLE = str(
+    Path(__file__).resolve().parents[2] / "docs" / "autotuned"
+    / "kernel_table.json")
+
+_LOCK = threading.Lock()
+_CACHE: Dict[str, Optional[Dict[str, Any]]] = {}
+
+
+def table_path() -> str:
+    return os.environ.get("DSTPU_KERNEL_TABLE", DEFAULT_TABLE)
+
+
+def invalidate_cache() -> None:
+    """Drop the parsed-table cache (tests swap tables via env var)."""
+    with _LOCK:
+        _CACHE.clear()
+
+
+def load_table(path: Optional[str] = None) -> Optional[Dict[str, Any]]:
+    """Parsed table dict, or None when absent/unreadable (never raises:
+    a missing table must degrade to the heuristic, not crash a step)."""
+    p = path or table_path()
+    with _LOCK:
+        if p in _CACHE:
+            return _CACHE[p]
+    try:
+        with open(p) as f:
+            data = json.load(f)
+        if not isinstance(data, dict) or "entries" not in data:
+            data = None
+    except Exception:
+        data = None
+    with _LOCK:
+        _CACHE[p] = data
+    return data
+
+
+# ---------------------------------------------------------------------------
+# shape bucketing
+# ---------------------------------------------------------------------------
+
+
+def bucket_pow2(n: int, lo: int = 128) -> int:
+    """Round ``n`` up to a power of two (floor ``lo``) — the same
+    compile-cache bucketing engine_v2 uses for prefill chunk lengths, so
+    one measured bucket covers every shape that compiles to it."""
+    b = lo
+    while b < n:
+        b *= 2
+    return b
+
+
+def attention_bucket(seq: int, head_dim: int, causal: bool) -> str:
+    """Bucket key for the attention kernels. Batch and head count are
+    folded out: the flash-vs-XLA crossover is dominated by S and D (the
+    grid is over B*N either way)."""
+    return (f"s{bucket_pow2(seq)}_d{head_dim}"
+            f"_{'causal' if causal else 'full'}")
+
+
+def gmm_bucket(m: int, k: int, n: int, groups: int) -> str:
+    """Bucket key for the grouped matmul: token rows bucket to powers of
+    two; k/n/group-count are architecture constants."""
+    return f"m{bucket_pow2(m)}_k{k}_n{n}_g{groups}"
+
+
+# ---------------------------------------------------------------------------
+# decisions
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class TableDecision:
+    measured: bool
+    win: bool
+    ratio: Optional[float]
+    blocks: Optional[Dict[str, int]]
+    reason: str
+
+
+def _backend() -> str:
+    try:
+        import jax
+
+        return jax.default_backend()
+    except Exception:
+        return "unknown"
+
+
+def decide(kernel: str, bucket: str,
+           path: Optional[str] = None) -> TableDecision:
+    """Look up (kernel, bucket) for the current backend.
+
+    Measured → win iff ratio >= 1.0 (ratio = xla_ms / kernel_ms; the
+    kernel must at least tie to earn the slot). Unmeasured → the caller
+    falls back to its legacy heuristic.
+    """
+    data = load_table(path)
+    if data is None:
+        return TableDecision(False, False, None, None, "no kernel table")
+    entry = (data.get("entries") or {}).get(kernel, {}).get(bucket)
+    if not isinstance(entry, dict):
+        return TableDecision(False, False, None, None,
+                             f"bucket {bucket} unmeasured")
+    be = entry.get("backend")
+    if be is not None and be != _backend():
+        return TableDecision(
+            False, False, None, None,
+            f"bucket {bucket} measured on {be}, running on {_backend()}")
+    try:
+        ratio = float(entry["ratio"])
+    except Exception:
+        return TableDecision(False, False, None, None,
+                             f"bucket {bucket} entry malformed")
+    blocks = entry.get("blocks")
+    if not isinstance(blocks, dict):
+        blocks = None
+    win = ratio >= 1.0
+    verdict = "win" if win else "loss"
+    return TableDecision(True, win, ratio, blocks,
+                         f"measured {verdict} ratio {ratio:.2f} on {bucket}")
+
+
+def record(kernel: str, bucket: str, kernel_ms: float, xla_ms: float,
+           blocks: Optional[Dict[str, int]] = None,
+           backend: Optional[str] = None,
+           path: Optional[str] = None) -> Dict[str, Any]:
+    """Persist one measurement (read-modify-write; kernel_bench calls
+    this per bucket). Returns the entry written."""
+    p = path or table_path()
+    try:
+        with open(p) as f:
+            data = json.load(f)
+        if not isinstance(data, dict):
+            data = {}
+    except Exception:
+        data = {}
+    data.setdefault("_meta", {})["schema"] = SCHEMA
+    entry = {
+        "kernel_ms": round(float(kernel_ms), 4),
+        "xla_ms": round(float(xla_ms), 4),
+        "ratio": round(float(xla_ms) / max(float(kernel_ms), 1e-9), 4),
+        "backend": backend or _backend(),
+    }
+    if blocks:
+        entry["blocks"] = {k: int(v) for k, v in blocks.items()}
+    data.setdefault("entries", {}).setdefault(kernel, {})[bucket] = entry
+    Path(p).parent.mkdir(parents=True, exist_ok=True)
+    with open(p, "w") as f:
+        json.dump(data, f, indent=2, sort_keys=True)
+        f.write("\n")
+    invalidate_cache()
+    return entry

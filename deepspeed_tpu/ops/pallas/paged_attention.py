@@ -74,9 +74,14 @@ def _visit(q_ref, kv_ref, m_ref, l_ref, acc_ref, visible, *, bs: int,
                    slice(n * gp, (n + 1) * gp), gp)
 
 
-def _kernel(bt_ref, ctx_ref, q_ref, kv_ref, out_ref,
-            m_ref, l_ref, acc_ref, *, bs: int, nkv: int, gp: int,
-            scale: float):
+def _kernel(bt_ref, ctx_ref, q_ref, *refs, bs: int, nkv: int, gp: int,
+            scale: float, pages: int):
+    # refs = pages kv page blocks, then out_ref + 3 scratch refs. The
+    # pages fold sequentially in ascending page order — the identical
+    # op sequence for every pages_per_compute_block, so outputs stay
+    # bit-identical across the autotuner's geometry candidates.
+    kv_refs = refs[:pages]
+    out_ref, m_ref, l_ref, acc_ref = refs[pages:]
     s = pl.program_id(0)
     j = pl.program_id(1)
     nj = pl.num_programs(1)
@@ -88,13 +93,18 @@ def _kernel(bt_ref, ctx_ref, q_ref, kv_ref, out_ref,
         acc_ref[...] = jnp.zeros_like(acc_ref)
 
     ctx = ctx_ref[s]
-    cols = j * bs + jax.lax.broadcasted_iota(jnp.int32, (gp, bs), 1)
-    visible = cols < ctx
+    for i, kv_ref in enumerate(kv_refs):
+        cols = ((j * pages + i) * bs
+                + jax.lax.broadcasted_iota(jnp.int32, (gp, bs), 1))
+        visible = cols < ctx
 
-    @pl.when(j * bs < ctx)  # pages past the context: no compute (and the
-    def _visit_page():      # index_map re-requests the same page: no DMA)
-        _visit(q_ref, kv_ref, m_ref, l_ref, acc_ref, visible,
-               bs=bs, nkv=nkv, gp=gp, scale=scale)
+        # pages past the context: no compute (and the index_map
+        # re-requests the same page: no DMA)
+        @pl.when((j * pages + i) * bs < ctx)
+        def _visit_page(kv_ref=kv_ref, visible=visible):
+            _visit(q_ref, kv_ref, m_ref, l_ref, acc_ref, visible,
+                   bs=bs, nkv=nkv, gp=gp, scale=scale)
+
     @pl.when(j == nj - 1)
     def _finalize():
         for n in range(nkv):
@@ -104,9 +114,10 @@ def _kernel(bt_ref, ctx_ref, q_ref, kv_ref, out_ref,
             out_ref[0, n] = (acc_ref[rows, :] / l).astype(out_ref.dtype)
 
 
-def _prefill_kernel(pos0_ref, ctx_ref, bt_ref, q_ref, kv_ref, out_ref,
-                    m_ref, l_ref, acc_ref, *, bs: int, nkv: int, g: int,
-                    tq: int, scale: float):
+def _prefill_kernel(pos0_ref, ctx_ref, bt_ref, q_ref, *refs, bs: int,
+                    nkv: int, g: int, tq: int, scale: float, pages: int):
+    kv_refs = refs[:pages]
+    out_ref, m_ref, l_ref, acc_ref = refs[pages:]
     s = pl.program_id(0)
     j = pl.program_id(1)
     nj = pl.num_programs(1)
@@ -122,21 +133,23 @@ def _prefill_kernel(pos0_ref, ctx_ref, bt_ref, q_ref, kv_ref, out_ref,
     ctx = ctx_ref[s]
     # query absolute position per row (row r = query r // g, group r % g)
     qpos = pos0 + jax.lax.broadcasted_iota(jnp.int32, (rows, bs), 0) // g
-    cols = j * bs + jax.lax.broadcasted_iota(jnp.int32, (rows, bs), 1)
-    # causal within the segment + bounded by the segment's total context;
-    # dead/padded segments have ctx == 0 -> nothing visible
-    visible = jnp.logical_and(cols <= qpos, cols < ctx)
+    for i, kv_ref in enumerate(kv_refs):
+        cols = ((j * pages + i) * bs
+                + jax.lax.broadcasted_iota(jnp.int32, (rows, bs), 1))
+        # causal within the segment + bounded by the segment's total
+        # context; dead/padded segments have ctx == 0 -> nothing visible
+        visible = jnp.logical_and(cols <= qpos, cols < ctx)
 
-    @pl.when(j * bs < ctx)
-    def _visit_page():
-        for n in range(nkv):
-            # q layout is [S, nkv, tq*g, hd] (wrapper pre-transposes):
-            # only leading-dim integer indexing, which Mosaic supports
-            q = q_ref[0, n].astype(jnp.float32) * scale  # [rows, hd]
-            k = kv_ref[0, :, 0, n].astype(jnp.float32)   # [bs, hd]
-            v = kv_ref[0, :, 1, n].astype(jnp.float32)
-            _fold_page(q, k, v, visible, m_ref, l_ref, acc_ref,
-                       slice(n * rows, (n + 1) * rows), rows)
+        @pl.when((j * pages + i) * bs < ctx)
+        def _visit_page(kv_ref=kv_ref, visible=visible):
+            for n in range(nkv):
+                # q layout is [S, nkv, tq*g, hd] (wrapper pre-transposes):
+                # only leading-dim integer indexing, which Mosaic supports
+                q = q_ref[0, n].astype(jnp.float32) * scale  # [rows, hd]
+                k = kv_ref[0, :, 0, n].astype(jnp.float32)   # [bs, hd]
+                v = kv_ref[0, :, 1, n].astype(jnp.float32)
+                _fold_page(q, k, v, visible, m_ref, l_ref, acc_ref,
+                           slice(n * rows, (n + 1) * rows), rows)
 
     @pl.when(j == nj - 1)
     def _finalize():
@@ -150,7 +163,8 @@ def _prefill_kernel(pos0_ref, ctx_ref, bt_ref, q_ref, kv_ref, out_ref,
 def paged_prefill_attention(q: jax.Array, kv_layer: jax.Array,
                             block_table: jax.Array, seg_pos0: jax.Array,
                             context_lens: jax.Array,
-                            scale: float = None) -> jax.Array:
+                            scale: float = None,
+                            pages_per_compute_block: int = 1) -> jax.Array:
     """Chunked-prefill attention over paged KV (SplitFuse chunk step).
 
     Each segment is one sequence's contiguous chunk of ``Tq`` new tokens
@@ -166,6 +180,11 @@ def paged_prefill_attention(q: jax.Array, kv_layer: jax.Array,
     seg_pos0     [S] absolute position of each segment's first query
     context_lens [S] keys visible to the segment's LAST query (pos0 +
                  n_real_tokens); 0 marks a dead segment
+
+    ``pages_per_compute_block`` (kernels config / autotuner axis) folds
+    that many KV pages per grid step — fewer grid steps, more DMA in
+    flight per step. Outputs are bit-identical for every legal value
+    (pages fold in the same sequential order).
 
     Returns [S, Tq, num_heads, head_dim] in q.dtype.
     """
@@ -186,21 +205,28 @@ def paged_prefill_attention(q: jax.Array, kv_layer: jax.Array,
           .transpose(0, 2, 1, 3, 4)
           .reshape(S, nkv, tq * g, hd))
 
-    def page(s, j, pos0, ctx, bt):
+    P = max(1, min(int(pages_per_compute_block), Bm))
+
+    def page(s, j, pos0, ctx, bt, i=0):
+        # clamp beyond-context iterations to the last live page: Mosaic
+        # skips the DMA when consecutive grid steps request the same block
         last = jax.lax.max(ctx[s] - 1, 0) // bs
-        j_eff = jax.lax.min(j, last)
+        j_eff = jax.lax.min(j * P + i, last)
         return jax.lax.min(jax.lax.max(bt[s, j_eff], 0), nb - 1)
+
+    def kv_spec(i):
+        return pl.BlockSpec(
+            (1, bs, 2, nkv, hd),
+            lambda s, j, pos0, ctx, bt: (page(s, j, pos0, ctx, bt, i),
+                                         0, 0, 0, 0))
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=3,
-        grid=(S, Bm),
+        grid=(S, -(-Bm // P)),
         in_specs=[
             pl.BlockSpec((1, nkv, tq * g, hd),
                          lambda s, j, pos0, ctx, bt: (s, 0, 0, 0)),
-            pl.BlockSpec((1, bs, 2, nkv, hd),
-                         lambda s, j, pos0, ctx, bt: (page(s, j, pos0, ctx,
-                                                          bt), 0, 0, 0, 0)),
-        ],
+        ] + [kv_spec(i) for i in range(P)],
         out_specs=pl.BlockSpec((1, nkv, tq * g, hd),
                                lambda s, j, pos0, ctx, bt: (s, 0, 0, 0)),
         scratch_shapes=[
@@ -211,12 +237,12 @@ def paged_prefill_attention(q: jax.Array, kv_layer: jax.Array,
     )
     out = pl.pallas_call(
         functools.partial(_prefill_kernel, bs=bs, nkv=nkv, g=g, tq=tq,
-                          scale=float(scale)),
+                          scale=float(scale), pages=P),
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((S, nkv, tq * g, hd), q.dtype),
         interpret=_interpret(),
     )(seg_pos0.astype(jnp.int32), context_lens.astype(jnp.int32),
-      block_table.astype(jnp.int32), qg, kv_layer)
+      block_table.astype(jnp.int32), qg, *([kv_layer] * P))
     return (out.reshape(S, nkv, tq, g, hd)
             .transpose(0, 2, 1, 3, 4)
             .reshape(S, tq, nh, hd))
@@ -224,7 +250,8 @@ def paged_prefill_attention(q: jax.Array, kv_layer: jax.Array,
 
 def paged_decode_attention(q: jax.Array, kv_layer: jax.Array,
                            block_table: jax.Array, context_lens: jax.Array,
-                           scale: float = None) -> jax.Array:
+                           scale: float = None,
+                           pages_per_compute_block: int = 1) -> jax.Array:
     """Decode attention over a paged KV pool.
 
     q            [S, num_heads, head_dim] — one query token per sequence
@@ -233,6 +260,10 @@ def paged_decode_attention(q: jax.Array, kv_layer: jax.Array,
                  may be stale/scratch; they are read but masked)
     context_lens [S] int32 — keys visible per sequence (including the
                  token written this step); 0 marks a dead slot (output 0)
+
+    ``pages_per_compute_block`` folds that many KV pages per grid step
+    (kernels config / autotuner axis); bit-identical for every legal
+    value — the pages fold in the same sequential order.
 
     Returns [S, num_heads, head_dim] in q.dtype.
     """
@@ -250,22 +281,26 @@ def paged_decode_attention(q: jax.Array, kv_layer: jax.Array,
     if gp != g:
         qg = jnp.pad(qg, ((0, 0), (0, 0), (0, gp - g), (0, 0)))
 
-    def page(s, j, bt, ctx):
+    P = max(1, min(int(pages_per_compute_block), Bm))
+
+    def page(s, j, bt, ctx, i=0):
         # clamp beyond-context iterations to the last live page: Mosaic
         # skips the DMA when consecutive grid steps request the same block
         last = jax.lax.max(ctx[s] - 1, 0) // bs
-        j_eff = jax.lax.min(j, last)
+        j_eff = jax.lax.min(j * P + i, last)
         return jax.lax.min(jax.lax.max(bt[s, j_eff], 0), nb - 1)
+
+    def kv_spec(i):
+        return pl.BlockSpec(
+            (1, bs, 2, nkv, hd),
+            lambda s, j, bt, ctx: (page(s, j, bt, ctx, i), 0, 0, 0, 0))
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
-        grid=(S, Bm),
+        grid=(S, -(-Bm // P)),
         in_specs=[
             pl.BlockSpec((1, nkv, gp, hd), lambda s, j, bt, ctx: (s, 0, 0, 0)),
-            pl.BlockSpec((1, bs, 2, nkv, hd),
-                         lambda s, j, bt, ctx: (page(s, j, bt, ctx),
-                                                0, 0, 0, 0)),
-        ],
+        ] + [kv_spec(i) for i in range(P)],
         out_specs=pl.BlockSpec((1, nkv, gp, hd),
                                lambda s, j, bt, ctx: (s, 0, 0, 0)),
         scratch_shapes=[
@@ -276,10 +311,10 @@ def paged_decode_attention(q: jax.Array, kv_layer: jax.Array,
     )
     out = pl.pallas_call(
         functools.partial(_kernel, bs=bs, nkv=nkv, gp=gp,
-                          scale=float(scale)),
+                          scale=float(scale), pages=P),
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((S, nkv, gp, hd), q.dtype),
         interpret=_interpret(),
     )(block_table.astype(jnp.int32), context_lens.astype(jnp.int32),
-      qg, kv_layer)
+      qg, *([kv_layer] * P))
     return out[:, :, :g, :].reshape(S, nh, hd)

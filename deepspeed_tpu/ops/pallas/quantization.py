@@ -170,6 +170,18 @@ def kv_dequantize(q: jax.Array, scale: jax.Array, bits: int = 8,
             ).astype(dtype)
 
 
+def kv_pack(q: jax.Array, bits: int) -> jax.Array:
+    """Storage codec for the quantized KV pool: int8 values pass through;
+    int4 packs two per byte (uint8 payload, last dim head_dim//2 — the
+    same nibble codec the disagg handoff wire uses)."""
+    return pack_int4(q) if bits == 4 else q
+
+
+def kv_unpack(p: jax.Array, bits: int) -> jax.Array:
+    """Inverse of kv_pack: uint8 nibble payload → int8 values in [-8, 7]."""
+    return unpack_int4(p) if bits == 4 else p
+
+
 # ---------------------------------------------------------------------------
 # quantized collectives (shard_map bodies)
 # ---------------------------------------------------------------------------

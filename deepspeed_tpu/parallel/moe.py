@@ -223,8 +223,14 @@ def _expert_ffn(sorted_x: jax.Array, group_sizes: jax.Array,
                 expert_params: Dict[str, jax.Array], activation: str,
                 dt) -> jax.Array:
     """Grouped-GEMM expert FFN over rows sorted by (local) expert."""
-    from deepspeed_tpu.ops.pallas.grouped_matmul import gmm
+    import functools
 
+    from deepspeed_tpu.ops import attention as attn_ops
+    from deepspeed_tpu.ops.pallas.grouped_matmul import gmm as gmm_raw
+
+    # engine-installed tile geometry (config.kernels.gmm_block_{m,n,k});
+    # gmm snaps each to the largest legal divisor per operand shape
+    gmm = functools.partial(gmm_raw, **attn_ops.kernel_gmm_tiles())
     wi, wo = expert_params["wi"].astype(dt), expert_params["wo"].astype(dt)
     if activation == "swiglu":
         wg = expert_params["wg"].astype(dt)

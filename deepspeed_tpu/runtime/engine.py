@@ -175,11 +175,19 @@ class Engine:
         from deepspeed_tpu.ops import attention as attn_ops
 
         if config.sparse_attention is not None:
+            import dataclasses as _dc
+
             from deepspeed_tpu.ops.pallas.blocksparse_attention import \
                 from_config as sparse_from_config
 
-            attn_ops.set_sparse_config(
-                sparse_from_config(config.sparse_attention))
+            scfg = config.sparse_attention
+            kblk = getattr(getattr(config, "kernels", None),
+                           "blocksparse_block", 0)
+            if kblk and kblk != scfg.block:
+                # kernels.blocksparse_block overrides the layout/kernel
+                # block granularity (0 = follow sparse_attention.block)
+                scfg = _dc.replace(scfg, block=kblk)
+            attn_ops.set_sparse_config(sparse_from_config(scfg))
             if getattr(getattr(model, "config", None), "attn_impl",
                        None) != "blocksparse":
                 logger.warning(
@@ -196,6 +204,12 @@ class Engine:
             # a previous engine in this process may have installed a
             # layout into the process-global dispatcher — clear it
             attn_ops.set_sparse_config(None)
+
+        # kernel geometry + dispatch policy (config.kernels): block sizes
+        # and the cost-table dispatch mode feed the same process-global
+        # dispatcher the sparse layout uses — multi_head_attention and the
+        # paged serving path read them at trace time
+        attn_ops.set_kernel_config(getattr(config, "kernels", None))
 
         # -- MoE expert execution engine selection (config.moe.impl) ------
         mcfg = getattr(model, "config", None)

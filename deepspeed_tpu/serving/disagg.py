@@ -186,7 +186,9 @@ def serialize_prefix(engine, tokens,
                   if getattr(kvc, "scales", None) is not None else None)
     finally:
         cache.unref(keys)
-    wire_bits, packed, wire_snr = src_bits, False, None
+    # an int4 pool's native payload is already nibble-packed — mark it
+    # so head_dim geometry and the installer's unpack stay correct
+    wire_bits, packed, wire_snr = src_bits, src_bits == 4, None
     if src_bits is None and wire in ("int8", "int4"):
         import jax.numpy as jnp
 
@@ -274,6 +276,7 @@ def install_prefix(engine, handoff: Optional[KVHandoff]
 
     from deepspeed_tpu.ops.pallas.quantization import (kv_dequantize,
                                                        kv_quantize,
+                                                       pack_int4,
                                                        unpack_int4)
 
     blocks = kvc.allocator.allocate(need)
@@ -287,12 +290,19 @@ def install_prefix(engine, handoff: Optional[KVHandoff]
     if dst_bits is not None:
         if handoff.wire_bits is None:
             # raw bf16 wire into a quantized pool: quantize-on-install
-            q, s = kv_quantize(payload)
+            q, s = kv_quantize(payload, bits=dst_bits)
+        elif dst_bits == 4 and handoff.wire_bits == 8:
+            # int8 wire values overflow the int4 grid: requantize on the
+            # coarser grid (the precision-mismatch warn above fired)
+            q, s = kv_quantize(
+                kv_dequantize(payload, ssel, dtype=jnp.float32), bits=4)
         else:
             # int8/int4 values install directly — dequant is q*s either
             # way, int4 just lands on a coarser grid
             q, s = payload.astype(jnp.int8), ssel
-        kvc.data = kvc.data.at[:, bidx].set(q)
+        if dst_bits == 4:
+            q = pack_int4(q.astype(jnp.int8))
+        kvc.data = kvc.data.at[:, bidx].set(q.astype(kvc.data.dtype))
         kvc.scales = kvc.scales.at[:, bidx].set(s)
     else:
         if handoff.wire_bits is None:

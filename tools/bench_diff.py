@@ -16,7 +16,10 @@ metric and **exits nonzero** when a metric crosses its threshold:
 - ``host_gap_ms``: max ratio 1.5 (noisy on a shared host — loose);
 - quantization gates (``BENCH_QUANT`` payloads): the new round's
   ``ok`` flag must be true and ``value`` (gate violations) must not
-  grow — the quant SNR gates re-checked at diff time.
+  grow — the quant SNR gates re-checked at diff time;
+- kernel tier (``BENCH_KERNELS`` payloads): every kernel:bucket in the
+  old round's ``winning_kernels`` must still be winning, and
+  ``flash_fallback_ratio`` must not rise by more than 0.10.
 
 Rounds with a different metric/unit (the headline changed shape, e.g.
 zero3 train → device fwd+bwd) are *incomparable*: reported, but only a
@@ -71,6 +74,12 @@ DEFAULT_THRESHOLDS: Dict[str, Tuple[str, float]] = {
     # blowup (a broken failover path, not scheduling noise) fails
     "kv_wire_ratio": ("max_ratio", 1.15),
     "ttft_p999_ms": ("max_ratio", 1.5),
+    # kernel tier (BENCH_KERNELS payloads): a kernel that won its bucket
+    # last round must still win (a silent all-XLA regression is exactly
+    # the failure the table-driven dispatch exists to catch), and the
+    # share of flash-worthy dispatches that lost the kernel must not
+    # creep up by more than 10 points
+    "flash_fallback_ratio": ("max_increase", 0.10),
 }
 
 # units where a larger headline value is worse
@@ -184,7 +193,25 @@ def diff_reports(old: Dict[str, Any], new: Dict[str, Any],
                 rule, limit = th[key]
                 ratio = nv / ov
                 check(key, rule, limit, ov, nv, ratio, ratio <= limit)
-        for arm in ("bf16", "int8"):
+        # kernel tier sentinels (BENCH_KERNELS payloads): no previously
+        # winning kernel may regress to losing, and the flash fallback
+        # ratio may not silently creep toward all-XLA
+        o_win, n_win = old.get("winning_kernels"), new.get("winning_kernels")
+        if isinstance(o_win, list) and isinstance(n_win, list):
+            regressed = sorted(set(o_win) - set(n_win))
+            check("winning_kernels", "no_regression", 0,
+                  len(o_win), len(n_win), float(len(regressed)),
+                  not regressed)
+            if regressed:
+                violations[-1]["regressed"] = regressed
+        ov = old.get("flash_fallback_ratio")
+        nv = new.get("flash_fallback_ratio")
+        if isinstance(ov, (int, float)) and isinstance(nv, (int, float)):
+            rule, limit = th["flash_fallback_ratio"]
+            rise = nv - ov
+            check("flash_fallback_ratio", rule, limit, ov, nv, rise,
+                  rise <= limit)
+        for arm in ("bf16", "int8", "int4"):
             o_arm = old.get(arm) if isinstance(old.get(arm), dict) else {}
             n_arm = new.get(arm) if isinstance(new.get(arm), dict) else {}
             ov = o_arm.get("peak_concurrent_sessions")

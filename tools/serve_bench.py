@@ -648,6 +648,12 @@ def run_quant() -> dict:
       raw vs int4-packed (serving/disagg.py); the quantized wire must
       ship <= ``QUANT_SERVE_MAX_WIRE_FRAC`` (default 0.35) of the raw
       bytes.
+    - **int4 storage arm** — the packed-nibble uint8 pool serves
+      >= ``QUANT_SERVE_MIN_SESSIONS_RATIO_INT4`` (default 1.7) x the
+      int8 arm's sessions on the same budget (head_dim 128: 1.94x
+      blocks), and the codec's decode round-trip on the bf16 arm's real
+      KV pool must hold >= ``QUANT_SERVE_MIN_DECODE_SNR_DB`` (default
+      14 dB; per-vector int4 measures ~18-19 dB).
 
     Violations ride the payload's ``ok``/``violations`` keys, the same
     contract as ``make bench-quant`` — ``tools/bench_diff.py`` fails the
@@ -674,6 +680,14 @@ def run_quant() -> dict:
     base_sessions = int(os.environ.get("QUANT_SERVE_SESSIONS",
                                        16 if on_tpu else 6))
     min_ratio = float(os.environ.get("QUANT_SERVE_MIN_SESSIONS_RATIO", 1.8))
+    # int4 arm: packed-nibble pool must roughly double int8's capacity
+    # again (head_dim 128: (128+4)/(64+4) = 1.94x blocks) and its
+    # decoded KV must stay above the SNR floor — per-vector int4
+    # measures ~18-19 dB on gaussian KV, a broken codec lands near 0
+    min_ratio4 = float(os.environ.get(
+        "QUANT_SERVE_MIN_SESSIONS_RATIO_INT4", 1.7))
+    min_snr4 = float(os.environ.get(
+        "QUANT_SERVE_MIN_DECODE_SNR_DB", 14.0))
     max_wire = float(os.environ.get("QUANT_SERVE_MAX_WIRE_FRAC", 0.35))
     block = 16
     max_seq_len = 1 << (prompt_len + gen + 1).bit_length()
@@ -739,8 +753,29 @@ def run_quant() -> dict:
 
     bf16_engine, bf16_prompts, bf16_arm = drive_arm(None)
     _, _, int8_arm = drive_arm(8)
+    _, _, int4_arm = drive_arm(4)
     ratio = (int8_arm["peak_concurrent_sessions"]
              / max(bf16_arm["peak_concurrent_sessions"], 1))
+    ratio4 = (int4_arm["peak_concurrent_sessions"]
+              / max(int8_arm["peak_concurrent_sessions"], 1))
+
+    # decode-SNR of the packed-nibble codec on the bf16 arm's REAL kv
+    # pool (the blocks the serve run just wrote, not synthetic data):
+    # quantize → pack → unpack → dequantize round-trip
+    from deepspeed_tpu.ops.pallas.quantization import (
+        kv_dequantize, kv_pack, kv_quantize, kv_unpack)
+
+    pool = np.asarray(bf16_engine.kv_cache.data, np.float32)
+    live = np.abs(pool).reshape(pool.shape[0], pool.shape[1], -1).sum(
+        (0, 2)) > 0
+    sample = jnp.asarray(pool[:, live][:, :8])
+    q4, s4 = kv_quantize(sample, bits=4)
+    back = np.asarray(kv_dequantize(kv_unpack(kv_pack(q4, 4), 4), s4,
+                                    dtype=jnp.float32))
+    src = np.asarray(sample, np.float32)
+    noise = float(((src - back) ** 2).mean())
+    decode_snr_db = float(10.0 * np.log10(
+        max(float((src ** 2).mean()), 1e-12) / max(noise, 1e-12)))
 
     # handoff wire: the SAME cached chain raw vs int4-packed
     raw_h = disagg.serialize_prefix(bf16_engine, bf16_prompts[0],
@@ -755,6 +790,14 @@ def run_quant() -> dict:
         violations.append({
             "region": "kv_capacity", "gate": "min_sessions_ratio",
             "limit": min_ratio, "got": round(ratio, 3)})
+    if ratio4 < min_ratio4:
+        violations.append({
+            "region": "kv_capacity", "gate": "min_sessions_ratio_int4",
+            "limit": min_ratio4, "got": round(ratio4, 3)})
+    if decode_snr_db < min_snr4:
+        violations.append({
+            "region": "kv_decode", "gate": "min_decode_snr_db",
+            "limit": min_snr4, "got": round(decode_snr_db, 2)})
     if wire_frac is None:
         violations.append({
             "region": "kv_wire", "gate": "serialized",
@@ -772,6 +815,9 @@ def run_quant() -> dict:
         "hbm_budget_bytes": int(hbm_budget),
         "bf16": bf16_arm,
         "int8": int8_arm,
+        "int4": int4_arm,
+        "int4_sessions_ratio": round(ratio4, 3),
+        "int4_decode_snr_db": round(decode_snr_db, 2),
         "handoff_wire_bytes_raw": (raw_h.wire_nbytes
                                    if raw_h is not None else None),
         "handoff_wire_bytes_int4": (q_h.wire_nbytes
