@@ -14,7 +14,7 @@ NPROC := $(shell nproc)
 XDIST ?= $(shell if [ $(NPROC) -gt 2 ] && python -c "import xdist" 2>/dev/null; then echo "-n $$(( $(NPROC) - 1 )) --dist loadfile"; fi)
 PYTEST ?= python -m pytest
 
-.PHONY: test smoke slow bench bench-real bench-proxy bench-hostgap bench-overlap bench-longctx bench-quant bench-kernels bench-diff quant-sweep fleet-demo chaos serve-slo serve-fleet serve-quant serve-procs
+.PHONY: test smoke slow bench bench-real bench-proxy bench-hostgap bench-overlap bench-longctx bench-quant bench-kernels bench-diff quant-sweep fleet-demo chaos serve-slo serve-fleet serve-quant serve-procs chaos-fleet
 
 smoke:
 	$(PYTEST) tests/ -q -m "not slow" $(XDIST)
@@ -154,6 +154,24 @@ serve-quant:
 # (docs/serving.md "Cross-process fleet").
 serve-procs:
 	BENCH_MODE=serve_procs python bench.py
+
+# Chaos-certified fleet (tools/serve_bench.py run_chaos_fleet): the full
+# transport fault matrix injected INSIDE the socket channel's wire path —
+# seeded frame drops, fixed per-frame delay, frame duplication, payload
+# byte corruption (CRC trip), and a one-way partition blackholing one
+# replica — plus mid-run SIGKILL, a crash-looping worker (quarantined by
+# the restart circuit breaker), and a hedged-requests arm against a slow
+# replica. Every arm replays the serve-procs diurnal+bursty schedule and
+# must finish with zero drops and token streams bit-identical to the
+# fault-free baseline (greedy decoding makes recovery observable);
+# crash-loop must quarantine without flapping while holding the
+# min-healthy floor, and the hedge arm must record >= 1 hedge win. The
+# one JSON line carries chaos.* keys bench_diff sentinels consume
+# (chaos.zero_drops must stay true, chaos.ttft_p999_ratio bounded).
+# CPU defaults; scale with CHAOS_FLEET_REQUESTS/CHAOS_FLEET_ARMS
+# (docs/resilience.md "Serving fleet fault matrix").
+chaos-fleet:
+	BENCH_MODE=chaos_fleet python bench.py
 
 # Fault-injection drill on the 8-device CPU sim: SIGKILL a training rank
 # mid-run, let the elastic agent restart it, and assert the auto-resumed

@@ -305,7 +305,7 @@ def overlap_report(model, step_ms, overlap_depth, streaming,
 def main():
     if os.environ.get("BENCH_MODE") in ("serve", "serve_slo",
                                         "serve_fleet", "serve_quant",
-                                        "serve_procs"):
+                                        "serve_procs", "chaos_fleet"):
         # serving benchmarks instead of the training headline
         # (tools/serve_bench.py): "serve" is the closed-loop v2-vs-v1
         # throughput comparison (SERVE_* env knobs); "serve_slo" is the
@@ -320,7 +320,11 @@ def main():
         # raw-vs-int4 handoff wire bytes (QUANT_SERVE_* env knobs);
         # "serve_procs" is the cross-process fleet — worker subprocesses
         # behind the socket transport, routing A/B + chaos + disagg
-        # arms over one diurnal/bursty schedule (PROCS_* env knobs)
+        # arms over one diurnal/bursty schedule (PROCS_* env knobs);
+        # "chaos_fleet" is the fault-matrix certification — every
+        # transport fault family (drop/delay/dup/corrupt/partition)
+        # plus kill/crash-loop/hedge arms over the same schedule, gated
+        # on zero drops + bit-identical streams (CHAOS_FLEET_* knobs)
         import sys
 
         sys.path.insert(0, os.path.join(os.path.dirname(
@@ -342,6 +346,11 @@ def main():
             print(json.dumps(procs_payload))
             if not procs_payload.get("ok", True):
                 sys.exit(1)  # gates: routing A/B, zero drops, wire ratio
+        elif os.environ.get("BENCH_MODE") == "chaos_fleet":
+            chaos_payload = serve_bench.run_chaos_fleet()
+            print(json.dumps(chaos_payload))
+            if not chaos_payload.get("ok", True):
+                sys.exit(1)  # gates: zero drops, bit-identical, p99.9
         else:
             print(json.dumps(serve_bench.run()))
         return

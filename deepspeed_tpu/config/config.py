@@ -661,8 +661,30 @@ class RouterConfig(ConfigModel):
     replicas — ``inproc`` (threads, no processes), ``socket``
     (localhost TCP, the primary), or ``file`` (spool-dir frames, the
     socketless fallback; docs/serving.md degraded-mode matrix) — with
-    ``max_frame_mb``/``connect_retries``/``connect_backoff_seconds``
-    bounding the frame size and the dial-with-backoff schedule."""
+    ``max_frame_mb`` bounding the frame size. The dial-with-backoff
+    schedule is a resilience ``RetryPolicy`` built by
+    :meth:`connect_retry_policy` from ``connect_retries`` /
+    ``connect_backoff_seconds`` / ``connect_backoff_max_seconds``
+    (the first two predate the policy and stay as aliases).
+
+    Health state machine (docs/serving.md "Replica health"):
+    ``health_mode`` is ``state_machine`` (healthy → suspect → dead with
+    hysteresis) or ``legacy`` (the single stale-heartbeat flip,
+    bit-exact pre-PR-15 routing). ``suspect_after_seconds`` is the
+    heartbeat age that demotes to suspect (0 = half of
+    ``stale_after_seconds``); ``transport_error_dead`` consecutive
+    channel errors declare dead; ``health_recover_checks`` consecutive
+    clean checks promote suspect back to healthy.
+
+    Hedged requests: after ``hedge_ttft_factor`` x the predicted TTFT
+    (floored at ``hedge_min_seconds``) with no first token, the router
+    resubmits to a second replica and keeps whichever stream emits
+    first — greedy decode makes the winner bit-identical either way.
+
+    Crash-loop containment (serving/supervisor.py): a lineage crashing
+    more than ``max_restarts_per_window`` times inside
+    ``restart_window_seconds`` is quarantined instead of restarted;
+    ``min_healthy`` is the floor below which drains are refused."""
 
     replicas: int = 2
     mode: str = "unified"
@@ -680,6 +702,29 @@ class RouterConfig(ConfigModel):
     max_frame_mb: int = 64
     connect_retries: int = 40
     connect_backoff_seconds: float = 0.05
+    connect_backoff_max_seconds: float = 1.0
+    health_mode: str = "state_machine"
+    suspect_after_seconds: float = 0.0  # 0 => stale_after_seconds / 2
+    transport_error_dead: int = 3
+    health_recover_checks: int = 2
+    hedge_enabled: bool = False
+    hedge_ttft_factor: float = 3.0
+    hedge_min_seconds: float = 0.25
+    max_restarts_per_window: int = 3
+    restart_window_seconds: float = 30.0
+    min_healthy: int = 1
+
+    def connect_retry_policy(self):
+        """The transport dial schedule as a resilience
+        :class:`RetryPolicy` — jitter 0 so reconnect timing stays
+        deterministic under the chaos gates."""
+        from deepspeed_tpu.resilience.policy import RetryPolicy
+
+        return RetryPolicy(
+            max_retries=max(0, self.connect_retries - 1),
+            backoff_base_s=self.connect_backoff_seconds,
+            backoff_max_s=self.connect_backoff_max_seconds,
+            jitter=0.0)
 
     def validate(self) -> None:
         if self.mode not in ("unified", "disagg"):
@@ -731,6 +776,42 @@ class RouterConfig(ConfigModel):
                 f"connect_backoff_seconds > 0, got "
                 f"({self.connect_retries}, "
                 f"{self.connect_backoff_seconds})")
+        if self.connect_backoff_max_seconds < self.connect_backoff_seconds:
+            raise ValueError(
+                f"serving.router.connect_backoff_max_seconds must be >= "
+                f"connect_backoff_seconds, got "
+                f"{self.connect_backoff_max_seconds}")
+        if self.health_mode not in ("state_machine", "legacy"):
+            raise ValueError(
+                f"serving.router.health_mode must be state_machine|"
+                f"legacy, got {self.health_mode!r}")
+        if self.suspect_after_seconds < 0:
+            raise ValueError(
+                f"serving.router.suspect_after_seconds must be >= 0 "
+                f"(0 = stale_after_seconds/2), got "
+                f"{self.suspect_after_seconds}")
+        if self.transport_error_dead < 1 or self.health_recover_checks < 1:
+            raise ValueError(
+                f"serving.router needs transport_error_dead >= 1 and "
+                f"health_recover_checks >= 1, got "
+                f"({self.transport_error_dead}, "
+                f"{self.health_recover_checks})")
+        if self.hedge_ttft_factor <= 0 or self.hedge_min_seconds < 0:
+            raise ValueError(
+                f"serving.router needs hedge_ttft_factor > 0 and "
+                f"hedge_min_seconds >= 0, got "
+                f"({self.hedge_ttft_factor}, {self.hedge_min_seconds})")
+        if self.max_restarts_per_window < 1 \
+                or self.restart_window_seconds <= 0:
+            raise ValueError(
+                f"serving.router needs max_restarts_per_window >= 1 and "
+                f"restart_window_seconds > 0, got "
+                f"({self.max_restarts_per_window}, "
+                f"{self.restart_window_seconds})")
+        if self.min_healthy < 1:
+            raise ValueError(
+                f"serving.router.min_healthy must be >= 1, got "
+                f"{self.min_healthy}")
 
 
 @register_config_model

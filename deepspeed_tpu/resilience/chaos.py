@@ -16,6 +16,8 @@ without config plumbing::
     DSTPU_CHAOS="kill_rank=1,kill_step=3,kill_signal=SIGKILL"
     DSTPU_CHAOS="collective_k=5,collective_mode=delay,collective_delay_s=2"
     DSTPU_CHAOS="stall_input_step=2,stall_input_s=1.5"
+    DSTPU_CHAOS="net_drop_frac=0.05,net_seed=7"
+    DSTPU_CHAOS="net_partition=r1:20"
 
 The injector is process-global (:func:`get_chaos_injector`) and inert
 unless a spec is armed — the hooks in the engine/comm hot paths cost one
@@ -27,11 +29,12 @@ of an unexplained death.
 from __future__ import annotations
 
 import os
+import random
 import signal
 import threading
 import time
 from dataclasses import dataclass
-from typing import Any, Dict, Optional
+from typing import Any, Dict, List, Optional
 
 from deepspeed_tpu.utils.logging import logger
 
@@ -63,6 +66,16 @@ class ChaosSpec:
       configured ``collective_timeout_s`` should catch).
     stall_input_step/stall_input_s: sleep inside the input pipeline at
       the given batch pull (1-based) — models a slow data source.
+    net_*: the transport fault family, evaluated inside the serving
+      channels (serving/transport/channel.py) so faults hit real bytes
+      on the wire. ``net_drop_frac`` drops that fraction of outbound
+      frames (seeded by ``net_seed``); ``net_delay_ms`` sleeps before
+      each outbound frame; ``net_dup`` duplicates every Nth frame;
+      ``net_corrupt`` flips one payload byte of every Nth frame (the
+      CRC catches it at the receiver); ``net_partition=rN:K`` blackholes
+      both directions of peer N's link for its first K wire ops, then
+      heals — the receiver's per-channel sequence numbers turn silent
+      drops into a detectable gap.
     """
 
     kill_rank: Optional[int] = None
@@ -74,10 +87,18 @@ class ChaosSpec:
     collective_op: Optional[str] = None
     stall_input_step: Optional[int] = None
     stall_input_s: float = 0.0
+    net_drop_frac: float = 0.0
+    net_delay_ms: float = 0.0
+    net_dup: Optional[int] = None
+    net_corrupt: Optional[int] = None
+    net_partition: Optional[str] = None
+    net_seed: Optional[int] = None
 
     _INT_FIELDS = ("kill_rank", "kill_step", "collective_k",
-                   "stall_input_step")
-    _FLOAT_FIELDS = ("collective_delay_s", "stall_input_s")
+                   "stall_input_step", "net_dup", "net_corrupt",
+                   "net_seed")
+    _FLOAT_FIELDS = ("collective_delay_s", "stall_input_s",
+                     "net_drop_frac", "net_delay_ms")
 
     @classmethod
     def parse(cls, text: str) -> "ChaosSpec":
@@ -112,7 +133,39 @@ class ChaosSpec:
             raise ValueError(
                 f"{CHAOS_ENV}: collective_mode must be fail|delay, got "
                 f"{spec.collective_mode!r}")
+        if not 0.0 <= spec.net_drop_frac < 1.0:
+            raise ValueError(
+                f"{CHAOS_ENV}: net_drop_frac must be in [0, 1), got "
+                f"{spec.net_drop_frac}")
+        spec.partition_target()  # validate rN:K early, not on the wire
         return spec
+
+    def partition_target(self) -> Optional[tuple]:
+        """``net_partition="rN:K"`` → (peer N, K wire ops blackholed)."""
+        if not self.net_partition:
+            return None
+        text = self.net_partition.strip()
+        try:
+            peer_s, rounds_s = text.split(":", 1)
+            if not peer_s.startswith("r"):
+                raise ValueError
+            peer, rounds = int(peer_s[1:]), int(rounds_s)
+        except ValueError:
+            raise ValueError(
+                f"{CHAOS_ENV}: net_partition must look like rN:K "
+                f"(e.g. r1:20), got {self.net_partition!r}") from None
+        if rounds < 1:
+            raise ValueError(
+                f"{CHAOS_ENV}: net_partition rounds must be >= 1, got "
+                f"{rounds}")
+        return peer, rounds
+
+    @property
+    def has_net_faults(self) -> bool:
+        return (self.net_drop_frac > 0.0 or self.net_delay_ms > 0.0
+                or self.net_dup is not None
+                or self.net_corrupt is not None
+                or self.net_partition is not None)
 
     @classmethod
     def from_env(cls, env=None) -> Optional["ChaosSpec"]:
@@ -141,6 +194,13 @@ class ChaosInjector:
         self.rank = rank
         self._collective_n = 0
         self._input_n = 0
+        self._wire_n = 0
+        self._partition_n = 0
+        self._net_rng = random.Random(
+            spec.net_seed if spec is not None
+            and spec.net_seed is not None else 0)
+        self.net_stats = {"dropped": 0, "duplicated": 0, "corrupted": 0,
+                          "delayed": 0, "partitioned": 0}
         self._lock = threading.Lock()
 
     @property
@@ -222,6 +282,69 @@ class ChaosInjector:
                        f"{s.stall_input_s}s")
         time.sleep(s.stall_input_s)
 
+    # -- transport wire hooks ------------------------------------------
+    def _partition_drops(self, peer: Optional[int]) -> bool:
+        """True while ``peer``'s link is blackholed (counts one wire op
+        against the partition window)."""
+        target = self.spec.partition_target()
+        if target is None or peer is None or peer != target[0]:
+            return False
+        with self._lock:
+            if self._partition_n >= target[1]:
+                return False
+            self._partition_n += 1
+        self.net_stats["partitioned"] += 1
+        self._record("chaos_net_partition", peer=peer,
+                     op=self._partition_n, window=target[1])
+        return True
+
+    def on_wire_tx(self, frame: bytes,
+                   peer: Optional[int] = None) -> List[bytes]:
+        """Channel send hook: one encoded frame in, the frames that
+        actually hit the wire out ([] = dropped, two = duplicated)."""
+        s = self.spec
+        if s is None or not s.has_net_faults:
+            return [frame]
+        if self._partition_drops(peer):
+            return []
+        with self._lock:
+            self._wire_n += 1
+            n = self._wire_n
+            dropped = (s.net_drop_frac > 0.0
+                       and self._net_rng.random() < s.net_drop_frac)
+        if dropped:
+            self.net_stats["dropped"] += 1
+            self._record("chaos_net_drop", peer=peer, frame=n)
+            return []
+        out = [frame]
+        if s.net_dup and n % s.net_dup == 0:
+            self.net_stats["duplicated"] += 1
+            self._record("chaos_net_dup", peer=peer, frame=n)
+            out = [frame, frame]
+        if s.net_corrupt and n % s.net_corrupt == 0:
+            from deepspeed_tpu.serving.transport.framing import \
+                HEADER_BYTES
+            body = len(frame) - HEADER_BYTES
+            if body > 0:
+                i = HEADER_BYTES + body // 2
+                out = [fr[:i] + bytes([fr[i] ^ 0xFF]) + fr[i + 1:]
+                       for fr in out]
+                self.net_stats["corrupted"] += 1
+                self._record("chaos_net_corrupt", peer=peer, frame=n)
+        if s.net_delay_ms > 0.0:
+            self.net_stats["delayed"] += 1
+            time.sleep(s.net_delay_ms / 1e3)
+        return out
+
+    def on_wire_rx(self, chunk: bytes,
+                   peer: Optional[int] = None) -> Optional[bytes]:
+        """Channel recv hook: raw bytes in, bytes to feed the frame
+        reader out (None = blackholed by a partition)."""
+        s = self.spec
+        if s is None or s.net_partition is None:
+            return chunk
+        return None if self._partition_drops(peer) else chunk
+
     # -- flight recorder (best-effort) ---------------------------------
     @staticmethod
     def _record(kind: str, **fields) -> None:
@@ -256,6 +379,15 @@ def get_chaos_injector() -> ChaosInjector:
         if _INJECTOR is None:
             _INJECTOR = ChaosInjector(spec=ChaosSpec.from_env())
         return _INJECTOR
+
+
+def set_chaos_injector(inj: Optional[ChaosInjector]) -> None:
+    """Arm (or disarm with None) the process-global injector directly —
+    the in-process alternative to DSTPU_CHAOS for harnesses that inject
+    transport faults on their own side of the wire (run_chaos_fleet)."""
+    global _INJECTOR
+    with _INJECTOR_LOCK:
+        _INJECTOR = inj
 
 
 def reset_chaos_injector() -> None:

@@ -124,7 +124,7 @@ class WorkerLoop:
         self.step_delay_s = float(spec.get("step_delay_ms", 0.0)) / 1e3
         self.heartbeat_s = float(spec.get("heartbeat_s", 0.1))
         self.draining = False
-        self._last_send = 0.0
+        self._last_send = 0.0  # time.monotonic(); cadence only
         self._sent_traces: set = set()
         self._busy_steps = 0
         self._received_submits = 0  # acked back in every report
@@ -213,19 +213,21 @@ class WorkerLoop:
             "traces": self._new_traces(),
             "geometry": self._geometry(),
         })
-        self._last_send = time.time()
+        self._last_send = time.monotonic()
 
     def _heartbeat_loop(self) -> None:
-        """Report-only sends at heartbeat cadence; no emissions or
-        traces, so the main loop stays the only writer of those."""
+        """Report-only sends at heartbeat cadence (monotonic clock — a
+        wall-clock step must not stall or burst the heartbeat); no
+        emissions or traces, so the main loop stays the only writer of
+        those."""
         while not self._hb_stop.is_set():
-            if (time.time() - self._last_send) >= self.heartbeat_s:
+            if (time.monotonic() - self._last_send) >= self.heartbeat_s:
                 try:
                     self.channel.send({
                         "type": "emit", "emitted": {},
                         "report": self._report(),
                         "traces": [], "geometry": self._geometry()})
-                    self._last_send = time.time()
+                    self._last_send = time.monotonic()
                 except Exception:
                     return  # channel gone; the main loop exits too
             self._hb_stop.wait(self.heartbeat_s / 4.0)
@@ -259,7 +261,7 @@ class WorkerLoop:
                 self.chaos.on_step(self._busy_steps)
             if self.step_delay_s > 0.0:
                 time.sleep(self.step_delay_s)  # simulated degradation
-            now = time.time()
+            now = time.monotonic()
             if emitted or (now - self._last_send) >= self.heartbeat_s:
                 try:
                     self._send_emit(emitted)

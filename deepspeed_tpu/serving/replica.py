@@ -64,7 +64,9 @@ class ServingReplica:
         # router wires this to its emission handler; called on the pump
         # thread with (replica, {uid: [tokens]}) after each serve round
         self.emit_callback: Optional[Callable] = None
-        self.last_heartbeat = time.time()
+        self.last_heartbeat = time.time()  # display only (load_report ts)
+        self.last_heartbeat_mono = time.monotonic()  # liveness decisions
+        self.transport_errors = 0  # in-process replicas have no wire
         self.killed = False
         self.steps = 0
         self.goodput_ewma = 0.0
@@ -92,15 +94,22 @@ class ServingReplica:
         return cls(engine, replica_id, role=role, publisher=publisher)
 
     # -- liveness ------------------------------------------------------
+    def heartbeat_age(self, now: Optional[float] = None) -> float:
+        """Seconds since the last pump, on the *monotonic* clock — a
+        stepped wall clock (NTP slew, manual reset) must never make a
+        healthy replica look dead. ``now``, when given, is a
+        ``time.monotonic()`` timestamp."""
+        now = time.monotonic() if now is None else now
+        return now - self.last_heartbeat_mono
+
     def alive(self, now: Optional[float] = None,
               stale_after: float = 5.0) -> bool:
         """Stale-heartbeat liveness — the same contract as the fleet
         aggregator's dead-rank detection: a killed replica is not dead
         until its heartbeat *ages out*, which is exactly what a real
         crashed process looks like to a router that can only observe
-        published state."""
-        now = time.time() if now is None else now
-        return (now - self.last_heartbeat) < stale_after
+        published state. ``now`` is monotonic (see heartbeat_age)."""
+        return self.heartbeat_age(now) < stale_after
 
     def kill(self) -> None:
         """Simulated crash: stop pumping (and heartbeating) immediately,
@@ -131,6 +140,7 @@ class ServingReplica:
         self.steps += 1
         now = time.time()
         self.last_heartbeat = now
+        self.last_heartbeat_mono = time.monotonic()
         dt = max(time.perf_counter() - t0, 1e-9)
         rate = sum(len(v) for v in emitted.values()) / dt
         self.goodput_ewma = (self._alpha * rate

@@ -19,12 +19,29 @@ The router owns three decisions and one promise:
   prefill replica and installed into a decode replica
   (serving/disagg.py), and the remainder of the budget decodes there.
   Decode p99 never waits behind another request's prompt.
-* **Failover** — a replica whose heartbeat goes stale is declared dead
-  and every one of its in-flight requests is resubmitted elsewhere with
-  the tokens generated so far folded into the prompt — PR 8's
-  zero-drop contract (preempt-and-requeue) extended across replica
-  death. Greedy decoding makes the continuation bit-identical to the
-  uninterrupted stream; tokens already handed out are never re-emitted.
+* **Failover** — a per-replica health state machine (``healthy →
+  suspect → dead``) driven by *monotonic* heartbeat age and consecutive
+  transport-error counts. A ``suspect`` replica (heartbeat past
+  ``suspect_after_s`` or any transport error) stops receiving new
+  routes but keeps its in-flight streams; it recovers to ``healthy``
+  only after ``health_recover_checks`` consecutive clean checks
+  (hysteresis — a flapping link doesn't flap the fleet). A ``dead``
+  replica (heartbeat past ``stale_after_s``, a failed send, or
+  ``transport_error_dead`` consecutive transport errors) has every one
+  of its in-flight requests resubmitted elsewhere with the tokens
+  generated so far folded into the prompt — PR 8's zero-drop contract
+  (preempt-and-requeue) extended across replica death. Greedy decoding
+  makes the continuation bit-identical to the uninterrupted stream;
+  tokens already handed out are never re-emitted.
+  ``health_mode="legacy"`` restores the single stale-threshold flip
+  bit-exactly.
+* **Hedged requests** — with ``hedge_enabled``, a routed request whose
+  predicted TTFT has been exceeded by ``hedge_ttft_factor`` with no
+  first token is resubmitted to a second replica; whichever stream
+  emits first owns the request (greedy decoding makes both streams
+  bit-identical, so the loser is dropped by the existing stale-emission
+  uid guard). Hedges are HEDGE spans on the request trace plus
+  ``serve.hedged``/``serve.hedge_wins`` counters.
 * **The promise** — every accepted request completes with its full
   token budget, through overload, handoff, and replica death alike.
 
@@ -92,13 +109,27 @@ def build_fleet(model, router_cfg=None, engine_kw=None,
     return FleetRouter(replicas, affinity_blocks=cfg.affinity_blocks,
                        stale_after_s=cfg.stale_after_seconds,
                        autoscale=autoscale, eos_token_id=eos_token_id,
-                       routing=getattr(cfg, "routing", "least_loaded"))
+                       routing=getattr(cfg, "routing", "least_loaded"),
+                       health_mode=getattr(cfg, "health_mode",
+                                           "state_machine"),
+                       suspect_after_s=getattr(cfg, "suspect_after_seconds",
+                                               None),
+                       transport_error_dead=getattr(
+                           cfg, "transport_error_dead", 3),
+                       health_recover_checks=getattr(
+                           cfg, "health_recover_checks", 2),
+                       hedge_enabled=getattr(cfg, "hedge_enabled", False),
+                       hedge_ttft_factor=getattr(
+                           cfg, "hedge_ttft_factor", 3.0),
+                       hedge_min_s=getattr(cfg, "hedge_min_seconds", 0.25))
 
 
 class _RequestRecord:
     __slots__ = ("uid", "tokens", "max_new_tokens", "replica_id", "phase",
                  "emitted", "done", "failovers", "affinity_key",
-                 "submitted_ts", "first_emit_ts", "last_emit_ts")
+                 "submitted_ts", "first_emit_ts", "last_emit_ts",
+                 "submitted_mono", "hedge_replica_id", "hedge_at_mono",
+                 "stale_rids")
 
     def __init__(self, uid, tokens, max_new_tokens, replica_id, phase,
                  affinity_key):
@@ -111,12 +142,23 @@ class _RequestRecord:
         self.done = False
         self.failovers = 0
         self.affinity_key = affinity_key
-        self.submitted_ts = time.time()
+        self.submitted_ts = time.time()  # display only (spans)
+        self.submitted_mono = time.monotonic()
         self.first_emit_ts = 0.0
         self.last_emit_ts = 0.0
+        self.hedge_replica_id: Optional[int] = None
+        self.hedge_at_mono: Optional[float] = None
+        # replicas that may STILL be streaming this uid (a hedge that
+        # lost the race, a primary abandoned by a hedge win): their
+        # late emissions are dropped by the ownership guard, but they
+        # must never be picked as a failover target for this request —
+        # the engine would hold two live streams of one uid
+        self.stale_rids: set = set()
 
 
 ROUTING_POLICIES = ("least_loaded", "predictive")
+HEALTH_MODES = ("state_machine", "legacy")
+_HEALTH_ORDER = {"healthy": 0, "suspect": 1, "dead": 2}
 
 
 class FleetRouter:
@@ -126,12 +168,22 @@ class FleetRouter:
                  autoscale=None,
                  eos_token_id: Optional[int] = None,
                  routing: str = "least_loaded",
-                 service_ewma_alpha: float = 0.3):
+                 service_ewma_alpha: float = 0.3,
+                 health_mode: str = "state_machine",
+                 suspect_after_s: Optional[float] = None,
+                 transport_error_dead: int = 3,
+                 health_recover_checks: int = 2,
+                 hedge_enabled: bool = False,
+                 hedge_ttft_factor: float = 3.0,
+                 hedge_min_s: float = 0.25):
         if not replicas:
             raise ValueError("FleetRouter needs at least one replica")
         if routing not in ROUTING_POLICIES:
             raise ValueError(f"routing must be one of {ROUTING_POLICIES},"
                              f" got {routing!r}")
+        if health_mode not in HEALTH_MODES:
+            raise ValueError(f"health_mode must be one of {HEALTH_MODES},"
+                             f" got {health_mode!r}")
         self.replicas = {r.replica_id: r for r in replicas}
         self.prefill_pool = [r.replica_id for r in replicas
                              if r.role == "prefill"]
@@ -145,6 +197,21 @@ class FleetRouter:
         self.autoscale = autoscale
         self.eos_token_id = eos_token_id
         self.routing = routing
+        self.health_mode = health_mode
+        # suspect at half the dead threshold unless configured — early
+        # enough to stop routing onto a silent replica well before the
+        # failover fires
+        self.suspect_after_s = (float(suspect_after_s)
+                                if suspect_after_s
+                                else self.stale_after_s / 2.0)
+        self.transport_error_dead = max(1, int(transport_error_dead))
+        self.health_recover_checks = max(1, int(health_recover_checks))
+        self.hedge_enabled = bool(hedge_enabled)
+        self.hedge_ttft_factor = float(hedge_ttft_factor)
+        self.hedge_min_s = float(hedge_min_s)
+        # rid -> {"state", "since" (monotonic), "ok_checks",
+        # "transitions"} — the per-replica health state machine
+        self._health: Dict[int, Dict[str, Any]] = {}
         self._lock = threading.RLock()
         self._requests: Dict[int, _RequestRecord] = {}
         # (pool, prefix-hash) -> replica id that holds those KV blocks
@@ -174,7 +241,8 @@ class FleetRouter:
         self._svc_seen: Dict[int, int] = {}
         self.stats = {"submitted": 0, "completed": 0, "handoffs": 0,
                       "handoff_recompute": 0, "failovers": 0,
-                      "failed_over_requests": 0, "affinity_hits": 0}
+                      "failed_over_requests": 0, "affinity_hits": 0,
+                      "hedged": 0, "hedge_wins": 0, "stranded": 0}
         for r in replicas:
             r.emit_callback = self._on_emissions
         from deepspeed_tpu.observability.hub import get_hub
@@ -231,6 +299,10 @@ class FleetRouter:
             self._check_fits(target, toks, max_new_tokens)
             rec = _RequestRecord(uid, toks, int(max_new_tokens),
                                  target.replica_id, phase, key)
+            if self.hedge_enabled and phase == "decode":
+                pred = self.predict_ttft(target, len(toks))
+                rec.hedge_at_mono = rec.submitted_mono + max(
+                    self.hedge_min_s, self.hedge_ttft_factor * pred)
             self._requests[uid] = rec
             self.stats["submitted"] += 1
             self._avg_budget = float(max_new_tokens) \
@@ -274,11 +346,46 @@ class FleetRouter:
             np.ascontiguousarray(toks[:span], np.int32).tobytes()
         ).hexdigest()
 
+    def _instant_health(self, r: ServingReplica, now: float) -> str:
+        """Stateless health read from the replica's observables at
+        monotonic ``now`` (the state machine adds hysteresis on top)."""
+        if getattr(r, "killed", False) or getattr(r, "_send_failed",
+                                                  False):
+            return "dead"
+        age = r.heartbeat_age(now)
+        terr = getattr(r, "transport_errors", 0)
+        if age >= self.stale_after_s or terr >= self.transport_error_dead:
+            return "dead"
+        if age >= self.suspect_after_s or terr > 0:
+            return "suspect"
+        return "healthy"
+
+    def _route_state(self, rid: int, now: float) -> str:
+        """Health as routing sees it: the worse of the instantaneous
+        read and the stored state — a suspect mid-recovery stays
+        suspect until the hysteresis clears it."""
+        inst = self._instant_health(self.replicas[rid], now)
+        stored = self._health.get(rid, {}).get("state", "healthy")
+        return (inst if _HEALTH_ORDER[inst] >= _HEALTH_ORDER[stored]
+                else stored)
+
     def _alive(self, pool: List[int]) -> List[ServingReplica]:
-        now = time.time()
-        out = [self.replicas[rid] for rid in pool
-               if rid not in self.dead
-               and self.replicas[rid].alive(now, self.stale_after_s)]
+        now = time.monotonic()
+        if self.health_mode == "legacy":
+            out = [self.replicas[rid] for rid in pool
+                   if rid not in self.dead
+                   and self.replicas[rid].alive(now, self.stale_after_s)]
+        else:
+            cands = [rid for rid in pool if rid not in self.dead]
+            states = {rid: self._route_state(rid, now) for rid in cands}
+            # healthy replicas take new routes; suspects only when
+            # nothing healthy is left (they keep in-flight streams
+            # either way — emissions don't pass through here)
+            out = [self.replicas[rid] for rid in cands
+                   if states[rid] == "healthy"]
+            if not out:
+                out = [self.replicas[rid] for rid in cands
+                       if states[rid] == "suspect"]
         if not out:  # last resort: any replica not yet declared dead
             out = [r for rid, r in self.replicas.items()
                    if rid not in self.dead]
@@ -287,11 +394,21 @@ class FleetRouter:
         return out
 
     def _pick(self, pool: List[int], key: Optional[str],
-              n_tokens: int = 0) -> ServingReplica:
+              n_tokens: int = 0,
+              exclude: Optional[set] = None) -> ServingReplica:
         """Affinity if the remembered replica is still live, else the
         configured policy (least-loaded or predicted-TTFT). Caller
-        holds the lock."""
+        holds the lock. ``exclude`` removes replicas that may still
+        hold a live stream of the request being placed (hedge losers);
+        an all-excluded pool raises like a dead one, which parks the
+        failover until fresh capacity arrives."""
         alive = self._alive(pool)
+        if exclude:
+            alive = [r for r in alive if r.replica_id not in exclude]
+            if not alive:
+                raise RuntimeError(
+                    "no live replicas without a stale stream of this "
+                    "request")
         pool_tag = id(pool)
         self._last_predicted_ms = None
         if key is not None:
@@ -368,9 +485,31 @@ class FleetRouter:
         with self._lock:
             for uid, toks in emitted.items():
                 rec = self._requests.get(uid)
-                if (rec is None or rec.done
-                        or rec.replica_id != replica.replica_id):
-                    continue  # stale emission from a failed-over replica
+                if rec is None or rec.done:
+                    continue
+                if rec.replica_id != replica.replica_id:
+                    if (rec.hedge_replica_id == replica.replica_id
+                            and not rec.emitted and toks):
+                        # hedge wins: the secondary produced the first
+                        # token first — adopt its stream; the primary's
+                        # later emissions become the stale ones (and it
+                        # still streams this uid: taint it)
+                        rec.stale_rids.add(rec.replica_id)
+                        rec.replica_id = replica.replica_id
+                        rec.hedge_replica_id = None
+                        self.stats["hedge_wins"] += 1
+                        self._hub.counter_add("serve.hedge_wins")
+                    else:
+                        # stale emission from a failed-over replica or
+                        # a hedge that lost the race
+                        continue
+                if (rec.hedge_replica_id is not None and toks
+                        and not rec.emitted):
+                    # first token came from the primary: the hedge lost,
+                    # but its replica still streams this uid to the end
+                    # of the budget — taint it for failover picks
+                    rec.stale_rids.add(rec.hedge_replica_id)
+                    rec.hedge_replica_id = None
                 if not rec.emitted and toks:
                     self._observe_first_token(replica.replica_id, rec, now)
                 elif toks and rec.last_emit_ts > 0.0:
@@ -463,25 +602,140 @@ class FleetRouter:
 
     # -- failover ------------------------------------------------------
     def check_health(self, now: Optional[float] = None) -> List[int]:
-        """Declare stale-heartbeat replicas dead and re-route their
-        in-flight requests. Also feeds the autoscaler and the fleet
-        gauges. Returns replica ids newly declared dead."""
-        now = time.time() if now is None else now
+        """Advance the per-replica health state machine (or, in legacy
+        mode, the single stale flip), declare dead replicas and
+        re-route their in-flight requests, fire due hedges, and feed
+        the autoscaler + fleet gauges. ``now`` is a monotonic
+        timestamp. Returns replica ids newly declared dead."""
+        now = time.monotonic() if now is None else now
         newly_dead = []
-        for rid, r in self.replicas.items():
-            if rid not in self.dead and not r.alive(now, self.stale_after_s):
-                newly_dead.append(rid)
+        if self.health_mode == "legacy":
+            for rid, r in self.replicas.items():
+                if rid not in self.dead \
+                        and not r.alive(now, self.stale_after_s):
+                    newly_dead.append(rid)
+        else:
+            with self._lock:
+                for rid, r in self.replicas.items():
+                    if rid in self.dead:
+                        continue
+                    if self._observe_health(rid, r, now) == "dead":
+                        newly_dead.append(rid)
         for rid in newly_dead:
             self._failover(rid)
+        # victims parked during a total outage (every replica dead in
+        # one window) retry every round: once the supervisor restores
+        # capacity they fail over like any other victim
+        with self._lock:
+            parked = sorted({rec.replica_id
+                             for rec in self._requests.values()
+                             if not rec.done
+                             and rec.replica_id in self.dead
+                             and rec.replica_id not in newly_dead})
+        for rid in parked:
+            self._failover(rid)
+        with self._lock:
+            self.stats["stranded"] = sum(
+                1 for rec in self._requests.values()
+                if not rec.done and rec.replica_id in self.dead)
+        if self.hedge_enabled:
+            self._check_hedges(now)
         self._update_fleet_gauges()
         return newly_dead
 
+    def _observe_health(self, rid: int, r: ServingReplica,
+                        now: float) -> str:
+        """One state-machine tick for one replica. Demotion is
+        immediate; promotion back to healthy requires
+        ``health_recover_checks`` consecutive clean reads (hysteresis).
+        Caller holds the lock."""
+        h = self._health.get(rid)
+        if h is None:
+            h = self._health[rid] = {"state": "healthy", "since": now,
+                                     "ok_checks": 0, "transitions": 0}
+        target = self._instant_health(r, now)
+        state = h["state"]
+        if target == "dead":
+            new = "dead"
+        elif state == "suspect":
+            if target == "healthy":
+                h["ok_checks"] += 1
+                new = ("healthy"
+                       if h["ok_checks"] >= self.health_recover_checks
+                       else "suspect")
+            else:
+                h["ok_checks"] = 0
+                new = "suspect"
+        else:
+            new = target
+        if new != state:
+            h["state"] = new
+            h["since"] = now
+            h["transitions"] += 1
+            h["ok_checks"] = 0
+        return new
+
+    def _check_hedges(self, now: float) -> None:
+        """Resubmit requests whose predicted TTFT has been exceeded by
+        ``hedge_ttft_factor`` with no first token. Plans are built
+        under the lock, submits happen outside it (the failover
+        discipline). Greedy decoding makes both streams bit-identical,
+        so whichever emits first wins and the loser is dropped by the
+        stale-emission guard in _on_emissions."""
+        if self.disagg:
+            return  # prefill handoffs have their own recompute path
+        plans = []
+        with self._lock:
+            for rec in self._requests.values():
+                if (rec.done or rec.emitted or rec.phase != "decode"
+                        or rec.hedge_replica_id is not None
+                        or rec.hedge_at_mono is None
+                        or now < rec.hedge_at_mono):
+                    continue
+                try:
+                    alive = [r for r in self._alive(self.decode_pool)
+                             if r.replica_id != rec.replica_id
+                             and r.replica_id not in rec.stale_rids]
+                except RuntimeError:
+                    continue
+                if not alive:
+                    continue
+                if self.routing == "predictive":
+                    target = min(alive, key=lambda r: (
+                        self.predict_ttft(r, len(rec.tokens)),
+                        r.load_score()))
+                else:
+                    target = min(alive, key=lambda r: r.load_score())
+                rec.hedge_replica_id = target.replica_id
+                self.stats["hedged"] += 1
+                waited_ms = (now - rec.submitted_mono) * 1e3
+                plans.append((rec, target,
+                              self._route_fields(target, "hedge"),
+                              waited_ms))
+        for rec, target, route, waited_ms in plans:
+            target.submit(Submission(
+                uid=rec.uid, tokens=rec.tokens,
+                max_new_tokens=rec.max_new_tokens,
+                span_notes=[
+                    ("HEDGE", {"from_replica": rec.replica_id,
+                               "to_replica": target.replica_id,
+                               "waited_ms": round(waited_ms, 3)}),
+                    ("ROUTE", route)]))
+            self._hub.counter_add("serve.hedged")
+
     def _failover(self, dead_rid: int) -> None:
         with self._lock:
-            self.dead.add(dead_rid)
-            self.stats["failovers"] += 1
+            if dead_rid not in self.dead:
+                self.dead.add(dead_rid)
+                if dead_rid in self._health:
+                    self._health[dead_rid]["state"] = "dead"
+                self.stats["failovers"] += 1
             victims = [rec for rec in self._requests.values()
                        if rec.replica_id == dead_rid and not rec.done]
+            for rec in self._requests.values():
+                # a dead hedge target just stops being a hedge
+                if rec.hedge_replica_id == dead_rid:
+                    rec.hedge_replica_id = None
             plans = []
             for rec in victims:
                 remaining = rec.max_new_tokens - len(rec.emitted)
@@ -489,18 +743,39 @@ class FleetRouter:
                     rec.done = True
                     self.stats["completed"] += 1
                     continue
-                if rec.phase == "prefill":
-                    pool = self.prefill_pool
-                    alive = [r for r in self._alive(pool)
-                             if r.replica_id != dead_rid]
-                    if not alive:  # prefill pool gone: decode end-to-end
-                        rec.phase = "decode"
-                        pool = self.decode_pool
-                    budget = 1 if rec.phase == "prefill" else remaining
-                else:
-                    pool, budget = self.decode_pool, remaining
-                target = self._pick(pool, rec.affinity_key,
-                                    len(rec.tokens))
+                if (rec.hedge_replica_id is not None
+                        and rec.hedge_replica_id not in self.dead
+                        and not rec.emitted):
+                    # a live hedge already holds this request verbatim —
+                    # promote it instead of resubmitting a third copy
+                    rec.replica_id = rec.hedge_replica_id
+                    rec.hedge_replica_id = None
+                    continue
+                rec.hedge_replica_id = None
+                try:
+                    if rec.phase == "prefill":
+                        pool = self.prefill_pool
+                        alive = [r for r in self._alive(pool)
+                                 if r.replica_id != dead_rid]
+                        if not alive:  # prefill pool gone: decode e2e
+                            rec.phase = "decode"
+                            pool = self.decode_pool
+                        budget = 1 if rec.phase == "prefill" \
+                            else remaining
+                    else:
+                        pool, budget = self.decode_pool, remaining
+                    rec.stale_rids.add(dead_rid)
+                    target = self._pick(pool, rec.affinity_key,
+                                        len(rec.tokens),
+                                        exclude=rec.stale_rids)
+                except RuntimeError:
+                    # transient total outage: every candidate died in
+                    # the same health window. Park the victim on its
+                    # dead replica id — check_health retries it once
+                    # the supervisor restores capacity; raising here
+                    # would turn a survivable outage into a crashed
+                    # router (new submits still fail loud).
+                    continue
                 old = rec.replica_id
                 rec.replica_id = target.replica_id
                 rec.failovers += 1
@@ -551,8 +826,8 @@ class FleetRouter:
               poll_s: float = 0.02) -> None:
         """Threaded mode: wait (health-checking) until every accepted
         request completed."""
-        deadline = time.time() + timeout_s
-        while time.time() < deadline:
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
             self.check_health()
             if self.pending() == 0:
                 return
@@ -637,13 +912,23 @@ class FleetRouter:
         with self._lock:
             stats = dict(self.stats)
             dead = sorted(self.dead)
+            now = time.monotonic()
+            health = {
+                str(rid): {
+                    "state": ("dead" if rid in self.dead
+                              else self._route_state(rid, now)),
+                    "transitions": self._health.get(rid, {}).get(
+                        "transitions", 0),
+                }
+                for rid in self.replicas}
         snap = {
-            "schema": "serving_fleet/v1",
+            "schema": "serving_fleet/v2",
             "ts": time.time(),
             "mode": "disagg" if self.disagg else "unified",
             "replicas": [r.load_report()
                          for r in self.replicas.values()],
             "dead_replicas": dead,
+            "health": health,
             "router": stats,
             "slo_attribution": self.slo_attribution(deadline_s),
         }

@@ -20,7 +20,15 @@ passed to ``serialize_handoff`` fires when the payload message lands).
 * **restart** — a worker that exits without being asked to is a crash:
   the stub is marked failed (so the router's next health check declares
   it dead and resubmits its in-flight requests — the zero-drop failover
-  path, unchanged), and a replacement spawns under a *new* replica id;
+  path, unchanged), and a replacement spawns under a *new* replica id.
+  Replacements inherit the crashed worker's *lineage*: repeat restarts
+  back off exponentially (``restart_policy``, a resilience RetryPolicy),
+  and a lineage crashing more than ``max_restarts_per_window`` times
+  inside ``restart_window_s`` trips the circuit breaker — it is
+  **quarantined** (recorded in the decision history, never respawned;
+  replacing its capacity becomes the autoscale signal's job) instead of
+  being restarted unboundedly. ``drain`` refuses to shrink the fleet
+  below ``min_healthy`` live workers (``drain_refused`` in the act log);
 * **autoscale acts** — the PR 10 signal stops being metrics-only: when
   ``desired`` exceeds the live count the supervisor spins up, when it
   drops below it picks a victim, stops new admissions
@@ -55,6 +63,9 @@ from deepspeed_tpu.serving.replica import Submission
 from deepspeed_tpu.serving.transport import (ChannelError, FileChannel,
                                              connect_with_backoff,
                                              decode_handoff, encode_handoff)
+
+
+_WARNED_LEGACY_CONNECT = False
 
 
 def _atomic_write_json(path: str, doc: Dict[str, Any]) -> None:
@@ -149,8 +160,12 @@ class RemoteReplica:
         self.draining = False
         self.exited = False  # worker announced a clean drain-exit
         self._send_failed = False
+        # consecutive channel errors; reset by any successful inbound
+        # message — the router's health state machine reads this
+        self.transport_errors = 0
         self._report = _empty_report(self.replica_id, role)
-        self._report_ts = time.time()  # grace until the first heartbeat
+        self._report_ts = time.time()  # display only (report ts)
+        self._report_mono = time.monotonic()  # liveness decisions
         self._sent_submits = 0  # vs the report's received_submits
         self._lock = threading.Lock()
         self._handoff_timeout_s = float(handoff_timeout_s)
@@ -158,16 +173,23 @@ class RemoteReplica:
         self._next_req = 0
 
     # -- the ServingReplica surface ------------------------------------
+    def heartbeat_age(self, now: Optional[float] = None) -> float:
+        """Seconds since the last inbound report, on the *monotonic*
+        clock — a stepped wall clock must never fail a healthy worker
+        over. ``now``, when given, is a ``time.monotonic()`` stamp."""
+        now = time.monotonic() if now is None else now
+        return now - self._report_mono
+
     def alive(self, now: Optional[float] = None,
               stale_after: float = 5.0) -> bool:
         """Liveness = recent heartbeat over a working channel. A dead
         worker stops reporting; a broken channel flips ``_send_failed``
         immediately — either way the router's health check fails the
-        replica over without waiting on process state."""
+        replica over without waiting on process state. ``now`` is
+        monotonic (see heartbeat_age)."""
         if self._send_failed:
             return False
-        now = time.time() if now is None else now
-        return (now - self._report_ts) < stale_after
+        return self.heartbeat_age(now) < stale_after
 
     def _unacked(self, r: Dict[str, Any]) -> int:
         """Submissions on the wire the worker's report can't see yet.
@@ -208,6 +230,7 @@ class RemoteReplica:
         except ChannelError:
             # the stale-heartbeat path will resubmit this request
             # elsewhere; losing the send is exactly a replica crash
+            self.transport_errors += 1
             self._send_failed = True
             return
         with self._lock:
@@ -222,11 +245,12 @@ class RemoteReplica:
             req = self._next_req
             self._next_req += 1
             self._handoff_cbs[req] = (
-                cb, time.time() + self._handoff_timeout_s)
+                cb, time.monotonic() + self._handoff_timeout_s)
         try:
             self.channel.send({"type": "serialize", "req": req,
                                "tokens": np.asarray(tokens, np.int32)})
         except ChannelError:
+            self.transport_errors += 1
             self._send_failed = True
             with self._lock:
                 self._handoff_cbs.pop(req, None)
@@ -255,6 +279,8 @@ class RemoteReplica:
             with self._lock:
                 self._report = dict(msg.get("report") or self._report)
                 self._report_ts = time.time()
+                self._report_mono = time.monotonic()
+            self.transport_errors = 0  # channel demonstrably works
             geo = msg.get("geometry")
             if geo:
                 self.engine.update_geometry(geo)
@@ -275,9 +301,9 @@ class RemoteReplica:
 
     def expire_handoffs(self, now: Optional[float] = None) -> int:
         """Time out serialize RPCs whose worker died mid-reply: each
-        orphaned continuation fires with None (recompute). Returns how
-        many expired."""
-        now = time.time() if now is None else now
+        orphaned continuation fires with None (recompute). ``now`` is
+        monotonic. Returns how many expired."""
+        now = time.monotonic() if now is None else now
         expired = []
         with self._lock:
             for req, (cb, deadline) in list(self._handoff_cbs.items()):
@@ -311,7 +337,12 @@ class ReplicaSupervisor:
                  spawn_timeout_s: float = 60.0,
                  default_role: str = "unified",
                  jax_platform: str = "cpu",
-                 python: Optional[str] = None):
+                 python: Optional[str] = None,
+                 connect_policy=None,
+                 restart_policy=None,
+                 max_restarts_per_window: int = 3,
+                 restart_window_s: float = 30.0,
+                 min_healthy: int = 1):
         if channel not in ("socket", "file"):
             raise ValueError(
                 f"channel must be socket|file, got {channel!r}")
@@ -335,8 +366,43 @@ class ReplicaSupervisor:
         self._rx_threads: Dict[int, threading.Thread] = {}
         self._rx_stop: Dict[int, threading.Event] = {}
         self._next_id = 0
-        # (ts, action, replica_id) — spawn | restart | drain
+        # (ts, action, replica_id) —
+        # spawn | restart | drain | quarantine | drain_refused
         self.actions: List[Tuple[float, str, int]] = []
+        # crash-loop containment (see class docstring)
+        from deepspeed_tpu.resilience.policy import RetryPolicy
+        self.connect_policy = connect_policy
+        if connect_policy is None and (
+                int(connect_retries) != 40
+                or float(connect_backoff_s) != 0.05):
+            global _WARNED_LEGACY_CONNECT
+            if not _WARNED_LEGACY_CONNECT:
+                _WARNED_LEGACY_CONNECT = True
+                import warnings
+                warnings.warn(
+                    "connect_retries/connect_backoff_s are legacy "
+                    "aliases; pass connect_policy= (a resilience "
+                    "RetryPolicy, e.g. RouterConfig."
+                    "connect_retry_policy()) instead",
+                    DeprecationWarning, stacklevel=2)
+        # jitter=0: restart timing must be deterministic for the chaos
+        # gates (and drift does nothing useful on a single host)
+        self.restart_policy = restart_policy or RetryPolicy(
+            max_retries=max(1, int(max_restarts_per_window)),
+            backoff_base_s=0.25, backoff_max_s=5.0, jitter=0.0)
+        self.max_restarts_per_window = int(max_restarts_per_window)
+        self.restart_window_s = float(restart_window_s)
+        self.min_healthy = max(1, int(min_healthy))
+        # rid -> lineage id (the first spawn's rid, carried through
+        # restarts so the breaker sees one crash-looping identity)
+        self._lineage: Dict[int, int] = {}
+        self._lineage_crashes: Dict[int, List[float]] = {}  # monotonic
+        self.quarantined: set = set()  # lineage ids
+        self._pending_restarts: List[Dict[str, Any]] = []
+        # spawn-time knobs remembered so restarts reproduce the worker
+        # (env carries e.g. the DSTPU_CHAOS spec of a chaos drill)
+        self._env_extra: Dict[int, Dict[str, str]] = {}
+        self._step_delay: Dict[int, float] = {}
         for sub in ("specs", "ready", "logs", "spool", "replicas"):
             os.makedirs(os.path.join(run_dir, sub), exist_ok=True)
 
@@ -353,10 +419,14 @@ class ReplicaSupervisor:
               replica_id: Optional[int] = None,
               step_delay_ms: float = 0.0,
               env_extra: Optional[Dict[str, str]] = None,
-              action: str = "spawn") -> RemoteReplica:
+              action: str = "spawn",
+              lineage: Optional[int] = None) -> RemoteReplica:
         rid = self._next_id if replica_id is None else int(replica_id)
         self._next_id = max(self._next_id, rid + 1)
         role = role or self.default_role
+        self._lineage[rid] = rid if lineage is None else int(lineage)
+        self._env_extra[rid] = dict(env_extra or {})
+        self._step_delay[rid] = float(step_delay_ms)
         spool = os.path.join(self.run_dir, "spool", f"replica_{rid}")
         ready = os.path.join(self.run_dir, "ready",
                              f"replica_{rid}.json")
@@ -386,7 +456,7 @@ class ReplicaSupervisor:
             stdout=log, stderr=subprocess.STDOUT, env=env)
         log.close()
         try:
-            chan = self._connect(proc, ready, spool)
+            chan = self._connect(proc, ready, spool, rid)
         except Exception:
             proc.kill()
             raise
@@ -399,14 +469,14 @@ class ReplicaSupervisor:
         return remote
 
     def _connect(self, proc: subprocess.Popen, ready_path: str,
-                 spool: str):
-        deadline = time.time() + self.spawn_timeout_s
+                 spool: str, rid: int):
+        deadline = time.monotonic() + self.spawn_timeout_s
         while not os.path.exists(ready_path):
             if proc.poll() is not None:
                 raise ChannelError(
                     f"worker exited with {proc.returncode} before "
                     f"publishing its ready file (see logs/)")
-            if time.time() >= deadline:
+            if time.monotonic() >= deadline:
                 raise ChannelError(
                     f"worker not ready within {self.spawn_timeout_s}s")
             time.sleep(0.01)
@@ -418,8 +488,10 @@ class ReplicaSupervisor:
                 "127.0.0.1", int(ready["port"]),
                 retries=self.connect_retries,
                 backoff_s=self.connect_backoff_s,
-                max_frame_bytes=max_frame)
-        return FileChannel(spool, side="a", max_frame_bytes=max_frame)
+                max_frame_bytes=max_frame,
+                policy=self.connect_policy, peer_id=rid)
+        return FileChannel(spool, side="a", max_frame_bytes=max_frame,
+                           peer_id=rid)
 
     def _start_rx(self, remote: RemoteReplica) -> None:
         stop = threading.Event()
@@ -429,6 +501,7 @@ class ReplicaSupervisor:
                 try:
                     msg = remote.channel.recv(timeout=0.1)
                 except ChannelError:
+                    remote.transport_errors += 1
                     remote._send_failed = True
                     return
                 if msg is not None:
@@ -447,13 +520,17 @@ class ReplicaSupervisor:
                 and self._procs[rid].poll() is None]
 
     def maintain(self, now: Optional[float] = None) -> Dict[str, int]:
-        """One supervision round: restart crashes, act on the autoscale
-        signal, expire orphaned handoff RPCs, refresh the merged fleet
-        snapshot. Call it from the serving loop at health-check cadence.
-        Returns counts of the actions taken."""
+        """One supervision round: contain crashes (failover now,
+        restart after backoff, quarantine a crash-looper), act on the
+        autoscale signal, expire orphaned handoff RPCs, refresh the
+        merged fleet snapshot. Call it from the serving loop at
+        health-check cadence. ``now`` (wall clock) stamps the decision
+        history only — scheduling runs on the monotonic clock. Returns
+        counts of the actions taken."""
         now = time.time() if now is None else now
+        mono = time.monotonic()
         acted = {"restarted": 0, "spawned": 0, "drained": 0,
-                 "handoffs_expired": 0}
+                 "quarantined": 0, "handoffs_expired": 0}
         autoscale = getattr(self.router, "autoscale", None) \
             if self.router is not None else None
 
@@ -461,23 +538,61 @@ class ReplicaSupervisor:
             remote = self.replicas[rid]
             proc = self._procs[rid]
             if proc.poll() is None:
-                acted["handoffs_expired"] += remote.expire_handoffs(now)
+                acted["handoffs_expired"] += remote.expire_handoffs(mono)
                 continue
             if remote.draining or remote.exited:
                 continue  # asked to leave; clean exit, nothing to heal
-            # crash: fail the stub now (fast failover), replace under a
-            # fresh id — the dead id stays dead, its in-flight work is
-            # the router's resubmit problem, not the new worker's
+            # crash: fail the stub now (fast failover) — the dead id
+            # stays dead, its in-flight work is the router's resubmit
+            # problem, not the replacement's
             remote._send_failed = True
             remote.draining = True
-            replacement = self.spawn(role=remote.role, action="restart")
             if self.router is not None:
-                self.router.check_health(now)  # declares rid dead
+                self.router.check_health()  # declares rid dead
+            lineage = self._lineage.get(rid, rid)
+            crashes = self._lineage_crashes.setdefault(lineage, [])
+            crashes.append(mono)
+            crashes[:] = [t for t in crashes
+                          if mono - t <= self.restart_window_s]
+            attempt = len(crashes)
+            if attempt > self.max_restarts_per_window:
+                # circuit breaker: this lineage crashes faster than it
+                # serves — stop feeding it restarts; the autoscale
+                # desired-vs-live path owns replacing its capacity
+                if lineage not in self.quarantined:
+                    self.quarantined.add(lineage)
+                    self.actions.append((now, "quarantine", rid))
+                    if autoscale is not None:
+                        autoscale.record_action("quarantine", rid, now)
+                    acted["quarantined"] += 1
+                continue
+            # first crash restarts immediately (the pre-breaker
+            # behavior); repeats back off exponentially
+            delay = (0.0 if attempt <= 1
+                     else self.restart_policy.backoff_s(attempt - 1))
+            self._pending_restarts.append({
+                "due_mono": mono + delay, "role": remote.role,
+                "lineage": lineage,
+                "env": self._env_extra.get(rid) or None,
+                "step_delay_ms": self._step_delay.get(rid, 0.0)})
+
+        still_pending = []
+        for plan in self._pending_restarts:
+            if plan["due_mono"] > time.monotonic():
+                still_pending.append(plan)
+                continue
+            replacement = self.spawn(
+                role=plan["role"], action="restart",
+                env_extra=plan["env"],
+                step_delay_ms=plan["step_delay_ms"],
+                lineage=plan["lineage"])
+            if self.router is not None:
                 self.router.add_replica(replacement)
             if autoscale is not None:
-                autoscale.record_action("restart", replacement.replica_id,
-                                        now)
+                autoscale.record_action("restart",
+                                        replacement.replica_id, now)
             acted["restarted"] += 1
+        self._pending_restarts = still_pending
 
         if autoscale is not None and autoscale.desired is not None:
             live = self._live_ids()
@@ -489,15 +604,22 @@ class ReplicaSupervisor:
                 acted["spawned"] += 1
             elif autoscale.desired < len(live) and len(live) > 1:
                 victim = self.replicas[max(live)]
-                self.drain(victim.replica_id)
-                autoscale.record_action("drain", victim.replica_id, now)
-                acted["drained"] += 1
+                if self.drain(victim.replica_id):
+                    autoscale.record_action("drain", victim.replica_id,
+                                            now)
+                    acted["drained"] += 1
         self.write_fleet_snapshot()
         return acted
 
-    def drain(self, replica_id: int) -> None:
+    def drain(self, replica_id: int) -> bool:
         """Graceful scale-down: no new admissions, worker finishes its
-        in-flight requests and exits 0."""
+        in-flight requests and exits 0. Refuses (returns False, with a
+        ``drain_refused`` act recorded) when draining would leave the
+        fleet below its ``min_healthy`` floor."""
+        if len(self._live_ids()) - 1 < self.min_healthy:
+            self.actions.append((time.time(), "drain_refused",
+                                 replica_id))
+            return False
         remote = self.replicas[replica_id]
         remote.draining = True
         if self.router is not None:
@@ -505,8 +627,10 @@ class ReplicaSupervisor:
         try:
             remote.channel.send({"type": "drain"})
         except ChannelError:
+            remote.transport_errors += 1
             remote._send_failed = True
         self.actions.append((time.time(), "drain", replica_id))
+        return True
 
     def kill(self, replica_id: int,
              sig: int = signal.SIGKILL) -> None:
@@ -562,12 +686,17 @@ class ReplicaSupervisor:
         if self.router is not None:
             snap = self.router.fleet_snapshot()
         else:
-            snap = {"schema": "serving_fleet/v1", "ts": time.time(),
+            snap = {"schema": "serving_fleet/v2", "ts": time.time(),
                     "replicas": [r.load_report()
                                  for r in self.replicas.values()]}
         snap["supervisor"] = {
             "actions": [{"ts": ts, "action": act, "replica": rid}
                         for ts, act, rid in self.actions[-64:]],
+            "restarts": sum(1 for _, act, _r in self.actions
+                            if act == "restart"),
+            "quarantined": sorted(self.quarantined),
+            "pending_restarts": len(self._pending_restarts),
+            "min_healthy": self.min_healthy,
             "procs": {str(rid): {
                 "pid": p.pid,
                 "running": p.poll() is None,
@@ -576,6 +705,8 @@ class ReplicaSupervisor:
             "transport": {str(rid): {
                 "tx_bytes": r.channel.bytes_sent,
                 "rx_bytes": r.channel.bytes_received,
+                "transport_errors": r.transport_errors,
+                "dup_frames": getattr(r.channel, "dup_frames", 0),
             } for rid, r in self.replicas.items()},
         }
         _atomic_write_json(path, snap)

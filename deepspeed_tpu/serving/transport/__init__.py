@@ -25,6 +25,7 @@ from deepspeed_tpu.serving.transport.channel import (ChannelError,
                                                      FileChannel,
                                                      SocketChannel,
                                                      SocketServer,
+                                                     TransportError,
                                                      connect_with_backoff)
 from deepspeed_tpu.serving.transport.framing import (DEFAULT_MAX_FRAME_BYTES,
                                                      FrameError, FrameReader,
@@ -36,7 +37,7 @@ from deepspeed_tpu.serving.transport.messages import (decode_handoff,
 
 __all__ = [
     "ChannelError", "DEFAULT_MAX_FRAME_BYTES", "FileChannel", "FrameError",
-    "FrameReader", "SocketChannel", "SocketServer", "connect_with_backoff",
-    "decode_handoff", "decode_message", "encode_frame", "encode_handoff",
-    "encode_message",
+    "FrameReader", "SocketChannel", "SocketServer", "TransportError",
+    "connect_with_backoff", "decode_handoff", "decode_message",
+    "encode_frame", "encode_handoff", "encode_message",
 ]

@@ -22,6 +22,18 @@ real transport cost, which is what ``quant.kv_wire`` accounting wants).
 A :class:`ChannelError` means the peer is gone or the stream is corrupt
 (framing errors surface here too): callers drop the channel and either
 reconnect with backoff or let the stale heartbeat drive failover.
+:class:`TransportError` is the send-path subclass — the OS refused the
+write — so retry policy can tell "my write failed" from "their stream
+lied".
+
+Every sent message carries a per-channel sequence number (``_chan_seq``,
+stripped before delivery). The receiver delivers in-sequence frames,
+silently discards duplicates (a fault-injected or retransmitted frame
+replays harmlessly), and raises :class:`ChannelError` on a gap — a
+silently dropped frame becomes a detectable fault at the next arrival
+instead of a hung request. Chaos net faults (``DSTPU_CHAOS net_*``,
+resilience/chaos.py) are injected here, on the encoded frames/chunks,
+when the process-global injector is armed.
 """
 
 from __future__ import annotations
@@ -40,44 +52,103 @@ from deepspeed_tpu.serving.transport.messages import (decode_message,
                                                       encode_message)
 
 _RECV_CHUNK = 1 << 16
+SEQ_KEY = "_chan_seq"
 
 
 class ChannelError(RuntimeError):
     """Peer gone or stream corrupt — drop the channel."""
 
 
-class SocketChannel:
+class TransportError(ChannelError):
+    """The send path itself failed (OS write/spool error) — typed so
+    retry policy can distinguish it from a corrupt inbound stream."""
+
+
+def _armed_net_injector():
+    """The process-global chaos injector iff it carries net faults.
+    Lazy import: channel.py must stay importable before proc_worker
+    pins JAX_PLATFORMS, and chaos off must cost one attr check."""
+    from deepspeed_tpu.resilience.chaos import get_chaos_injector
+
+    inj = get_chaos_injector()
+    if inj.armed and inj.spec.has_net_faults:
+        return inj
+    return None
+
+
+class _SeqMixin:
+    """Per-channel sequence numbering shared by both transports."""
+
+    def _seq_init(self) -> None:
+        self._tx_seq = 0
+        self._rx_expected = 0
+        self.dup_frames = 0
+
+    def _seq_deliver(self, msg: Dict[str, Any]
+                     ) -> Optional[Dict[str, Any]]:
+        """In-sequence → deliver; duplicate → None (discard); gap →
+        ChannelError. Unnumbered messages pass through untouched."""
+        seq = msg.pop(SEQ_KEY, None)
+        if seq is None:
+            return msg
+        if seq == self._rx_expected:
+            self._rx_expected += 1
+            return msg
+        if seq < self._rx_expected:
+            self.dup_frames += 1
+            return None
+        raise ChannelError(
+            f"sequence gap: expected frame {self._rx_expected}, got "
+            f"{seq} ({seq - self._rx_expected} frame(s) lost)")
+
+
+class SocketChannel(_SeqMixin):
     def __init__(self, sock: socket.socket,
-                 max_frame_bytes: int = DEFAULT_MAX_FRAME_BYTES):
+                 max_frame_bytes: int = DEFAULT_MAX_FRAME_BYTES,
+                 peer_id: Optional[int] = None):
         self._sock = sock
         self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         self._reader = FrameReader(max_frame_bytes)
         self._inbox: deque = deque()
         self._send_lock = threading.Lock()
         self.max_frame_bytes = int(max_frame_bytes)
+        self.peer_id = peer_id
         self.bytes_sent = 0
         self.bytes_received = 0
         self.closed = False
+        self._seq_init()
 
     def send(self, msg: Dict[str, Any]) -> int:
         """Frame + write one message; returns the bytes put on the
-        wire. Raises ChannelError when the peer is gone."""
-        frame = encode_frame(encode_message(msg), self.max_frame_bytes)
+        wire. Raises TransportError when the peer is gone. The sequence
+        number is assigned under the send lock — two sender threads
+        (heartbeat + main loop) must not interleave seq order."""
         with self._send_lock:
             if self.closed:
                 raise ChannelError("channel closed")
-            try:
-                self._sock.sendall(frame)
-            except OSError as e:
-                self.close()
-                raise ChannelError(f"send failed: {e}") from e
-            self.bytes_sent += len(frame)
-        return len(frame)
+            frame = encode_frame(
+                encode_message(dict(msg, **{SEQ_KEY: self._tx_seq})),
+                self.max_frame_bytes)
+            self._tx_seq += 1
+            inj = _armed_net_injector()
+            frames = ([frame] if inj is None
+                      else inj.on_wire_tx(frame, peer=self.peer_id))
+            sent = 0
+            for fr in frames:
+                try:
+                    self._sock.sendall(fr)
+                except OSError as e:
+                    self.close()
+                    raise TransportError(f"send failed: {e}") from e
+                sent += len(fr)
+            self.bytes_sent += sent
+        return sent
 
     def recv(self, timeout: Optional[float] = 0.0
              ) -> Optional[Dict[str, Any]]:
         """Next message, or None when nothing arrives within
-        ``timeout``. Raises ChannelError on peer close / corruption."""
+        ``timeout``. Raises ChannelError on peer close / corruption /
+        a sequence gap (a dropped frame upstream)."""
         if self._inbox:
             return self._inbox.popleft()
         if self.closed:
@@ -98,12 +169,22 @@ class SocketChannel:
                 self.close()
                 raise ChannelError("peer closed the connection")
             self.bytes_received += len(chunk)
+            inj = _armed_net_injector()
+            if inj is not None:
+                chunk = inj.on_wire_rx(chunk, peer=self.peer_id)
+            if chunk is None:
+                chunk = b""
             try:
                 for payload in self._reader.feed(chunk):
-                    self._inbox.append(decode_message(payload))
+                    msg = self._seq_deliver(decode_message(payload))
+                    if msg is not None:
+                        self._inbox.append(msg)
             except FrameError as e:
                 self.close()
                 raise ChannelError(str(e)) from e
+            except ChannelError:
+                self.close()
+                raise
             if not self._inbox and deadline is not None \
                     and time.time() >= deadline:
                 return None
@@ -149,27 +230,41 @@ class SocketServer:
 def connect_with_backoff(host: str, port: int, retries: int = 20,
                          backoff_s: float = 0.05,
                          backoff_max_s: float = 1.0,
-                         max_frame_bytes: int = DEFAULT_MAX_FRAME_BYTES
+                         max_frame_bytes: int = DEFAULT_MAX_FRAME_BYTES,
+                         policy: Optional[Any] = None,
+                         peer_id: Optional[int] = None
                          ) -> SocketChannel:
     """Dial the peer, retrying refused/reset connects on an exponential
     schedule (worker startup and supervisor restart both race this).
-    Raises ChannelError once the budget is spent."""
+    ``policy`` (a resilience.policy.RetryPolicy) supersedes the legacy
+    retries/backoff_s knobs: attempts = max_retries + 1, delays from
+    ``policy.backoff_s(attempt)``. Raises ChannelError once the budget
+    is spent."""
+    if policy is not None:
+        attempts = max(1, int(policy.max_retries) + 1)
+    else:
+        attempts = max(1, int(retries))
     delay = float(backoff_s)
     last: Optional[Exception] = None
-    for _ in range(max(1, int(retries))):
+    for attempt in range(1, attempts + 1):
         try:
             sock = socket.create_connection((host, port), timeout=5.0)
-            return SocketChannel(sock, max_frame_bytes)
+            return SocketChannel(sock, max_frame_bytes, peer_id=peer_id)
         except OSError as e:
             last = e
-            time.sleep(delay)
-            delay = min(delay * 2.0, float(backoff_max_s))
+            if attempt >= attempts:
+                break
+            if policy is not None:
+                time.sleep(policy.backoff_s(attempt))
+            else:
+                time.sleep(delay)
+                delay = min(delay * 2.0, float(backoff_max_s))
     raise ChannelError(
-        f"could not connect to {host}:{port} after {retries} attempts: "
+        f"could not connect to {host}:{port} after {attempts} attempts: "
         f"{last}")
 
 
-class FileChannel:
+class FileChannel(_SeqMixin):
     """Spool-dir frames: the socketless degraded fallback.
 
     One spool directory holds two one-way lanes (``a2b``/``b2a``); each
@@ -181,7 +276,8 @@ class FileChannel:
     ChannelError exactly like a corrupt socket stream."""
 
     def __init__(self, spool_dir: str, side: str,
-                 max_frame_bytes: int = DEFAULT_MAX_FRAME_BYTES):
+                 max_frame_bytes: int = DEFAULT_MAX_FRAME_BYTES,
+                 peer_id: Optional[int] = None):
         if side not in ("a", "b"):
             raise ValueError(f"side must be 'a' or 'b', got {side!r}")
         self.spool_dir = spool_dir
@@ -192,28 +288,44 @@ class FileChannel:
         os.makedirs(self._tx, exist_ok=True)
         os.makedirs(self._rx, exist_ok=True)
         self.max_frame_bytes = int(max_frame_bytes)
+        self.peer_id = peer_id
         self._seq = 0
         self._lock = threading.Lock()
         self.bytes_sent = 0
         self.bytes_received = 0
         self.closed = False
+        self._seq_init()
 
     def send(self, msg: Dict[str, Any]) -> int:
-        frame = encode_frame(encode_message(msg), self.max_frame_bytes)
         with self._lock:
             if self.closed:
                 raise ChannelError("channel closed")
-            path = os.path.join(self._tx, f"{self._seq:012d}.frame")
-            self._seq += 1
-        tmp = path + f".tmp.{os.getpid()}"
-        with open(tmp, "wb") as f:
-            f.write(frame)
-            f.flush()
-            os.fsync(f.fileno())
-        os.replace(tmp, path)
+            frame = encode_frame(
+                encode_message(dict(msg, **{SEQ_KEY: self._tx_seq})),
+                self.max_frame_bytes)
+            self._tx_seq += 1
+            inj = _armed_net_injector()
+            frames = ([frame] if inj is None
+                      else inj.on_wire_tx(frame, peer=self.peer_id))
+            spool = [(fr, os.path.join(self._tx,
+                                       f"{self._seq + i:012d}.frame"))
+                     for i, fr in enumerate(frames)]
+            self._seq += len(frames)
+        sent = 0
+        for fr, path in spool:
+            tmp = path + f".tmp.{os.getpid()}"
+            try:
+                with open(tmp, "wb") as f:
+                    f.write(fr)
+                    f.flush()
+                    os.fsync(f.fileno())
+                os.replace(tmp, path)
+            except OSError as e:
+                raise TransportError(f"spool write failed: {e}") from e
+            sent += len(fr)
         with self._lock:
-            self.bytes_sent += len(frame)
-        return len(frame)
+            self.bytes_sent += sent
+        return sent
 
     def _next_file(self) -> Optional[str]:
         try:
@@ -235,6 +347,11 @@ class FileChannel:
                     frame = f.read()
                 os.unlink(path)
                 self.bytes_received += len(frame)
+                inj = _armed_net_injector()
+                if inj is not None:
+                    frame = inj.on_wire_rx(frame, peer=self.peer_id)
+                if frame is None:
+                    continue
                 reader = FrameReader(self.max_frame_bytes)
                 try:
                     payloads = reader.feed(frame)
@@ -246,7 +363,10 @@ class FileChannel:
                         f"{len(payloads)} frames + "
                         f"{reader.pending_bytes} stray bytes "
                         "(expected exactly one)")
-                return decode_message(payloads[0])
+                msg = self._seq_deliver(decode_message(payloads[0]))
+                if msg is None:
+                    continue
+                return msg
             if deadline is not None and time.time() >= deadline:
                 return None
             time.sleep(poll_s)

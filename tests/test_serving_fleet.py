@@ -241,7 +241,9 @@ class TestDisaggFleet:
             router.submit(uid, p, max_new_tokens=4)
         router.run_until_complete()
         snap = router.fleet_snapshot(deadline_s=5.0)
-        assert snap["schema"] == "serving_fleet/v1"
+        assert snap["schema"] == "serving_fleet/v2"
+        assert set(snap["health"]) == \
+            {str(r["replica"]) for r in snap["replicas"]}
         assert snap["mode"] == "disagg"
         assert {r["role"] for r in snap["replicas"]} == \
             {"prefill", "decode"}
@@ -296,15 +298,33 @@ class TestFailover:
         snap = router.fleet_snapshot()
         assert snap["dead_replicas"] == [victim_id]
 
-    def test_last_replica_death_raises(self, tiny):
+    def test_total_outage_parks_inflight_and_recovers(self, tiny):
+        """Every replica dead at once is a MOMENT when a supervisor is
+        restarting workers, not a verdict: in-flight requests park and
+        retry each health check; only NEW submissions fail loud."""
         router = make_fleet(tiny, roles=("unified",),
                             router_kw={"stale_after_s": 0.05})
         router.submit(1, np.asarray([1, 2, 3, 4], np.int32),
                       max_new_tokens=4)
         router.replicas[0].kill()
         time.sleep(0.1)
+        assert router.check_health() == [0]  # no raise: victim parked
+        assert router.pending() == 1
+        assert router.stats["stranded"] == 1
         with pytest.raises(RuntimeError, match="no live replicas"):
-            router.check_health()
+            router.submit(2, np.asarray([1, 2, 3], np.int32),
+                          max_new_tokens=2)
+        # capacity returns: the parked request fails over + completes
+        model, params = tiny
+        fresh = ServingReplica.create(model, 1, role="unified",
+                                      params=params, dtype=jnp.float32,
+                                      **ENGINE_DEFAULTS)
+        router.add_replica(fresh)
+        router.check_health()
+        assert router.stats["stranded"] == 0
+        assert router.stats["failed_over_requests"] == 1
+        router.run_until_complete()
+        assert len(router.results()[1]) == 4
 
 
 # -- autoscale signal ----------------------------------------------------

@@ -74,6 +74,12 @@ DEFAULT_THRESHOLDS: Dict[str, Tuple[str, float]] = {
     # blowup (a broken failover path, not scheduling noise) fails
     "kv_wire_ratio": ("max_ratio", 1.15),
     "ttft_p999_ms": ("max_ratio", 1.5),
+    # chaos-certified fleet (BENCH_MODE=chaos_fleet): the worst
+    # fault-arm p99.9 TTFT relative to the fault-free arm may not grow
+    # >1.5x round-over-round (a slower recovery path), and the boolean
+    # chaos.zero_drops / chaos.bit_identical certificates must stay
+    # true — those are checked unconditionally below, not ratio'd
+    "chaos.ttft_p999_ratio": ("max_ratio", 1.5),
     # kernel tier (BENCH_KERNELS payloads): a kernel that won its bucket
     # last round must still win (a silent all-XLA regression is exactly
     # the failure the table-driven dispatch exists to catch), and the
@@ -186,7 +192,8 @@ def diff_reports(old: Dict[str, Any], new: Dict[str, Any],
                   drop <= limit)
         # cross-process fleet sentinels (serve_procs payloads): KV wire
         # compression and the chaos arm's p99.9 failover tail
-        for key in ("kv_wire_ratio", "ttft_p999_ms"):
+        for key in ("kv_wire_ratio", "ttft_p999_ms",
+                    "chaos.ttft_p999_ratio"):
             ov, nv = old.get(key), new.get(key)
             if isinstance(ov, (int, float)) and \
                     isinstance(nv, (int, float)) and ov > 0:
@@ -223,6 +230,15 @@ def diff_reports(old: Dict[str, Any], new: Dict[str, Any],
                 check(f"{arm}.peak_concurrent_sessions", rule,
                       limit * loosen, ov, nv, ratio,
                       ratio >= limit * loosen)
+
+    # chaos certificates ride any payload that carries them — the new
+    # round's zero-drops and bit-identical flags must be true regardless
+    # of comparability (a chaos round that dropped a request or diverged
+    # a stream is broken on its own, not relative to the old round)
+    for cert in ("chaos.zero_drops", "chaos.bit_identical"):
+        if cert in new:
+            check(cert, "must_stay_true", 1, old.get(cert),
+                  new.get(cert), float(bool(new[cert])), bool(new[cert]))
 
     # quant acceptance gates ride every payload that carries them —
     # comparable or not, a failing gate in the NEW round always fails

@@ -1251,6 +1251,405 @@ def run_procs() -> dict:
     }
 
 
+def _drive_chaos_arm(arm, base_dir, model_spec, engine_spec, prompts,
+                     arrivals, gen, knobs):
+    """One chaos-certification arm: the SAME workload and arrival
+    schedule through a 2-worker socket fleet, with exactly one fault
+    family armed.
+
+    Net faults (``drop``/``delay``/``dup``/``corrupt``/``partition``)
+    are armed as the process-global chaos injector in THIS process, so
+    they hit the supervisor-side channel endpoints — real frames on the
+    real socket. ``kill`` and ``crashloop`` reuse the worker-side
+    ``DSTPU_CHAOS`` self-kill. ``hedge`` degrades one worker with a
+    per-round delay and lets hedged requests race around it. Fault arms
+    run with hedging enabled: a submit frame the fault family ate is a
+    request with no stream anywhere, and the hedge deadline is what
+    resurrects it (the seq-gap ChannelError then recycles the worker).
+    """
+    import threading
+
+    from deepspeed_tpu.resilience.chaos import (ChaosInjector, ChaosSpec,
+                                                reset_chaos_injector,
+                                                set_chaos_injector)
+    from deepspeed_tpu.serving import FleetRouter, ReplicaSupervisor
+    from deepspeed_tpu.serving.replica import Submission
+
+    net_specs = {
+        "drop": f"net_drop_frac={knobs['drop_frac']},net_seed=7",
+        "delay": "net_delay_ms=5",
+        "dup": "net_dup=2",
+        "corrupt": "net_corrupt=6",
+        "partition": f"net_partition=r1:{knobs['partition_ops']}",
+    }
+    run_dir = os.path.join(base_dir, arm)
+    crashloop = arm == "crashloop"
+    sup = ReplicaSupervisor(
+        run_dir, model=model_spec, engine=dict(engine_spec),
+        seed=knobs["seed"],
+        max_restarts_per_window=2 if crashloop else 3,
+        restart_window_s=60.0 if crashloop else 30.0,
+        min_healthy=1)
+    n_rep = knobs["replicas"]
+    remotes = [sup.spawn(role="unified")]
+    if arm == "kill":
+        remotes.append(sup.spawn(role="unified", env_extra={
+            "DSTPU_CHAOS": "kill_rank=1,kill_step=2,kill_signal=SIGKILL"}))
+    elif crashloop:
+        # no kill_rank: every respawned incarnation crashes on its
+        # first busy round — the supervisor's breaker must contain it
+        remotes.append(sup.spawn(role="unified", env_extra={
+            "DSTPU_CHAOS": "kill_step=1,kill_signal=SIGKILL"}))
+    elif arm == "hedge":
+        remotes.append(sup.spawn(role="unified",
+                                 step_delay_ms=knobs["slow_step_ms"]))
+    else:
+        remotes += [sup.spawn(role="unified")
+                    for _ in range(max(1, n_rep - 1))]
+    router = FleetRouter(
+        remotes, stale_after_s=knobs["stale_after_s"],
+        affinity_blocks=0,
+        # least_loaded for the hedge arm so the degraded worker keeps
+        # RECEIVING work (predictive would learn to dodge it and the
+        # hedge path would never fire)
+        routing="least_loaded" if arm == "hedge" else "predictive",
+        hedge_enabled=arm != "none",
+        hedge_ttft_factor=2.0 if arm == "hedge" else 3.0,
+        hedge_min_s=0.3 if arm == "hedge" else 1.0)
+    sup.router = router
+
+    n = len(prompts)
+    first_tok = {}
+    tlock = threading.Lock()
+    t0_box = [None]
+
+    def _wrap_new():
+        for r in router.replicas.values():
+            if getattr(r, "_bench_wrapped", False):
+                continue
+            orig_cb = r.emit_callback
+
+            def cb(replica, emitted, _orig=orig_cb):
+                if t0_box[0] is not None:
+                    tnow = time.perf_counter() - t0_box[0]
+                    with tlock:
+                        for uid in emitted:
+                            if uid not in first_tok:
+                                first_tok[uid] = tnow
+                _orig(replica, emitted)
+
+            r.emit_callback = cb
+            r._bench_wrapped = True
+
+    _wrap_new()
+
+    # each DSTPU_CHAOS incarnation gets one direct probe (uid >= 2e6,
+    # outside the workload) so its busy-round kill actually fires —
+    # routed traffic alone might starve a fresh replica and leave the
+    # drill unexercised
+    probed = set()
+
+    def _probe_chaos_workers():
+        for rid, remote in list(sup.replicas.items()):
+            if rid in probed or remote.draining or remote.exited:
+                continue
+            if "DSTPU_CHAOS" not in (sup._env_extra.get(rid) or {}):
+                continue
+            probed.add(rid)
+            remote.submit(Submission(uid=2_000_000 + rid,
+                                     tokens=prompts[0],
+                                     max_new_tokens=4))
+
+    # compile warm-up OUTSIDE the timed window and BEFORE the injector
+    # arms (a dropped warm probe would wedge the warm barrier): direct
+    # stub probes, skipping DSTPU_CHAOS victims — their busy-round
+    # budget belongs to the drill
+    warm = [r for r in remotes
+            if "DSTPU_CHAOS" not in (
+                sup._env_extra.get(r.replica_id) or {})]
+    for j, r in enumerate(warm):
+        r.submit(Submission(uid=1_000_000 + j, tokens=prompts[0],
+                            max_new_tokens=gen))
+    warm_deadline = time.time() + 180.0
+    while time.time() < warm_deadline and not all(
+            r.load_report().get("inflight", 0) == 0 for r in warm):
+        sup.maintain()
+        router.check_health()
+        time.sleep(0.05)
+
+    if arm in net_specs:
+        set_chaos_injector(
+            ChaosInjector(ChaosSpec.parse(net_specs[arm]), rank=0))
+    try:
+        from deepspeed_tpu.resilience.chaos import get_chaos_injector
+
+        t0 = time.perf_counter()
+        t0_box[0] = t0
+        i = 0
+        last_maint = 0.0
+        inj_stats = None
+        while i < n:
+            now = time.perf_counter() - t0
+            if arrivals[i] <= now:
+                router.submit(i, prompts[i], max_new_tokens=gen)
+                i += 1
+                continue
+            if now - last_maint >= knobs["maintain_s"]:
+                sup.maintain()
+                router.check_health()
+                _wrap_new()
+                _probe_chaos_workers()
+                last_maint = now
+            time.sleep(min(max(arrivals[i] - now, 0.0), 0.01))
+        if arm == "corrupt":
+            # corruption is the one fault that kills workers faster
+            # than the restart window forgives: every Nth frame corrupt
+            # FOREVER means each failover burst re-corrupts, and the
+            # breaker (correctly) quarantines the whole fleet — that is
+            # a broken NIC, not a survivable fault. The drill models a
+            # bounded corruption burst instead: faults through the
+            # arrival window, clean wire for the drain, so what gets
+            # certified is the recovery (CRC trip -> worker dies loud
+            # -> restart + failover) and not a dead-wire verdict.
+            inj_stats = dict(get_chaos_injector().net_stats)
+            reset_chaos_injector()
+        deadline = time.time() + knobs["drain_timeout_s"]
+        while time.time() < deadline:
+            sup.maintain()
+            router.check_health()
+            _wrap_new()
+            _probe_chaos_workers()
+            if router.pending() == 0:
+                break
+            time.sleep(0.02)
+        if crashloop:
+            # the workload can drain before the looper's final crash —
+            # keep supervising until the breaker verdict is in (each
+            # respawned incarnation is probed so its busy-round kill
+            # actually fires)
+            cl_deadline = time.time() + 60.0
+            while time.time() < cl_deadline and not sup.quarantined:
+                sup.maintain()
+                router.check_health()
+                _probe_chaos_workers()
+                time.sleep(0.05)
+        wall = time.perf_counter() - t0
+        if inj_stats is None and arm in net_specs:
+            inj_stats = dict(get_chaos_injector().net_stats)
+    finally:
+        if arm in net_specs:
+            reset_chaos_injector()
+    sup.write_fleet_snapshot()
+    results = router.results()
+    live_end = len(sup._live_ids())
+    dup_frames = sum(getattr(r.channel, "dup_frames", 0)
+                     for r in sup.replicas.values())
+    sup.shutdown()
+
+    results = {uid: t for uid, t in results.items() if uid < n}
+    completed = sum(1 for t in results.values() if len(t) >= gen)
+    total_tokens = sum(len(t) for t in results.values())
+    ttfts = {uid: t - arrivals[uid] for uid, t in first_tok.items()
+             if uid < n}
+    acts = [a[1] for a in sup.actions]
+    return {
+        "arm": arm,
+        "requests": n,
+        "completed": completed,
+        "dropped": n - completed,
+        "wall_s": round(wall, 3),
+        "tokens_per_s": round(total_tokens / max(wall, 1e-9), 1),
+        **_percentiles_ms(list(ttfts.values())),
+        "tokens": {str(uid): results[uid] for uid in sorted(results)},
+        "restarts": acts.count("restart"),
+        "quarantines": acts.count("quarantine"),
+        "quarantined_lineages": sorted(sup.quarantined),
+        "drain_refused": acts.count("drain_refused"),
+        "live_at_end": live_end,
+        "failed_over_requests": router.stats["failed_over_requests"],
+        "hedged": router.stats["hedged"],
+        "hedge_wins": router.stats["hedge_wins"],
+        "rx_dup_frames": dup_frames,
+        "net_faults": inj_stats,
+        "supervisor_actions": [[round(ts - t0, 3), act, rid]
+                               for ts, act, rid in sup.actions],
+    }
+
+
+def run_chaos_fleet() -> dict:
+    """Chaos-certification bench (``BENCH_MODE=chaos_fleet``,
+    ``make chaos-fleet``): the PR-13 diurnal + bursty open-loop workload
+    served through a socket process fleet while one fault family at a
+    time is armed — ``drop``/``delay``/``dup``/``corrupt`` (seeded
+    frame-level transport faults), ``partition`` (both directions of one
+    worker's link blackholed for a wire-op window), ``kill`` (worker
+    SIGKILLs itself mid-request), ``crashloop`` (every respawn crashes
+    until the supervisor's circuit breaker quarantines the lineage), and
+    ``hedge`` (one degraded worker, hedged requests race around it) —
+    against a fault-free ``none`` baseline. One JSON line; violations
+    ride ``ok``/``violations`` so ``tools/bench_diff.py`` fails the
+    round on any broken gate.
+
+    Gates: every arm drops zero requests (``chaos.zero_drops``); every
+    completed stream is bit-identical to the fault-free baseline
+    (``chaos.bit_identical`` — greedy decoding through failover,
+    hedging, dups and partitions must not change a single token); the
+    worst fault-arm TTFT p99.9 stays within CHAOS_MAX_P999_RATIO of the
+    baseline (``chaos.ttft_p999_ratio``); the crash-looper is
+    quarantined exactly once with restarts bounded by the breaker
+    window and the min-healthy floor held; no other arm quarantines
+    anything; the hedge arm records ``hedge_wins >= 1``
+    (``chaos.hedge_wins``).
+
+    Env knobs (CPU defaults in parens): CHAOS_FLEET_REQUESTS (8),
+    CHAOS_FLEET_PROMPT (32), CHAOS_FLEET_GEN (8), CHAOS_FLEET_RATE
+    (2.0/s), CHAOS_FLEET_PERIOD_S (4), CHAOS_FLEET_REPLICAS (2),
+    CHAOS_FLEET_STALE_S (1.0), CHAOS_FLEET_SLOW_STEP_MS (1500),
+    CHAOS_FLEET_DROP_FRAC (0.12), CHAOS_FLEET_PARTITION_OPS (60),
+    CHAOS_MAX_P999_RATIO (50), CHAOS_FLEET_ARMS, CHAOS_FLEET_RUN_DIR,
+    CHAOS_FLEET_SEED, CHAOS_FLEET_DRAIN_TIMEOUT_S (180)."""
+    import numpy as np
+
+    base_dir = os.environ.get("CHAOS_FLEET_RUN_DIR",
+                              "/tmp/dstpu_chaos_fleet")
+    model_name = os.environ.get("CHAOS_FLEET_MODEL", "tiny")
+    n_req = int(os.environ.get("CHAOS_FLEET_REQUESTS", 8))
+    prompt_len = int(os.environ.get("CHAOS_FLEET_PROMPT", 32))
+    gen = int(os.environ.get("CHAOS_FLEET_GEN", 8))
+    rate = float(os.environ.get("CHAOS_FLEET_RATE", 2.0))
+    period_s = float(os.environ.get("CHAOS_FLEET_PERIOD_S", 4.0))
+    seed = int(os.environ.get("CHAOS_FLEET_SEED", 0))
+    max_ratio = float(os.environ.get("CHAOS_MAX_P999_RATIO", 50.0))
+    arms = os.environ.get(
+        "CHAOS_FLEET_ARMS",
+        "none,drop,delay,dup,corrupt,partition,kill,crashloop,hedge"
+    ).split(",")
+    block = 8
+    blocks_per_seq = (prompt_len + gen) // block + 3
+
+    model_spec = {"name": model_name,
+                  "overrides": {"dtype": "float32",
+                                "param_dtype": "float32"}}
+    engine_spec = dict(
+        kv_blocks=blocks_per_seq * max(4, n_req) + 2,
+        kv_block_size=block, max_tokens_per_step=64,
+        max_seqs_per_step=8, max_blocks_per_seq=blocks_per_seq,
+        dtype="float32", request_trace={"sample_rate": 1.0})
+
+    rng = np.random.default_rng(seed)
+    vocab = 256
+    shared = rng.integers(0, vocab, (prompt_len * 3 // 4,))
+    prompts = []
+    for _ in range(n_req):
+        tail = rng.integers(0, vocab,
+                            (prompt_len - len(shared),))
+        prompts.append(np.concatenate(
+            [shared, tail]).astype(np.int32))
+    arrivals = _nhpp_arrivals(n_req, rate, period_s, 3.0, 0.2, rng)
+
+    knobs = {
+        "replicas": int(os.environ.get("CHAOS_FLEET_REPLICAS", 2)),
+        "stale_after_s": float(os.environ.get("CHAOS_FLEET_STALE_S",
+                                              1.0)),
+        "slow_step_ms": float(os.environ.get("CHAOS_FLEET_SLOW_STEP_MS",
+                                             1500.0)),
+        "drop_frac": float(os.environ.get("CHAOS_FLEET_DROP_FRAC",
+                                          0.12)),
+        "partition_ops": int(os.environ.get("CHAOS_FLEET_PARTITION_OPS",
+                                            60)),
+        "maintain_s": 0.05,
+        "drain_timeout_s": float(os.environ.get(
+            "CHAOS_FLEET_DRAIN_TIMEOUT_S", 180.0)),
+        "seed": seed,
+    }
+    results = {}
+    for arm in arms:
+        arm = arm.strip()
+        results[arm] = _drive_chaos_arm(
+            arm, base_dir, model_spec, engine_spec, prompts, arrivals,
+            gen, knobs)
+
+    violations = []
+    base = results.get("none")
+    fault_arms = [a for a in results if a != "none"]
+    for arm, r in results.items():
+        if r["dropped"] > 0:
+            violations.append({
+                "region": arm, "gate": "zero_drops",
+                "limit": 0, "got": r["dropped"]})
+    bit_identical = True
+    if base:
+        for arm in fault_arms:
+            if results[arm]["tokens"] != base["tokens"]:
+                bit_identical = False
+                diff = [u for u in base["tokens"]
+                        if results[arm]["tokens"].get(u)
+                        != base["tokens"][u]]
+                violations.append({
+                    "region": arm, "gate": "bit_identical",
+                    "limit": "tokens == fault-free baseline",
+                    "got": f"streams differ for uids {diff[:8]}"})
+    p999_ratio = None
+    if base and base.get("ttft_p999_ms"):
+        worst = max((results[a]["ttft_p999_ms"] for a in fault_arms
+                     if results[a].get("ttft_p999_ms")), default=None)
+        if worst is not None:
+            p999_ratio = round(worst / base["ttft_p999_ms"], 3)
+            if p999_ratio > max_ratio:
+                violations.append({
+                    "region": "chaos", "gate": "ttft_p999_ratio",
+                    "limit": max_ratio, "got": p999_ratio})
+    cl = results.get("crashloop")
+    if cl:
+        if not cl["quarantined_lineages"]:
+            violations.append({
+                "region": "crashloop", "gate": "quarantined",
+                "limit": ">=1 lineage", "got": cl["quarantines"]})
+        if cl["quarantines"] > len(cl["quarantined_lineages"]):
+            violations.append({
+                "region": "crashloop", "gate": "no_quarantine_flaps",
+                "limit": "one quarantine act per lineage",
+                "got": cl["quarantines"]})
+        if cl["restarts"] > 2:
+            violations.append({
+                "region": "crashloop", "gate": "restarts_bounded",
+                "limit": 2, "got": cl["restarts"]})
+        if cl["live_at_end"] < 1:
+            violations.append({
+                "region": "crashloop", "gate": "min_healthy_floor",
+                "limit": ">=1 live worker", "got": cl["live_at_end"]})
+    for arm in results:
+        if arm != "crashloop" and results[arm]["quarantines"] > 0:
+            violations.append({
+                "region": arm, "gate": "no_stray_quarantine",
+                "limit": 0, "got": results[arm]["quarantines"]})
+    hedge = results.get("hedge")
+    if hedge and hedge["hedge_wins"] < 1:
+        violations.append({
+            "region": "hedge", "gate": "hedge_wins",
+            "limit": ">=1", "got": hedge["hedge_wins"]})
+    for r in results.values():
+        r.pop("tokens", None)  # compared above; too bulky to print
+
+    return {
+        "metric": f"{model_name} chaos_fleet tokens/s "
+                  f"({knobs['replicas']} worker procs, {n_req} req, "
+                  f"{len(results)} fault arms, socket transport)",
+        "value": base["tokens_per_s"] if base else None,
+        "unit": "tokens/s",
+        "chaos.zero_drops": all(r["dropped"] == 0
+                                for r in results.values()),
+        "chaos.bit_identical": bit_identical,
+        "chaos.ttft_p999_ratio": p999_ratio,
+        "chaos.hedge_wins": hedge["hedge_wins"] if hedge else None,
+        "chaos.quarantined": (len(cl["quarantined_lineages"])
+                              if cl else None),
+        "arms": results,
+        "ok": not violations,
+        "violations": violations,
+    }
+
+
 if __name__ == "__main__":
     mode = os.environ.get("BENCH_MODE", "serve")
     if mode == "serve_fleet":
@@ -1260,6 +1659,11 @@ if __name__ == "__main__":
         _pp = run_procs()
         print(json.dumps(_pp))
         if not _pp.get("ok", True):
+            raise SystemExit(1)
+    elif mode == "chaos_fleet":
+        _cp = run_chaos_fleet()
+        print(json.dumps(_cp))
+        if not _cp.get("ok", True):
             raise SystemExit(1)
     elif mode == "serve_quant":
         _qp = run_quant()

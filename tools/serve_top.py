@@ -18,12 +18,14 @@ tool is the read side — pure host code, no jax:
   python tools/serve_top.py --fleet RUN_DIR             # cross-process run
   python tools/serve_top.py --fleet --demo              # 2-replica demo
 
-``--fleet`` reads a ``serving_fleet/v1`` snapshot document
+``--fleet`` reads a ``serving_fleet/v2`` snapshot document
 (``FleetRouter.fleet_snapshot()``; ``make serve-fleet`` writes one per
-arm into FLEET_TRACE_DIR) and prints the per-replica load-report table,
-the router counters (handoffs, failovers, affinity hits), the autoscale
-state, and the fleet-level SLO attribution with per-replica miss
-counts. Given a *directory* (a ``make serve-procs`` run dir), it loads
+arm into FLEET_TRACE_DIR) — v1 documents from older runs still render,
+minus the health column — and prints the per-replica load-report table
+(including the PR 15 health state machine state and hedge counters),
+the router counters (handoffs, failovers, affinity hits, hedges), the
+autoscale state, the supervisor's restart/quarantine tallies, and the
+fleet-level SLO attribution with per-replica miss counts. Given a *directory* (a ``make serve-procs`` run dir), it loads
 the supervisor's merged ``fleet_snapshot.json`` — falling back to the
 raw per-worker reports under ``<run_dir>/replicas/`` — so a
 cross-process fleet is observable mid-run from a second terminal.
@@ -71,7 +73,7 @@ def parse_args(argv=None):
                    help="run a small CPU serve_step workload through the "
                         "v2 engine and print its attribution table")
     p.add_argument("--fleet", action="store_true",
-                   help="treat the positional arg as a serving_fleet/v1 "
+                   help="treat the positional arg as a serving_fleet/v2 "
                         "snapshot (FleetRouter.fleet_snapshot / make "
                         "serve-fleet) or a cross-process run dir (make "
                         "serve-procs) and print the per-replica fleet "
@@ -154,16 +156,24 @@ def _run_demo() -> int:
 
 
 def _fleet_table(snap: dict) -> str:
-    """Render a serving_fleet/v1 snapshot as the fleet dashboard."""
+    """Render a serving_fleet/v2 snapshot as the fleet dashboard
+    (v1 documents render too — health falls back to the dead set)."""
     lines = [f"## serving fleet ({snap.get('mode', '?')} mode)", "",
              "| replica | role | steps | queue | live | inflight | "
              "kv free | goodput tok/s | kv quant | wire | "
              "handoff wire/logical | kv SNR dB | state |",
              "|---|---|---|---|---|---|---|---|---|---|---|---|---|"]
     dead = set(snap.get("dead_replicas", []))
+    health = snap.get("health") or {}  # v2; absent in v1 documents
     for r in snap.get("replicas", []):
-        state = ("DEAD" if r["replica"] in dead
-                 else "killed" if r.get("killed") else "up")
+        h = health.get(str(r["replica"]))
+        if h:
+            state = h["state"]
+            if h.get("transitions"):
+                state += f" ({h['transitions']}x)"
+        else:
+            state = ("DEAD" if r["replica"] in dead
+                     else "killed" if r.get("killed") else "up")
         bits = r.get("kv_quant_bits")
         quant = "bf16" if bits is None else f"int{bits}"
         wire = r.get("handoff_wire", "auto")
@@ -182,7 +192,8 @@ def _fleet_table(snap: dict) -> str:
     lines += ["", "router: " + "  ".join(
         f"{k}={st[k]}" for k in ("submitted", "completed", "handoffs",
                                  "handoff_recompute", "failovers",
-                                 "failed_over_requests", "affinity_hits")
+                                 "failed_over_requests", "affinity_hits",
+                                 "hedged", "hedge_wins")
         if k in st)]
     auto = snap.get("autoscale")
     if auto:
@@ -199,6 +210,18 @@ def _fleet_table(snap: dict) -> str:
                          for a in acts[-6:])
         lines += [f"supervisor: {up}/{len(procs)} worker processes up  "
                   f"actions={len(acts)}" + (f"  [{tail}]" if tail else "")]
+        extra = []
+        if "restarts" in sup:
+            extra.append(f"restarts={sup['restarts']}")
+        if sup.get("quarantined"):
+            q = ",".join(f"r{r}" for r in sup["quarantined"])
+            extra.append(f"quarantined=[{q}]")
+        if sup.get("pending_restarts"):
+            extra.append(f"pending_restarts={sup['pending_restarts']}")
+        if "min_healthy" in sup:
+            extra.append(f"min_healthy={sup['min_healthy']}")
+        if extra:
+            lines += ["containment: " + "  ".join(extra)]
         wire = sup.get("transport", {})
         if wire:
             lines += ["transport: " + "  ".join(
@@ -262,7 +285,7 @@ def _load_run_dir_snapshot(run_dir: str):
     if not reports:
         return None
     roles = {r.get("role") for r in reports.values()}
-    return {"schema": "serving_fleet/v1",
+    return {"schema": "serving_fleet/v2",
             "mode": "disagg" if "prefill" in roles else "unified",
             "replicas": [reports[k] for k in sorted(reports)]}
 
@@ -285,9 +308,10 @@ def main(argv=None) -> int:
         else:
             with open(args.traces) as f:
                 snap = json.load(f)
-        if snap.get("schema") != "serving_fleet/v1":
-            print(f"serve_top: {args.traces} is not a serving_fleet/v1 "
-                  f"snapshot (schema={snap.get('schema')!r})",
+        if snap.get("schema") not in ("serving_fleet/v1",
+                                      "serving_fleet/v2"):
+            print(f"serve_top: {args.traces} is not a serving_fleet "
+                  f"v1/v2 snapshot (schema={snap.get('schema')!r})",
                   file=sys.stderr)
             return 1
         print(_fleet_table(snap))
