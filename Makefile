@@ -14,7 +14,7 @@ NPROC := $(shell nproc)
 XDIST ?= $(shell if [ $(NPROC) -gt 2 ] && python -c "import xdist" 2>/dev/null; then echo "-n $$(( $(NPROC) - 1 )) --dist loadfile"; fi)
 PYTEST ?= python -m pytest
 
-.PHONY: test smoke slow bench bench-real bench-proxy bench-hostgap bench-overlap bench-longctx bench-quant bench-kernels bench-diff quant-sweep fleet-demo chaos serve-slo serve-fleet serve-quant serve-procs chaos-fleet
+.PHONY: test smoke slow bench bench-real bench-proxy bench-hostgap bench-overlap bench-longctx bench-quant bench-kernels bench-diff quant-sweep fleet-demo chaos serve-slo serve-fleet serve-quant serve-procs chaos-fleet obs-fleet
 
 smoke:
 	$(PYTEST) tests/ -q -m "not slow" $(XDIST)
@@ -172,6 +172,20 @@ serve-procs:
 # (docs/resilience.md "Serving fleet fault matrix").
 chaos-fleet:
 	BENCH_MODE=chaos_fleet python bench.py
+
+# Observability-plane certification (tools/serve_bench.py run_obs_fleet):
+# (a) request-tracer emit-point overhead at sample_rate=1.0 vs a disabled
+# tracer, gated at OBS_MAX_TRACE_OVERHEAD_US per request — tracing must
+# stay within noise of the untraced serve path; (b) clock-sync offset
+# accuracy: an echo-worker subprocess with a ±250 ms skewed wall clock
+# (DSTPU_CLOCK_SKEW_S) is pinged through a real socket channel under the
+# clean / delay / dup net-fault arms, and every arm's
+# |estimate - true skew| must land inside the estimator's own reported
+# uncertainty (the honest-bound gate) and under OBS_MAX_OFFSET_ERR_MS.
+# One JSON line with obs.* keys bench_diff sentinels consume
+# (docs/observability.md "Fleet tracing & clock sync").
+obs-fleet:
+	BENCH_MODE=obs_fleet python bench.py
 
 # Fault-injection drill on the 8-device CPU sim: SIGKILL a training rank
 # mid-run, let the elastic agent restart it, and assert the auto-resumed

@@ -305,7 +305,8 @@ def overlap_report(model, step_ms, overlap_depth, streaming,
 def main():
     if os.environ.get("BENCH_MODE") in ("serve", "serve_slo",
                                         "serve_fleet", "serve_quant",
-                                        "serve_procs", "chaos_fleet"):
+                                        "serve_procs", "chaos_fleet",
+                                        "obs_fleet"):
         # serving benchmarks instead of the training headline
         # (tools/serve_bench.py): "serve" is the closed-loop v2-vs-v1
         # throughput comparison (SERVE_* env knobs); "serve_slo" is the
@@ -324,7 +325,11 @@ def main():
         # "chaos_fleet" is the fault-matrix certification — every
         # transport fault family (drop/delay/dup/corrupt/partition)
         # plus kill/crash-loop/hedge arms over the same schedule, gated
-        # on zero drops + bit-identical streams (CHAOS_FLEET_* knobs)
+        # on zero drops + bit-identical streams (CHAOS_FLEET_* knobs);
+        # "obs_fleet" is the observability-plane certification — tracer
+        # emit-point overhead vs disabled, and clock-sync offset
+        # accuracy against a skewed-clock worker subprocess under the
+        # clean/delay/dup net-fault arms (OBS_* env knobs)
         import sys
 
         sys.path.insert(0, os.path.join(os.path.dirname(
@@ -351,6 +356,11 @@ def main():
             print(json.dumps(chaos_payload))
             if not chaos_payload.get("ok", True):
                 sys.exit(1)  # gates: zero drops, bit-identical, p99.9
+        elif os.environ.get("BENCH_MODE") == "obs_fleet":
+            obs_payload = serve_bench.run_obs_fleet()
+            print(json.dumps(obs_payload))
+            if not obs_payload.get("ok", True):
+                sys.exit(1)  # gates: trace overhead, offset-in-bound
         else:
             print(json.dumps(serve_bench.run()))
         return

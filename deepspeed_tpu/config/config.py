@@ -414,6 +414,102 @@ class RequestTraceConfig(ConfigModel):
 
 @register_config_model
 @dataclass
+class ClockSyncConfig(ConfigModel):
+    """Per-channel fleet clock sync (observability/clocksync.py;
+    docs/observability.md "Fleet tracing & clock sync").
+
+    The supervisor attaches one NTP-style offset estimator to every
+    worker channel: ``rounds`` ping/pong exchanges at spawn, a re-ping
+    whenever the newest sample is older than ``resync_seconds``. The
+    estimate is the median offset of the ``k`` lowest-RTT samples in a
+    ``window``-bounded history; ``min_samples`` round trips gate
+    ``synced`` (before that — and always with ``enabled=false`` — every
+    consumer passes raw timestamps through, bit-exact with the
+    pre-clocksync localhost behavior)."""
+
+    enabled: bool = True
+    rounds: int = 8
+    resync_seconds: float = 5.0
+    k: int = 5
+    window: int = 32
+    min_samples: int = 3
+
+    def validate(self) -> None:
+        if self.rounds < 1:
+            raise ValueError(
+                f"observability.clock_sync.rounds must be >= 1, got "
+                f"{self.rounds}")
+        if self.resync_seconds <= 0:
+            raise ValueError(
+                f"observability.clock_sync.resync_seconds must be > 0, "
+                f"got {self.resync_seconds}")
+        if not 1 <= self.k <= self.window:
+            raise ValueError(
+                f"observability.clock_sync needs 1 <= k <= window, got "
+                f"k={self.k} window={self.window}")
+        if self.min_samples < 1:
+            raise ValueError(
+                f"observability.clock_sync.min_samples must be >= 1, "
+                f"got {self.min_samples}")
+
+
+@register_config_model
+@dataclass
+class BurnRateConfig(ConfigModel):
+    """SLO burn-rate alerting (observability/burn_rate.py;
+    docs/observability.md "Burn-rate alerts").
+
+    The SRE multi-window shape: with ``slo_target`` 0.999 the error
+    budget is 0.1%, and the alert fires when BOTH the fast window
+    (``fast_window_seconds`` at >= ``fast_burn`` x budget-neutral
+    spend) and the slow window agree — fast catches the cliff, slow
+    suppresses self-healing blips. ``deadline_ms`` is the per-request
+    SLO deadline on ``objective`` (``ttft`` or ``e2e``); null leaves
+    alerting off even when enabled. A firing alert clears after
+    ``clear_checks`` consecutive clean evaluations; ``min_events``
+    observations must sit in the fast window before the first fire."""
+
+    enabled: bool = False
+    deadline_ms: Optional[float] = None
+    slo_target: float = 0.999
+    fast_window_seconds: float = 60.0
+    fast_burn: float = 14.4
+    slow_window_seconds: float = 600.0
+    slow_burn: float = 6.0
+    clear_checks: int = 3
+    min_events: int = 10
+    objective: str = "ttft"
+
+    def validate(self) -> None:
+        if not 0.0 < self.slo_target < 1.0:
+            raise ValueError(
+                f"burn_rate.slo_target must be in (0, 1), got "
+                f"{self.slo_target}")
+        if self.deadline_ms is not None and self.deadline_ms <= 0:
+            raise ValueError(
+                f"burn_rate.deadline_ms must be > 0 (or null), got "
+                f"{self.deadline_ms}")
+        if not 0 < self.fast_window_seconds <= self.slow_window_seconds:
+            raise ValueError(
+                f"burn_rate needs 0 < fast_window_seconds <= "
+                f"slow_window_seconds, got ({self.fast_window_seconds}, "
+                f"{self.slow_window_seconds})")
+        if self.fast_burn <= 0 or self.slow_burn <= 0:
+            raise ValueError(
+                f"burn_rate burn thresholds must be > 0, got "
+                f"({self.fast_burn}, {self.slow_burn})")
+        if self.clear_checks < 1 or self.min_events < 1:
+            raise ValueError(
+                f"burn_rate.clear_checks and min_events must be >= 1, "
+                f"got ({self.clear_checks}, {self.min_events})")
+        if self.objective not in ("ttft", "e2e"):
+            raise ValueError(
+                f"burn_rate.objective must be ttft|e2e, got "
+                f"{self.objective!r}")
+
+
+@register_config_model
+@dataclass
 class PerformanceConfig(ConfigModel):
     """Pipelined training loop (docs/performance.md).
 
@@ -518,9 +614,11 @@ class ObservabilityConfig(ConfigModel):
     watchdog: WatchdogConfig = field(default_factory=WatchdogConfig)
     request_trace: RequestTraceConfig = field(
         default_factory=RequestTraceConfig)
+    clock_sync: ClockSyncConfig = field(default_factory=ClockSyncConfig)
 
     def validate(self) -> None:
         self.request_trace.validate()
+        self.clock_sync.validate()
         if self.flight_events < 0:
             raise ValueError(
                 f"observability.flight_events must be >= 0, got "
@@ -713,6 +811,7 @@ class RouterConfig(ConfigModel):
     max_restarts_per_window: int = 3
     restart_window_seconds: float = 30.0
     min_healthy: int = 1
+    burn_rate: BurnRateConfig = field(default_factory=BurnRateConfig)
 
     def connect_retry_policy(self):
         """The transport dial schedule as a resilience
@@ -812,6 +911,7 @@ class RouterConfig(ConfigModel):
             raise ValueError(
                 f"serving.router.min_healthy must be >= 1, got "
                 f"{self.min_healthy}")
+        self.burn_rate.validate()
 
 
 @register_config_model

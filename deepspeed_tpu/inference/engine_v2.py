@@ -33,6 +33,7 @@ from deepspeed_tpu.inference.ragged.ragged_batch import build_ragged_batch
 from deepspeed_tpu.inference.scheduler import SplitFuseScheduler
 from deepspeed_tpu.inference.spec_decode import PromptLookupDrafter
 from deepspeed_tpu.models.transformer import TransformerLM
+from deepspeed_tpu.observability.clocksync import wall_time
 from deepspeed_tpu.parallel import topology as topo
 from deepspeed_tpu.utils.logging import log_dist
 
@@ -582,7 +583,9 @@ class InferenceEngineV2:
             # span start backdates by the step wall so prefill lanes
             # line up with the step that computed them
             wall_ms = (now - t0) * 1e3
-            t_start = time.time() - (now - t0)
+            # same clock domain as every other span (skew-aware wall
+            # time): a stamp from the raw clock would rebase acausally
+            t_start = wall_time() - (now - t0)
             for seq, new_tokens, start_pos in scheduled:
                 if start_pos < len(seq.input_tokens):
                     self.tracer.on_prefill(seq.uid, t_start, wall_ms,

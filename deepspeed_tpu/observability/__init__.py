@@ -10,11 +10,17 @@ See docs/observability.md.
 from deepspeed_tpu.observability.attribution import (REGIONS, RegionCost,
                                                      attribute_step,
                                                      attribution_markdown)
+from deepspeed_tpu.observability.burn_rate import BurnRateAlerter
 from deepspeed_tpu.observability.chrome_trace import (
-    chrome_trace_events, export_chrome_trace, export_rank_from_run_dir,
-    export_request_traces, request_trace_events)
+    chrome_trace_events, export_chrome_trace, export_fleet_merged_trace,
+    export_rank_from_run_dir, export_request_traces, request_trace_events)
+from deepspeed_tpu.observability.clocksync import (ClockSyncEstimator,
+                                                   wall_time)
 from deepspeed_tpu.observability.fleet import (FleetAggregator, FleetPublisher,
                                                format_report, resolve_run_dir)
+from deepspeed_tpu.observability.fleet_metrics import (FleetMetricsPlane,
+                                                       compact_snapshot,
+                                                       merge_snapshots)
 from deepspeed_tpu.observability.flight_recorder import (
     FlightRecorder, dump_flight_recorder, get_flight_recorder,
     install_crash_handlers, reset_flight_recorder)
@@ -85,4 +91,11 @@ __all__ = [
     "load_traces_jsonl",
     "slo_attribution",
     "slo_attribution_markdown",
+    "BurnRateAlerter",
+    "ClockSyncEstimator",
+    "wall_time",
+    "FleetMetricsPlane",
+    "compact_snapshot",
+    "merge_snapshots",
+    "export_fleet_merged_trace",
 ]

@@ -1,0 +1,242 @@
+"""Multi-window SLO burn-rate alerting for the serving fleet.
+
+A p99.9 gate (``chaos.ttft_p999_ratio`` in bench_diff) tells you the SLO
+was blown *after* the run. This module is the layer that pages first:
+the SRE-workbook multi-window, multi-burn-rate alert. With an SLO
+target of 99.9%, the **error budget** is 0.1% of requests; the **burn
+rate** is how many times faster than budget-neutral the fleet is
+currently spending it::
+
+    burn = miss_rate / (1 - slo_target)
+
+A burn rate of 1.0 exhausts the budget exactly at the SLO window's end;
+14.4 exhausts a 30-day budget in 2 days. The alert fires only when BOTH
+a fast window (default 60 s, burn >= 14.4 — catches a cliff in minutes)
+and a slow window (default 600 s, burn >= 6.0 — suppresses blips that
+self-heal) are over their thresholds. That pairing is the standard
+defense against both flavors of false page: a single fast window alerts
+on one unlucky batch, a single slow window alerts an hour late.
+
+Hysteresis: once firing, the alert clears only after ``clear_checks``
+consecutive evaluations below *both* thresholds — a fleet oscillating
+around the threshold pages once, not every 5 seconds.
+
+The alerter owns its own deadline (``deadline_ms``) rather than reusing
+``RequestTracer.slo_deadline_ms`` because the router-side per-replica
+tracers (supervisor.RemoteEngineView) have no deadline configured —
+they mirror worker traces. ``observe_trace`` computes the miss verdict
+locally from TTFT (or e2e with ``objective="e2e"``).
+
+Alert transitions emit three ways so no consumer needs a new pipe:
+typed ``slo_alert`` hub events (JSONL sink), ``slo.alerts_fired`` /
+``slo.burn_rate_fast`` / ``slo.burn_rate_slow`` metrics, and a flight-
+recorder record (a post-crash dump shows the page that preceded the
+wedge). Host-side, jax-free, lock-protected (router submit threads +
+health-check thread).
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import deque
+from typing import Any, Dict, Optional
+
+from deepspeed_tpu.observability.clocksync import wall_time as _wall
+
+
+class BurnRateAlerter:
+    """Dual-window burn-rate evaluator over a stream of SLO verdicts.
+
+    Parameters
+    ----------
+    deadline_ms:
+        The SLO deadline applied to each observed trace (TTFT by
+        default). Required — an alerter without a deadline has no error
+        to rate.
+    slo_target:
+        Fraction of requests that must meet the deadline (0.999 ->
+        0.1% error budget).
+    fast_window_s / fast_burn, slow_window_s / slow_burn:
+        The two (window, threshold) pairs; the alert fires when both
+        windows' burn rates are at/above their thresholds.
+    clear_checks:
+        Consecutive clean evaluations required to clear a firing alert.
+    min_events:
+        Minimum observations inside the fast window before the alert
+        may fire (a 1-request window with 1 miss is not a page).
+    objective:
+        ``"ttft"`` (default) or ``"e2e"`` — which latency the deadline
+        applies to.
+    """
+
+    def __init__(self, deadline_ms: float, slo_target: float = 0.999,
+                 fast_window_s: float = 60.0, fast_burn: float = 14.4,
+                 slow_window_s: float = 600.0, slow_burn: float = 6.0,
+                 clear_checks: int = 3, min_events: int = 10,
+                 objective: str = "ttft", hub=None, flight=None):
+        if not (0.0 < slo_target < 1.0):
+            raise ValueError(f"slo_target must be in (0,1), got {slo_target}")
+        if objective not in ("ttft", "e2e"):
+            raise ValueError(f"objective must be ttft|e2e, got {objective!r}")
+        self.deadline_ms = float(deadline_ms)
+        self.slo_target = float(slo_target)
+        self.fast_window_s = float(fast_window_s)
+        self.fast_burn = float(fast_burn)
+        self.slow_window_s = float(slow_window_s)
+        self.slow_burn = float(slow_burn)
+        self.clear_checks = max(1, int(clear_checks))
+        self.min_events = max(1, int(min_events))
+        self.objective = objective
+        self._hub = hub
+        self._flight = flight
+        self._lock = threading.Lock()
+        # (ts, ok) pairs, newest last, trimmed to the slow window
+        self._events: deque = deque()
+        self.firing = False
+        self._clean_streak = 0
+        self.stats = {"observed": 0, "misses": 0, "alerts_fired": 0,
+                      "alerts_cleared": 0}
+        self._last_eval: Dict[str, Any] = {}
+
+    # -- ingest ----------------------------------------------------------
+
+    def observe(self, ok: bool, now: Optional[float] = None) -> None:
+        """One request outcome (True = met the SLO)."""
+        ts = _wall() if now is None else float(now)
+        with self._lock:
+            self._events.append((ts, bool(ok)))
+            self.stats["observed"] += 1
+            if not ok:
+                self.stats["misses"] += 1
+            self._trim(ts)
+
+    def observe_trace(self, t, now: Optional[float] = None) -> None:
+        """Feed one finished RequestTrace, judging it against THIS
+        alerter's deadline (the trace's tracer may have none)."""
+        lat_s = t.e2e_s if self.objective == "e2e" else t.ttft_s
+        if lat_s is None:
+            # finished without the measured latency (flushed before the
+            # first token, given a deadline): budget-relevant miss
+            ok = False
+        else:
+            ok = lat_s * 1e3 <= self.deadline_ms
+        self.observe(ok, now=now)
+
+    def _trim(self, now: float) -> None:
+        horizon = now - self.slow_window_s
+        ev = self._events
+        while ev and ev[0][0] < horizon:
+            ev.popleft()
+
+    # -- evaluation ------------------------------------------------------
+
+    def _window_burn(self, now: float, window_s: float):
+        """(burn_rate, events) for the trailing window. Caller holds
+        the lock."""
+        lo = now - window_s
+        n = miss = 0
+        for ts, ok in self._events:
+            if ts >= lo:
+                n += 1
+                if not ok:
+                    miss += 1
+        if n == 0:
+            return 0.0, 0
+        return (miss / n) / (1.0 - self.slo_target), n
+
+    def evaluate(self, now: Optional[float] = None) -> Dict[str, Any]:
+        """Recompute both windows and run the fire/clear state machine.
+        Returns the evaluation snapshot (also kept for
+        :meth:`snapshot`). Call on the health-check cadence."""
+        ts = _wall() if now is None else float(now)
+        with self._lock:
+            self._trim(ts)
+            fast, fast_n = self._window_burn(ts, self.fast_window_s)
+            slow, slow_n = self._window_burn(ts, self.slow_window_s)
+            over = (fast >= self.fast_burn and slow >= self.slow_burn
+                    and fast_n >= self.min_events)
+            fired = cleared = False
+            if over:
+                self._clean_streak = 0
+                if not self.firing:
+                    self.firing = True
+                    fired = True
+                    self.stats["alerts_fired"] += 1
+            elif self.firing:
+                self._clean_streak += 1
+                if self._clean_streak >= self.clear_checks:
+                    self.firing = False
+                    cleared = True
+                    self.stats["alerts_cleared"] += 1
+            ev = {"ts": ts, "firing": self.firing,
+                  "burn_fast": round(fast, 4), "burn_slow": round(slow, 4),
+                  "events_fast": fast_n, "events_slow": slow_n,
+                  "fired": fired, "cleared": cleared}
+            self._last_eval = ev
+        if self._hub is not None:
+            self._hub.gauge("slo.burn_rate_fast", fast)
+            self._hub.gauge("slo.burn_rate_slow", slow)
+            self._hub.gauge("slo.alert_firing", 1.0 if self.firing else 0.0)
+            if fired:
+                self._hub.counter_add("slo.alerts_fired")
+                self._hub.record_event(
+                    "slo_alert", state="firing", objective=self.objective,
+                    deadline_ms=self.deadline_ms,
+                    burn_fast=round(fast, 4), burn_slow=round(slow, 4),
+                    events_fast=fast_n)
+            elif cleared:
+                self._hub.record_event(
+                    "slo_alert", state="cleared", objective=self.objective,
+                    deadline_ms=self.deadline_ms,
+                    burn_fast=round(fast, 4), burn_slow=round(slow, 4))
+        if self._flight is not None and (fired or cleared):
+            self._flight.record(
+                "slo_alert", state="firing" if fired else "cleared",
+                objective=self.objective, deadline_ms=self.deadline_ms,
+                burn_fast=round(fast, 4), burn_slow=round(slow, 4),
+                events_fast=fast_n, events_slow=slow_n)
+        return ev
+
+    # -- readout ---------------------------------------------------------
+
+    def snapshot(self) -> Dict[str, Any]:
+        with self._lock:
+            return {
+                "firing": self.firing,
+                "objective": self.objective,
+                "deadline_ms": self.deadline_ms,
+                "slo_target": self.slo_target,
+                "windows": {
+                    "fast": {"window_s": self.fast_window_s,
+                             "burn_threshold": self.fast_burn},
+                    "slow": {"window_s": self.slow_window_s,
+                             "burn_threshold": self.slow_burn},
+                },
+                "last_eval": dict(self._last_eval),
+                "stats": dict(self.stats),
+            }
+
+    @classmethod
+    def from_config(cls, cfg, hub=None, flight=None
+                    ) -> Optional["BurnRateAlerter"]:
+        """Build from a BurnRateConfig / dict; None when disabled or
+        no deadline is configured (the off-switch)."""
+        if cfg is None:
+            return None
+        get = (cfg.get if isinstance(cfg, dict)
+               else lambda k, d=None: getattr(cfg, k, d))
+        if not get("enabled", False):
+            return None
+        deadline = get("deadline_ms", None)
+        if deadline is None:
+            return None
+        return cls(deadline_ms=float(deadline),
+                   slo_target=float(get("slo_target", 0.999)),
+                   fast_window_s=float(get("fast_window_seconds", 60.0)),
+                   fast_burn=float(get("fast_burn", 14.4)),
+                   slow_window_s=float(get("slow_window_seconds", 600.0)),
+                   slow_burn=float(get("slow_burn", 6.0)),
+                   clear_checks=int(get("clear_checks", 3)),
+                   min_events=int(get("min_events", 10)),
+                   objective=str(get("objective", "ttft")),
+                   hub=hub, flight=flight)

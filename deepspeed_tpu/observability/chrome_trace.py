@@ -30,6 +30,7 @@ Track layout (one Chrome "process" per rank):
 
 from __future__ import annotations
 
+import copy
 import json
 import os
 from typing import Any, Dict, Iterable, List, Optional
@@ -216,6 +217,66 @@ def export_fleet_request_traces(path: str, traces_by_replica) -> str:
         evs.append({"name": "process_name", "ph": "M", "pid": rid,
                     "args": {"name": f"replica r{rid}"}})
         evs += request_trace_events(traces_by_replica[rid], rank=rid, t0=t0)
+    d = os.path.dirname(os.path.abspath(path))
+    os.makedirs(d, exist_ok=True)
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        json.dump({"traceEvents": evs, "displayTimeUnit": "ms"}, f)
+    os.replace(tmp, path)
+    return path
+
+
+def export_fleet_merged_trace(path: str, lanes) -> str:
+    """The fleet's ONE timeline: every OS process (router + workers) as
+    one Chrome process, every timestamp shifted into the first lane's
+    clock domain by that lane's estimated offset.
+
+    ``lanes`` is a list of dicts, one per OS process::
+
+        {"pid": 0, "name": "router", "traces": [...],   # RequestTraces
+         "flight_events": [...],                        # optional
+         "offset_s": 0.0,          # peer-minus-reference clock offset
+         "uncertainty_s": 0.0}     # reported alongside, not applied
+
+    ``offset_s`` follows the clocksync convention (lane clock minus
+    reference clock): each lane's timestamps have it *subtracted*, so a
+    worker 250 ms ahead renders exactly where the router observed its
+    effects. The uncertainty is stamped on the lane's process metadata
+    (``clock_uncertainty_ms``) — Perfetto shows it in the process
+    tooltip; span-level flags are request_trace.rebase's job. Traces
+    already rebased upstream (supervisor ingest) belong in a lane with
+    ``offset_s=0``: double-shifting is the one way to make this export
+    lie."""
+    shifted = []
+    for lane in lanes:
+        off = float(lane.get("offset_s", 0.0))
+        traces = [t for t in lane.get("traces") or () if t.spans]
+        fl = [dict(e, ts=e["ts"] - off)
+              for e in lane.get("flight_events") or ()
+              if e.get("ts") is not None]
+        shifted.append((lane, off, traces, fl))
+    floor = [t.spans[0].ts - off
+             for _, off, traces, _ in shifted for t in traces]
+    floor += [e["ts"] for _, _, _, fl in shifted for e in fl]
+    t0 = min(floor, default=0.0)
+    evs: List[Dict[str, Any]] = []
+    for i, (lane, off, traces, fl) in enumerate(shifted):
+        pid = int(lane.get("pid", i))
+        unc_ms = round(float(lane.get("uncertainty_s", 0.0)) * 1e3, 4)
+        evs.append({"name": "process_name", "ph": "M", "pid": pid,
+                    "args": {"name": str(lane.get("name", f"proc {pid}")),
+                             "clock_offset_ms": round(off * 1e3, 4),
+                             "clock_uncertainty_ms": unc_ms}})
+        if fl:
+            evs += chrome_trace_events((), fl, rank=pid, t0=t0)
+        if traces:
+            if off or lane.get("uncertainty_s"):
+                # shift copies, not the caller's live trace objects
+                unc = float(lane.get("uncertainty_s", 0.0))
+                traces = [copy.deepcopy(t).rebase(
+                    off, unc, domain=str(lane.get("name", f"proc {pid}")))
+                    for t in traces]
+            evs += request_trace_events(traces, rank=pid, t0=t0)
     d = os.path.dirname(os.path.abspath(path))
     os.makedirs(d, exist_ok=True)
     tmp = f"{path}.tmp.{os.getpid()}"

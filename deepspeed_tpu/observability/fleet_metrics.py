@@ -1,0 +1,169 @@
+"""Shared-nothing fleet metrics: hub snapshots over the transport.
+
+``observability/fleet.py`` aggregates cross-rank metrics through the
+run directory — fine for SPMD training ranks that already share a
+filesystem, wrong for a serving fleet whose workers may live on other
+hosts. This module is the complement: each worker condenses its
+process-local MetricsHub into a **compact snapshot** (filtered gauges +
+counters, histograms reduced to their summary stats) and piggybacks it
+on the heartbeat/emit replies it is already sending
+(serving/proc_worker.py). The supervisor folds the per-replica
+snapshots into one ``fleet_metrics`` view — no shared run dir, no extra
+connections, no new protocol message.
+
+Compactness matters because the snapshot rides the heartbeat hot path:
+``compact_snapshot`` keeps only metric names under the given prefixes
+(default: the ``serve.*`` and ``slo.*`` families) and ships histogram
+*summaries* (count/sum/mean/p50/p95/p99), not bucket arrays. Merging
+histogram summaries across workers is lossy by nature — counts and sums
+add exactly; percentiles cannot be averaged, so the merged view reports
+the per-worker range (max p99 is the fleet p99 lower bound a dashboard
+actually wants).
+
+Host-side, jax-free. The plane is lock-protected: rx threads ingest per
+replica while the supervisor thread renders the merged view.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Dict, Iterable, Optional
+
+DEFAULT_PREFIXES = ("serve.", "slo.", "router.", "fleet.")
+
+
+def compact_snapshot(hub, prefixes: Iterable[str] = DEFAULT_PREFIXES
+                     ) -> Dict[str, Any]:
+    """Condense a MetricsHub into a wire-friendly dict: gauges and
+    counters filtered by name prefix, histograms as summary stats.
+    Returns ``{}`` when the hub is None/empty — callers can skip the
+    key entirely and keep pre-metrics-plane payloads bit-exact."""
+    if hub is None:
+        return {}
+    snap = hub.snapshot()
+    pfx = tuple(prefixes)
+
+    def keep(name: str) -> bool:
+        return name.startswith(pfx)
+
+    out: Dict[str, Any] = {}
+    gauges = {k: v for k, v in (snap.get("gauges") or {}).items()
+              if keep(k)}
+    counters = {k: v for k, v in (snap.get("counters") or {}).items()
+                if keep(k)}
+    hists = {k: v for k, v in (snap.get("histograms") or {}).items()
+             if keep(k) and v.get("count")}
+    if gauges:
+        out["gauges"] = gauges
+    if counters:
+        out["counters"] = counters
+    if hists:
+        out["histograms"] = hists
+    return out
+
+
+def merge_snapshots(per_replica: Dict[str, Dict[str, Any]]
+                    ) -> Dict[str, Any]:
+    """Fold per-replica compact snapshots into one fleet view.
+
+    Counters sum. Gauges ship per-replica (a fleet "queue depth" gauge
+    summed across workers is meaningful; a summed "utilization" is
+    not — the caller knows which is which, we don't guess) plus a
+    ``sum`` convenience. Histogram summaries merge exactly where math
+    allows (count, sum, min, max -> true fleet values; mean recomputed
+    from the merged sum/count) and report the per-worker spread where
+    it doesn't (p50/p95/p99 -> max across workers: the conservative
+    fleet tail)."""
+    counters: Dict[str, float] = {}
+    gauges: Dict[str, Dict[str, Any]] = {}
+    hists: Dict[str, Dict[str, Any]] = {}
+    for rid, snap in sorted(per_replica.items()):
+        for name, v in (snap.get("counters") or {}).items():
+            counters[name] = counters.get(name, 0) + v
+        for name, v in (snap.get("gauges") or {}).items():
+            g = gauges.setdefault(name, {"by_replica": {}, "sum": 0.0})
+            g["by_replica"][rid] = v
+            try:
+                g["sum"] += float(v)
+            except (TypeError, ValueError):
+                pass
+        for name, h in (snap.get("histograms") or {}).items():
+            m = hists.get(name)
+            if m is None:
+                hists[name] = m = {"count": 0, "sum": 0.0,
+                                   "min": None, "max": None,
+                                   "p50": 0.0, "p95": 0.0, "p99": 0.0,
+                                   "replicas": 0}
+            m["count"] += int(h.get("count", 0))
+            m["sum"] += float(h.get("sum", 0.0))
+            for k, fold in (("min", min), ("max", max)):
+                hv = h.get(k)
+                if hv is not None:
+                    m[k] = hv if m[k] is None else fold(m[k], hv)
+            for p in ("p50", "p95", "p99"):
+                m[p] = max(m[p], float(h.get(p, 0.0)))
+            m["replicas"] += 1
+    for m in hists.values():
+        m["mean"] = m["sum"] / m["count"] if m["count"] else 0.0
+    return {"counters": counters, "gauges": gauges, "histograms": hists}
+
+
+class FleetMetricsPlane:
+    """The supervisor/router-side aggregator: ingests one compact
+    snapshot per replica (from the rx thread handling that replica's
+    heartbeat) and renders the merged fleet view on demand.
+
+    ``stale_after_s`` guards the merge against dead workers: a replica
+    whose last snapshot is older than the bound is reported in
+    ``stale`` and excluded from the merged numbers — a crashed worker's
+    frozen queue-depth gauge must not prop up the fleet view."""
+
+    def __init__(self, stale_after_s: float = 5.0):
+        self.stale_after_s = float(stale_after_s)
+        self._lock = threading.Lock()
+        self._by_replica: Dict[str, Dict[str, Any]] = {}
+        self._mono: Dict[str, float] = {}
+        self.ingested = 0
+
+    def ingest(self, replica_id: str, snapshot: Optional[Dict[str, Any]]
+               ) -> None:
+        """Store a replica's latest snapshot (empty/None snapshots are
+        ignored — heartbeats from a worker with no hub activity yet)."""
+        if not snapshot:
+            return
+        with self._lock:
+            self._by_replica[str(replica_id)] = snapshot
+            self._mono[str(replica_id)] = time.monotonic()
+            self.ingested += 1
+
+    def forget(self, replica_id: str) -> None:
+        with self._lock:
+            self._by_replica.pop(str(replica_id), None)
+            self._mono.pop(str(replica_id), None)
+
+    def replica_snapshot(self, replica_id: str
+                         ) -> Optional[Dict[str, Any]]:
+        with self._lock:
+            snap = self._by_replica.get(str(replica_id))
+            return dict(snap) if snap is not None else None
+
+    def merged(self, now_mono: Optional[float] = None) -> Dict[str, Any]:
+        """The fleet view: merged metrics over fresh replicas plus the
+        staleness report."""
+        now = time.monotonic() if now_mono is None else float(now_mono)
+        with self._lock:
+            fresh = {}
+            stale = {}
+            for rid, snap in self._by_replica.items():
+                age = now - self._mono.get(rid, 0.0)
+                if age <= self.stale_after_s:
+                    fresh[rid] = snap
+                else:
+                    stale[rid] = round(age, 3)
+            merged = merge_snapshots(fresh)
+            merged["replicas"] = sorted(fresh)
+            if stale:
+                merged["stale"] = stale
+            merged["ingested"] = self.ingested
+            return merged

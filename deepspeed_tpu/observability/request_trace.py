@@ -41,6 +41,14 @@ Phase semantics (docs/serving.md "Request tracing"):
   rejected drafts, carved out of ``decode`` (decode + spec_overhead
   together cover the emission gaps).
 
+Clock domains: every timestamp here comes from :func:`clocksync.
+wall_time` — identical to ``time.time()`` unless a skew is injected.
+A trace produced in another process (a fleet worker) lives in that
+process's clock domain until :meth:`RequestTrace.rebase` shifts it by
+the per-channel estimated offset; spans whose duration is smaller than
+the offset estimate's uncertainty bound are flagged
+``clock_uncertain=true`` rather than silently presented as ordered.
+
 All host-side and jax-free.
 """
 
@@ -51,9 +59,10 @@ import json
 import os
 import random
 import threading
-import time
 from collections import deque
 from typing import Any, Callable, Dict, Iterable, List, Optional
+
+from deepspeed_tpu.observability.clocksync import wall_time as _wall
 
 # Typed span kinds (the on-wire vocabulary; chrome_trace.py renders one
 # lane per request from these).
@@ -104,10 +113,44 @@ class RequestTrace:
     spec_drafted: int = 0
     spec_accepted: int = 0
     spec_overhead_ms: float = 0.0
+    # set by rebase(): which process's clock produced the original
+    # timestamps, the offset that was subtracted, and its uncertainty.
+    # None means the trace never crossed a clock domain — to_dict emits
+    # no clock keys then, keeping pre-clocksync output bit-exact.
+    clock_domain: Optional[str] = None
+    clock_offset_s: float = 0.0
+    clock_uncertainty_s: float = 0.0
 
     def add(self, kind: str, ts: float, dur_ms: float = 0.0,
             **fields) -> None:
         self.spans.append(Span(kind, ts, dur_ms, fields))
+
+    def rebase(self, offset_s: float, uncertainty_s: float = 0.0,
+               domain: Optional[str] = None) -> "RequestTrace":
+        """Shift every timestamp out of the producing process's clock
+        domain into the caller's: ``local_ts = peer_ts - offset_s``
+        (``offset_s`` = peer minus local, the
+        clocksync.ClockSyncEstimator convention). Spans shorter than
+        the offset's uncertainty bound get ``clock_uncertain=true`` —
+        their *internal* ordering against same-domain neighbors is
+        exact, but their placement against the other domain is not, and
+        pretending otherwise is how misordered timelines ship. Returns
+        self (ingest-path chaining)."""
+        off = float(offset_s)
+        unc = float(uncertainty_s)
+        self.enqueue_ts -= off
+        if self.first_token_ts is not None:
+            self.first_token_ts -= off
+        if self.finish_ts is not None:
+            self.finish_ts -= off
+        for s in self.spans:
+            s.ts -= off
+            if s.dur_ms and unc * 1e3 > s.dur_ms:
+                s.fields["clock_uncertain"] = True
+        self.clock_domain = domain
+        self.clock_offset_s += off
+        self.clock_uncertainty_s = max(self.clock_uncertainty_s, unc)
+        return self
 
     # -- measurements --------------------------------------------------
 
@@ -175,7 +218,7 @@ class RequestTrace:
         return self.phases(until=self.first_token_ts)
 
     def to_dict(self) -> Dict[str, Any]:
-        return {
+        d = {
             "trace_id": self.trace_id,
             "uid": self.uid,
             "status": self.status,
@@ -195,6 +238,11 @@ class RequestTrace:
                             for k, v in self.ttft_phases().items()},
             "spans": [s.to_dict() for s in self.spans],
         }
+        if self.clock_domain is not None:
+            d["clock_domain"] = self.clock_domain
+            d["clock_offset_s"] = round(self.clock_offset_s, 9)
+            d["clock_uncertainty_s"] = round(self.clock_uncertainty_s, 9)
+        return d
 
     @classmethod
     def from_dict(cls, d: Dict[str, Any]) -> "RequestTrace":
@@ -209,6 +257,11 @@ class RequestTrace:
                 preemptions=int(d.get("preemptions", 0)),
                 spec_drafted=int(d.get("spec_drafted", 0)),
                 spec_accepted=int(d.get("spec_accepted", 0)))
+        if d.get("clock_domain") is not None:
+            t.clock_domain = str(d["clock_domain"])
+            t.clock_offset_s = float(d.get("clock_offset_s", 0.0))
+            t.clock_uncertainty_s = float(
+                d.get("clock_uncertainty_s", 0.0))
         for s in d.get("spans", []):
             fields = {k: v for k, v in s.items()
                       if k not in ("kind", "ts", "dur_ms")}
@@ -243,6 +296,10 @@ class RequestTracer:
                       "dropped": 0, "slo_misses": 0}
         self._hub = hub
         self._flight = flight
+        # optional BurnRateAlerter (observability/burn_rate.py): fed one
+        # observation per finished trace; owns its own deadline so it
+        # works even when this tracer has no slo_deadline_ms.
+        self.alerter = None
         if flight is not None:
             self.attach_flight(flight)
 
@@ -292,12 +349,12 @@ class RequestTracer:
                         "prompt_tokens": t.prompt_tokens,
                         "generated_tokens": t.generated_tokens,
                         "preemptions": t.preemptions,
-                        "age_s": round(time.time() - t.enqueue_ts, 4),
+                        "age_s": round(_wall() - t.enqueue_ts, 4),
                         "last_span": (t.spans[-1].to_dict()
                                       if t.spans else None),
                         "phases": {k: round(v, 4)
                                    for k, v in t.phases(
-                                       until=time.time()).items()}})
+                                       until=_wall()).items()}})
         return out
 
     # -- emit points ----------------------------------------------------
@@ -313,10 +370,10 @@ class RequestTracer:
         if old is not None:
             # uid reuse while a trace is still open (caller recycled the
             # uid without finishing): close the old one out
-            self._finish_trace(old, "superseded", time.time())
+            self._finish_trace(old, "superseded", _wall())
         self._n_started += 1
         self.stats["started"] += 1
-        now = time.time()
+        now = _wall()
         t = RequestTrace(trace_id=f"req-{uid}-{self._n_started}", uid=uid,
                          prompt_tokens=int(prompt_tokens), enqueue_ts=now)
         t.add("ENQUEUE", now, prompt_tokens=int(prompt_tokens),
@@ -329,7 +386,7 @@ class RequestTracer:
         t = self._active.get(uid) if self.enabled else None
         if t is None:
             return
-        now = time.time()
+        now = _wall()
         t.add("ADMIT", now, wait_s=round(wait_s, 6), requeued=bool(requeued))
         if requeued and self._hub is not None:
             # queue re-entry latency of a preemption round trip,
@@ -342,7 +399,7 @@ class RequestTracer:
         if t is None:
             return
         t.prefix_hit_tokens += int(tokens)
-        t.add("PREFIX_HIT", time.time(), tokens=int(tokens))
+        t.add("PREFIX_HIT", _wall(), tokens=int(tokens))
 
     def on_prefill(self, uid: int, start: float, dur_ms: float,
                    tokens: int, start_pos: int) -> None:
@@ -357,7 +414,7 @@ class RequestTracer:
         t = self._active.get(uid) if self.enabled else None
         if t is None:
             return
-        now = time.time()
+        now = _wall()
         first = t.first_token_ts is None
         if first:
             t.first_token_ts = now
@@ -374,7 +431,7 @@ class RequestTracer:
         t = self._active.get(uid) if self.enabled else None
         if t is None:
             return
-        now = time.time()
+        now = _wall()
         t.spec_drafted += int(drafted)
         t.spec_accepted += int(accepted)
         t.add("SPEC_DRAFT", now, n=int(drafted))
@@ -385,7 +442,7 @@ class RequestTracer:
         t = self._active.get(uid) if self.enabled else None
         if t is None:
             return
-        now = time.time()
+        now = _wall()
         t.preemptions += 1
         t.add("PREEMPT", now, reason=reason, generated=int(generated))
         t.add("REQUEUE", now, reason=reason)
@@ -396,13 +453,13 @@ class RequestTracer:
         t = self._active.get(uid) if self.enabled else None
         if t is None:
             return
-        t.add(kind, time.time(), **fields)
+        t.add(kind, _wall(), **fields)
 
     def on_finish(self, uid: int, status: str = "finished") -> None:
         t = self._active.pop(uid, None) if self.enabled else None
         if t is None:
             return
-        self._finish_trace(t, status, time.time())
+        self._finish_trace(t, status, _wall())
 
     # -- finish / sampling ----------------------------------------------
 
@@ -432,6 +489,8 @@ class RequestTracer:
                 e2e_ms=(round(t.e2e_s * 1e3, 3)
                         if t.e2e_s is not None else None),
                 tokens=t.generated_tokens, preemptions=t.preemptions)
+        if self.alerter is not None:
+            self.alerter.observe_trace(t, now=now)
         # tail-based sampling: the drop decision happens HERE, with the
         # outcome known — every violator is kept, the healthy bulk is
         # down-sampled, and a dropped trace costs nothing further

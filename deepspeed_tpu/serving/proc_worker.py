@@ -204,15 +204,30 @@ class WorkerLoop:
         rep["received_submits"] = self._received_submits
         return rep
 
+    def _metrics(self) -> Dict[str, Any]:
+        """Compact snapshot of this process's hub, piggybacked on every
+        emit so the supervisor's fleet metrics plane needs no shared
+        filesystem. Empty (and omitted from the wire message) when the
+        hub has nothing under the serving prefixes."""
+        from deepspeed_tpu.observability.fleet_metrics import \
+            compact_snapshot
+        from deepspeed_tpu.observability.hub import peek_hub
+
+        return compact_snapshot(peek_hub())
+
     def _send_emit(self, emitted: Dict[int, list]) -> None:
-        self.channel.send({
+        msg = {
             "type": "emit",
             "emitted": {str(u): [int(t) for t in toks]
                         for u, toks in emitted.items()},
             "report": self._report(),
             "traces": self._new_traces(),
             "geometry": self._geometry(),
-        })
+        }
+        metrics = self._metrics()
+        if metrics:
+            msg["metrics"] = metrics
+        self.channel.send(msg)
         self._last_send = time.monotonic()
 
     def _heartbeat_loop(self) -> None:
@@ -223,10 +238,13 @@ class WorkerLoop:
         while not self._hb_stop.is_set():
             if (time.monotonic() - self._last_send) >= self.heartbeat_s:
                 try:
-                    self.channel.send({
-                        "type": "emit", "emitted": {},
-                        "report": self._report(),
-                        "traces": [], "geometry": self._geometry()})
+                    msg = {"type": "emit", "emitted": {},
+                           "report": self._report(),
+                           "traces": [], "geometry": self._geometry()}
+                    metrics = self._metrics()
+                    if metrics:
+                        msg["metrics"] = metrics
+                    self.channel.send(msg)
                     self._last_send = time.monotonic()
                 except Exception:
                     return  # channel gone; the main loop exits too

@@ -33,6 +33,8 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
+from deepspeed_tpu.observability.clocksync import wall_time
+
 ROLES = ("unified", "prefill", "decode")
 
 
@@ -64,7 +66,9 @@ class ServingReplica:
         # router wires this to its emission handler; called on the pump
         # thread with (replica, {uid: [tokens]}) after each serve round
         self.emit_callback: Optional[Callable] = None
-        self.last_heartbeat = time.time()  # display only (load_report ts)
+        # load_report ts: this process's wall clock (skew-aware, so a
+        # cross-process supervisor can rebase it like any other stamp)
+        self.last_heartbeat = wall_time()
         self.last_heartbeat_mono = time.monotonic()  # liveness decisions
         self.transport_errors = 0  # in-process replicas have no wire
         self.killed = False
@@ -138,7 +142,7 @@ class ServingReplica:
         emitted = self.engine.serve_step(eos_token_id=eos_token_id) \
             if busy else {}
         self.steps += 1
-        now = time.time()
+        now = wall_time()
         self.last_heartbeat = now
         self.last_heartbeat_mono = time.monotonic()
         dt = max(time.perf_counter() - t0, 1e-9)

@@ -121,7 +121,21 @@ def build_fleet(model, router_cfg=None, engine_kw=None,
                        hedge_enabled=getattr(cfg, "hedge_enabled", False),
                        hedge_ttft_factor=getattr(
                            cfg, "hedge_ttft_factor", 3.0),
-                       hedge_min_s=getattr(cfg, "hedge_min_seconds", 0.25))
+                       hedge_min_s=getattr(cfg, "hedge_min_seconds", 0.25),
+                       alerter=_build_alerter(
+                           getattr(cfg, "burn_rate", None)))
+
+
+def _build_alerter(burn_cfg):
+    """BurnRateAlerter from a RouterConfig.burn_rate block (None when
+    disabled — the default keeps the router alert-free, bit-exact with
+    pre-alerting behavior)."""
+    if burn_cfg is None:
+        return None
+    from deepspeed_tpu.observability.burn_rate import BurnRateAlerter
+    from deepspeed_tpu.observability.hub import get_hub
+
+    return BurnRateAlerter.from_config(burn_cfg, hub=get_hub())
 
 
 class _RequestRecord:
@@ -175,7 +189,8 @@ class FleetRouter:
                  health_recover_checks: int = 2,
                  hedge_enabled: bool = False,
                  hedge_ttft_factor: float = 3.0,
-                 hedge_min_s: float = 0.25):
+                 hedge_min_s: float = 0.25,
+                 alerter=None):
         if not replicas:
             raise ValueError("FleetRouter needs at least one replica")
         if routing not in ROUTING_POLICIES:
@@ -243,8 +258,15 @@ class FleetRouter:
                       "handoff_recompute": 0, "failovers": 0,
                       "failed_over_requests": 0, "affinity_hits": 0,
                       "hedged": 0, "hedge_wins": 0, "stranded": 0}
+        # one BurnRateAlerter for the FLEET (observability/burn_rate.py):
+        # every replica's finished traces feed it through the tracer
+        # hook, and check_health runs its fire/clear state machine —
+        # the burn rate is a fleet property, not a per-replica one
+        self.alerter = alerter
         for r in replicas:
             r.emit_callback = self._on_emissions
+            if alerter is not None:
+                r.engine.tracer.alerter = alerter
         from deepspeed_tpu.observability.hub import get_hub
 
         self._hub = get_hub()
@@ -266,6 +288,8 @@ class FleetRouter:
             elif rid not in self.decode_pool:
                 self.decode_pool.append(rid)
             replica.emit_callback = self._on_emissions
+            if self.alerter is not None:
+                replica.engine.tracer.alerter = self.alerter
 
     def remove_replica(self, replica_id: int) -> None:
         """Stop routing NEW work to a replica (supervisor drain). The
@@ -310,21 +334,31 @@ class FleetRouter:
                     self._ewma_alpha * float(max_new_tokens)
                     + (1.0 - self._ewma_alpha) * self._avg_budget)
             route = self._route_fields(target, self._last_policy,
-                                       self._last_predicted_ms)
+                                       self._last_predicted_ms, uid=uid)
         target.submit(Submission(
             uid=uid, tokens=toks, max_new_tokens=budget,
             span_notes=[("ROUTE", route)]))
         return target.replica_id
 
     def _route_fields(self, target: ServingReplica, policy: str,
-                      predicted_ms: Optional[float] = None
-                      ) -> Dict[str, Any]:
+                      predicted_ms: Optional[float] = None,
+                      uid: Optional[int] = None) -> Dict[str, Any]:
         """ROUTE span fields: placement decision + the transport byte
         counters at decision time, so cross-process lanes show what each
         hop had already paid on the wire (replica_id itself is stamped
-        by the replica applying the submission — in ITS process)."""
+        by the replica applying the submission — in ITS process).
+
+        With ``uid`` the fields double as Dapper-style trace context:
+        the router-side trace id and clock-domain label travel inside
+        the ROUTE span note, land in the worker's trace via
+        ``tracer.note``, and ship back with the trace dicts — the merge
+        side joins both processes' spans on ``fleet_trace_id`` without
+        any wire-protocol change."""
         fields: Dict[str, Any] = {"replica": target.replica_id,
                                   "role": target.role, "policy": policy}
+        if uid is not None:
+            fields["fleet_trace_id"] = f"fleet-{int(uid)}"
+            fields["parent_domain"] = "router"
         tx = getattr(target, "transport_bytes", None)
         if tx is not None:
             sent, received = tx()
@@ -593,7 +627,8 @@ class FleetRouter:
             if payload is None:
                 with self._lock:
                     self.stats["handoff_recompute"] += 1
-            route = self._route_fields(target, "disagg_handoff")
+            route = self._route_fields(target, "disagg_handoff",
+                                       uid=rec.uid)
             target.submit(Submission(
                 uid=rec.uid, tokens=tokens, max_new_tokens=remaining,
                 handoff=payload, span_notes=[("ROUTE", route)]))
@@ -641,6 +676,8 @@ class FleetRouter:
         if self.hedge_enabled:
             self._check_hedges(now)
         self._update_fleet_gauges()
+        if self.alerter is not None:
+            self.alerter.evaluate()
         return newly_dead
 
     def _observe_health(self, rid: int, r: ServingReplica,
@@ -710,7 +747,8 @@ class FleetRouter:
                 self.stats["hedged"] += 1
                 waited_ms = (now - rec.submitted_mono) * 1e3
                 plans.append((rec, target,
-                              self._route_fields(target, "hedge"),
+                              self._route_fields(target, "hedge",
+                                                 uid=rec.uid),
                               waited_ms))
         for rec, target, route, waited_ms in plans:
             target.submit(Submission(
@@ -785,7 +823,8 @@ class FleetRouter:
                     if rec.emitted else rec.tokens
                 plans.append((rec.uid, tokens, budget, old, target,
                               len(rec.emitted),
-                              self._route_fields(target, "failover")))
+                              self._route_fields(target, "failover",
+                                                 uid=rec.uid)))
         for uid, tokens, budget, old, target, recovered, route in plans:
             target.submit(Submission(
                 uid=uid, tokens=tokens, max_new_tokens=budget,
@@ -934,4 +973,12 @@ class FleetRouter:
         }
         if self.autoscale is not None:
             snap["autoscale"] = self.autoscale.snapshot()
+        if self.alerter is not None:
+            snap["alerts"] = self.alerter.snapshot()
+        clock = {
+            str(rid): info for rid, r in self.replicas.items()
+            if (info := getattr(r, "clock_info", lambda: None)())
+            is not None}
+        if clock:
+            snap["clock"] = clock
         return snap

@@ -41,9 +41,16 @@ and through ``ReplicaPublisher`` into ``<run_dir>/replicas/`` —
 :meth:`write_fleet_snapshot` merges channel-side state into
 ``<run_dir>/fleet_snapshot.json`` for ``serve_top --fleet``.
 
-Clock note: predicted-TTFT routing and trace spans compare
-``time.time()`` across processes. Localhost fleets share one clock, so
-this is exact; a multi-host port would need send-time deltas instead.
+Clock note: worker-side wall timestamps (load-report ``ts``, trace
+spans) are rebased into the supervisor's clock domain via the
+per-channel NTP-style offset estimator
+(observability/clocksync.ClockSyncEstimator, attached to each channel
+at spawn, re-synced by :meth:`ReplicaSupervisor.maintain`). With
+``clock_sync=False`` — or before an estimator has its minimum sample
+count — the raw timestamps pass through untouched, bit-exact with the
+pre-clocksync behavior that assumed localhost's shared ``time.time()``.
+Liveness never depends on wall clocks either way: heartbeat ages use
+``time.monotonic()`` on the supervisor side only.
 """
 
 from __future__ import annotations
@@ -115,6 +122,10 @@ class RemoteEngineView:
         self.kv_cache = _KVCacheView(block_size, total_blocks)
         self.max_blocks_per_seq = int(max_blocks_per_seq)
         self.tracer = RequestTracer(enabled=True, sample_rate=1.0)
+        # per-channel ClockSyncEstimator + domain label, set by the
+        # owning RemoteReplica; None means ingest raw (bit-exact)
+        self.clock = None
+        self.clock_domain: Optional[str] = None
 
     def update_geometry(self, geo: Dict[str, Any]) -> None:
         self.kv_cache.config.block_size = int(geo["block_size"])
@@ -124,12 +135,23 @@ class RemoteEngineView:
     def ingest_traces(self, docs: List[Dict[str, Any]]) -> None:
         from deepspeed_tpu.observability.request_trace import RequestTrace
 
+        clk = self.clock
+        rebase = clk is not None and clk.synced
+        if rebase:
+            # one estimate per batch: spans from one emit must land in
+            # one coherent shift, not straddle a mid-batch re-sync
+            off, unc = clk.offset_s, clk.uncertainty_s
         t = self.tracer
         with t._lock:
             for d in docs:
-                t._ring.append(RequestTrace.from_dict(d))
+                tr = RequestTrace.from_dict(d)
+                if rebase:
+                    tr.rebase(off, unc, domain=self.clock_domain)
+                t._ring.append(tr)
                 t.stats["finished"] += 1
                 t.stats["kept"] += 1
+                if t.alerter is not None:
+                    t.alerter.observe_trace(tr)
 
 
 def _empty_report(replica_id: int, role: str) -> Dict[str, Any]:
@@ -155,6 +177,14 @@ class RemoteReplica:
         self.channel = channel
         self.engine = RemoteEngineView(block_size, total_blocks,
                                        max_blocks_per_seq)
+        # the channel's ClockSyncEstimator (attached by the supervisor
+        # before construction when clock_sync is on; the channel layer
+        # defaults it to None) drives trace/report rebasing
+        self.engine.clock = getattr(channel, "clock", None)
+        self.engine.clock_domain = self.name
+        # FleetMetricsPlane fed by the metrics the worker piggybacks on
+        # heartbeats (set by the supervisor; None drops them)
+        self.metrics_plane = None
         self.emit_callback: Optional[Callable] = None
         self.killed = False
         self.draining = False
@@ -260,6 +290,11 @@ class RemoteReplica:
         return (int(self.channel.bytes_sent),
                 int(self.channel.bytes_received))
 
+    def clock_info(self) -> Optional[Dict[str, Any]]:
+        """The channel clock estimate (None with clock sync off)."""
+        clk = getattr(self.channel, "clock", None)
+        return clk.to_dict() if clk is not None else None
+
     def kill(self) -> None:
         self.killed = True
 
@@ -276,11 +311,22 @@ class RemoteReplica:
     def handle_message(self, msg: Dict[str, Any]) -> None:
         kind = msg.get("type")
         if kind == "emit":
+            rep = dict(msg.get("report") or self._report)
+            clk = getattr(self.channel, "clock", None)
+            if clk is not None and clk.synced and rep.get("ts"):
+                # worker wall time -> supervisor wall time; the raw
+                # stamp survives as ts_worker for cross-checks. With
+                # clock sync off/unsynced the dict is untouched.
+                rep["ts_worker"] = rep["ts"]
+                rep["ts"] = clk.rebase(rep["ts"])
             with self._lock:
-                self._report = dict(msg.get("report") or self._report)
+                self._report = rep
                 self._report_ts = time.time()
                 self._report_mono = time.monotonic()
             self.transport_errors = 0  # channel demonstrably works
+            metrics = msg.get("metrics")
+            if metrics and self.metrics_plane is not None:
+                self.metrics_plane.ingest(self.name, metrics)
             geo = msg.get("geometry")
             if geo:
                 self.engine.update_geometry(geo)
@@ -342,7 +388,10 @@ class ReplicaSupervisor:
                  restart_policy=None,
                  max_restarts_per_window: int = 3,
                  restart_window_s: float = 30.0,
-                 min_healthy: int = 1):
+                 min_healthy: int = 1,
+                 clock_sync: bool = True,
+                 clock_sync_rounds: int = 8,
+                 clock_resync_s: float = 5.0):
         if channel not in ("socket", "file"):
             raise ValueError(
                 f"channel must be socket|file, got {channel!r}")
@@ -403,6 +452,15 @@ class ReplicaSupervisor:
         # (env carries e.g. the DSTPU_CHAOS spec of a chaos drill)
         self._env_extra: Dict[int, Dict[str, str]] = {}
         self._step_delay: Dict[int, float] = {}
+        # fleet observability: per-channel clock sync + the transport-
+        # borne metrics plane (no shared filesystem required)
+        self.clock_sync = bool(clock_sync)
+        self.clock_sync_rounds = max(1, int(clock_sync_rounds))
+        self.clock_resync_s = float(clock_resync_s)
+        from deepspeed_tpu.observability.fleet_metrics import \
+            FleetMetricsPlane
+        self.metrics_plane = FleetMetricsPlane(
+            stale_after_s=max(1.0, 20.0 * self.heartbeat_s))
         for sub in ("specs", "ready", "logs", "spool", "replicas"):
             os.makedirs(os.path.join(run_dir, sub), exist_ok=True)
 
@@ -460,11 +518,25 @@ class ReplicaSupervisor:
         except Exception:
             proc.kill()
             raise
+        if self.clock_sync:
+            from deepspeed_tpu.observability.clocksync import \
+                ClockSyncEstimator
+            chan.clock = ClockSyncEstimator()
         bs, total, mps = self._engine_geometry()
         remote = RemoteReplica(rid, role, chan, bs, total, mps)
+        remote.metrics_plane = self.metrics_plane
         self.replicas[rid] = remote
         self._procs[rid] = proc
         self._start_rx(remote)
+        if self.clock_sync:
+            # initial burst: the estimator is synced (min_samples) well
+            # before the first routed request; pongs land on the rx
+            # thread just started above
+            for _ in range(self.clock_sync_rounds):
+                try:
+                    chan.ping_clock()
+                except ChannelError:
+                    break
         self.actions.append((time.time(), action, rid))
         return remote
 
@@ -533,6 +605,21 @@ class ReplicaSupervisor:
                  "quarantined": 0, "handoffs_expired": 0}
         autoscale = getattr(self.router, "autoscale", None) \
             if self.router is not None else None
+
+        if self.clock_sync:
+            # periodic re-sync: drift and NTP steps on the worker side
+            # show up within one resync period, not at the next spawn
+            for remote in self.replicas.values():
+                clk = getattr(remote.channel, "clock", None)
+                if (clk is None or remote._send_failed
+                        or remote.draining or remote.exited):
+                    continue
+                if mono - clk.last_sync_mono >= self.clock_resync_s:
+                    try:
+                        remote.channel.ping_clock()
+                    except ChannelError:
+                        remote.transport_errors += 1
+                        remote._send_failed = True
 
         for rid in list(self.replicas):
             remote = self.replicas[rid]
@@ -709,5 +796,13 @@ class ReplicaSupervisor:
                 "dup_frames": getattr(r.channel, "dup_frames", 0),
             } for rid, r in self.replicas.items()},
         }
+        if self.clock_sync:
+            snap["clock"] = {
+                str(rid): info for rid, r in self.replicas.items()
+                if (info := r.clock_info()) is not None}
+        if self.metrics_plane.ingested:
+            # the transport-borne metrics plane: per-worker hub values
+            # merged with no shared run dir (workers may be remote)
+            snap["fleet_metrics"] = self.metrics_plane.merged()
         _atomic_write_json(path, snap)
         return path

@@ -34,6 +34,15 @@ silently dropped frame becomes a detectable fault at the next arrival
 instead of a hung request. Chaos net faults (``DSTPU_CHAOS net_*``,
 resilience/chaos.py) are injected here, on the encoded frames/chunks,
 when the process-global injector is armed.
+
+Clock sync (observability/clocksync.py) also lives at this layer:
+``clock_ping``/``clock_pong`` messages are intercepted below the
+message protocol — a receive path answers pings automatically and
+feeds pongs into the channel's attached :class:`ClockSyncEstimator`
+(``channel.clock``), so every channel owner gets per-peer offset
+estimation without any protocol change. Clock messages ride normal
+sequenced frames, which means the chaos net-fault matrix exercises
+them like any other traffic.
 """
 
 from __future__ import annotations
@@ -77,12 +86,52 @@ def _armed_net_injector():
 
 
 class _SeqMixin:
-    """Per-channel sequence numbering shared by both transports."""
+    """Per-channel sequence numbering + clock-message interception
+    shared by both transports."""
 
     def _seq_init(self) -> None:
         self._tx_seq = 0
         self._rx_expected = 0
         self.dup_frames = 0
+        # attach a clocksync.ClockSyncEstimator to make this endpoint
+        # the ping-initiating side; the peer side needs nothing — any
+        # receive path answers pings automatically
+        self.clock = None
+
+    def ping_clock(self) -> int:
+        """Send one clock ping (the pong, when it lands on any receive
+        path, feeds ``self.clock``). Returns the bytes sent."""
+        from deepspeed_tpu.observability.clocksync import wall_time
+
+        return self.send({"type": "clock_ping", "t0": wall_time()})
+
+    def _clock_intercept(self, msg: Dict[str, Any]
+                         ) -> Optional[Dict[str, Any]]:
+        """Consume clock messages below the protocol: answer pings,
+        feed pongs into the estimator. Returns None when the message
+        was a clock message (never delivered to the channel owner)."""
+        kind = msg.get("type")
+        if kind == "clock_ping":
+            from deepspeed_tpu.observability.clocksync import wall_time
+
+            t1 = wall_time()
+            try:
+                self.send({"type": "clock_pong",
+                           "t0": msg.get("t0", 0.0), "t1": t1,
+                           "t2": wall_time()})
+            except ChannelError:
+                pass  # peer gone mid-pong; the send path flagged it
+            return None
+        if kind == "clock_pong":
+            if self.clock is not None:
+                from deepspeed_tpu.observability.clocksync import \
+                    wall_time
+
+                self.clock.add_round_trip(
+                    float(msg.get("t0", 0.0)), float(msg.get("t1", 0.0)),
+                    float(msg.get("t2", 0.0)), wall_time())
+            return None
+        return msg
 
     def _seq_deliver(self, msg: Dict[str, Any]
                      ) -> Optional[Dict[str, Any]]:
@@ -177,6 +226,8 @@ class SocketChannel(_SeqMixin):
             try:
                 for payload in self._reader.feed(chunk):
                     msg = self._seq_deliver(decode_message(payload))
+                    if msg is not None:
+                        msg = self._clock_intercept(msg)
                     if msg is not None:
                         self._inbox.append(msg)
             except FrameError as e:
@@ -364,6 +415,8 @@ class FileChannel(_SeqMixin):
                         f"{reader.pending_bytes} stray bytes "
                         "(expected exactly one)")
                 msg = self._seq_deliver(decode_message(payloads[0]))
+                if msg is not None:
+                    msg = self._clock_intercept(msg)
                 if msg is None:
                     continue
                 return msg

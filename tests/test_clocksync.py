@@ -1,0 +1,450 @@
+"""Clock-sync tests: the NTP-style per-channel estimator, the skewed
+wall clock, trace rebasing, and the skew-corrected merged Perfetto
+export (docs/observability.md "Fleet tracing & clock sync").
+
+The load-bearing guarantees:
+- the estimator recovers a known injected skew to within its OWN
+  reported uncertainty, including under asymmetric delay (where the
+  point estimate is biased by up to half the asymmetry — the bound
+  must widen to cover it, never lie);
+- the channel layer answers clock pings below the message protocol, so
+  a real subprocess with a stepped clock syncs without worker code;
+- with clock sync off (no estimator, no rebase) every byte of trace
+  output is identical to the pre-clocksync format — the bit-exact
+  off-switch;
+- the merged fleet export renders a ±250 ms-skewed worker's spans
+  causally AFTER the router decisions that produced them.
+
+Everything here is jax-free (transport + observability only).
+"""
+
+import copy
+import json
+import math
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+from deepspeed_tpu.observability.chrome_trace import (
+    export_fleet_merged_trace)
+from deepspeed_tpu.observability.clocksync import (SKEW_ENV,
+                                                   ClockSyncEstimator,
+                                                   wall_time)
+from deepspeed_tpu.observability.request_trace import (RequestTrace,
+                                                       RequestTracer)
+from deepspeed_tpu.serving.transport import (SocketServer,
+                                             connect_with_backoff)
+
+ECHO_WORKER = os.path.join(os.path.dirname(__file__),
+                           "transport_echo_worker.py")
+
+
+# -- wall clock ----------------------------------------------------------
+
+
+class TestWallTime:
+    def test_unset_env_is_time_time(self, monkeypatch):
+        monkeypatch.delenv(SKEW_ENV, raising=False)
+        assert abs(wall_time() - time.time()) < 0.05
+
+    def test_skew_read_per_call(self, monkeypatch):
+        """The env is consulted on every call, so a test can STEP the
+        clock mid-run — the scenario the estimator's reset exists
+        for."""
+        monkeypatch.setenv(SKEW_ENV, "5.0")
+        assert wall_time() - time.time() == pytest.approx(5.0, abs=0.05)
+        monkeypatch.setenv(SKEW_ENV, "-2.0")
+        assert wall_time() - time.time() == pytest.approx(-2.0, abs=0.05)
+
+    def test_garbage_skew_falls_back(self, monkeypatch):
+        monkeypatch.setenv(SKEW_ENV, "not-a-number")
+        assert abs(wall_time() - time.time()) < 0.05
+
+
+# -- estimator math ------------------------------------------------------
+
+
+def feed(est, true_offset, fwd_s, rev_s, t0=1000.0, proc_s=0.0):
+    """One synthetic round trip: local t0, one-way delays fwd/rev, peer
+    clock ahead by ``true_offset``."""
+    t1 = t0 + fwd_s + true_offset
+    t2 = t1 + proc_s
+    t3 = t0 + fwd_s + proc_s + rev_s
+    est.add_round_trip(t0, t1, t2, t3)
+    return t3
+
+
+class TestEstimatorMath:
+    def test_symmetric_trips_recover_offset_exactly(self):
+        est = ClockSyncEstimator(min_samples=3)
+        t = 1000.0
+        for _ in range(6):
+            feed(est, 0.25, 0.001, 0.001, t0=t)
+            t += 1.0
+        assert est.synced
+        assert est.offset_s == pytest.approx(0.25, abs=1e-9)
+        assert est.uncertainty_s < 0.002
+
+    def test_unsynced_below_min_samples_is_identity(self):
+        est = ClockSyncEstimator(min_samples=3)
+        feed(est, 0.25, 0.001, 0.001)
+        assert not est.synced
+        assert est.offset_s == 0.0
+        assert est.uncertainty_s == float("inf")
+        assert est.rebase(123.0) == 123.0  # identity until synced
+
+    def test_asymmetric_delay_bias_stays_inside_bound(self):
+        """A one-way 10 ms delay biases the estimate by 5 ms — NTP's
+        irreducible ambiguity. The gate is honesty: the reported
+        uncertainty (best_rtt/2 + dispersion) must cover the bias."""
+        est = ClockSyncEstimator(min_samples=3)
+        t = 1000.0
+        for _ in range(8):
+            feed(est, 0.25, 0.010, 0.0, t0=t)  # all delay on one leg
+            t += 1.0
+        assert est.synced
+        err = abs(est.offset_s - 0.25)
+        assert err == pytest.approx(0.005, abs=1e-6)
+        assert err <= est.uncertainty_s
+
+    def test_median_of_lowest_rtt_rejects_queued_samples(self):
+        """Samples delayed by queueing (a busy worker, a chaos delay
+        arm) carry wild offsets AND high RTTs — the K-lowest-RTT median
+        must keep the estimate pinned to the clean samples."""
+        est = ClockSyncEstimator(k=5, min_samples=3)
+        t = 1000.0
+        for _ in range(6):
+            feed(est, 0.25, 0.0005, 0.0005, t0=t)
+            t += 1.0
+        for _ in range(4):  # queueing spikes: 200 ms one-way
+            feed(est, 0.25, 0.2, 0.0, t0=t)
+            t += 1.0
+        assert est.offset_s == pytest.approx(0.25, abs=1e-4)
+        assert est.uncertainty_s < 0.005
+
+    def test_negative_rtt_sample_dropped(self):
+        """A clock stepped mid-flight can produce rtt < 0; the sample
+        must be discarded, not poison the window."""
+        est = ClockSyncEstimator(min_samples=1)
+        est.add_round_trip(1000.0, 1000.5, 1000.5, 1000.0 - 1.0)
+        assert est.n_samples == 0 and not est.synced
+
+    def test_reset_reconverges_after_clock_step(self):
+        """After the peer's clock steps, the old window would median
+        across two regimes — reset() drops it and the estimator
+        re-converges on the new offset."""
+        est = ClockSyncEstimator(min_samples=3)
+        t = 1000.0
+        for _ in range(5):
+            feed(est, 0.25, 0.001, 0.001, t0=t)
+            t += 1.0
+        assert est.offset_s == pytest.approx(0.25, abs=1e-6)
+        est.reset()
+        assert not est.synced and est.offset_s == 0.0
+        for _ in range(5):
+            feed(est, -0.1, 0.001, 0.001, t0=t)
+            t += 1.0
+        assert est.offset_s == pytest.approx(-0.1, abs=1e-6)
+
+    def test_drift_tracks_rate_difference(self):
+        """A peer clock RATE difference (1 ms/s here) shows up as a
+        nonzero drift EWMA long before the offset outgrows the
+        bound."""
+        est = ClockSyncEstimator(k=1, window=4, min_samples=1)
+        t, off = 1000.0, 0.25
+        for _ in range(20):
+            feed(est, off, 0.001, 0.001, t0=t)
+            t += 1.0
+            off += 0.001
+        assert est.drift == pytest.approx(1e-3, rel=0.5)
+
+    def test_to_dict_shapes(self):
+        est = ClockSyncEstimator(min_samples=3)
+        d = est.to_dict()
+        assert d["synced"] is False and d["offset_ms"] is None
+        t = 1000.0
+        for _ in range(4):
+            feed(est, 0.25, 0.001, 0.001, t0=t)
+            t += 1.0
+        d = est.to_dict()
+        assert d["synced"] is True
+        assert d["offset_ms"] == pytest.approx(250.0, abs=0.1)
+        assert d["uncertainty_ms"] < 5.0
+        assert d["samples"] == 4 and d["window"] == 4
+
+
+# -- channel ping/pong against a real skewed subprocess ------------------
+
+
+def _spawn_skewed_echo(port: int, skew_s: float) -> subprocess.Popen:
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)  # the worker never imports jax
+    env[SKEW_ENV] = repr(skew_s)
+    return subprocess.Popen([sys.executable, ECHO_WORKER, str(port)],
+                            env=env)
+
+
+def _accepting_server():
+    srv = SocketServer()
+    box = {}
+    t = threading.Thread(
+        target=lambda: box.setdefault("s", srv.accept(timeout=10.0)),
+        daemon=True)
+    t.start()
+    return srv, box, t
+
+
+def _sync_rounds(chan, n):
+    """Interleave pings with echo traffic so the worker's recv never
+    idles out and the parent's recv drains pongs en route."""
+    for i in range(n):
+        chan.ping_clock()
+        chan.send({"type": "obs", "i": i})
+        assert chan.recv(timeout=10.0) is not None
+
+
+class TestChannelClockSync:
+    def test_recovers_subprocess_skew(self, monkeypatch):
+        """The ISSUE scenario: a worker 250 ms ahead. The channel's
+        auto-answered pings must recover the skew to within the
+        estimator's own bound (and a 50 ms absolute cap — localhost
+        RTTs are sub-millisecond)."""
+        monkeypatch.delenv(SKEW_ENV, raising=False)
+        srv, box, t = _accepting_server()
+        proc = _spawn_skewed_echo(srv.port, 0.25)
+        try:
+            t.join(timeout=10.0)
+            chan = box["s"]
+            chan.clock = ClockSyncEstimator()
+            _sync_rounds(chan, 8)
+            est = chan.clock
+            assert est.synced
+            assert abs(est.offset_s - 0.25) <= est.uncertainty_s + 1e-3
+            assert abs(est.offset_s - 0.25) < 0.05
+            chan.send({"type": "quit"})
+        finally:
+            box.get("s") and box["s"].close()
+            srv.close()
+            proc.wait(timeout=10.0)
+
+    def test_stepped_local_clock_reconverges_after_reset(self,
+                                                         monkeypatch):
+        """Step OUR wall clock mid-run (the env is read per call): the
+        true offset changes under the estimator's feet. After reset(),
+        it re-converges on the new truth — the supervisor's re-sync
+        path for an NTP step."""
+        monkeypatch.delenv(SKEW_ENV, raising=False)
+        srv, box, t = _accepting_server()
+        proc = _spawn_skewed_echo(srv.port, 0.25)
+        try:
+            t.join(timeout=10.0)
+            chan = box["s"]
+            chan.clock = ClockSyncEstimator()
+            _sync_rounds(chan, 6)
+            assert abs(chan.clock.offset_s - 0.25) < 0.05
+            # our clock steps +0.25 s: worker and parent now agree
+            monkeypatch.setenv(SKEW_ENV, "0.25")
+            chan.clock.reset()
+            _sync_rounds(chan, 6)
+            assert chan.clock.synced
+            assert abs(chan.clock.offset_s) < 0.05
+            chan.send({"type": "quit"})
+        finally:
+            box.get("s") and box["s"].close()
+            srv.close()
+            proc.wait(timeout=10.0)
+
+    def test_peer_without_estimator_ignores_pongs(self):
+        """An endpoint with no estimator attached still answers pings
+        and silently consumes pongs — clock traffic never surfaces as
+        protocol messages."""
+        srv, box, t = _accepting_server()
+        client = connect_with_backoff("127.0.0.1", srv.port)
+        try:
+            t.join(timeout=10.0)
+            server = box["s"]
+            client.ping_clock()
+            client.send({"type": "data"})
+            # server sees only the data message; the ping was answered
+            # below the protocol
+            msg = server.recv(timeout=5.0)
+            assert msg == {"type": "data"}
+            # client consumes the pong without an estimator: nothing
+            # surfaces, nothing crashes
+            assert client.recv(timeout=0.2) is None
+        finally:
+            client.close()
+            box.get("s") and box["s"].close()
+            srv.close()
+
+
+# -- trace rebasing + the bit-exact off-switch ---------------------------
+
+
+def make_trace(uid=1, base=1000.0, domain_skew=0.0):
+    """ENQUEUE -> PREFILL(8ms) -> DECODE_EMIT -> FINISH, stamped in a
+    clock ``domain_skew`` ahead of the reference."""
+    b = base + domain_skew
+    t = RequestTrace(trace_id=f"req-{uid}", uid=uid, prompt_tokens=16,
+                     enqueue_ts=b)
+    t.add("ENQUEUE", b, prompt_tokens=16)
+    t.add("PREFILL", b + 0.002, dur_ms=8.0, tokens=16)
+    t.add("DECODE_EMIT", b + 0.012, n=1, first=True)
+    t.first_token_ts = b + 0.012
+    t.add("FINISH", b + 0.020)
+    t.finish_ts = b + 0.020
+    t.status = "finished"
+    return t
+
+
+class TestRebase:
+    def test_rebase_shifts_all_stamps(self):
+        t = make_trace(domain_skew=0.25)
+        ref = make_trace(domain_skew=0.0)
+        t.rebase(0.25, 0.0001, domain="r0")
+        assert t.enqueue_ts == pytest.approx(ref.enqueue_ts)
+        assert t.first_token_ts == pytest.approx(ref.first_token_ts)
+        assert t.finish_ts == pytest.approx(ref.finish_ts)
+        for s, rs in zip(t.spans, ref.spans):
+            assert s.ts == pytest.approx(rs.ts)
+        # durations and derived latencies are offset-invariant
+        assert t.ttft_s == pytest.approx(ref.ttft_s)
+        assert t.clock_domain == "r0"
+        assert t.clock_offset_s == pytest.approx(0.25)
+
+    def test_spans_shorter_than_uncertainty_flagged(self):
+        """A 8 ms span under a 20 ms uncertainty cannot be causally
+        ordered against the other domain — it must say so."""
+        t = make_trace()
+        t.rebase(0.0, 0.020, domain="r1")
+        prefill = [s for s in t.spans if s.kind == "PREFILL"][0]
+        assert prefill.fields.get("clock_uncertain") is True
+        # instant markers (dur 0) are not flagged — the flag means
+        # "duration comparable to the error", not "everything"
+        enqueue = [s for s in t.spans if s.kind == "ENQUEUE"][0]
+        assert "clock_uncertain" not in enqueue.fields
+
+    def test_long_spans_not_flagged(self):
+        t = make_trace()
+        t.rebase(0.25, 0.001, domain="r1")  # 1 ms unc < 8 ms span
+        prefill = [s for s in t.spans if s.kind == "PREFILL"][0]
+        assert "clock_uncertain" not in prefill.fields
+
+    def test_to_dict_bit_exact_without_rebase(self):
+        """The off-switch: a never-rebased trace serializes WITHOUT any
+        clock key — byte-identical to the pre-clocksync format."""
+        d = make_trace().to_dict()
+        assert "clock_domain" not in d
+        assert "clock_offset_s" not in d
+        assert "clock_uncertainty_s" not in d
+        for s in d["spans"]:
+            assert "clock_uncertain" not in s
+
+    def test_dict_roundtrip_preserves_clock_fields(self):
+        t = make_trace(domain_skew=0.25).rebase(0.25, 0.005, domain="r2")
+        d = json.loads(json.dumps(t.to_dict()))
+        back = RequestTrace.from_dict(d)
+        assert back.clock_domain == "r2"
+        assert back.clock_offset_s == pytest.approx(0.25)
+        assert back.clock_uncertainty_s == pytest.approx(0.005)
+
+
+# -- merged Perfetto golden: causal ordering under ±250 ms ---------------
+
+
+def _load_events(path):
+    with open(path) as f:
+        return json.load(f)["traceEvents"]
+
+
+class TestMergedPerfetto:
+    def test_merged_export_restores_causal_order(self, tmp_path):
+        """Router routes at T, worker (clock +250 ms) prefills at
+        T+2 ms but STAMPS it T+252 ms; a second worker (clock -250 ms)
+        stamps T+2 ms as T-248 ms. Raw stamps order the timeline
+        prefill-before-route (and worker-1 250 ms early); the merged
+        export must put every worker span after its ROUTE decision."""
+        base = 2000.0
+        router = RequestTrace(trace_id="req-1", uid=1, enqueue_ts=base)
+        router.add("ENQUEUE", base)
+        router.add("ROUTE", base + 0.001, replica_id=0)
+        w_ahead = make_trace(uid=1, base=base + 0.002, domain_skew=0.25)
+        w_behind = make_trace(uid=2, base=base + 0.002,
+                              domain_skew=-0.25)
+        # sanity: the raw stamps really are causally broken
+        assert w_behind.spans[0].ts < router.spans[1].ts
+        path = str(tmp_path / "fleet_merged.json")
+        export_fleet_merged_trace(path, [
+            {"pid": 0, "name": "router", "traces": [router],
+             "offset_s": 0.0},
+            {"pid": 1, "name": "r0", "traces": [w_ahead],
+             "offset_s": 0.25, "uncertainty_s": 0.0005},
+            {"pid": 2, "name": "r1", "traces": [w_behind],
+             "offset_s": -0.25, "uncertainty_s": 0.0005},
+        ])
+        evs = _load_events(path)
+        route_us = [e["ts"] for e in evs
+                    if e.get("pid") == 0 and e.get("name") == "ROUTE"]
+        assert route_us, "router ROUTE span missing from the merge"
+        worker_us = [e["ts"] for e in evs
+                     if e.get("pid") in (1, 2) and "ts" in e
+                     and e.get("ph") in ("X", "i")]
+        assert worker_us, "worker lanes missing from the merge"
+        assert min(worker_us) >= max(route_us), \
+            "skew correction did not restore route-before-work order"
+        # timestamps are non-negative and on one shared base
+        assert min(e["ts"] for e in evs if "ts" in e) >= 0.0
+
+    def test_process_metadata_carries_clock_quality(self, tmp_path):
+        path = str(tmp_path / "meta.json")
+        export_fleet_merged_trace(path, [
+            {"pid": 7, "name": "r3", "traces": [make_trace()],
+             "offset_s": 0.1, "uncertainty_s": 0.002}])
+        meta = [e for e in _load_events(path)
+                if e.get("ph") == "M" and e.get("name") == "process_name"]
+        assert meta[0]["args"]["name"] == "r3"
+        assert meta[0]["args"]["clock_offset_ms"] == pytest.approx(100.0)
+        assert meta[0]["args"]["clock_uncertainty_ms"] == \
+            pytest.approx(2.0)
+
+    def test_zero_offset_lane_is_passthrough(self, tmp_path):
+        """offset 0 + no uncertainty: the lane's trace objects are not
+        copied or mutated, and span timings match a direct export."""
+        t = make_trace(base=3000.0)
+        before = copy.deepcopy(t.to_dict())
+        path = str(tmp_path / "raw.json")
+        export_fleet_merged_trace(
+            path, [{"pid": 0, "name": "solo", "traces": [t]}])
+        assert t.to_dict() == before, "export mutated the caller's trace"
+        evs = _load_events(path)
+        prefill = [e for e in evs if e.get("name") == "PREFILL"][0]
+        assert prefill["dur"] == pytest.approx(8000.0)  # 8 ms in us
+
+    def test_export_does_not_mutate_offset_lanes(self, tmp_path):
+        t = make_trace(domain_skew=0.25)
+        before = copy.deepcopy(t.to_dict())
+        path = str(tmp_path / "copy.json")
+        export_fleet_merged_trace(
+            path, [{"pid": 1, "name": "r0", "traces": [t],
+                    "offset_s": 0.25}])
+        assert t.to_dict() == before
+
+
+# -- tracer + alerter wiring --------------------------------------------
+
+
+class TestTracerClockPlumbing:
+    def test_finish_feeds_attached_alerter(self):
+        from deepspeed_tpu.observability.burn_rate import BurnRateAlerter
+
+        tracer = RequestTracer(enabled=True, sample_rate=1.0)
+        tracer.alerter = BurnRateAlerter(deadline_ms=1e6)
+        tracer.on_enqueue(1, prompt_tokens=4)
+        tracer.on_emit(1, 1)
+        tracer.on_finish(1)
+        assert tracer.alerter.stats["observed"] == 1
+        assert tracer.alerter.stats["misses"] == 0

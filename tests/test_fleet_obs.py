@@ -1,0 +1,374 @@
+"""Fleet observability-plane tests: SLO burn-rate alerting and the
+transport-borne metrics plane (docs/observability.md "Burn-rate
+alerts" / "Fleet tracing & clock sync").
+
+The load-bearing guarantees:
+- the multi-window burn-rate alert fires on a genuine cliff within the
+  FAST window — minutes before a post-run p99.9 gate could notice —
+  and does NOT fire on a fast-window blip the slow window has not
+  confirmed;
+- hysteresis: a fleet oscillating around the threshold pages once;
+- the off-switch builds no alerter at all (``from_config`` -> None);
+- per-worker hub snapshots merge into one fleet view with exact
+  counter/count/sum math, conservative tail percentiles, and stale
+  workers excluded.
+
+Jax-free, in-process.
+"""
+
+import json
+import time
+
+import pytest
+
+from deepspeed_tpu.observability.burn_rate import BurnRateAlerter
+from deepspeed_tpu.observability.fleet_metrics import (DEFAULT_PREFIXES,
+                                                       FleetMetricsPlane,
+                                                       compact_snapshot,
+                                                       merge_snapshots)
+from deepspeed_tpu.observability.hub import MetricsHub
+
+
+# -- burn rate -----------------------------------------------------------
+
+
+def alerter(**kw):
+    kw.setdefault("deadline_ms", 100.0)
+    kw.setdefault("slo_target", 0.999)
+    kw.setdefault("fast_window_s", 60.0)
+    kw.setdefault("slow_window_s", 600.0)
+    kw.setdefault("min_events", 10)
+    return BurnRateAlerter(**kw)
+
+
+class TestBurnRateAlerter:
+    def test_cliff_fires_within_fast_window(self):
+        """A total outage (every request missing) reaches burn 1000 —
+        both windows trip as soon as min_events accumulate: the alert
+        fires ~60 s into the incident, not after the run."""
+        a = alerter()
+        t0 = 1_000_000.0
+        for i in range(20):  # 20 misses over 20 s
+            a.observe(False, now=t0 + i)
+        ev = a.evaluate(now=t0 + 20.0)
+        assert ev["fired"] and a.firing
+        assert ev["burn_fast"] >= 14.4 and ev["burn_slow"] >= 6.0
+        assert a.stats["alerts_fired"] == 1
+
+    def test_fires_before_p999_gate_could(self):
+        """The headline property: with a 99.9% target, a p99.9 gate
+        needs ~1000 requests to even define the percentile; the
+        burn-rate alert pages after min_events (10) misses."""
+        a = alerter()
+        t0 = 5_000_000.0
+        n_seen = 0
+        fired_at = None
+        for i in range(1000):
+            a.observe(False, now=t0 + i * 0.1)
+            n_seen += 1
+            if a.evaluate(now=t0 + i * 0.1)["fired"]:
+                fired_at = n_seen
+                break
+        assert fired_at is not None and fired_at <= 20, \
+            f"alert took {fired_at} events — a p99.9 gate needs ~1000"
+
+    def test_fast_blip_without_slow_confirmation_stays_quiet(self):
+        """min_events misses inside the fast window, but diluted by a
+        long healthy history in the slow window: the slow burn stays
+        under threshold and no page goes out (the blip defense)."""
+        a = alerter(min_events=5)
+        t0 = 2_000_000.0
+        for i in range(2000):  # 500 s of healthy traffic, 4/s
+            a.observe(True, now=t0 + i * 0.25)
+        now = t0 + 500.0
+        for i in range(6):  # short burst of misses
+            a.observe(False, now=now + i)
+        ev = a.evaluate(now=now + 6.0)
+        assert ev["burn_fast"] >= 14.4  # the fast window IS over
+        assert ev["burn_slow"] < 6.0
+        assert not ev["fired"] and not a.firing
+
+    def test_min_events_suppresses_thin_windows(self):
+        """One unlucky request in an idle fleet is burn 1000 — and not
+        a page."""
+        a = alerter(min_events=10)
+        t0 = 3_000_000.0
+        for i in range(3):
+            a.observe(False, now=t0 + i)
+        ev = a.evaluate(now=t0 + 3.0)
+        assert not ev["fired"] and not a.firing
+
+    def test_hysteresis_clears_after_consecutive_clean_checks(self):
+        a = alerter(clear_checks=3)
+        t0 = 4_000_000.0
+        for i in range(20):
+            a.observe(False, now=t0 + i)
+        assert a.evaluate(now=t0 + 20.0)["fired"]
+        # recovery: healthy traffic pushes both windows under threshold
+        t1 = t0 + 700.0  # old misses aged out of both windows
+        for i in range(50):
+            a.observe(True, now=t1 + i * 0.1)
+        ev1 = a.evaluate(now=t1 + 5.0)
+        ev2 = a.evaluate(now=t1 + 6.0)
+        assert a.firing and not ev1["cleared"] and not ev2["cleared"]
+        ev3 = a.evaluate(now=t1 + 7.0)
+        assert ev3["cleared"] and not a.firing
+        assert a.stats["alerts_cleared"] == 1
+        # one page for the whole incident, not one per evaluation
+        assert a.stats["alerts_fired"] == 1
+
+    def test_observe_trace_judges_against_own_deadline(self):
+        """The alerter owns its deadline — supervisor-side mirror
+        tracers have none. A trace with no measured TTFT (flushed
+        pre-token) is a budget-relevant miss."""
+        from deepspeed_tpu.observability.request_trace import RequestTrace
+
+        a = alerter(deadline_ms=50.0)
+        ok = RequestTrace(trace_id="a", uid=1, enqueue_ts=100.0,
+                          first_token_ts=100.01)
+        miss = RequestTrace(trace_id="b", uid=2, enqueue_ts=100.0,
+                            first_token_ts=100.2)
+        never = RequestTrace(trace_id="c", uid=3, enqueue_ts=100.0)
+        for t in (ok, miss, never):
+            a.observe_trace(t, now=200.0)
+        assert a.stats["observed"] == 3
+        assert a.stats["misses"] == 2
+
+    def test_e2e_objective(self):
+        from deepspeed_tpu.observability.request_trace import RequestTrace
+
+        a = alerter(deadline_ms=50.0, objective="e2e")
+        t = RequestTrace(trace_id="a", uid=1, enqueue_ts=100.0,
+                         first_token_ts=100.01, finish_ts=100.2)
+        a.observe_trace(t, now=200.0)
+        assert a.stats["misses"] == 1  # e2e 200 ms > 50 ms
+
+    def test_hub_and_flight_emissions(self):
+        class Flight:
+            def __init__(self):
+                self.records = []
+
+            def record(self, kind, **fields):
+                self.records.append((kind, fields))
+
+        hub, flight = MetricsHub(), Flight()
+        a = alerter(hub=hub, flight=flight)
+        t0 = 6_000_000.0
+        for i in range(20):
+            a.observe(False, now=t0 + i)
+        a.evaluate(now=t0 + 20.0)
+        snap = hub.snapshot()
+        assert snap["gauges"]["slo.alert_firing"] == 1.0
+        assert snap["gauges"]["slo.burn_rate_fast"] >= 14.4
+        assert snap["counters"]["slo.alerts_fired"] == 1.0
+        kinds = [k for k, _ in flight.records]
+        assert kinds == ["slo_alert"]
+        assert flight.records[0][1]["state"] == "firing"
+
+    def test_snapshot_shape(self):
+        a = alerter()
+        s = a.snapshot()
+        assert s["firing"] is False and s["objective"] == "ttft"
+        assert s["windows"]["fast"]["burn_threshold"] == 14.4
+        assert json.dumps(s)  # wire-serializable
+
+    def test_from_config_off_switch(self):
+        assert BurnRateAlerter.from_config(None) is None
+        assert BurnRateAlerter.from_config(
+            {"enabled": False, "deadline_ms": 100.0}) is None
+        assert BurnRateAlerter.from_config({"enabled": True}) is None
+        a = BurnRateAlerter.from_config(
+            {"enabled": True, "deadline_ms": 100.0,
+             "fast_window_seconds": 30.0, "slow_window_seconds": 300.0})
+        assert a is not None
+        assert a.fast_window_s == 30.0 and a.slow_window_s == 300.0
+
+    def test_from_config_accepts_config_object(self):
+        from deepspeed_tpu.config.config import BurnRateConfig
+
+        cfg = BurnRateConfig(enabled=True, deadline_ms=75.0)
+        a = BurnRateAlerter.from_config(cfg)
+        assert a is not None and a.deadline_ms == 75.0
+        assert BurnRateAlerter.from_config(BurnRateConfig()) is None
+
+    def test_invalid_params_rejected(self):
+        with pytest.raises(ValueError, match="slo_target"):
+            BurnRateAlerter(deadline_ms=10.0, slo_target=1.5)
+        with pytest.raises(ValueError, match="objective"):
+            BurnRateAlerter(deadline_ms=10.0, objective="p99")
+
+
+# -- metrics plane -------------------------------------------------------
+
+
+def worker_hub(requests=3, ttfts=(0.01, 0.02)):
+    hub = MetricsHub()
+    hub.counter_add("serve.requests", requests)
+    hub.gauge("serve.queue_depth", 2.0)
+    for v in ttfts:
+        hub.histogram("serve.ttft_seconds").observe(v)
+    # off-prefix families must not ride the heartbeat
+    hub.gauge("train.loss", 1.0)
+    hub.counter_add("quant.fetches", 5)
+    return hub
+
+
+class TestCompactSnapshot:
+    def test_filters_to_serving_prefixes(self):
+        snap = compact_snapshot(worker_hub())
+        assert set(snap) == {"gauges", "counters", "histograms"}
+        assert snap["counters"] == {"serve.requests": 3.0}
+        assert snap["gauges"] == {"serve.queue_depth": 2.0}
+        assert "train.loss" not in snap["gauges"]
+        h = snap["histograms"]["serve.ttft_seconds"]
+        assert h["count"] == 2
+
+    def test_empty_hub_is_empty_dict(self):
+        assert compact_snapshot(None) == {}
+        assert compact_snapshot(MetricsHub()) == {}
+
+    def test_snapshot_is_wire_serializable(self):
+        assert json.loads(json.dumps(compact_snapshot(worker_hub())))
+
+
+class TestMergeSnapshots:
+    def test_counters_sum_gauges_fan_out(self):
+        m = merge_snapshots({
+            "r0": compact_snapshot(worker_hub(requests=3)),
+            "r1": compact_snapshot(worker_hub(requests=4)),
+        })
+        assert m["counters"]["serve.requests"] == 7.0
+        g = m["gauges"]["serve.queue_depth"]
+        assert g["by_replica"] == {"r0": 2.0, "r1": 2.0}
+        assert g["sum"] == 4.0
+
+    def test_histograms_merge_exact_where_math_allows(self):
+        m = merge_snapshots({
+            "r0": compact_snapshot(worker_hub(ttfts=(0.01, 0.02))),
+            "r1": compact_snapshot(worker_hub(ttfts=(0.10,))),
+        })
+        h = m["histograms"]["serve.ttft_seconds"]
+        assert h["count"] == 3
+        assert h["sum"] == pytest.approx(0.13)
+        assert h["mean"] == pytest.approx(0.13 / 3)
+        assert h["min"] == pytest.approx(0.01)
+        assert h["max"] == pytest.approx(0.10)
+        # tail percentiles: max across workers (conservative fleet p99)
+        assert h["p99"] >= 0.10 - 1e-9
+        assert h["replicas"] == 2
+
+
+class TestFleetMetricsPlane:
+    def test_merged_matches_per_worker_hub_values(self):
+        """The acceptance check: the live fleet view equals what each
+        worker's own hub reports, with NO shared filesystem — the
+        snapshots traveled as plain dicts."""
+        hubs = {"r0": worker_hub(requests=2), "r1": worker_hub(requests=5)}
+        plane = FleetMetricsPlane(stale_after_s=5.0)
+        for rid, hub in hubs.items():
+            plane.ingest(rid, json.loads(
+                json.dumps(compact_snapshot(hub))))  # wire roundtrip
+        m = plane.merged()
+        expect = sum(h.snapshot()["counters"]["serve.requests"]
+                     for h in hubs.values())
+        assert m["counters"]["serve.requests"] == expect
+        for rid, hub in hubs.items():
+            assert (m["gauges"]["serve.queue_depth"]["by_replica"][rid]
+                    == hub.snapshot()["gauges"]["serve.queue_depth"])
+        assert m["replicas"] == ["r0", "r1"]
+        assert m["ingested"] == 2
+
+    def test_stale_workers_excluded_and_reported(self):
+        plane = FleetMetricsPlane(stale_after_s=1.0)
+        plane.ingest("r0", compact_snapshot(worker_hub(requests=2)))
+        now = time.monotonic()
+        plane._mono["r0"] = now - 10.0  # age the snapshot artificially
+        plane.ingest("r1", compact_snapshot(worker_hub(requests=5)))
+        m = plane.merged(now_mono=now)
+        assert m["counters"]["serve.requests"] == 5.0
+        assert m["replicas"] == ["r1"]
+        assert "r0" in m["stale"] and m["stale"]["r0"] >= 9.0
+
+    def test_empty_snapshots_ignored(self):
+        plane = FleetMetricsPlane()
+        plane.ingest("r0", {})
+        plane.ingest("r1", None)
+        assert plane.ingested == 0
+        m = plane.merged()
+        assert m["replicas"] == [] and m["counters"] == {}
+
+    def test_forget_removes_replica(self):
+        plane = FleetMetricsPlane()
+        plane.ingest("r0", compact_snapshot(worker_hub()))
+        plane.forget("r0")
+        assert plane.merged()["replicas"] == []
+
+
+# -- supervisor-side ingest rebasing (in-process, no subprocess) --------
+
+
+class TestSupervisorIngestRebase:
+    def _view(self):
+        from deepspeed_tpu.serving.supervisor import RemoteEngineView
+
+        return RemoteEngineView(block_size=8, total_blocks=16,
+                                max_blocks_per_seq=4)
+
+    def _trace_doc(self, skew=0.25, base=1000.0):
+        from deepspeed_tpu.observability.request_trace import RequestTrace
+
+        b = base + skew
+        t = RequestTrace(trace_id="req-9", uid=9, enqueue_ts=b,
+                         first_token_ts=b + 0.02, finish_ts=b + 0.03,
+                         status="finished")
+        t.add("ENQUEUE", b)
+        t.add("FINISH", b + 0.03)
+        return t.to_dict()
+
+    def test_synced_clock_rebases_ingested_traces(self):
+        view = self._view()
+
+        class Clk:
+            synced = True
+            offset_s = 0.25
+            uncertainty_s = 0.001
+
+        view.clock = Clk()
+        view.clock_domain = "r0"
+        view.ingest_traces([self._trace_doc(skew=0.25)])
+        (tr,) = view.tracer.finished()
+        assert tr.clock_domain == "r0"
+        assert tr.enqueue_ts == pytest.approx(1000.0)
+        assert tr.ttft_s == pytest.approx(0.02)  # offset-invariant
+
+    def test_no_clock_is_bit_exact_passthrough(self):
+        """The off-switch at the supervisor layer: without an estimator
+        the ingested trace re-serializes byte-identically."""
+        view = self._view()
+        doc = self._trace_doc(skew=0.25)
+        view.ingest_traces([json.loads(json.dumps(doc))])
+        (tr,) = view.tracer.finished()
+        assert tr.to_dict() == doc
+        assert "clock_domain" not in tr.to_dict()
+
+    def test_unsynced_clock_is_passthrough(self):
+        view = self._view()
+
+        class Clk:
+            synced = False
+            offset_s = 0.0
+            uncertainty_s = float("inf")
+
+        view.clock = Clk()
+        view.clock_domain = "r0"
+        doc = self._trace_doc(skew=0.25)
+        view.ingest_traces([json.loads(json.dumps(doc))])
+        (tr,) = view.tracer.finished()
+        assert tr.to_dict() == doc
+
+    def test_ingest_feeds_alerter(self):
+        view = self._view()
+        view.tracer.alerter = BurnRateAlerter(deadline_ms=1.0)
+        view.ingest_traces([self._trace_doc()])
+        assert view.tracer.alerter.stats["observed"] == 1
+        assert view.tracer.alerter.stats["misses"] == 1  # 20ms > 1ms

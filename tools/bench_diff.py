@@ -86,6 +86,14 @@ DEFAULT_THRESHOLDS: Dict[str, Tuple[str, float]] = {
     # share of flash-worthy dispatches that lost the kernel must not
     # creep up by more than 10 points
     "flash_fallback_ratio": ("max_increase", 0.10),
+    # observability plane (BENCH_MODE=obs_fleet): the per-request tracer
+    # emit-point overhead gets a loose order-of-magnitude leash (tens of
+    # µs measured on a shared host — only a blowup is signal), and the
+    # worst clock-offset error may not grow by more than 5 ms absolute;
+    # the boolean obs.trace_overhead_ok / obs.offset_bound_ok
+    # certificates are checked unconditionally below
+    "obs.trace_overhead_us": ("max_ratio", 3.0),
+    "obs.offset_err_ms": ("max_increase", 5.0),
 }
 
 # units where a larger headline value is worse
@@ -218,6 +226,23 @@ def diff_reports(old: Dict[str, Any], new: Dict[str, Any],
             rise = nv - ov
             check("flash_fallback_ratio", rule, limit, ov, nv, rise,
                   rise <= limit)
+        # observability-plane sentinels (obs_fleet payloads): tracer
+        # overhead trend and the worst clock-offset error
+        ov = old.get("obs.trace_overhead_us")
+        nv = new.get("obs.trace_overhead_us")
+        if isinstance(ov, (int, float)) and isinstance(nv, (int, float)) \
+                and ov > 0:
+            rule, limit = th["obs.trace_overhead_us"]
+            ratio = nv / ov
+            check("obs.trace_overhead_us", rule, limit, ov, nv, ratio,
+                  ratio <= limit)
+        ov = old.get("obs.offset_err_ms")
+        nv = new.get("obs.offset_err_ms")
+        if isinstance(ov, (int, float)) and isinstance(nv, (int, float)):
+            rule, limit = th["obs.offset_err_ms"]
+            rise = nv - ov
+            check("obs.offset_err_ms", rule, limit, ov, nv, rise,
+                  rise <= limit)
         for arm in ("bf16", "int8", "int4"):
             o_arm = old.get(arm) if isinstance(old.get(arm), dict) else {}
             n_arm = new.get(arm) if isinstance(new.get(arm), dict) else {}
@@ -231,11 +256,13 @@ def diff_reports(old: Dict[str, Any], new: Dict[str, Any],
                       limit * loosen, ov, nv, ratio,
                       ratio >= limit * loosen)
 
-    # chaos certificates ride any payload that carries them — the new
-    # round's zero-drops and bit-identical flags must be true regardless
-    # of comparability (a chaos round that dropped a request or diverged
-    # a stream is broken on its own, not relative to the old round)
-    for cert in ("chaos.zero_drops", "chaos.bit_identical"):
+    # chaos + observability certificates ride any payload that carries
+    # them — the new round's flags must be true regardless of
+    # comparability (a chaos round that dropped a request, or an obs
+    # round whose clock estimate escaped its own uncertainty bound, is
+    # broken on its own, not relative to the old round)
+    for cert in ("chaos.zero_drops", "chaos.bit_identical",
+                 "obs.trace_overhead_ok", "obs.offset_bound_ok"):
         if cert in new:
             check(cert, "must_stay_true", 1, old.get(cert),
                   new.get(cert), float(bool(new[cert])), bool(new[cert]))

@@ -1650,6 +1650,196 @@ def run_chaos_fleet() -> dict:
     }
 
 
+def _obs_clock_arm(arm: str, spec_text: str, skew_s: float,
+                   rounds: int) -> dict:
+    """One clock-sync accuracy arm: an echo worker subprocess whose wall
+    clock is skewed by ``skew_s`` (DSTPU_CLOCK_SKEW_S in its env), pinged
+    ``rounds`` times through a real socket channel while the parent-side
+    chaos injector runs one net-fault family. Pings are interleaved with
+    regular echo messages so the worker's 10 s recv timeout never fires
+    and the parent's recv drains the pongs en route.
+
+    ``net_drop`` is deliberately NOT in the matrix: a dropped frame is a
+    sequence gap, i.e. a dead channel by design — clock sync on a dead
+    channel is meaningless. Delay and dup are the faults a live channel
+    survives. The delay arm slows every parent-side outbound frame,
+    which both delays the ping's departure (after t0 is stamped) and —
+    because the interleaved data send sleeps before the parent drains
+    its socket — the pong's processing (t3): the round trip inflates by
+    ~2x the delay, and the gate asserts the estimator's *widened*
+    uncertainty still covers its true error (the honest-bound
+    property), not that the error stays tiny."""
+    import subprocess
+
+    from deepspeed_tpu.observability.clocksync import ClockSyncEstimator
+    from deepspeed_tpu.resilience.chaos import (ChaosInjector, ChaosSpec,
+                                                reset_chaos_injector,
+                                                set_chaos_injector)
+    from deepspeed_tpu.serving.transport import ChannelError, SocketServer
+
+    echo_worker = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "tests", "transport_echo_worker.py")
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)  # the worker never imports jax
+    env["DSTPU_CLOCK_SKEW_S"] = repr(skew_s)
+    env.pop("DSTPU_CHAOS", None)  # faults are parent-side only
+
+    srv = SocketServer()
+    proc = subprocess.Popen([sys.executable, echo_worker, str(srv.port)],
+                            env=env)
+    out = {"arm": arm, "synced": False, "offset_ms": None,
+           "uncertainty_ms": None, "err_ms": None, "within_bound": False,
+           "rounds": 0}
+    chan = None
+    try:
+        chan = srv.accept(timeout=10.0)
+        chan.clock = ClockSyncEstimator()
+        if spec_text:
+            set_chaos_injector(ChaosInjector(ChaosSpec.parse(spec_text)))
+        try:
+            for i in range(rounds):
+                chan.ping_clock()
+                chan.send({"type": "obs", "i": i})
+                reply = chan.recv(timeout=10.0)
+                if reply is None:
+                    break
+                out["rounds"] += 1
+        finally:
+            reset_chaos_injector()
+        est = chan.clock
+        out["synced"] = est.synced
+        if est.synced:
+            off, unc = est.offset_s, est.uncertainty_s
+            err = abs(off - skew_s)
+            out["offset_ms"] = round(off * 1e3, 3)
+            out["uncertainty_ms"] = round(unc * 1e3, 3)
+            out["err_ms"] = round(err * 1e3, 3)
+            # honest-bound gate: the error must sit inside the
+            # estimator's own reported uncertainty (+1 ms measurement
+            # noise floor for CI jitter)
+            out["within_bound"] = err <= unc + 1e-3
+        chan.send({"type": "quit"})
+    except ChannelError as e:
+        out["error"] = str(e)
+    finally:
+        if chan is not None:
+            chan.close()
+        srv.close()
+        try:
+            proc.wait(timeout=10.0)
+        except Exception:
+            proc.kill()
+            proc.wait(timeout=10.0)
+    return out
+
+
+def run_obs_fleet() -> dict:
+    """Observability-plane certification (``BENCH_MODE=obs_fleet``,
+    ``make obs-fleet``): two gates, one JSON line.
+
+    1. **Tracing overhead** — drive N synthetic request lifecycles
+       (enqueue/admit/prefill/emit*G/finish) through a RequestTracer at
+       sample_rate=1.0 and again through a disabled tracer; the per-
+       request delta must stay under OBS_MAX_TRACE_OVERHEAD_US
+       (``obs.trace_overhead_ok``). This is the "tracing is within noise
+       of the untraced serve bench" gate, measured at the emit points
+       themselves so it cannot be washed out by model time.
+
+    2. **Clock-sync accuracy under the chaos matrix** — a real echo-
+       worker subprocess with a skewed wall clock (OBS_FLEET_SKEW_S,
+       default 0.25 s — the ±250 ms fleet-skew scenario) is pinged
+       through a socket channel under ``clean`` / ``delay``
+       (net_delay_ms on the parent's wire path) / ``dup`` arms. Every
+       arm must
+       converge with |estimate - true skew| inside the estimator's OWN
+       reported uncertainty (``obs.offset_bound_ok``) and under the
+       absolute cap OBS_MAX_OFFSET_ERR_MS.
+
+    Env knobs: OBS_TRACE_REQUESTS (200), OBS_TRACE_GEN (16),
+    OBS_MAX_TRACE_OVERHEAD_US (250), OBS_FLEET_SKEW_S (0.25),
+    OBS_CLOCK_ROUNDS (12), OBS_MAX_OFFSET_ERR_MS (50),
+    OBS_FLEET_DELAY_MS (5), OBS_FLEET_ARMS (clean,delay,dup)."""
+    from deepspeed_tpu.observability.request_trace import RequestTracer
+
+    n_req = int(os.environ.get("OBS_TRACE_REQUESTS", 200))
+    gen = int(os.environ.get("OBS_TRACE_GEN", 16))
+    max_overhead_us = float(os.environ.get("OBS_MAX_TRACE_OVERHEAD_US",
+                                           250.0))
+    skew_s = float(os.environ.get("OBS_FLEET_SKEW_S", 0.25))
+    rounds = int(os.environ.get("OBS_CLOCK_ROUNDS", 12))
+    max_err_ms = float(os.environ.get("OBS_MAX_OFFSET_ERR_MS", 50.0))
+    delay_ms = float(os.environ.get("OBS_FLEET_DELAY_MS", 5.0))
+    arm_names = os.environ.get("OBS_FLEET_ARMS",
+                               "clean,delay,dup").split(",")
+
+    # -- gate 1: emit-point overhead, traced vs disabled ---------------
+    def _drive(tracer: RequestTracer) -> float:
+        t0 = time.perf_counter()
+        for uid in range(n_req):
+            tracer.on_enqueue(uid, prompt_tokens=32, queue_depth=1)
+            tracer.on_admit(uid, wait_s=0.0)
+            tracer.on_prefill(uid, start=time.time(), dur_ms=1.0,
+                              tokens=32, start_pos=0)
+            for _ in range(gen):
+                tracer.on_emit(uid, 1)
+            tracer.on_finish(uid)
+        return time.perf_counter() - t0
+
+    _drive(RequestTracer(enabled=True, sample_rate=1.0,
+                         ring_size=n_req))  # warm up code paths
+    traced_s = _drive(RequestTracer(enabled=True, sample_rate=1.0,
+                                    ring_size=n_req))
+    disabled_s = _drive(RequestTracer(enabled=False))
+    overhead_us = max(0.0, (traced_s - disabled_s) / n_req * 1e6)
+
+    # -- gate 2: clock offset accuracy under net faults ----------------
+    specs = {"clean": "", "delay": f"net_delay_ms={delay_ms}",
+             "dup": "net_dup=3"}
+    arms = {}
+    for arm in arm_names:
+        arm = arm.strip()
+        arms[arm] = _obs_clock_arm(arm, specs.get(arm, ""), skew_s,
+                                   rounds)
+
+    violations = []
+    if overhead_us > max_overhead_us:
+        violations.append({"region": "trace", "gate": "overhead_us",
+                           "limit": max_overhead_us,
+                           "got": round(overhead_us, 1)})
+    for arm, r in arms.items():
+        if not r["synced"]:
+            violations.append({"region": arm, "gate": "clock_synced",
+                               "limit": "estimator converged",
+                               "got": r.get("error", "unsynced")})
+            continue
+        if not r["within_bound"]:
+            violations.append({"region": arm, "gate": "offset_bound",
+                               "limit": f"err <= {r['uncertainty_ms']}ms"
+                                        " (own bound)",
+                               "got": r["err_ms"]})
+        if r["err_ms"] > max_err_ms:
+            violations.append({"region": arm, "gate": "offset_err_ms",
+                               "limit": max_err_ms, "got": r["err_ms"]})
+
+    worst_err = max((r["err_ms"] for r in arms.values()
+                     if r.get("err_ms") is not None), default=None)
+    return {
+        "metric": f"obs_fleet trace overhead ({n_req} req, "
+                  f"{len(arms)} clock arms, skew {skew_s * 1e3:.0f}ms)",
+        "value": round(overhead_us, 2),
+        "unit": "us/request",
+        "obs.trace_overhead_us": round(overhead_us, 2),
+        "obs.trace_overhead_ok": overhead_us <= max_overhead_us,
+        "obs.offset_err_ms": worst_err,
+        "obs.offset_bound_ok": all(r["synced"] and r["within_bound"]
+                                   for r in arms.values()),
+        "arms": arms,
+        "ok": not violations,
+        "violations": violations,
+    }
+
+
 if __name__ == "__main__":
     mode = os.environ.get("BENCH_MODE", "serve")
     if mode == "serve_fleet":
@@ -1664,6 +1854,11 @@ if __name__ == "__main__":
         _cp = run_chaos_fleet()
         print(json.dumps(_cp))
         if not _cp.get("ok", True):
+            raise SystemExit(1)
+    elif mode == "obs_fleet":
+        _op = run_obs_fleet()
+        print(json.dumps(_op))
+        if not _op.get("ok", True):
             raise SystemExit(1)
     elif mode == "serve_quant":
         _qp = run_quant()

@@ -228,6 +228,46 @@ def _fleet_table(snap: dict) -> str:
                 f"r{rid}:tx={w['tx_bytes']}:rx={w['rx_bytes']}"
                 for rid, w in sorted(wire.items(),
                                      key=lambda kv: int(kv[0])))]
+    clock = snap.get("clock") or {}
+    if clock:
+        parts = []
+        for rid, c in sorted(clock.items(), key=lambda kv: str(kv[0])):
+            if c.get("synced"):
+                parts.append(f"r{rid}:offset={c['offset_ms']:+.2f}ms"
+                             f"±{c['uncertainty_ms']:.2f}")
+            else:
+                parts.append(f"r{rid}:unsynced({c.get('samples', 0)})")
+        lines += ["clock: " + "  ".join(parts)]
+    alerts = snap.get("alerts")
+    if alerts:
+        ev = alerts.get("last_eval") or {}
+        state = "FIRING" if alerts.get("firing") else "ok"
+        lines += [f"slo alert [{state}]: "
+                  f"objective={alerts.get('objective')} "
+                  f"deadline={alerts.get('deadline_ms')}ms "
+                  f"burn fast={ev.get('burn_fast', 0)} "
+                  f"slow={ev.get('burn_slow', 0)} "
+                  f"fired={alerts.get('stats', {}).get('alerts_fired', 0)}"]
+    fm = snap.get("fleet_metrics") or {}
+    if fm.get("counters") or fm.get("histograms"):
+        lines += ["", "### fleet metrics (transport plane, "
+                  f"{len(fm.get('replicas', []))} workers)", ""]
+        if fm.get("counters"):
+            lines += ["counters: " + "  ".join(
+                f"{k}={v}" for k, v in sorted(fm["counters"].items()))]
+        hists = fm.get("histograms") or {}
+        if hists:
+            lines += ["", "| histogram | count | mean | p50 | p95 | p99 |",
+                      "|---|---|---|---|---|---|"]
+            for name in sorted(hists):
+                h = hists[name]
+                lines.append(
+                    f"| {name} | {h['count']} | {h['mean']:.4g} | "
+                    f"{h['p50']:.4g} | {h['p95']:.4g} | {h['p99']:.4g} |")
+        if fm.get("stale"):
+            stale = "  ".join(f"{r}:{age}s"
+                              for r, age in sorted(fm["stale"].items()))
+            lines += [f"stale workers: {stale}"]
     attr = snap.get("slo_attribution") or {}
     per = attr.get("per_replica") or {}
     if per:
