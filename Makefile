@@ -14,7 +14,7 @@ NPROC := $(shell nproc)
 XDIST ?= $(shell if [ $(NPROC) -gt 2 ] && python -c "import xdist" 2>/dev/null; then echo "-n $$(( $(NPROC) - 1 )) --dist loadfile"; fi)
 PYTEST ?= python -m pytest
 
-.PHONY: test smoke slow bench bench-real bench-proxy bench-hostgap bench-overlap bench-longctx bench-quant bench-kernels bench-diff quant-sweep fleet-demo chaos serve-slo serve-fleet serve-quant serve-procs chaos-fleet obs-fleet
+.PHONY: test smoke slow bench bench-real bench-proxy bench-hostgap bench-overlap bench-longctx bench-quant bench-kernels bench-diff quant-sweep fleet-demo chaos serve-slo serve-fleet serve-quant serve-tier serve-procs chaos-fleet obs-fleet
 
 smoke:
 	$(PYTEST) tests/ -q -m "not slow" $(XDIST)
@@ -139,6 +139,17 @@ serve-fleet:
 # "Quantized KV cache & handoff wire").
 serve-quant:
 	BENCH_MODE=serve_quant python bench.py
+
+# Tiered-KV + adaptive-speculation arm: sessions held per HBM GB with
+# the host-memory tier vs HBM-only on the same byte budget (must hold
+# >= 2x), warm-resume TTFT vs cold re-prefill (must cost <= 0.5x), and
+# the distilled drafter's accepted-tokens-per-step edge over prompt
+# lookup (must beat >= 1.05x) — all three streams asserted
+# bit-identical. Violations ride ok/violations, so bench_diff fails
+# the round on a regression (TIER_SERVE_* env knobs; docs/serving.md
+# "Tiered KV hierarchy" / "Adaptive speculation").
+serve-tier:
+	BENCH_MODE=serve_tier python bench.py
 
 # Cross-process fleet (tools/serve_bench.py run_procs): real worker
 # SUBPROCESSES behind the length-prefixed CRC socket transport

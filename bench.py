@@ -305,8 +305,8 @@ def overlap_report(model, step_ms, overlap_depth, streaming,
 def main():
     if os.environ.get("BENCH_MODE") in ("serve", "serve_slo",
                                         "serve_fleet", "serve_quant",
-                                        "serve_procs", "chaos_fleet",
-                                        "obs_fleet"):
+                                        "serve_tier", "serve_procs",
+                                        "chaos_fleet", "obs_fleet"):
         # serving benchmarks instead of the training headline
         # (tools/serve_bench.py): "serve" is the closed-loop v2-vs-v1
         # throughput comparison (SERVE_* env knobs); "serve_slo" is the
@@ -319,6 +319,10 @@ def main():
         # "serve_quant" is the int8-KV capacity arm — concurrent
         # sessions per fixed HBM budget (int8 vs bf16 pool) plus the
         # raw-vs-int4 handoff wire bytes (QUANT_SERVE_* env knobs);
+        # "serve_tier" is the host-memory KV tier arm — sessions held
+        # per HBM GB (tiered vs HBM-only), warm-resume TTFT vs cold
+        # re-prefill, and the distilled-drafter acceptance edge
+        # (TIER_SERVE_* env knobs);
         # "serve_procs" is the cross-process fleet — worker subprocesses
         # behind the socket transport, routing A/B + chaos + disagg
         # arms over one diurnal/bursty schedule (PROCS_* env knobs);
@@ -346,6 +350,12 @@ def main():
             print(json.dumps(quant_payload))
             if not quant_payload.get("ok", True):
                 sys.exit(1)  # same fail-loud contract as BENCH_QUANT
+        elif os.environ.get("BENCH_MODE") == "serve_tier":
+            tier_payload = serve_bench.run_tier()
+            print(json.dumps(tier_payload))
+            if not tier_payload.get("ok", True):
+                sys.exit(1)  # gates: sessions ratio, warm-resume TTFT,
+                #             bit-identity, distilled-drafter edge
         elif os.environ.get("BENCH_MODE") == "serve_procs":
             procs_payload = serve_bench.run_procs()
             print(json.dumps(procs_payload))

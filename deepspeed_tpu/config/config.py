@@ -939,12 +939,23 @@ class ServingConfig(ConfigModel):
     ``kv_quant_bits`` stores KV-cache blocks as quantized payloads with
     one fp32 scale per head_dim vector: 8 keeps int8 storage, 4 packs
     two nibbles per byte (~1.9x more sessions at head_dim 128; decode
-    SNR gated in ``make serve-quant``). None keeps today's bf16 pool
-    bit-exactly — the quantized pytree never enters the traced
-    program. ``handoff_wire`` picks the disaggregated-prefill KV
-    handoff codec: "auto" ships the pool's native format, "raw" forces
-    full precision, "int8"/"int4" quantize bf16 pools for the wire
-    (int4 packs two values per byte; dequantized on install)."""
+    SNR gated in ``make serve-quant``), "fp8" stores e4m3 floats (same
+    2x footprint as int8 with format-native dynamic range). None keeps
+    today's bf16 pool bit-exactly — the quantized pytree never enters
+    the traced program. ``handoff_wire`` picks the disaggregated-prefill
+    KV handoff codec: "auto" ships the pool's native format, "raw"
+    forces full precision, "int8"/"int4" quantize bf16 pools for the
+    wire (int4 packs two values per byte; dequantized on install).
+
+    ``host_kv_tier`` attaches a ``host_tier_mb``-byte host-memory tier
+    below the HBM pool (ragged/kv_tier.py): KV pressure PAGES cold
+    prefix chains and preempted sessions out in pool-native format
+    instead of discarding them, and returning sessions warm-resume
+    decode without re-prefill. Off keeps the HBM-only engine
+    bit-exactly. ``spec_adaptive_k`` makes the speculative draft length
+    per-request adaptive (acceptance-EWMA x batch-occupancy controller,
+    ``spec_accept_alpha`` smoothing); off is the fixed-``spec_k``
+    legacy path, and greedy output stays token-identical either way."""
 
     max_queue_depth: Optional[int] = None
     prefix_cache: bool = True
@@ -952,8 +963,12 @@ class ServingConfig(ConfigModel):
     spec_k: int = 4
     spec_ngram: int = 3
     decode_steps: int = 8
-    kv_quant_bits: Optional[int] = None
+    kv_quant_bits: Optional[Any] = None
     handoff_wire: str = "auto"
+    host_kv_tier: bool = False
+    host_tier_mb: int = 256
+    spec_adaptive_k: bool = False
+    spec_accept_alpha: float = 0.25
     router: RouterConfig = field(default_factory=RouterConfig)
 
     def validate(self) -> None:
@@ -962,19 +977,23 @@ class ServingConfig(ConfigModel):
                 f"serving.max_queue_depth must be >= 1 (or null for "
                 f"unbounded), got {self.max_queue_depth}")
         for name, lo in (("spec_k", 1), ("spec_ngram", 1),
-                         ("decode_steps", 1)):
+                         ("decode_steps", 1), ("host_tier_mb", 1)):
             if getattr(self, name) < lo:
                 raise ValueError(
                     f"serving.{name} must be >= {lo}, got "
                     f"{getattr(self, name)}")
-        if self.kv_quant_bits not in (None, 4, 8):
+        if self.kv_quant_bits not in (None, 4, 8, "fp8"):
             raise ValueError(
-                f"serving.kv_quant_bits must be null, 4 or 8, got "
-                f"{self.kv_quant_bits}")
+                f"serving.kv_quant_bits must be null, 4, 8 or \"fp8\", "
+                f"got {self.kv_quant_bits}")
         if self.handoff_wire not in ("auto", "raw", "int8", "int4"):
             raise ValueError(
                 f"serving.handoff_wire must be one of auto/raw/int8/"
                 f"int4, got {self.handoff_wire!r}")
+        if not (0.0 < self.spec_accept_alpha <= 1.0):
+            raise ValueError(
+                f"serving.spec_accept_alpha must be in (0, 1], got "
+                f"{self.spec_accept_alpha}")
         self.router.validate()
 
 

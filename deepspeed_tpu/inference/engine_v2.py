@@ -16,6 +16,7 @@ serves the whole workload.
 from __future__ import annotations
 
 import dataclasses
+import math
 import time
 from collections import deque
 from functools import partial
@@ -54,6 +55,11 @@ class _QueuedRequest:
     # queue re-entry after a preemption (vs a fresh put()) — the trace
     # records the round trip's requeue wait on re-admission
     requeued: bool = False
+    # the victim's KV is parked in the host tier (ragged/kv_tier.py):
+    # admission restores blocks and resumes decode instead of
+    # re-prefilling; ``tokens`` carries the folded history anyway as
+    # the fallback if the tier spills the session before readmission
+    paged: bool = False
 
 
 # Process-level jit cache shared by every engine instance. A fleet of
@@ -108,8 +114,11 @@ class InferenceEngineV2:
                  spec_decode: bool = False, spec_k: int = 4,
                  spec_ngram: int = 3, drafter: Optional[Any] = None,
                  max_queue_depth: Optional[int] = None,
-                 kv_quant_bits: Optional[int] = None,
+                 kv_quant_bits: Optional[Any] = None,
                  handoff_wire: str = "auto",
+                 host_kv_tier: bool = False, host_tier_mb: int = 256,
+                 spec_adaptive_k: bool = False,
+                 spec_accept_alpha: float = 0.25,
                  serving: Optional[Any] = None,
                  request_trace: Optional[Any] = None,
                  metric_labels: Optional[Dict[str, str]] = None):
@@ -128,6 +137,10 @@ class InferenceEngineV2:
             max_queue_depth = serving.max_queue_depth
             kv_quant_bits = getattr(serving, "kv_quant_bits", None)
             handoff_wire = getattr(serving, "handoff_wire", "auto")
+            host_kv_tier = getattr(serving, "host_kv_tier", False)
+            host_tier_mb = getattr(serving, "host_tier_mb", 256)
+            spec_adaptive_k = getattr(serving, "spec_adaptive_k", False)
+            spec_accept_alpha = getattr(serving, "spec_accept_alpha", 0.25)
 
         # reuse v1's TP placement logic for params/mesh
         self._v1 = InferenceEngine(model, mesh=mesh, params=params,
@@ -161,6 +174,16 @@ class InferenceEngineV2:
         if prefix_cache:
             self.kv_cache.prefix_cache = PrefixCache(
                 kv_block_size, metric_labels=self._metric_labels)
+        # host-memory KV tier (ragged/kv_tier.py): KV pressure PAGES
+        # blocks out (through the pool's own compact storage format)
+        # instead of evicting them — cold prefix chains and preempted
+        # sessions come back without re-prefill
+        if host_kv_tier:
+            from deepspeed_tpu.inference.ragged.kv_tier import HostKVTier
+
+            self.kv_cache.host_tier = HostKVTier(
+                capacity_bytes=int(host_tier_mb) << 20,
+                metric_labels=self._metric_labels)
 
         self.state = StateManager(self.kv_cache,
                                   max_tracked_sequences=4 * max_seqs_per_step,
@@ -197,7 +220,9 @@ class InferenceEngineV2:
                       "requeued": 0, "truncated": 0,
                       "prefix_hit_tokens": 0,
                       "spec_steps": 0, "spec_proposed": 0,
-                      "spec_accepted": 0}
+                      "spec_accepted": 0, "spec_backoff_rounds": 0,
+                      "paged_out": 0, "paged_in": 0,
+                      "warm_resume_tokens": 0}
         # admission queue: put() never raises on a full KV pool — requests
         # wait FIFO here and admit as blocks free up; preemption victims
         # requeue at the FRONT with their generated tokens preserved
@@ -210,6 +235,22 @@ class InferenceEngineV2:
         self._drafter = drafter if drafter is not None else (
             PromptLookupDrafter(max_ngram=spec_ngram) if spec_decode
             else None)
+        # adaptive draft length (ISSUE 17): per-request k chosen each
+        # spec round from the measured acceptance EWMA and batch
+        # occupancy — speculate hard when decode is memory-bound and the
+        # batch is idle, back off toward k=0 under load. Off (the
+        # default) is the bit-exact legacy fixed-k path; on changes only
+        # HOW MANY drafts verify, never the accepted greedy chain.
+        self._spec_adaptive = bool(spec_adaptive_k)
+        self._spec_alpha = float(spec_accept_alpha)
+        self._spec_accept_ewma: Optional[float] = None    # global
+        self._seq_accept_ewma: Dict[int, float] = {}      # per request
+        self._spec_wasted_verify_tokens = 0
+        # backoff curve: the j-th draft's expected yield is a^j; draft
+        # while a^j >= cut, where cut scales with batch occupancy (at
+        # full occupancy verify rows crowd out real decode tokens)
+        self._spec_cut_base = 0.25
+        self._spec_load_gain = 3.0
         # request-latency observability (docs/observability.md): TTFT is
         # put()->first emitted token; decode latency is the gap between
         # consecutive emitted tokens of one sequence (a burst spreads its
@@ -359,6 +400,17 @@ class InferenceEngineV2:
         now = time.perf_counter()
         while self._queue and self.can_schedule(len(self._queue[0].tokens)):
             req = self._queue.popleft()
+            if req.paged:
+                outcome = self._try_page_in(req, now)
+                if outcome == "stall":
+                    # the session's blocks don't fit RIGHT NOW (live
+                    # pressure): keep FIFO order and retry next round
+                    self._queue.appendleft(req)
+                    break
+                if outcome == "resumed":
+                    continue
+                # tier spilled the session: fall through — ``tokens``
+                # carries the folded history for prefix recompute
             seq = self.state.get_or_create(req.uid, req.tokens,
                                            req.max_new_tokens)
             seq.prior_generated = req.prior_generated
@@ -386,6 +438,7 @@ class InferenceEngineV2:
         self.state.release(uid)
         admit = self._admit_time.pop(uid, None)
         self._last_emit_time.pop(uid, None)
+        self._seq_accept_ewma.pop(uid, None)
         return admit
 
     def _requeue(self, seq, reason: str = "pool_exhausted") -> None:
@@ -431,6 +484,134 @@ class InferenceEngineV2:
         self._hub.gauge("serve.queue_wait_depth", len(self._queue),
                         labels=self._metric_labels)
 
+    def _page_out(self, seq, reason: str = "paged_out") -> bool:
+        """Preempt ``seq`` by PAGING its KV to the host tier instead of
+        discarding it: block contents copy out in pool-native format (a
+        pure byte copy — bit-exact round trip by construction) together
+        with the descriptor state, and the request requeues at the queue
+        front flagged ``paged``. Readmission restores the blocks and
+        resumes *decode* — zero re-prefill FLOPs, token stream identical
+        to a never-paged run. False when paging doesn't apply (no tier,
+        mid-prefill, at the per-seq cap, or session oversize for the
+        tier) — the caller falls back to ``_requeue`` recompute."""
+        tier = getattr(self.kv_cache, "host_tier", None)
+        if tier is None or seq.pending_prefill or seq.seen_tokens <= 0:
+            return False
+        if (self.kv_cache.blocks_needed(seq.total_tokens + 1)
+                > self.max_blocks_per_seq):
+            return False  # could never regrow: _requeue owns truncation
+        # trim to the blocks holding real KV: rejected speculative
+        # drafts may have grown the block list past the accepted
+        # frontier, and those trailing blocks hold only draft garbage
+        keep = self.kv_cache.blocks_needed(seq.seen_tokens)
+        if keep <= 0 or keep > len(seq.kv_blocks):
+            return False
+        from deepspeed_tpu.inference.ragged.kv_tier import PagedSession
+
+        payload, scales = self.kv_cache.read_blocks_host(
+            np.asarray(seq.kv_blocks[:keep], np.int64))
+        sess = PagedSession(
+            uid=seq.uid,
+            input_tokens=np.asarray(seq.input_tokens, np.int32),
+            generated=list(seq.generated),
+            seen_tokens=seq.seen_tokens,
+            max_new_tokens=seq.max_new_tokens,
+            prior_generated=seq.prior_generated,
+            payload=payload, scales=scales,
+            admit_time=self._admit_time.get(seq.uid))
+        if not tier.put_session(sess):
+            return False
+        self.tracer.on_preempt(seq.uid, reason=reason,
+                               generated=len(seq.generated))
+        # folded history rides in the queued request as the fallback:
+        # if the tier spills the session before readmission, admission
+        # degrades to the ordinary prefix-recompute path
+        tokens = np.concatenate(
+            [np.asarray(seq.input_tokens, np.int32),
+             np.asarray(seq.generated, np.int32)])
+        prior = seq.prior_generated + len(seq.generated)
+        admit = self._release_seq(seq.uid)
+        self._queue.appendleft(_QueuedRequest(
+            uid=seq.uid, tokens=tokens, max_new_tokens=seq.max_new_tokens,
+            enqueue_time=time.perf_counter(), prior_generated=prior,
+            admit_time=admit, requeued=True, paged=True))
+        self.stats["preempted"] += 1
+        self.stats["preempt_reasons"][reason] = \
+            self.stats["preempt_reasons"].get(reason, 0) + 1
+        self.stats["paged_out"] += 1
+        self._hub.counter_add("serve.preempted", labels=self._metric_labels)
+        self._hub.counter_add(f"serve.preempted_reason.{reason}",
+                              labels=self._metric_labels)
+        self._hub.gauge("serve.queue_wait_depth", len(self._queue),
+                        labels=self._metric_labels)
+        return True
+
+    def _try_page_in(self, req: _QueuedRequest, now: float) -> str:
+        """Warm-resume a ``paged`` queued request from the host tier.
+        Returns ``"resumed"`` (decode continues, zero prefill),
+        ``"stall"`` (session present but HBM can't take its blocks this
+        round — keep queue order, retry later), or ``"recompute"`` (the
+        tier spilled the session; the folded tokens re-prefill)."""
+        tier = getattr(self.kv_cache, "host_tier", None)
+        sess = tier.peek_session(req.uid) if tier is not None else None
+        if sess is None:
+            return "recompute"
+        keep = sess.n_blocks
+        if keep > self.kv_cache.free_blocks:
+            self.kv_cache.reclaim(keep - self.kv_cache.free_blocks)
+        if keep > self.kv_cache.free_blocks:
+            return "stall"
+        sess = tier.pop_session(req.uid)
+        seq = self.state.get_or_create(sess.uid, sess.input_tokens,
+                                       sess.max_new_tokens)
+        seq.generated = list(sess.generated)
+        seq.prior_generated = sess.prior_generated
+        seq.seen_tokens = sess.seen_tokens
+        blocks = self.kv_cache.allocator.allocate(keep)
+        seq.kv_blocks = np.asarray(blocks, np.int64)
+        self.kv_cache.write_blocks(blocks, sess.payload, sess.scales)
+        seq.resumed_from_tier = keep
+        self.stats["paged_in"] += 1
+        self.stats["admitted"] += 1
+        self.stats["warm_resume_tokens"] += sess.seen_tokens
+        self._hub.counter_add("serve.warm_resume_tokens", sess.seen_tokens,
+                              labels=self._metric_labels)
+        self.tracer.on_admit(req.uid, wait_s=now - req.enqueue_time,
+                             requeued=True)
+        if sess.admit_time is not None:
+            self._admit_time[req.uid] = sess.admit_time
+        elif req.admit_time is not None:
+            self._admit_time[req.uid] = req.admit_time
+        self._admission_hist.observe(now - req.enqueue_time)
+        return "resumed"
+
+    def page_out(self, uid: int) -> bool:
+        """Explicitly park a live sequence's KV in the host tier (e.g. a
+        session going idle between turns). The request re-enters the
+        admission queue flagged ``paged`` and warm-resumes when capacity
+        allows. False when paging doesn't apply — the sequence stays
+        live."""
+        seq = self.state.seqs.get(uid)
+        if seq is None or seq.done:
+            return False
+        return self._page_out(seq, reason="explicit_page_out")
+
+    def holds_prefix_blocks(self, tokens) -> int:
+        """How many full prefix blocks of ``tokens`` this engine can
+        serve without prefill, counting BOTH the HBM prefix cache and
+        the host tier behind it — the fleet router's session-affinity
+        signal (serving/router.py prefers the replica already holding a
+        returning session's blocks)."""
+        cache = self.kv_cache.prefix_cache
+        if cache is None:
+            return 0
+        toks = np.asarray(tokens, np.int32).ravel()
+        tier = getattr(self.kv_cache, "host_tier", None)
+        if tier is not None:
+            return tier.holds_chain_prefix(cache, toks)
+        keys, _ = cache.lookup(toks, max_tokens=max(0, len(toks) - 1))
+        return len(keys)
+
     def step(self, temperature: float = 0.0, seed: int = 0,
              eos_token_id: Optional[int] = None) -> Dict[int, int]:
         """Run one SplitFuse step. Returns {uid: new_token} for sequences
@@ -448,11 +629,21 @@ class InferenceEngineV2:
             live = [s for s in self.state.seqs.values() if not s.done]
             if len(live) > 1 or (live and self._queue):
                 victim = live[-1]
-                log_dist(
-                    f"KV pool exhausted: preempting uid={victim.uid} "
-                    f"({len(victim.generated)} tokens generated) — "
-                    "requeued for readmission", ranks=[0])
-                self._requeue(victim)
+                # page to the host tier when one is attached (decode
+                # resumes without re-prefill); recompute-requeue is the
+                # fallback when paging doesn't apply
+                if self._page_out(victim):
+                    log_dist(
+                        f"KV pool exhausted: paged uid={victim.uid} to "
+                        f"the host tier ({len(victim.generated)} tokens "
+                        "generated) — warm resume on readmission",
+                        ranks=[0])
+                else:
+                    log_dist(
+                        f"KV pool exhausted: preempting uid={victim.uid} "
+                        f"({len(victim.generated)} tokens generated) — "
+                        "requeued for readmission", ranks=[0])
+                    self._requeue(victim)
             elif live:
                 # a lone sequence the pool cannot grow for: requeueing
                 # would just readmit it into the same wall, so end it
@@ -779,6 +970,30 @@ class InferenceEngineV2:
         self._release_finished()
         return emitted
 
+    def _spec_round_k(self, seq, occ: float) -> int:
+        """Draft length for ``seq`` this spec round. Fixed ``spec_k``
+        unless adaptive speculation is on; then the controller models
+        the j-th draft's expected yield as a^j (a = the request's
+        measured acceptance EWMA, global EWMA as cold-start fallback)
+        and drafts while a^j >= cut, where the cutoff rises with batch
+        occupancy: an idle batch speculates hard (verify rows ride a
+        memory-bound step for ~free), a full batch backs off toward k=0
+        (verify rows crowd out real decode tokens). Only draft COUNT
+        changes — accepted tokens are always the model's own greedy
+        argmax chain, so bit-identity to fixed-k greedy holds."""
+        if not self._spec_adaptive:
+            return self.spec_k
+        cut = min(0.95, self._spec_cut_base
+                  * (1.0 + self._spec_load_gain * occ))
+        a = self._seq_accept_ewma.get(seq.uid, self._spec_accept_ewma)
+        if a is None:
+            return self.spec_k  # no signal yet: speculate optimistically
+        a = min(max(a, 0.0), 0.99)
+        if a <= cut:
+            return 0
+        return max(0, min(self.spec_k,
+                          int(math.log(cut) / math.log(a))))
+
     def _try_spec_step(self, eos_token_id: Optional[int]
                        ) -> Optional[Dict[int, List[int]]]:
         """One speculative greedy decode round: the drafter proposes up
@@ -806,8 +1021,12 @@ class InferenceEngineV2:
         total = 0
         need_total = 0
         n_drafted = 0
+        occ = len(live) / max(1, self.max_seqs)
+        adaptive_k_sum = 0
         for s in live:
-            k = min(self.spec_k, s.gen_budget_left - 1,
+            k_round = self._spec_round_k(s, occ)
+            adaptive_k_sum += k_round
+            k = min(k_round, s.gen_budget_left - 1,
                     self.max_tokens - total - 1)
             drafts: List[int] = []
             if k > 0:
@@ -829,6 +1048,11 @@ class InferenceEngineV2:
             chunks.append(np.asarray([t0] + drafts, np.int32))
             total += 1 + len(drafts)
         if n_drafted == 0:
+            if self._spec_adaptive and adaptive_k_sum == 0:
+                # the controller chose k=0 across the batch (load high
+                # or acceptance low): deliberate backoff, not a drafter
+                # miss — the burst path serves this round
+                self.stats["spec_backoff_rounds"] += 1
             return None  # nothing proposed: the burst path is faster
         if need_total > self.kv_cache.available_blocks:
             return None
@@ -873,6 +1097,28 @@ class InferenceEngineV2:
             self.tracer.on_spec(s.uid, drafted=n - 1,
                                 accepted=len(emit) - 1)
             self._spec_hist.observe(len(emit) - 1)
+            if n > 1:
+                # measured acceptance feeds the adaptive-k controller
+                # (per-request EWMA, global EWMA as the cold-start
+                # fallback) and the drafter's own counters
+                rate = (len(emit) - 1) / (n - 1)
+                a = self._spec_alpha
+                prev = self._seq_accept_ewma.get(s.uid)
+                self._seq_accept_ewma[s.uid] = (
+                    rate if prev is None else a * rate + (1 - a) * prev)
+                prev_g = self._spec_accept_ewma
+                self._spec_accept_ewma = (
+                    rate if prev_g is None else a * rate + (1 - a) * prev_g)
+                note = getattr(self._drafter, "note_result", None)
+                if note is not None:
+                    note(n - 1, len(emit) - 1)
+            # rows computed past the accepted frontier: the verify
+            # round's wasted work (what adaptive-k minimizes under load)
+            wasted = n - len(emit)
+            if wasted:
+                self._spec_wasted_verify_tokens += wasted
+                self._hub.counter_add("serve.spec_wasted_verify_tokens",
+                                      wasted, labels=self._metric_labels)
             budget_left = s.gen_budget_left
             final: List[int] = []
             for tok in emit:
@@ -888,6 +1134,9 @@ class InferenceEngineV2:
             emitted[s.uid] = final
             wasted_rows[s.uid] = n - len(final)
         self.stats["spec_steps"] += 1
+        if self._spec_accept_ewma is not None:
+            self._hub.gauge("serve.spec_accept_ewma", self._spec_accept_ewma,
+                            labels=self._metric_labels)
         now = time.perf_counter()
         self._step_hist.observe(now - t_start)
         round_wall_ms = (now - t_start) * 1e3
@@ -950,9 +1199,12 @@ class InferenceEngineV2:
     def flush(self, uids: List[int]) -> None:
         """Drop sequences + free KV (reference engine_v2.py flush);
         covers queued-but-unadmitted requests too."""
+        tier = getattr(self.kv_cache, "host_tier", None)
         for uid in uids:
             self.tracer.on_finish(uid, "flushed")
             self._release_seq(uid)
+            if tier is not None and tier.has_session(uid):
+                tier.pop_session(uid)  # flushed sessions never resume
         drop = set(uids)
         if any(r.uid in drop for r in self._queue):
             self._queue = deque(r for r in self._queue if r.uid not in drop)
@@ -1017,6 +1269,13 @@ class InferenceEngineV2:
             out["spec_acceptance_rate"] = (self.stats["spec_accepted"]
                                            / self.stats["spec_proposed"])
             out["spec_accepted_len"] = self._spec_hist.snapshot()
+        if self._spec_accept_ewma is not None:
+            out["spec_accept_ewma"] = self._spec_accept_ewma
+        if self._spec_wasted_verify_tokens:
+            out["spec_wasted_verify_tokens"] = self._spec_wasted_verify_tokens
+        tier = getattr(self.kv_cache, "host_tier", None)
+        if tier is not None:
+            out["host_tier"] = tier.snapshot()
         if self._drafter is not None and hasattr(self._drafter, "stats"):
             out["drafter"] = dict(self._drafter.stats)
         return out

@@ -45,11 +45,15 @@ def _kv_parts(kv_state):
     return kv_state, None
 
 
-def _kv_bits(kv_layer) -> int:
+def _kv_bits(kv_layer):
     """Storage width of a quantized pool, inferred at trace time from
     the payload dtype: int8 holds one value per byte; uint8 is the
     packed-nibble int4 pool (two values per byte, last dim head_dim//2
-    — the codec PR 12 ships for the handoff wire, applied to storage)."""
+    — the codec PR 12 ships for the handoff wire, applied to storage);
+    float8_e4m3fn is the fp8 quality-midpoint pool (ISSUE 17), which
+    the codec passes through unpacked."""
+    if kv_layer.dtype == jnp.float8_e4m3fn:
+        return "fp8"
     return 4 if kv_layer.dtype == jnp.uint8 else 8
 
 

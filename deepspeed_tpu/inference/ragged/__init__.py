@@ -2,6 +2,7 @@
 
 from deepspeed_tpu.inference.ragged.blocked_allocator import BlockedAllocator
 from deepspeed_tpu.inference.ragged.kv_cache import BlockedKVCache, KVCacheConfig
+from deepspeed_tpu.inference.ragged.kv_tier import HostKVTier, PagedSession
 from deepspeed_tpu.inference.ragged.prefix_cache import PrefixCache
 from deepspeed_tpu.inference.ragged.sequence import (
     SequenceDescriptor, StateManager)
@@ -10,7 +11,9 @@ from deepspeed_tpu.inference.ragged.ragged_batch import RaggedBatch
 __all__ = [
     "BlockedAllocator",
     "BlockedKVCache",
+    "HostKVTier",
     "KVCacheConfig",
+    "PagedSession",
     "PrefixCache",
     "SequenceDescriptor",
     "StateManager",

@@ -36,13 +36,14 @@ class KVCacheConfig:
     # None = bf16 pool (bit-exact legacy program); 8 = int8 payload with one
     # fp32 scale per (layer, block, row, k/v, head) vector; 4 = packed-nibble
     # uint8 payload (two values per byte, ~1.9x more sessions at head_dim
-    # 128) with the same per-vector fp32 scale.
-    quant_bits: Optional[int] = None
+    # 128) with the same per-vector fp32 scale; "fp8" = e4m3 payload (the
+    # quality midpoint between int8 and int4) with the same per-vector scale.
+    quant_bits: Optional[object] = None
 
     def __post_init__(self):
-        if self.quant_bits not in (None, 4, 8):
-            raise ValueError(
-                f"kv quant_bits must be None, 4 or 8, got {self.quant_bits}")
+        if self.quant_bits not in (None, 4, 8, "fp8"):
+            raise ValueError(f"kv quant_bits must be None, 4, 8 or 'fp8', "
+                             f"got {self.quant_bits}")
         if self.quant_bits == 4 and self.head_dim % 2:
             raise ValueError(
                 f"int4 KV storage packs two values per byte and needs an "
@@ -58,7 +59,7 @@ class KVCacheConfig:
     def bytes_per_block(self) -> int:
         vecs = self.num_layers * self.block_size * 2 * self.kv_heads
         if self.quant_bits is not None:
-            # int8/packed-int4 payload + fp32 scale per head vector
+            # int8/fp8/packed-int4 payload + fp32 scale per head vector
             return vecs * (self.payload_width + 4)
         itemsize = jnp.dtype(self.dtype).itemsize
         return vecs * self.head_dim * itemsize
@@ -78,12 +79,14 @@ class BlockedKVCache:
         self.config = config
         self.allocator = BlockedAllocator(config.num_blocks)
         self.prefix_cache = None  # Optional[PrefixCache], attached by owner
+        self.host_tier = None     # Optional[HostKVTier], attached by owner
         shape = (config.num_layers, config.num_blocks, config.block_size,
                  2, config.kv_heads, config.payload_width)
         quantized = config.quant_bits is not None
         # int4 packs nibbles into uint8 (the runner infers the width from
-        # the pool dtype at trace time: int8 → 8, uint8 → 4)
+        # the pool dtype at trace time: int8 → 8, uint8 → 4, e4m3 → fp8)
         pool_dtype = (jnp.uint8 if config.quant_bits == 4
+                      else jnp.float8_e4m3fn if config.quant_bits == "fp8"
                       else jnp.int8 if quantized else config.dtype)
         self.scales = None
         if mesh is not None and tp_axis in mesh.axis_names and (
@@ -129,6 +132,31 @@ class BlockedKVCache:
         bs = self.config.block_size
         return (num_tokens + bs - 1) // bs
 
+    # -- host-tier block I/O (ragged/kv_tier.py) -----------------------
+
+    def read_blocks_host(self, block_ids):
+        """Device→host copy of the pool contents at ``block_ids``:
+        ``(payload [L, n, bs, 2, H, W], scales [L, n, bs, 2, H] | None)``
+        in the pool's native storage format — for a quantized pool this
+        IS the compact kv_pack wire format, so paging it out costs no
+        conversion (the disagg serialize idiom applied to the tier)."""
+        idx = np.asarray(block_ids, np.int64)
+        payload = np.asarray(self.data[:, idx])
+        scales = (np.asarray(self.scales[:, idx])
+                  if self.scales is not None else None)
+        return payload, scales
+
+    def write_blocks(self, block_ids, payload, scales=None) -> None:
+        """Host→device restore of pool contents at ``block_ids`` —
+        the inverse of :meth:`read_blocks_host`, bit-exact when the
+        payload is pool-native."""
+        idx = jnp.asarray(np.asarray(block_ids, np.int64))
+        self.data = self.data.at[:, idx].set(
+            jnp.asarray(payload, self.data.dtype))
+        if self.scales is not None and scales is not None:
+            self.scales = self.scales.at[:, idx].set(
+                jnp.asarray(scales, jnp.float32))
+
     def free(self, blocks) -> None:
         if len(blocks):
             self.allocator.free(blocks)
@@ -147,9 +175,22 @@ class BlockedKVCache:
 
     def reclaim(self, n: int) -> int:
         """Evict up to ``n`` idle prefix-cached blocks back into the
-        allocator free list; returns how many were reclaimed."""
+        allocator free list; returns how many were reclaimed. With a
+        host tier attached, cold chains page OUT (contents parked in
+        host memory under the same chain keys) instead of being
+        dropped — a returning session pages back in without
+        re-prefill."""
         if n <= 0 or self.prefix_cache is None:
             return 0
+        if self.host_tier is not None:
+            entries = self.prefix_cache.evict_entries(n)
+            if entries:
+                keys = [k for k, _ in entries]
+                blocks = [b for _, b in entries]
+                payload, scales = self.read_blocks_host(blocks)
+                self.host_tier.put_chain(keys, payload, scales)
+                self.allocator.free(np.asarray(blocks, np.int64))
+            return len(entries)
         evicted = self.prefix_cache.evict(n)
         if evicted:
             self.allocator.free(np.asarray(evicted, np.int64))

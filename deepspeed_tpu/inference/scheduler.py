@@ -31,7 +31,13 @@ class SplitFuseScheduler:
         # out — sustained growth means the token budget is undersized
         # for the arrival rate.
         self.stats = {"steps": 0, "decode_tokens": 0, "prefill_tokens": 0,
-                      "kv_starved_skips": 0, "prefill_starvation_steps": 0}
+                      "kv_starved_skips": 0, "prefill_starvation_steps": 0,
+                      # paged-out is a first-class sequence state
+                      # (ragged/kv_tier.py): decode tokens produced by
+                      # sequences whose KV was restored from the host
+                      # tier — warm-resume work the pool never
+                      # re-prefilled
+                      "resumed_decode_tokens": 0}
         self.last_scheduled_seqs = 0
         self.last_scheduled_tokens = 0
         # rotating start for the prefill scan: insertion order alone lets
@@ -69,6 +75,8 @@ class SplitFuseScheduler:
                    else int(seq.input_tokens[-1]))
             out.append((seq, np.asarray([tok], np.int32), seq.seen_tokens))
             self.stats["decode_tokens"] += 1
+            if seq.resumed_from_tier:
+                self.stats["resumed_decode_tokens"] += 1
             budget -= 1
             slots -= 1
 

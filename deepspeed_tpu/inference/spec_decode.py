@@ -22,7 +22,7 @@ to host. ``Drafter`` is the hook for a real draft model: anything with
 
 from __future__ import annotations
 
-from typing import Any, List, Optional, Protocol, Sequence, \
+from typing import Any, Dict, List, Optional, Protocol, Sequence, \
     runtime_checkable
 
 
@@ -33,13 +33,46 @@ class Drafter(Protocol):
     ``tokens`` is the sequence's full token history (prompt + generated)
     and the return value is up to ``k`` proposed next tokens. An empty
     list means "no proposal" — the engine falls back to plain decode for
-    that sequence this step."""
+    that sequence this step.
+
+    Drafters that also want acceptance feedback implement
+    ``note_result(drafted, accepted)`` (see :class:`DrafterStats` — the
+    engine calls it after every verify round when present). It is kept
+    out of the runtime-checkable protocol so a bare ``propose``-only
+    object still satisfies ``isinstance(x, Drafter)``."""
 
     def propose(self, tokens: Sequence[int], k: int) -> List[int]:
         ...
 
 
-class PromptLookupDrafter:
+class DrafterStats:
+    """Uniform drafter-side counters for every drafter (ISSUE 17 small
+    fix): proposal-side stats tracked at ``propose`` time plus
+    verify-side ``drafted_tokens``/``accepted_tokens`` fed back by the
+    engine through :meth:`note_result` — so acceptance rate is readable
+    per drafter, not split ad hoc between the drafter and
+    ``engine_v2._try_spec_step``."""
+
+    def __init__(self):
+        self.stats: Dict[str, int] = {
+            "calls": 0, "proposals": 0, "proposed_tokens": 0, "empty": 0,
+            "drafted_tokens": 0, "accepted_tokens": 0}
+
+    def note_result(self, drafted: int, accepted: int) -> None:
+        """Engine feedback after one verify round: ``drafted`` tokens of
+        this drafter's proposal went through the verifier, ``accepted``
+        of them matched the greedy chain."""
+        self.stats["drafted_tokens"] += int(drafted)
+        self.stats["accepted_tokens"] += int(accepted)
+
+    @property
+    def acceptance_rate(self) -> Optional[float]:
+        if not self.stats["drafted_tokens"]:
+            return None
+        return self.stats["accepted_tokens"] / self.stats["drafted_tokens"]
+
+
+class PromptLookupDrafter(DrafterStats):
     """N-gram / prompt-lookup drafter: match the last ``n`` tokens
     (``max_ngram`` down to ``min_ngram``) against earlier history and
     propose the tokens that followed the most recent match."""
@@ -49,14 +82,9 @@ class PromptLookupDrafter:
             raise ValueError(
                 f"need 1 <= min_ngram <= max_ngram, got "
                 f"({min_ngram}, {max_ngram})")
+        super().__init__()
         self.max_ngram = max_ngram
         self.min_ngram = min_ngram
-        # drafter-side observability: how often the n-gram scan finds a
-        # proposal at all (acceptance lives in the engine's
-        # serve.spec_* counters; a low proposal rate means the workload
-        # is non-repetitive and speculation is idling, not failing)
-        self.stats = {"calls": 0, "proposals": 0, "proposed_tokens": 0,
-                      "empty": 0}
 
     def propose(self, tokens: Sequence[int], k: int) -> List[int]:
         self.stats["calls"] += 1
@@ -80,7 +108,7 @@ class PromptLookupDrafter:
         return []
 
 
-class TransformerDrafter:
+class TransformerDrafter(DrafterStats):
     """Real draft model behind the ``Drafter`` protocol: a (small)
     ``TransformerConfig`` model rolled out greedily for ``k`` tokens.
 
@@ -93,12 +121,19 @@ class TransformerDrafter:
     every history length — no per-length retraces in the serve loop.
     History longer than the window keeps only the trailing ``window``
     tokens (draft quality degrades gracefully; acceptance still gates).
+
+    A fresh ``.small()`` drafter knows nothing about the target; earn
+    its acceptance rate with :meth:`distill_from` (KL distillation
+    against the target's logits on the target's own greedy rollouts)
+    and persist the result with :meth:`save`/:meth:`load` the way the
+    autotuner persists ``docs/autotuned/`` artifacts.
     """
 
     def __init__(self, model: Any, params: Optional[Any] = None,
                  window: int = 64, seed: int = 0):
         import jax
 
+        super().__init__()
         self.model = model
         self.window = int(window)
         if self.window < 2:
@@ -107,8 +142,7 @@ class TransformerDrafter:
             params = model.init(jax.random.PRNGKey(seed))
         self.params = params
         self._apply = jax.jit(lambda p, t: model.apply(p, t))
-        self.stats = {"calls": 0, "proposals": 0, "proposed_tokens": 0,
-                      "empty": 0}
+        self.distill_summary: Optional[Dict[str, Any]] = None
 
     @classmethod
     def small(cls, vocab_size: int, window: int = 64, hidden: int = 32,
@@ -148,3 +182,156 @@ class TransformerDrafter:
         self.stats["proposals"] += 1
         self.stats["proposed_tokens"] += len(out)
         return out
+
+    # -- distillation (ISSUE 17 tentpole a) ----------------------------
+
+    def distill_from(self, target_model: Any, target_params: Any,
+                     steps: int = 150, batch: int = 16, lr: float = 1e-2,
+                     seed: int = 0, prefix_len: int = 4,
+                     temperature: float = 1.0,
+                     resample_every: int = 50) -> Dict[str, Any]:
+        """Short KL-distillation loop against the target's logits.
+
+        Training data is the distribution that matters for acceptance:
+        the TARGET's own greedy rollouts — drafts are verified against
+        the target's argmax chain, so matching it on its own
+        trajectories is exactly the objective. Each trajectory starts
+        from a random prefix whose length is itself drawn uniformly in
+        ``[2, prefix_len]`` (set ``prefix_len`` near the serving prompt
+        length: a drafter trained only on short prefixes collapses when
+        the serve prompt pushes random tokens into positions it always
+        saw as greedy chain). Rollouts are resampled every
+        ``resample_every`` steps so the drafter fits target dynamics,
+        not one fixed batch. Loss is soft-label cross-entropy
+        ``-Σ softmax(target/T) · log_softmax(draft)`` over the rollout
+        positions (prefix positions masked out), optimized with Adam.
+        Returns (and stores on ``self.distill_summary``) the final loss
+        and held-out top-1 agreement with the target — the offline
+        proxy for acceptance rate.
+
+        Offline by design: run once per target, persist with
+        :meth:`save` (the ``docs/autotuned/`` artifact pattern), load
+        at serve time."""
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+        import optax
+
+        W = self.window
+        vocab = self.model.config.vocab_size
+        prefix_len = max(2, min(int(prefix_len), W - 1))
+        rng = np.random.default_rng(seed)
+        t_apply = jax.jit(lambda p, t: target_model.apply(p, t))
+
+        def rollout(n: int):
+            """[n, W] target-greedy trajectories from random prefixes
+            of per-sample random length in [2, prefix_len]."""
+            plens = rng.integers(2, prefix_len + 1, size=n)
+            toks = np.zeros((n, W), np.int32)
+            toks[:, :prefix_len] = rng.integers(
+                0, vocab, size=(n, prefix_len), dtype=np.int32)
+            for t in range(int(plens.min()), W):
+                logits = np.asarray(t_apply(target_params,
+                                            jnp.asarray(toks)))
+                greedy = logits[:, t - 1].argmax(-1)
+                on = plens <= t
+                toks[on, t] = greedy[on]
+            return toks, plens
+
+        def make_batch(n: int):
+            toks, plens = rollout(n)
+            inputs = jnp.asarray(toks)
+            targets = np.asarray(t_apply(target_params, inputs),
+                                 np.float32)
+            soft = jax.nn.softmax(
+                jnp.asarray(targets[:, :-1])
+                / max(temperature, 1e-6), axis=-1)
+            labels = jnp.asarray(toks[:, 1:])
+            # position t predicts token t+1: supervised iff t+1 is a
+            # rollout position, i.e. t >= plen - 1
+            mask = jnp.asarray(
+                (np.arange(W - 1)[None, :]
+                 >= (plens - 1)[:, None]).astype(np.float32))
+            return inputs, soft, labels, mask
+
+        def loss_fn(p, inputs, soft, mask):
+            logits = self.model.apply(p, inputs).astype(jnp.float32)
+            logp = jax.nn.log_softmax(logits[:, :-1], -1)
+            ce = -jnp.sum(soft * logp, axis=-1)
+            return jnp.sum(ce * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+
+        opt = optax.adam(lr)
+        opt_state = opt.init(self.params)
+
+        @jax.jit
+        def train_step(p, s, inputs, soft, mask):
+            loss, grads = jax.value_and_grad(loss_fn)(p, inputs, soft,
+                                                      mask)
+            updates, s = opt.update(grads, s, p)
+            return optax.apply_updates(p, updates), s, loss
+
+        resample_every = max(1, int(resample_every))
+        params, loss = self.params, float("nan")
+        inputs = soft = labels = mask = None
+        for step in range(int(steps)):
+            if step % resample_every == 0:
+                inputs, soft, labels, mask = make_batch(int(batch))
+            params, opt_state, loss = train_step(params, opt_state,
+                                                 inputs, soft, mask)
+        self.params = params
+        # held-out agreement: fresh rollouts the training never saw
+        inputs, soft, labels, mask = make_batch(int(batch))
+        final = self.model.apply(params, inputs).astype(jnp.float32)
+        hits = (jnp.argmax(final[:, :-1], -1) == labels
+                ).astype(jnp.float32)
+        agree = float(jnp.sum(hits * mask)
+                      / jnp.maximum(jnp.sum(mask), 1.0))
+        self.distill_summary = {
+            "steps": int(steps), "batch": int(batch), "lr": float(lr),
+            "final_loss": float(loss), "top1_agreement": agree,
+            "window": W, "vocab_size": int(vocab)}
+        return self.distill_summary
+
+    # -- persistence (the docs/autotuned/ artifact pattern) ------------
+
+    def save(self, path: str) -> None:
+        """Persist distilled weights + geometry as one ``.npz``: leaves
+        in deterministic tree order, config/summary as a JSON metadata
+        record — the drafter analog of ``docs/autotuned/*.json``."""
+        import json
+
+        import numpy as np
+        from jax.tree_util import tree_flatten
+
+        leaves, _ = tree_flatten(self.params)
+        cfg = self.model.config
+        meta = {"vocab_size": int(cfg.vocab_size),
+                "hidden": int(cfg.hidden_size),
+                "layers": int(cfg.num_layers),
+                "heads": int(cfg.num_heads),
+                "window": int(self.window),
+                "distill": self.distill_summary}
+        np.savez(path,
+                 __meta__=np.frombuffer(json.dumps(meta).encode(),
+                                        np.uint8),
+                 **{f"p{i}": np.asarray(v) for i, v in enumerate(leaves)})
+
+    @classmethod
+    def load(cls, path: str) -> "TransformerDrafter":
+        import json
+
+        import jax.numpy as jnp
+        import numpy as np
+        from jax.tree_util import tree_flatten, tree_unflatten
+
+        data = np.load(path)
+        meta = json.loads(bytes(bytearray(data["__meta__"])))
+        d = cls.small(meta["vocab_size"], window=meta["window"],
+                      hidden=meta["hidden"], layers=meta["layers"],
+                      heads=meta["heads"])
+        leaves, treedef = tree_flatten(d.params)
+        d.params = tree_unflatten(
+            treedef, [jnp.asarray(data[f"p{i}"]).astype(v.dtype)
+                      for i, v in enumerate(leaves)])
+        d.distill_summary = meta.get("distill")
+        return d

@@ -149,7 +149,7 @@ def unpack_int4(p: jax.Array) -> jax.Array:
 # ---------------------------------------------------------------------------
 
 
-def kv_quantize(x: jax.Array, bits: int = 8) -> Tuple[jax.Array, jax.Array]:
+def kv_quantize(x: jax.Array, bits=8) -> Tuple[jax.Array, jax.Array]:
     """Quantize a KV tensor [..., head_dim] with one fp32 scale per head
     vector: (int8 payload [..., head_dim], fp32 scales [...]).
 
@@ -157,28 +157,39 @@ def kv_quantize(x: jax.Array, bits: int = 8) -> Tuple[jax.Array, jax.Array]:
     its own scale — the granularity the paged cache stores alongside the
     int8 payload. Reuses the blockwise dispatch (Pallas on TPU when the
     tiling constraints hold, jnp reference on CPU CI).
+
+    ``bits="fp8"`` stores e4m3 values instead of an integer grid — the
+    quality midpoint between int8 and int4, via the fp_quantizer cast
+    path (per-vector scale maps the absmax to the format's max normal).
     """
     hd = x.shape[-1]
+    if bits == "fp8":
+        from deepspeed_tpu.ops.fp_quantizer import fp_quantize
+
+        q, s = fp_quantize(x, fmt="e4m3", group_size=hd)
+        return q, s[..., 0]
     q, s = quantize_blockwise(x, bits=bits, block=hd)
     return q, s[..., 0]
 
 
-def kv_dequantize(q: jax.Array, scale: jax.Array, bits: int = 8,
+def kv_dequantize(q: jax.Array, scale: jax.Array, bits=8,
                   dtype=jnp.bfloat16) -> jax.Array:
-    """Inverse of kv_quantize: (int8 [..., head_dim], fp32 [...]) → dtype."""
+    """Inverse of kv_quantize: (int8/fp8 [..., head_dim], fp32 [...]) →
+    dtype — value-times-scale either way (fp8 payloads upcast exactly)."""
     return (q.astype(jnp.float32) * scale[..., None].astype(jnp.float32)
             ).astype(dtype)
 
 
-def kv_pack(q: jax.Array, bits: int) -> jax.Array:
-    """Storage codec for the quantized KV pool: int8 values pass through;
-    int4 packs two per byte (uint8 payload, last dim head_dim//2 — the
-    same nibble codec the disagg handoff wire uses)."""
+def kv_pack(q: jax.Array, bits) -> jax.Array:
+    """Storage codec for the quantized KV pool: int8/fp8 values pass
+    through; int4 packs two per byte (uint8 payload, last dim head_dim//2
+    — the same nibble codec the disagg handoff wire uses)."""
     return pack_int4(q) if bits == 4 else q
 
 
-def kv_unpack(p: jax.Array, bits: int) -> jax.Array:
-    """Inverse of kv_pack: uint8 nibble payload → int8 values in [-8, 7]."""
+def kv_unpack(p: jax.Array, bits) -> jax.Array:
+    """Inverse of kv_pack: uint8 nibble payload → int8 values in [-8, 7];
+    int8/fp8 payloads pass through."""
     return unpack_int4(p) if bits == 4 else p
 
 

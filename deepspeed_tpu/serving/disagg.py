@@ -34,7 +34,7 @@ never a request.
 from __future__ import annotations
 
 import dataclasses
-from typing import List, Optional, Tuple
+from typing import Any, List, Optional, Tuple
 
 import numpy as np
 
@@ -67,9 +67,9 @@ class KVHandoff:
     block_data: np.ndarray
     block_size: int
     scales: Optional[np.ndarray] = None
-    wire_bits: Optional[int] = None   # None = full-precision payload
+    wire_bits: Optional[Any] = None   # None = full precision; 4/8/"fp8"
     packed: bool = False              # int4 nibble packing along head_dim
-    src_quant_bits: Optional[int] = None
+    src_quant_bits: Optional[Any] = None
     wire_snr_db: Optional[float] = None  # measured at wire-quantize time
 
     @property
@@ -291,14 +291,26 @@ def install_prefix(engine, handoff: Optional[KVHandoff]
         if handoff.wire_bits is None:
             # raw bf16 wire into a quantized pool: quantize-on-install
             q, s = kv_quantize(payload, bits=dst_bits)
+        elif handoff.wire_bits == dst_bits:
+            # wire already in the pool's own format: install directly
+            q = payload if dst_bits == "fp8" else payload.astype(jnp.int8)
+            s = ssel
+        elif dst_bits == "fp8" or handoff.wire_bits == "fp8":
+            # int<->fp8: the stored codes don't reinterpret (int grids
+            # are scale*code on an integer lattice, e4m3 is a float
+            # format), so round-trip through f32 onto the destination's
+            # grid (the precision-mismatch warn above fired)
+            q, s = kv_quantize(
+                kv_dequantize(payload, ssel, dtype=jnp.float32),
+                bits=dst_bits)
         elif dst_bits == 4 and handoff.wire_bits == 8:
             # int8 wire values overflow the int4 grid: requantize on the
             # coarser grid (the precision-mismatch warn above fired)
             q, s = kv_quantize(
                 kv_dequantize(payload, ssel, dtype=jnp.float32), bits=4)
         else:
-            # int8/int4 values install directly — dequant is q*s either
-            # way, int4 just lands on a coarser grid
+            # int4 values install into an int8 pool directly — dequant
+            # is q*s either way, just on a coarser grid
             q, s = payload.astype(jnp.int8), ssel
         if dst_bits == 4:
             q = pack_int4(q.astype(jnp.int8))

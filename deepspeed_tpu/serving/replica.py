@@ -195,6 +195,7 @@ class ServingReplica:
         live = [s for s in e.state.seqs.values() if not s.done]
         total = e.kv_cache.allocator.total_blocks
         free = e.kv_cache.free_blocks
+        tier = getattr(e.kv_cache, "host_tier", None)
         return {
             "replica": self.replica_id,
             "role": self.role,
@@ -217,7 +218,29 @@ class ServingReplica:
             "handoff_logical_bytes": getattr(
                 e, "_handoff_logical_bytes", 0),
             "kv_wire_snr_db": getattr(e, "_last_kv_wire_snr_db", None),
+            # adaptive speculation + host KV tier (ISSUE 17): measured
+            # acceptance EWMA + rejected-verify-row count drive the
+            # per-request draft-length controller; the host-tier gauges
+            # show how much session state lives below HBM (and
+            # paged_out/paged_in how often decode warm-resumes)
+            "spec_accept_ewma": getattr(e, "_spec_accept_ewma", None),
+            "spec_wasted_verify_tokens": getattr(
+                e, "_spec_wasted_verify_tokens", 0),
+            "host_tier_bytes": (0 if tier is None else tier.used_bytes),
+            "host_tier_blocks": (0 if tier is None else tier.total_blocks),
+            "host_tier_sessions": (0 if tier is None
+                                   else tier.session_count),
+            "paged_out": e.stats.get("paged_out", 0),
+            "paged_in": e.stats.get("paged_in", 0),
         }
+
+    def holds_prefix(self, tokens) -> int:
+        """Full prefix blocks of ``tokens`` this replica can serve
+        without prefill (HBM prefix cache + host tier) — the router's
+        session-affinity probe. RemoteReplica proxies don't implement
+        this; the router getattr-guards the call."""
+        fn = getattr(self.engine, "holds_prefix_blocks", None)
+        return 0 if fn is None else fn(tokens)
 
     def load_score(self) -> float:
         """Routing cost: queued + live work, plus KV-pool pressure as a

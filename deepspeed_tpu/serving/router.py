@@ -257,6 +257,7 @@ class FleetRouter:
         self.stats = {"submitted": 0, "completed": 0, "handoffs": 0,
                       "handoff_recompute": 0, "failovers": 0,
                       "failed_over_requests": 0, "affinity_hits": 0,
+                      "tier_affinity_hits": 0,
                       "hedged": 0, "hedge_wins": 0, "stranded": 0}
         # one BurnRateAlerter for the FLEET (observability/burn_rate.py):
         # every replica's finished traces feed it through the tracer
@@ -315,10 +316,12 @@ class FleetRouter:
                 raise ValueError(f"uid={uid} already in flight")
             key = self._affinity_key(toks)
             if self.disagg:
-                target = self._pick(self.prefill_pool, key, len(toks))
+                target = self._pick(self.prefill_pool, key, len(toks),
+                                    tokens=toks)
                 phase, budget = "prefill", 1
             else:
-                target = self._pick(self.decode_pool, key, len(toks))
+                target = self._pick(self.decode_pool, key, len(toks),
+                                    tokens=toks)
                 phase, budget = "decode", int(max_new_tokens)
             self._check_fits(target, toks, max_new_tokens)
             rec = _RequestRecord(uid, toks, int(max_new_tokens),
@@ -429,8 +432,12 @@ class FleetRouter:
 
     def _pick(self, pool: List[int], key: Optional[str],
               n_tokens: int = 0,
-              exclude: Optional[set] = None) -> ServingReplica:
+              exclude: Optional[set] = None,
+              tokens: Optional[np.ndarray] = None) -> ServingReplica:
         """Affinity if the remembered replica is still live, else the
+        host-KV-tier probe (the replica already HOLDING a returning
+        session's paged-out blocks warm-resumes it without re-prefill —
+        worth more than a marginally lower load score), else the
         configured policy (least-loaded or predicted-TTFT). Caller
         holds the lock. ``exclude`` removes replicas that may still
         hold a live stream of the request being placed (hedge losers);
@@ -451,6 +458,29 @@ class FleetRouter:
                 self.stats["affinity_hits"] += 1
                 self._last_policy = "affinity"
                 return self.replicas[rid]
+        if tokens is not None:
+            # tiered-KV placement: probe only replicas WITH a host tier
+            # (in-process handles expose holds_prefix; RemoteReplica
+            # proxies don't and are skipped — they compete on load).
+            # Probing every submit is an O(prefix blocks) hash walk per
+            # tiered replica, host-side only.
+            best, best_hits = None, 0
+            for r in alive:
+                eng = getattr(r, "engine", None)
+                if getattr(getattr(eng, "kv_cache", None),
+                           "host_tier", None) is None:
+                    continue
+                hits = r.holds_prefix(tokens)
+                if hits > best_hits or (hits == best_hits and hits > 0
+                                        and r.load_score()
+                                        < best.load_score()):
+                    best, best_hits = r, hits
+            if best is not None and best_hits > 0:
+                self.stats["tier_affinity_hits"] += 1
+                self._last_policy = "tier_affinity"
+                if key is not None:
+                    self._affinity[(pool_tag, key)] = best.replica_id
+                return best
         if self.routing == "predictive":
             # ties (no observations yet) fall back to load score, so a
             # cold fleet degrades to exactly the least-loaded policy

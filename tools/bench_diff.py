@@ -94,6 +94,14 @@ DEFAULT_THRESHOLDS: Dict[str, Tuple[str, float]] = {
     # certificates are checked unconditionally below
     "obs.trace_overhead_us": ("max_ratio", 3.0),
     "obs.offset_err_ms": ("max_increase", 5.0),
+    # tiered-KV arm (BENCH_MODE=serve_tier): sessions held per HBM GB
+    # is a capacity headline like any throughput number, the
+    # warm-resume TTFT ratio may not drift back toward re-prefill cost,
+    # and the distilled drafter's accept rate must not quietly erode
+    # (its hard >=1.05x-vs-lookup edge gate rides quant_gates below)
+    "tier.sessions_per_gb": ("min_ratio", 0.85),
+    "tier.warm_resume_ttft_ratio": ("max_ratio", 1.25),
+    "spec.accept_rate": ("min_ratio", 0.9),
 }
 
 # units where a larger headline value is worse
@@ -243,6 +251,24 @@ def diff_reports(old: Dict[str, Any], new: Dict[str, Any],
             rise = nv - ov
             check("obs.offset_err_ms", rule, limit, ov, nv, rise,
                   rise <= limit)
+        # tiered-KV sentinels (serve_tier payloads): host-tier session
+        # capacity, warm-resume TTFT trend, and drafter accept rate
+        for key in ("tier.sessions_per_gb", "spec.accept_rate"):
+            ov, nv = old.get(key), new.get(key)
+            if isinstance(ov, (int, float)) and \
+                    isinstance(nv, (int, float)) and ov > 0:
+                rule, limit = th[key]
+                ratio = nv / ov
+                check(key, rule, limit * loosen, ov, nv, ratio,
+                      ratio >= limit * loosen)
+        ov = old.get("tier.warm_resume_ttft_ratio")
+        nv = new.get("tier.warm_resume_ttft_ratio")
+        if isinstance(ov, (int, float)) and isinstance(nv, (int, float)) \
+                and ov > 0:
+            rule, limit = th["tier.warm_resume_ttft_ratio"]
+            ratio = nv / ov
+            check("tier.warm_resume_ttft_ratio", rule, limit, ov, nv,
+                  ratio, ratio <= limit)
         for arm in ("bf16", "int8", "int4"):
             o_arm = old.get(arm) if isinstance(old.get(arm), dict) else {}
             n_arm = new.get(arm) if isinstance(new.get(arm), dict) else {}

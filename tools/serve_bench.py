@@ -832,6 +832,304 @@ def run_quant() -> dict:
     }
 
 
+def run_tier() -> dict:
+    """Tiered-KV + adaptive-speculation bench (``BENCH_MODE=serve_tier``,
+    ``make serve-tier``): the host-memory KV tier's two acceptance
+    numbers plus the distilled drafter's acceptance edge, one JSON line.
+
+    - **sessions per HBM GB** — both arms serve ``oversub``x more
+      sessions than one fixed HBM byte budget holds. The HBM-only arm
+      evicts cold chains (a returning session pays full re-prefill); the
+      tiered arm pages them to host memory instead. A session counts as
+      *held* when its full prompt chain is still servable without
+      prefill (HBM prefix cache or host tier). The tiered arm must hold
+      >= ``TIER_SERVE_MIN_SESSIONS_RATIO`` (default 2.0) x the HBM-only
+      arm on the SAME budget.
+    - **warm-resume TTFT** — a mid-decode session pages out
+      (``engine.page_out``), then resumes: host->HBM block restore + one
+      decode step, vs the cold path re-prefilling the same token count.
+      Warm must cost <= ``TIER_SERVE_MAX_RESUME_RATIO`` (default 0.5) x
+      cold.
+    - **drafter acceptance** — a ``TransformerDrafter`` distilled
+      against the target (weights persisted like ``docs/autotuned/``
+      artifacts) vs model-free prompt lookup, both with adaptive draft
+      length on: the distilled drafter must bank
+      >= ``TIER_SERVE_MIN_ACCEPT_EDGE`` (default 1.05) x prompt
+      lookup's ACCEPTED DRAFT TOKENS PER ENGINE STEP on the workload
+      it was distilled for. Per-step, not raw accept_rate: lookup
+      abstains whenever no n-gram matches, and abstention inflates
+      accept_rate (a drafter that only drafts sure things scores ~1.0
+      with zero speedup) — tokens banked per verify round is the
+      number that pays for speculation.
+
+    Violations ride ``ok``/``violations`` (the ``make serve-quant``
+    contract); ``tier.sessions_per_gb`` / ``tier.warm_resume_ttft_ratio``
+    / ``spec.accept_rate`` are round-over-round sentinels in
+    ``tools/bench_diff.py``."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from deepspeed_tpu.inference.engine_v2 import InferenceEngineV2
+    from deepspeed_tpu.inference.ragged.kv_cache import KVCacheConfig
+    from deepspeed_tpu.inference.spec_decode import (PromptLookupDrafter,
+                                                     TransformerDrafter)
+    from deepspeed_tpu.models.zoo import get_model
+
+    on_tpu = jax.default_backend() == "tpu"
+    block = 8
+    prompt_len = int(os.environ.get("TIER_SERVE_PROMPT", 24))
+    gen = int(os.environ.get("TIER_SERVE_GEN", 8))
+    base_sessions = int(os.environ.get("TIER_SERVE_SESSIONS", 4))
+    oversub = int(os.environ.get("TIER_SERVE_OVERSUB", 3))
+    min_ratio = float(os.environ.get("TIER_SERVE_MIN_SESSIONS_RATIO", 2.0))
+    max_resume = float(os.environ.get("TIER_SERVE_MAX_RESUME_RATIO", 0.5))
+    min_edge = float(os.environ.get("TIER_SERVE_MIN_ACCEPT_EDGE", 1.05))
+    distill_steps = int(os.environ.get("TIER_SERVE_DISTILL_STEPS", 300))
+
+    model = get_model("tiny", dtype=jnp.float32, param_dtype=jnp.float32)
+    cfg = model.config
+    params = jax.jit(model.init)(jax.random.PRNGKey(0))
+    rng = np.random.default_rng(0)
+
+    blocks_per_seq = (prompt_len + gen) // block + 2
+    kv_cfg = KVCacheConfig(num_layers=cfg.num_layers,
+                           kv_heads=cfg.kv_heads, head_dim=cfg.head_dim,
+                           block_size=block, num_blocks=1)
+    hbm_budget = kv_cfg.bytes_per_block * blocks_per_seq * base_sessions
+    kv_blocks = hbm_budget // kv_cfg.bytes_per_block
+    n_req = base_sessions * oversub
+    full_chain = (prompt_len - 1) // block  # final token stays uncached
+    prompts = [rng.integers(0, cfg.vocab_size, (prompt_len,))
+               .astype(np.int32) for _ in range(n_req)]
+
+    def drive_arm(tiered: bool):
+        engine = InferenceEngineV2(
+            model, params=params, dtype=jnp.float32,
+            kv_blocks=int(kv_blocks), kv_block_size=block,
+            max_tokens_per_step=32, max_seqs_per_step=base_sessions,
+            max_blocks_per_seq=blocks_per_seq, prefix_cache=True,
+            host_kv_tier=tiered, host_tier_mb=64)
+        engine.put(list(range(n_req)), prompts, max_new_tokens=gen)
+        tier = getattr(engine.kv_cache, "host_tier", None)
+        peak_resident = 0
+        t0 = time.perf_counter()
+        emitted = {}
+        while engine.state.seqs or engine._queue:
+            out = engine.serve_step()
+            live = sum(1 for s in engine.state.seqs.values() if not s.done)
+            parked = 0 if tier is None else tier.session_count
+            peak_resident = max(peak_resident, live + parked)
+            for uid, toks in out.items():
+                emitted.setdefault(uid, []).extend(toks)
+        wall = time.perf_counter() - t0
+        # a session is HELD when its whole prompt chain is still
+        # servable without prefill (HBM prefix cache or host tier)
+        held = sum(1 for p in prompts
+                   if engine.holds_prefix_blocks(p) >= full_chain)
+        snap = engine.snapshot()
+        return engine, emitted, {
+            "tiered": tiered,
+            "kv_blocks": int(kv_blocks),
+            "hbm_budget_bytes": int(hbm_budget),
+            "requests": n_req,
+            "sessions_held": held,
+            "sessions_held_per_hbm_gb": round(
+                held / (hbm_budget / (1 << 30)), 1),
+            "peak_resident_sessions": peak_resident,
+            "paged_out": snap["stats"]["paged_out"],
+            "paged_in": snap["stats"]["paged_in"],
+            "warm_resume_tokens": snap["stats"]["warm_resume_tokens"],
+            "preempted": snap["stats"]["preempted"],
+            "tokens": sum(len(t) for t in emitted.values()),
+            "wall_s": round(wall, 3),
+            "host_tier": snap.get("host_tier"),
+        }
+
+    base_engine, base_out, base_arm = drive_arm(False)
+    tier_engine, tier_out, tier_arm = drive_arm(True)
+    # paging is an optimization, never a semantics change: both arms
+    # must emit the identical greedy streams
+    bit_identical = all(base_out.get(u) == tier_out.get(u)
+                        for u in range(n_req))
+    sessions_ratio = (tier_arm["sessions_held"]
+                      / max(base_arm["sessions_held"], 1))
+
+    # -- warm-resume TTFT vs cold re-prefill (same engine, warm jit) ----
+    resume_prompt_len = int(os.environ.get("TIER_SERVE_RESUME_PROMPT", 96))
+    resume_gen = int(os.environ.get("TIER_SERVE_RESUME_GEN", 16))
+    rng = np.random.default_rng(1)  # own stream: arms stay independent
+    r_blocks_per_seq = (resume_prompt_len + 2 * resume_gen) // block + 2
+    # decode_steps=1 keeps the TTFT honest: a multi-token burst would
+    # pad BOTH arms' first-token step with K-1 extra decode tokens and
+    # compress the warm/cold ratio toward 1
+    r_engine = InferenceEngineV2(
+        model, params=params, dtype=jnp.float32,
+        kv_blocks=4 * r_blocks_per_seq, kv_block_size=block,
+        max_tokens_per_step=16, max_seqs_per_step=2, decode_steps=1,
+        max_blocks_per_seq=r_blocks_per_seq, prefix_cache=True,
+        host_kv_tier=True, host_tier_mb=64)
+
+    def first_token_latency(uid, toks, max_new):
+        r_engine.put([uid], [toks], max_new_tokens=max_new)
+        t0 = time.perf_counter()
+        while True:
+            out = r_engine.serve_step()
+            if out.get(uid):
+                return time.perf_counter() - t0
+
+    def resume_cycle(uid, prompt, measure):
+        """Decode ``resume_gen`` tokens, page out mid-decode, resume;
+        returns the paged-out -> first-resumed-token latency. The
+        un-measured warmup call runs the IDENTICAL shape first so the
+        measured cycle times the steady state (host->HBM restore + one
+        decode step), not first-compile of the restore path."""
+        r_engine.put([uid], [prompt], max_new_tokens=2 * resume_gen)
+        got = 0
+        while got < resume_gen:
+            got += len(r_engine.serve_step().get(uid, []))
+        assert r_engine.page_out(uid), "page_out refused a live session"
+        t0 = time.perf_counter()
+        while True:
+            if r_engine.serve_step().get(uid):
+                dt = time.perf_counter() - t0
+                break
+        # drain to completion only for the warmup (compiles tail paths)
+        if not measure:
+            while any(not s.done
+                      for s in r_engine.state.seqs.values()):
+                r_engine.serve_step()
+        r_engine.flush([uid])
+        return dt
+
+    warm_prompt = rng.integers(0, cfg.vocab_size, (resume_prompt_len,)
+                               ).astype(np.int32)
+    resume_cycle(1000, warm_prompt, measure=False)
+    a_prompt = rng.integers(0, cfg.vocab_size, (resume_prompt_len,)
+                            ).astype(np.int32)
+    warm_ttft = resume_cycle(1, a_prompt, measure=True)
+    # cold arm: the SAME token count arrives fresh (different tokens —
+    # no prefix-cache help) and pays full re-prefill before its first
+    # token
+    cold_toks = rng.integers(
+        0, cfg.vocab_size,
+        (resume_prompt_len + resume_gen,)).astype(np.int32)
+    cold_ttft = first_token_latency(2, cold_toks, resume_gen)
+    resume_ratio = warm_ttft / max(cold_ttft, 1e-9)
+
+    # -- distilled drafter vs prompt lookup (adaptive k on both) --------
+    drafter_path = os.environ.get(
+        "TIER_SERVE_DRAFTER_PATH",
+        os.path.join(os.path.dirname(__file__), "..", "docs", "autotuned",
+                     "spec_drafter_tiny.npz"))
+    distilled = None
+    if os.path.exists(drafter_path):
+        try:
+            distilled = TransformerDrafter.load(drafter_path)
+            if distilled.model.config.vocab_size != cfg.vocab_size:
+                distilled = None
+        except Exception:
+            distilled = None  # stale artifact: re-distill below
+    if distilled is None:
+        distilled = TransformerDrafter.small(cfg.vocab_size, window=64)
+        # prefix_len tracks the serve prompt length: the drafter must
+        # see random tokens in every position a prompt can occupy
+        distilled.distill_from(model, params, steps=distill_steps,
+                               batch=16, seed=0, prefix_len=16)
+        distilled.save(drafter_path)
+
+    rng = np.random.default_rng(2)  # own stream: arms stay independent
+    spec_prompts = [rng.integers(0, cfg.vocab_size, (16,)).astype(np.int32)
+                    for _ in range(10)]
+
+    def spec_arm(drafter):
+        engine = InferenceEngineV2(
+            model, params=params, dtype=jnp.float32,
+            kv_blocks=64, kv_block_size=block,
+            max_tokens_per_step=64, max_seqs_per_step=8,
+            max_blocks_per_seq=16, prefix_cache=False,
+            spec_decode=True, spec_k=4, spec_adaptive_k=True,
+            drafter=drafter)
+        engine.put(list(range(len(spec_prompts))), spec_prompts,
+                   max_new_tokens=24)
+        out, steps = {}, 0
+        while engine.state.seqs or engine._queue:
+            for uid, toks in engine.serve_step().items():
+                out.setdefault(uid, []).extend(toks)
+            steps += 1
+        snap = engine.snapshot()
+        drafted = snap["stats"]["spec_proposed"]
+        accepted = snap["stats"]["spec_accepted"]
+        return out, {
+            "drafted_tokens": drafted,
+            "accepted_tokens": accepted,
+            "accept_rate": round(accepted / max(drafted, 1), 4),
+            # the throughput number: extra tokens each verify round
+            # actually banked. Raw accept_rate rewards ABSTENTION (a
+            # drafter that only drafts sure things scores ~1.0 with
+            # zero speedup), so the drafter-vs-drafter edge is judged
+            # on accepted tokens per engine step instead.
+            "accepted_per_step": round(accepted / max(steps, 1), 4),
+            "engine_steps": steps,
+            "accept_ewma": snap.get("spec_accept_ewma"),
+            "wasted_verify_tokens": snap.get(
+                "spec_wasted_verify_tokens", 0),
+            "spec_backoff_rounds": snap["stats"]["spec_backoff_rounds"],
+        }
+
+    lookup_out, lookup_arm = spec_arm(PromptLookupDrafter(max_ngram=3))
+    distilled_out, distilled_arm = spec_arm(distilled)
+    spec_identical = all(lookup_out.get(u) == distilled_out.get(u)
+                         for u in range(len(spec_prompts)))
+    accept_edge = (distilled_arm["accepted_per_step"]
+                   / max(lookup_arm["accepted_per_step"], 1e-9))
+
+    violations = []
+    if sessions_ratio < min_ratio:
+        violations.append({
+            "region": "kv_tier", "gate": "min_sessions_ratio",
+            "limit": min_ratio, "got": round(sessions_ratio, 3)})
+    if resume_ratio > max_resume:
+        violations.append({
+            "region": "kv_tier", "gate": "max_warm_resume_ttft_ratio",
+            "limit": max_resume, "got": round(resume_ratio, 3)})
+    if not bit_identical:
+        violations.append({
+            "region": "kv_tier", "gate": "bit_identical_streams",
+            "limit": True, "got": False})
+    if not spec_identical:
+        violations.append({
+            "region": "spec", "gate": "bit_identical_streams",
+            "limit": True, "got": False})
+    if accept_edge < min_edge:
+        violations.append({
+            "region": "spec", "gate": "min_distilled_accept_edge",
+            "limit": min_edge, "got": round(accept_edge, 3)})
+    return {
+        "metric": f"tiny serve_tier sessions-held ratio (tiered/HBM-only,"
+                  f" {'tpu' if on_tpu else 'cpu'})",
+        "value": round(sessions_ratio, 3),
+        "unit": "x",
+        "hbm_budget_bytes": int(hbm_budget),
+        "hbm_only": base_arm,
+        "tiered": tier_arm,
+        "tier.sessions_per_gb": tier_arm["sessions_held_per_hbm_gb"],
+        "tier.warm_resume_ttft_ratio": round(resume_ratio, 4),
+        "warm_resume_ttft_ms": round(warm_ttft * 1e3, 2),
+        "cold_ttft_ms": round(cold_ttft * 1e3, 2),
+        "bit_identical": bit_identical,
+        "spec_lookup": lookup_arm,
+        "spec_distilled": distilled_arm,
+        "spec.accept_rate": distilled_arm["accept_rate"],
+        "spec_accept_edge": round(accept_edge, 3),
+        "drafter_artifact": os.path.relpath(
+            drafter_path, os.path.join(os.path.dirname(__file__), "..")),
+        "drafter_distill": distilled.distill_summary,
+        "ok": not violations,
+        "violations": violations,
+    }
+
+
 def _nhpp_arrivals(n, rate, period_s, burst_factor, burst_frac, rng):
     """Nonhomogeneous Poisson arrivals by thinning: a diurnal sinusoid
     (the day/night cycle compressed to ``period_s``) with a burst window
@@ -1864,6 +2162,11 @@ if __name__ == "__main__":
         _qp = run_quant()
         print(json.dumps(_qp))
         if not _qp.get("ok", True):
+            raise SystemExit(1)
+    elif mode == "serve_tier":
+        _tp = run_tier()
+        print(json.dumps(_tp))
+        if not _tp.get("ok", True):
             raise SystemExit(1)
     else:
         print(json.dumps(run_slo() if mode == "serve_slo" else run()))

@@ -161,8 +161,10 @@ def _fleet_table(snap: dict) -> str:
     lines = [f"## serving fleet ({snap.get('mode', '?')} mode)", "",
              "| replica | role | steps | queue | live | inflight | "
              "kv free | goodput tok/s | kv quant | wire | "
-             "handoff wire/logical | kv SNR dB | state |",
-             "|---|---|---|---|---|---|---|---|---|---|---|---|---|"]
+             "handoff wire/logical | host tier | spec acc | kv SNR dB | "
+             "state |",
+             "|---|---|---|---|---|---|---|---|---|---|---|---|---|"
+             "---|---|"]
     dead = set(snap.get("dead_replicas", []))
     health = snap.get("health") or {}  # v2; absent in v1 documents
     for r in snap.get("replicas", []):
@@ -175,11 +177,18 @@ def _fleet_table(snap: dict) -> str:
             state = ("DEAD" if r["replica"] in dead
                      else "killed" if r.get("killed") else "up")
         bits = r.get("kv_quant_bits")
-        quant = "bf16" if bits is None else f"int{bits}"
+        quant = ("bf16" if bits is None
+                 else bits if isinstance(bits, str) else f"int{bits}")
         wire = r.get("handoff_wire", "auto")
         wb, lb = (r.get("handoff_wire_bytes", 0),
                   r.get("handoff_logical_bytes", 0))
         hand = f"{wb}/{lb}" if lb else "-"
+        # host-tier occupancy: bytes parked below HBM + parked session
+        # count ("-" for an HBM-only replica)
+        tb, ts = r.get("host_tier_bytes", 0), r.get("host_tier_sessions", 0)
+        tier = f"{tb / (1 << 20):.1f}MB/{ts}s" if tb or ts else "-"
+        acc = r.get("spec_accept_ewma")
+        acc_s = "-" if acc is None else f"{acc:.2f}"
         snr = r.get("kv_wire_snr_db")
         snr_s = "-" if snr is None else f"{snr:.1f}"
         lines.append(
@@ -187,12 +196,13 @@ def _fleet_table(snap: dict) -> str:
             f"{r['queue_wait_depth']} | {r['live_seqs']} | "
             f"{r['inflight']} | {r['kv_free_frac'] * 100:.0f}% | "
             f"{r['goodput_tokens_per_s']} | {quant} | {wire} | "
-            f"{hand} | {snr_s} | {state} |")
+            f"{hand} | {tier} | {acc_s} | {snr_s} | {state} |")
     st = snap.get("router", {})
     lines += ["", "router: " + "  ".join(
         f"{k}={st[k]}" for k in ("submitted", "completed", "handoffs",
                                  "handoff_recompute", "failovers",
                                  "failed_over_requests", "affinity_hits",
+                                 "tier_affinity_hits",
                                  "hedged", "hedge_wins")
         if k in st)]
     auto = snap.get("autoscale")
