@@ -14,7 +14,7 @@ NPROC := $(shell nproc)
 XDIST ?= $(shell if [ $(NPROC) -gt 2 ] && python -c "import xdist" 2>/dev/null; then echo "-n $$(( $(NPROC) - 1 )) --dist loadfile"; fi)
 PYTEST ?= python -m pytest
 
-.PHONY: test smoke slow bench bench-real bench-proxy bench-hostgap bench-overlap bench-longctx bench-quant bench-kernels bench-diff quant-sweep fleet-demo chaos serve-slo serve-fleet serve-quant serve-tier serve-procs chaos-fleet obs-fleet
+.PHONY: test smoke slow bench bench-real bench-proxy bench-hostgap bench-overlap bench-longctx bench-quant bench-kernels bench-diff quant-sweep fleet-demo chaos serve-slo serve-fleet serve-quant serve-tier serve-procs chaos-fleet obs-fleet replay-fleet
 
 smoke:
 	$(PYTEST) tests/ -q -m "not slow" $(XDIST)
@@ -197,6 +197,20 @@ chaos-fleet:
 # (docs/observability.md "Fleet tracing & clock sync").
 obs-fleet:
 	BENCH_MODE=obs_fleet python bench.py
+
+# Fleet black-box certification (tools/serve_bench.py run_replay_fleet):
+# record one chaos-fault fleet arm into the append-only CRC-framed
+# journal (admissions + per-candidate routing forensics + chaos
+# injections + per-request token checksum chains), then re-drive a
+# fresh fleet from the journal alone (tools/replay.py) and require
+# every replayed token stream bit-identical to the recorded chains;
+# corrupt one chain link and require the replay CLI to exit nonzero
+# naming the exact uid + decode step; bound the recorder's cost under
+# REPLAY_MAX_JOURNAL_US / REPLAY_MAX_JOURNAL_BYTES per request. One
+# JSON line with replay.* keys bench_diff sentinels consume
+# (docs/observability.md "Fleet black box & incident replay").
+replay-fleet:
+	BENCH_MODE=replay_fleet python bench.py
 
 # Fault-injection drill on the 8-device CPU sim: SIGKILL a training rank
 # mid-run, let the elastic agent restart it, and assert the auto-resumed

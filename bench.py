@@ -306,7 +306,8 @@ def main():
     if os.environ.get("BENCH_MODE") in ("serve", "serve_slo",
                                         "serve_fleet", "serve_quant",
                                         "serve_tier", "serve_procs",
-                                        "chaos_fleet", "obs_fleet"):
+                                        "chaos_fleet", "obs_fleet",
+                                        "replay_fleet"):
         # serving benchmarks instead of the training headline
         # (tools/serve_bench.py): "serve" is the closed-loop v2-vs-v1
         # throughput comparison (SERVE_* env knobs); "serve_slo" is the
@@ -333,7 +334,12 @@ def main():
         # "obs_fleet" is the observability-plane certification — tracer
         # emit-point overhead vs disabled, and clock-sync offset
         # accuracy against a skewed-clock worker subprocess under the
-        # clean/delay/dup net-fault arms (OBS_* env knobs)
+        # clean/delay/dup net-fault arms (OBS_* env knobs);
+        # "replay_fleet" is the fleet black-box certification — record
+        # a chaos-fault arm into the append-only journal, re-drive a
+        # fresh fleet from the journal alone and require bit-identical
+        # token streams, bounded journal overhead, and a corrupted
+        # journal to be named by uid + decode step (REPLAY_* env knobs)
         import sys
 
         sys.path.insert(0, os.path.join(os.path.dirname(
@@ -371,6 +377,12 @@ def main():
             print(json.dumps(obs_payload))
             if not obs_payload.get("ok", True):
                 sys.exit(1)  # gates: trace overhead, offset-in-bound
+        elif os.environ.get("BENCH_MODE") == "replay_fleet":
+            replay_payload = serve_bench.run_replay_fleet()
+            print(json.dumps(replay_payload))
+            if not replay_payload.get("ok", True):
+                sys.exit(1)  # gates: bit-identical replay, journal
+                #             overhead/bytes, corrupt-journal naming
         else:
             print(json.dumps(serve_bench.run()))
         return

@@ -573,6 +573,33 @@ class PerformanceConfig(ConfigModel):
 
 @register_config_model
 @dataclass
+class JournalConfig(ConfigModel):
+    """Fleet black-box journal (observability/journal.py): append-only
+    CRC-framed capture of admissions, routing/preemption/failover
+    decisions with their inputs, chaos injections, and per-request
+    emitted-token checksum chains — enough to re-drive the run
+    bit-identically with tools/replay.py. Off by default: the journal
+    is a forensic artifact, not ambient telemetry. ``dir`` is where
+    ``<run>.journal`` files land (gitignored, like ``dstpu_flight/``);
+    ``max_mb`` caps one journal file — past it records are dropped
+    (counted, plus one TRUNCATED marker) rather than failing the
+    run."""
+
+    enabled: bool = False
+    dir: str = "dstpu_journal"
+    max_mb: float = 64.0
+
+    def validate(self) -> None:
+        if self.max_mb <= 0:
+            raise ValueError(
+                f"observability.journal.max_mb must be > 0, got "
+                f"{self.max_mb}")
+        if not self.dir:
+            raise ValueError("observability.journal.dir must be set")
+
+
+@register_config_model
+@dataclass
 class ObservabilityConfig(ConfigModel):
     """Unified observability hub (observability/hub.py). Per-step
     StepTrace rows (wall time, loss, tokens/s, MFU, comm deltas,
@@ -615,10 +642,12 @@ class ObservabilityConfig(ConfigModel):
     request_trace: RequestTraceConfig = field(
         default_factory=RequestTraceConfig)
     clock_sync: ClockSyncConfig = field(default_factory=ClockSyncConfig)
+    journal: JournalConfig = field(default_factory=JournalConfig)
 
     def validate(self) -> None:
         self.request_trace.validate()
         self.clock_sync.validate()
+        self.journal.validate()
         if self.flight_events < 0:
             raise ValueError(
                 f"observability.flight_events must be >= 0, got "

@@ -35,6 +35,7 @@ from deepspeed_tpu.inference.scheduler import SplitFuseScheduler
 from deepspeed_tpu.inference.spec_decode import PromptLookupDrafter
 from deepspeed_tpu.models.transformer import TransformerLM
 from deepspeed_tpu.observability.clocksync import wall_time
+from deepspeed_tpu.observability.journal import get_journal
 from deepspeed_tpu.parallel import topology as topo
 from deepspeed_tpu.utils.logging import log_dist
 
@@ -355,6 +356,12 @@ class InferenceEngineV2:
 
     # -- core step (reference engine_v2.py:107 put) -----------------------
 
+    @property
+    def _journal_owner(self) -> str:
+        """This engine's ingress-claim identity for the fleet journal
+        (stable per instance; see FleetJournal.claim_ingress)."""
+        return f"engine:{id(self)}"
+
     def put(self, uids: List[int], tokens_list: List[np.ndarray],
             max_new_tokens: int = 64) -> None:
         """Submit new sequences (uid -> prompt tokens). Requests enter a
@@ -366,6 +373,12 @@ class InferenceEngineV2:
         pool), and RuntimeError when ``max_queue_depth`` is configured
         and the queue is full (opt-in fail-fast backpressure)."""
         now = time.perf_counter()
+        jr = get_journal()
+        # a router-fronted engine defers ADMIT/EMIT journaling to the
+        # router (which owns request identity); a standalone engine is
+        # its own ingress and records admissions here
+        journal_ingress = (jr is not None and jr.claim_ingress(
+            self._journal_owner) == self._journal_owner)
         for uid, toks in zip(uids, tokens_list):
             toks = np.asarray(toks, np.int32).ravel()
             blocks = self.kv_cache.blocks_needed(len(toks) + 1)
@@ -384,6 +397,8 @@ class InferenceEngineV2:
             self._queue.append(_QueuedRequest(
                 uid=uid, tokens=toks, max_new_tokens=max_new_tokens,
                 enqueue_time=now, admit_time=now))
+            if journal_ingress:
+                jr.admit(uid, toks.tolist(), int(max_new_tokens))
             self.stats["queued"] += 1
             self._hub.counter_add("serve.requests", labels=self._metric_labels)
             self.tracer.on_enqueue(uid, len(toks),
@@ -468,6 +483,12 @@ class InferenceEngineV2:
             return
         self.tracer.on_preempt(seq.uid, reason=reason,
                                generated=len(seq.generated))
+        jr = get_journal()
+        if jr is not None:
+            jr.decision("PREEMPT", uid=seq.uid, reason=reason,
+                        generated=len(seq.generated),
+                        free_blocks=self.kv_cache.free_blocks,
+                        queue_depth=len(self._queue))
         prior = seq.prior_generated + len(seq.generated)
         admit = self._release_seq(seq.uid)
         self._queue.appendleft(_QueuedRequest(
@@ -523,6 +544,13 @@ class InferenceEngineV2:
             return False
         self.tracer.on_preempt(seq.uid, reason=reason,
                                generated=len(seq.generated))
+        jr = get_journal()
+        if jr is not None:
+            jr.decision("PAGE_OUT", uid=seq.uid, reason=reason,
+                        seen_tokens=int(seq.seen_tokens),
+                        n_blocks=int(keep),
+                        free_blocks=self.kv_cache.free_blocks,
+                        queue_depth=len(self._queue))
         # folded history rides in the queued request as the fallback:
         # if the tier spills the session before readmission, admission
         # degrades to the ordinary prefix-recompute path
@@ -1166,15 +1194,21 @@ class InferenceEngineV2:
         SplitFuse step. Returns {uid: tokens emitted this round}. The
         open-loop SLO harness (tools/serve_bench.py) drives this."""
         self._admit_from_queue()
+        out: Optional[Dict[int, List[int]]] = None
         if temperature == 0.0:
-            spec = self._try_spec_step(eos_token_id)
-            if spec is not None:
-                return spec
-            burst = self._try_decode_burst(eos_token_id)
-            if burst is not None:
-                return burst
-        emitted = self.step(temperature, seed, eos_token_id)
-        return {uid: [tok] for uid, tok in emitted.items()}
+            out = self._try_spec_step(eos_token_id)
+            if out is None:
+                out = self._try_decode_burst(eos_token_id)
+        if out is None:
+            emitted = self.step(temperature, seed, eos_token_id)
+            out = {uid: [tok] for uid, tok in emitted.items()}
+        jr = get_journal()
+        if jr is not None and out and jr.claim_ingress(
+                self._journal_owner) == self._journal_owner:
+            for uid, toks in out.items():
+                if toks:
+                    jr.emit(uid, toks)
+        return out
 
     def generate_all(self, temperature: float = 0.0, seed: int = 0,
                      eos_token_id: Optional[int] = None,

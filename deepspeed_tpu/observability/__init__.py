@@ -25,6 +25,12 @@ from deepspeed_tpu.observability.flight_recorder import (
     FlightRecorder, dump_flight_recorder, get_flight_recorder,
     install_crash_handlers, reset_flight_recorder)
 from deepspeed_tpu.observability.histogram import Histogram
+from deepspeed_tpu.observability.journal import (FleetJournal,
+                                                 config_fingerprint,
+                                                 get_journal, load_journal,
+                                                 render_incident_log,
+                                                 reset_journal, set_journal,
+                                                 verify_streams)
 from deepspeed_tpu.observability.hub import (MetricsHub, compile_stats,
                                              get_hub, peek_hub, reset_hub)
 from deepspeed_tpu.observability.profile_trace import (TraceCapture,
@@ -94,6 +100,14 @@ __all__ = [
     "BurnRateAlerter",
     "ClockSyncEstimator",
     "wall_time",
+    "FleetJournal",
+    "get_journal",
+    "set_journal",
+    "reset_journal",
+    "load_journal",
+    "verify_streams",
+    "render_incident_log",
+    "config_fingerprint",
     "FleetMetricsPlane",
     "compact_snapshot",
     "merge_snapshots",

@@ -1,0 +1,582 @@
+"""Fleet black box: deterministic traffic capture and decision forensics.
+
+The observability stack can *describe* an incident — request traces,
+flight-recorder dumps, one merged timeline — but until this module it
+could not *reproduce* one. :class:`FleetJournal` is an append-only,
+CRC-framed, ``wall_time()``-stamped journal that captures everything a
+fresh fleet needs to re-run a serving session bit-identically:
+
+* a **HEADER** record with the config fingerprint (serving / router /
+  engine config hashes, autotuned-table identity, model seed — weights
+  are identified by fingerprint, never serialized) plus the literal
+  re-drive recipe (model spec, seed, engine/router kwargs);
+* an **ADMIT** record per request: uid, prompt tokens,
+  ``max_new_tokens``, and the scheduled arrival offset from run start;
+* every **decision with its inputs**: ROUTE carries the per-candidate
+  predicted-TTFT / health / load scores (not just the winner);
+  PREEMPT / PAGE_OUT / HEDGE / FAILOVER / AUTOSCALE / SUPERVISOR acts
+  carry the state that triggered them;
+* **CHAOS** records for every injected fault (kind + seed + sequence
+  position) so a replay can re-arm the same injector;
+* an **EMIT** checksum chain per request: a rolling CRC32 over the
+  emitted token ids, one link per decode step — the ground truth the
+  replayer compares against, at ~13 bytes/token instead of re-recording
+  the stream.
+
+Frames reuse the length-prefixed CRC32 wire format from
+``serving/transport/framing.py`` (``MAGIC | len | crc32 | payload``) —
+no second ad-hoc format. Unlike the socket path, a journal that ends
+mid-frame is *expected* (the process crashed while appending), so
+:func:`load_journal` is a salvage reader: it returns every complete,
+CRC-valid frame and stops cleanly at the first torn or corrupt one,
+never raising.
+
+The journal is process-wide and optional: ``get_journal()`` returns
+``None`` unless a run installed one with ``set_journal`` — every
+call site guards on that, so the disabled path costs one global read.
+All stamps come from :func:`deepspeed_tpu.observability.clocksync.wall_time`
+so the journal, request spans, and fleet snapshot share one clock
+domain.
+
+Everything here is host-side, jax-free, and import-cheap.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import io
+import json
+import os
+import threading
+import time
+import zlib
+from typing import Any, Dict, Iterable, List, Optional, Sequence, Tuple
+
+from deepspeed_tpu.observability.clocksync import wall_time
+
+SCHEMA = "fleet_journal/v1"
+
+_framing_mod = None
+
+
+def _framing():
+    """The transport framing module, imported on first use — the
+    serving package's __init__ imports the router, which imports this
+    module, so a top-level import here would be a cycle (and would make
+    every observability import pay the serving/jax import chain)."""
+    global _framing_mod
+    if _framing_mod is None:
+        from deepspeed_tpu.serving.transport import framing
+        _framing_mod = framing
+    return _framing_mod
+
+# Decision kinds with dedicated helpers / renderers. ``decision()``
+# accepts any kind string — this list is documentation plus the
+# incident-log ordering, not an allowlist.
+DECISION_KINDS = ("ROUTE", "PREEMPT", "PAGE_OUT", "HEDGE", "FAILOVER",
+                  "AUTOSCALE", "SUPERVISOR")
+
+
+def token_chain(prev: int, token: int) -> int:
+    """One link of the per-request emitted-token checksum chain:
+    ``crc32(token_le64, prev)``. Chains compose per decode step, so a
+    divergence names the exact step, not just the request."""
+    return zlib.crc32(
+        int(token).to_bytes(8, "little", signed=True),
+        int(prev)) & 0xFFFFFFFF
+
+
+def chain_tokens(tokens: Iterable[int], prev: int = 0) -> List[int]:
+    """The full chain for a token stream (``prev`` seeds continuation)."""
+    out: List[int] = []
+    c = int(prev)
+    for t in tokens:
+        c = token_chain(c, t)
+        out.append(c)
+    return out
+
+
+def config_fingerprint(**blocks: Any) -> Dict[str, str]:
+    """Short content hashes for named config blocks plus a combined
+    digest. Values are canonical-JSON'd (sorted keys, default=str so
+    dtypes and paths hash stably); the combined hash covers the block
+    names too, so adding a block changes the fingerprint."""
+    out: Dict[str, str] = {}
+    acc = hashlib.sha256()
+    for name in sorted(blocks):
+        blob = json.dumps(blocks[name], sort_keys=True,
+                          separators=(",", ":"), default=str)
+        out[name] = hashlib.sha256(blob.encode()).hexdigest()[:12]
+        acc.update(name.encode())
+        acc.update(blob.encode())
+    out["combined"] = acc.hexdigest()[:16]
+    return out
+
+
+class FleetJournal:
+    """Append-only CRC-framed journal writer.
+
+    Thread-safe: the router's pump threads, the supervisor's maintain
+    loop, and the chaos injector all append concurrently. Each record
+    is one frame holding compact JSON with at least ``kind`` and ``ts``
+    (``wall_time()``). The writer self-times every append
+    (``append_s``) so the bench can gate journal overhead without a
+    separate harness, and enforces ``max_mb`` by dropping records past
+    the cap (after one TRUNCATED marker) rather than erroring mid-run.
+    """
+
+    def __init__(self, path: str, max_mb: float = 64.0):
+        self.path = str(path)
+        self.max_bytes = int(float(max_mb) * (1 << 20))
+        d = os.path.dirname(os.path.abspath(self.path))
+        os.makedirs(d, exist_ok=True)
+        self.t0 = wall_time()
+        _framing()  # import at construction, not inside the first
+        # self-timed append (the overhead gate measures appends only)
+        self._lock = threading.Lock()
+        self._f: Optional[io.BufferedWriter] = None
+        self._chains: Dict[Any, int] = {}
+        self._chain_len: Dict[Any, int] = {}
+        self._ingress: Optional[str] = None
+        self.n_records = 0
+        self.n_dropped = 0
+        self.bytes_written = 0
+        self.append_s = 0.0
+        self._truncated = False
+        self._closed = False
+
+    # -- ingress ownership --------------------------------------------
+    def claim_ingress(self, owner: str) -> str:
+        """First claimant owns ADMIT/EMIT journaling. In an in-process
+        fleet both the router and its engines see the same journal; the
+        router claims first so token streams are journaled exactly once
+        (at the point that owns request identity). A standalone engine
+        run has no router, so the engine's claim wins there."""
+        with self._lock:
+            if self._ingress is None:
+                self._ingress = str(owner)
+            return self._ingress
+
+    def owns_ingress(self, owner: str) -> bool:
+        with self._lock:
+            return self._ingress is None or self._ingress == str(owner)
+
+    # -- record writers ------------------------------------------------
+    def _append(self, rec: Dict[str, Any]) -> None:
+        t_in = time.perf_counter()
+        rec.setdefault("ts", wall_time())
+        try:
+            payload = json.dumps(rec, separators=(",", ":"),
+                                 default=str).encode()
+        except (TypeError, ValueError):
+            with self._lock:
+                self.n_dropped += 1
+            return
+        frame = _framing().encode_frame(payload)
+        with self._lock:
+            if self._closed:
+                self.n_dropped += 1
+                return
+            if self.bytes_written + len(frame) > self.max_bytes:
+                if not self._truncated:
+                    self._truncated = True
+                    marker = _framing().encode_frame(json.dumps(
+                        {"kind": "TRUNCATED", "ts": wall_time(),
+                         "records": self.n_records},
+                        separators=(",", ":")).encode())
+                    self._write(marker)
+                self.n_dropped += 1
+            else:
+                self._write(frame)
+                self.n_records += 1
+        self.append_s += time.perf_counter() - t_in
+
+    def _write(self, frame: bytes) -> None:
+        if self._f is None:
+            self._f = open(self.path, "wb")
+        self._f.write(frame)
+        self._f.flush()
+        self.bytes_written += len(frame)
+
+    def write_header(self, fingerprint: Dict[str, str],
+                     replay: Optional[Dict[str, Any]] = None,
+                     **extra: Any) -> None:
+        """The run header: fingerprint identifies what ran (weights by
+        hash, not bytes); ``replay`` is the literal re-drive recipe
+        (model spec + seed + engine/router kwargs) a replayer feeds to
+        the same constructors the recorded run used."""
+        rec = {"kind": "HEADER", "schema": SCHEMA, "t0": self.t0,
+               "fingerprint": dict(fingerprint)}
+        if replay is not None:
+            rec["replay"] = replay
+        rec.update(extra)
+        self._append(rec)
+
+    def admit(self, uid: Any, prompt_tokens: Sequence[int],
+              max_new_tokens: int,
+              arrival_offset_s: Optional[float] = None,
+              **extra: Any) -> None:
+        if arrival_offset_s is None:
+            arrival_offset_s = wall_time() - self.t0
+        rec = {"kind": "ADMIT", "uid": uid,
+               "prompt_tokens": [int(t) for t in prompt_tokens],
+               "max_new_tokens": int(max_new_tokens),
+               "arrival_offset_s": round(float(arrival_offset_s), 6)}
+        rec.update(extra)
+        self._append(rec)
+
+    def decision(self, kind: str, **fields: Any) -> None:
+        """One decision with its inputs. ``fields`` must carry enough
+        of the triggering state to audit the decision post-hoc (ROUTE:
+        per-candidate scores; PREEMPT: free blocks + queue depth; ...).
+        """
+        rec: Dict[str, Any] = {"kind": str(kind)}
+        rec.update(fields)
+        self._append(rec)
+
+    def chaos(self, fault: str, **fields: Any) -> None:
+        rec: Dict[str, Any] = {"kind": "CHAOS", "fault": str(fault)}
+        rec.update(fields)
+        self._append(rec)
+
+    def emit(self, uid: Any, tokens: Sequence[int]) -> None:
+        """Extend ``uid``'s checksum chain by one record per decode
+        batch. ``start`` is the chain index of the first link so the
+        replayer can detect gaps as well as mismatches."""
+        toks = [int(t) for t in tokens]
+        if not toks:
+            return
+        with self._lock:
+            prev = self._chains.get(uid, 0)
+            start = self._chain_len.get(uid, 0)
+            chain = chain_tokens(toks, prev)
+            self._chains[uid] = chain[-1]
+            self._chain_len[uid] = start + len(chain)
+        self._append({"kind": "EMIT", "uid": uid, "start": start,
+                      "chain": chain})
+
+    def note(self, kind: str, **fields: Any) -> None:
+        """Free-form annotation record (chaos spec text, arm labels...).
+        Ignored by the replayer's verification pass."""
+        rec: Dict[str, Any] = {"kind": str(kind)}
+        rec.update(fields)
+        self._append(rec)
+
+    # -- lifecycle -----------------------------------------------------
+    def snapshot(self) -> Dict[str, Any]:
+        with self._lock:
+            n_req = len(self._chain_len)
+            return {
+                "path": self.path,
+                "records": self.n_records,
+                "dropped": self.n_dropped,
+                "bytes": self.bytes_written,
+                "truncated": self._truncated,
+                "requests": n_req,
+                "append_us_total": round(self.append_s * 1e6, 1),
+                "append_us_per_request": round(
+                    self.append_s * 1e6 / max(1, n_req), 2),
+                "bytes_per_request": round(
+                    self.bytes_written / max(1, n_req), 1),
+                "ingress": self._ingress,
+            }
+
+    def close(self) -> None:
+        with self._lock:
+            self._closed = True
+            if self._f is not None:
+                try:
+                    self._f.flush()
+                    self._f.close()
+                except OSError:
+                    pass
+                self._f = None
+
+    @classmethod
+    def from_config(cls, cfg: Any, name: str = "fleet.journal"
+                    ) -> Optional["FleetJournal"]:
+        """Build from an ``observability.journal`` config block
+        (``{enabled, dir, max_mb}``); None when disabled/absent."""
+        jc = getattr(getattr(cfg, "observability", cfg), "journal", None)
+        if jc is None or not getattr(jc, "enabled", False):
+            return None
+        return cls(os.path.join(jc.dir, name), max_mb=jc.max_mb)
+
+
+# -- process-wide handle (mirrors flight_recorder's singleton) ---------
+_journal: Optional[FleetJournal] = None
+_journal_lock = threading.Lock()
+
+
+def get_journal() -> Optional[FleetJournal]:
+    """The installed journal, or None (the default: journaling off and
+    every hook reduced to one global read)."""
+    return _journal
+
+
+def set_journal(journal: Optional[FleetJournal]) -> Optional[FleetJournal]:
+    global _journal
+    with _journal_lock:
+        prev = _journal
+        _journal = journal
+    return prev
+
+
+def reset_journal() -> None:
+    global _journal
+    with _journal_lock:
+        j, _journal = _journal, None
+    if j is not None:
+        j.close()
+
+
+# -- salvage reader ----------------------------------------------------
+def load_journal(path: str) -> List[Dict[str, Any]]:
+    """Every complete, CRC-valid record in ``path``, in order.
+
+    A journal's tail is torn whenever the recording process died
+    mid-append, so unlike the socket ``FrameReader`` (which must treat
+    desync as fatal) this walks the same wire format directly and stops
+    cleanly at the first incomplete or corrupt frame — all the records
+    before it are intact by construction (each frame's CRC covers its
+    payload). Never raises on journal content; a missing file is just
+    an empty journal."""
+    try:
+        with open(path, "rb") as f:
+            data = f.read()
+    except OSError:
+        return []
+    fr = _framing()
+    records: List[Dict[str, Any]] = []
+    off, n = 0, len(data)
+    while n - off >= fr.HEADER_BYTES:
+        magic, length, crc = fr._HEADER.unpack_from(data, off)
+        if magic != fr.MAGIC:
+            break
+        end = off + fr.HEADER_BYTES + length
+        if length > fr.DEFAULT_MAX_FRAME_BYTES or end > n:
+            break  # torn tail (or corrupt length field)
+        payload = data[off + fr.HEADER_BYTES:end]
+        if (zlib.crc32(payload) & 0xFFFFFFFF) != crc:
+            break
+        try:
+            rec = json.loads(payload)
+        except ValueError:
+            break
+        if isinstance(rec, dict):
+            records.append(rec)
+        off = end
+    return records
+
+
+def dump_journal(path: str, records: Iterable[Dict[str, Any]]) -> int:
+    """Re-frame ``records`` to ``path`` (tests and tooling: corrupt a
+    chain, rewrite, replay). Returns the record count."""
+    n = 0
+    with open(path, "wb") as f:
+        for rec in records:
+            payload = json.dumps(rec, separators=(",", ":"),
+                                 default=str).encode()
+            f.write(_framing().encode_frame(payload))
+            n += 1
+    return n
+
+
+# -- verification ------------------------------------------------------
+def journal_header(records: Sequence[Dict[str, Any]]
+                   ) -> Optional[Dict[str, Any]]:
+    for rec in records:
+        if rec.get("kind") == "HEADER":
+            return rec
+    return None
+
+
+def admitted_requests(records: Sequence[Dict[str, Any]]
+                      ) -> List[Dict[str, Any]]:
+    """ADMIT records in journal (= arrival) order."""
+    return [r for r in records if r.get("kind") == "ADMIT"]
+
+
+def recorded_chains(records: Sequence[Dict[str, Any]]
+                    ) -> Dict[Any, List[int]]:
+    """Per-uid emitted-token checksum chains, reassembled from EMIT
+    records. A ``start`` gap (lost EMIT record) truncates that uid's
+    chain at the gap — verification then flags the first un-verifiable
+    step instead of silently skipping it."""
+    chains: Dict[Any, List[int]] = {}
+    for rec in records:
+        if rec.get("kind") != "EMIT":
+            continue
+        uid = rec.get("uid")
+        chain = chains.setdefault(uid, [])
+        if rec.get("start") != len(chain):
+            continue  # gap: keep the verified prefix only
+        chain.extend(int(c) for c in rec.get("chain", ()))
+    return chains
+
+
+def verify_streams(records: Sequence[Dict[str, Any]],
+                   streams: Dict[Any, Sequence[int]]
+                   ) -> Dict[str, Any]:
+    """Compare replayed token ``streams`` against the recorded checksum
+    chains. Returns a verdict naming the **first diverging request and
+    decode step** (first = recorded admission order, then step index).
+
+    Divergence reasons: ``chain_mismatch`` (same step, different
+    token), ``short_stream`` / ``long_stream`` (replay emitted fewer /
+    more tokens than recorded), ``missing_request`` (replay produced no
+    stream for an admitted uid)."""
+    expected = recorded_chains(records)
+    admits = admitted_requests(records)
+    order = [r.get("uid") for r in admits]
+    known = set(order)
+    for uid in expected:
+        if uid not in known:
+            known.add(uid)
+            order.append(uid)
+
+    def norm(uid: Any) -> Any:
+        # JSON round-trips int keys fine (values, not dict keys), but a
+        # caller may pass str uids — match on equality of str() forms
+        # when the exact key is absent.
+        if uid in streams:
+            return uid
+        for k in streams:
+            if str(k) == str(uid):
+                return k
+        return uid
+
+    first: Optional[Dict[str, Any]] = None
+    divergent = 0
+    verified_tokens = 0
+    for uid in order:
+        exp = expected.get(uid, [])
+        got_tokens = list(streams.get(norm(uid), []))
+        got = chain_tokens(got_tokens)
+        div: Optional[Dict[str, Any]] = None
+        for step in range(min(len(exp), len(got))):
+            if exp[step] != got[step]:
+                div = {"uid": uid, "step": step,
+                       "reason": "chain_mismatch",
+                       "expected_chain": exp[step],
+                       "got_chain": got[step]}
+                break
+            verified_tokens += 1
+        if div is None and len(got) < len(exp):
+            div = {"uid": uid, "step": len(got),
+                   "reason": ("missing_request" if not got_tokens
+                              and uid not in streams
+                              and norm(uid) not in streams
+                              else "short_stream"),
+                   "expected_chain": exp[len(got)],
+                   "got_chain": None}
+        elif div is None and len(got) > len(exp):
+            div = {"uid": uid, "step": len(exp),
+                   "reason": "long_stream",
+                   "expected_chain": None,
+                   "got_chain": got[len(exp)]}
+        if div is not None:
+            divergent += 1
+            if first is None:
+                first = div
+    return {
+        "schema": "fleet_replay_verdict/v1",
+        "bit_identical": first is None,
+        "requests": len(order),
+        "verified_tokens": verified_tokens,
+        "divergent_requests": divergent,
+        "first_divergence": first,
+    }
+
+
+# -- incident-log rendering (serve_top --journal) ----------------------
+def request_outcomes(records: Sequence[Dict[str, Any]]
+                     ) -> Dict[Any, Dict[str, Any]]:
+    """Per-request outcome summary: emitted token count vs budget, and
+    every decision that touched the request."""
+    out: Dict[Any, Dict[str, Any]] = {}
+    chains = recorded_chains(records)
+    for rec in records:
+        if rec.get("kind") == "ADMIT":
+            uid = rec.get("uid")
+            out[uid] = {"uid": uid, "prompt": len(
+                rec.get("prompt_tokens", ())),
+                "max_new_tokens": rec.get("max_new_tokens"),
+                "arrival_offset_s": rec.get("arrival_offset_s"),
+                "emitted": len(chains.get(uid, ())),
+                "decisions": []}
+        elif rec.get("kind") in DECISION_KINDS:
+            uid = rec.get("uid")
+            if uid in out:
+                out[uid]["decisions"].append(rec.get("kind"))
+    for uid, row in out.items():
+        budget = row.get("max_new_tokens")
+        row["outcome"] = ("complete" if budget and row["emitted"] >= budget
+                          else "partial" if row["emitted"] else "no_tokens")
+    return out
+
+
+def _fmt_fields(rec: Dict[str, Any], skip: Tuple[str, ...]) -> str:
+    parts = []
+    for k in sorted(rec):
+        if k in skip or k in ("kind", "ts"):
+            continue
+        v = rec[k]
+        if isinstance(v, float):
+            v = round(v, 4)
+        parts.append(f"{k}={v}")
+    return " ".join(parts)
+
+
+def render_incident_log(records: Sequence[Dict[str, Any]],
+                        kinds: Optional[Sequence[str]] = None
+                        ) -> List[str]:
+    """Human-readable decision-by-decision incident log. Each line is
+    ``+offset  KIND  fields`` — inputs included, because a decision
+    without its inputs is not auditable."""
+    hdr = journal_header(records)
+    t0 = float(hdr.get("t0", 0.0)) if hdr else None
+    lines: List[str] = []
+    want = set(kinds) if kinds else None
+    for rec in records:
+        kind = rec.get("kind", "?")
+        if want is not None and kind not in want:
+            continue
+        ts = rec.get("ts")
+        if t0 is None and isinstance(ts, (int, float)):
+            t0 = float(ts)
+        off = (f"+{float(ts) - t0:9.4f}s"
+               if isinstance(ts, (int, float)) and t0 is not None
+               else " " * 11)
+        if kind == "HEADER":
+            fp = rec.get("fingerprint", {})
+            lines.append(f"{off}  HEADER    fingerprint="
+                         f"{fp.get('combined', '?')} schema="
+                         f"{rec.get('schema')}")
+        elif kind == "ADMIT":
+            lines.append(
+                f"{off}  ADMIT     uid={rec.get('uid')} "
+                f"prompt={len(rec.get('prompt_tokens', ()))}tok "
+                f"max_new={rec.get('max_new_tokens')} "
+                f"arrival=+{rec.get('arrival_offset_s')}s")
+        elif kind == "EMIT":
+            lines.append(
+                f"{off}  EMIT      uid={rec.get('uid')} "
+                f"steps={rec.get('start')}.."
+                f"{rec.get('start', 0) + len(rec.get('chain', ()))}")
+        elif kind == "CHAOS":
+            lines.append(f"{off}  CHAOS     fault={rec.get('fault')} "
+                         + _fmt_fields(rec, ("fault",)))
+        else:
+            lines.append(f"{off}  {kind:<9} " + _fmt_fields(rec, ()))
+    return lines
+
+
+__all__ = [
+    "SCHEMA", "DECISION_KINDS", "FleetJournal",
+    "get_journal", "set_journal", "reset_journal",
+    "token_chain", "chain_tokens", "config_fingerprint",
+    "load_journal", "dump_journal", "journal_header",
+    "admitted_requests", "recorded_chains", "verify_streams",
+    "request_outcomes", "render_incident_log",
+]

@@ -345,14 +345,26 @@ class ChaosInjector:
             return chunk
         return None if self._partition_drops(peer) else chunk
 
-    # -- flight recorder (best-effort) ---------------------------------
-    @staticmethod
-    def _record(kind: str, **fields) -> None:
+    # -- flight recorder + fleet journal (best-effort) ------------------
+    def _record(self, kind: str, **fields) -> None:
         try:
             from deepspeed_tpu.observability.flight_recorder import \
                 get_flight_recorder
 
             get_flight_recorder().record(kind, **fields)
+        except Exception:
+            pass
+        try:
+            from deepspeed_tpu.observability.journal import get_journal
+
+            jr = get_journal()
+            if jr is not None:
+                # fault kind + seed + sequence position: everything a
+                # replay needs to re-arm the injector and line the
+                # injection up against the decisions around it
+                spec = self.spec
+                seed = (spec.net_seed if spec is not None else None)
+                jr.chaos(kind, seed=seed, rank=self.rank, **fields)
         except Exception:
             pass
 

@@ -27,8 +27,10 @@ bursty arrival process.
 
 from __future__ import annotations
 
-import time
 from typing import Any, Dict, Optional
+
+from deepspeed_tpu.observability.clocksync import wall_time
+from deepspeed_tpu.observability.journal import get_journal
 
 
 class AutoscaleSignal:
@@ -61,7 +63,7 @@ class AutoscaleSignal:
                now: Optional[float] = None) -> int:
         """One evaluation; returns the (possibly unchanged) desired
         replica count and mirrors every signal into hub gauges."""
-        now = time.time() if now is None else now
+        now = wall_time() if now is None else now
         n = max(1, int(n_replicas))
         if self.desired is None:
             self.desired = min(max(n, self.min_replicas), self.max_replicas)
@@ -86,6 +88,7 @@ class AutoscaleSignal:
                 self.desired = min(self.max_replicas, self.desired + 1)
                 self._up_streak = 0
                 self.history.append((now, self.desired))
+                self._journal("up", now, pressure, slo_miss_rate)
         elif cold:
             self._down_streak += 1
             self._up_streak = 0
@@ -93,6 +96,7 @@ class AutoscaleSignal:
                 if self.desired > self.min_replicas:
                     self.desired = self.desired - 1
                     self.history.append((now, self.desired))
+                    self._journal("down", now, pressure, slo_miss_rate)
                 self._down_streak = 0
         else:
             self._up_streak = 0
@@ -106,6 +110,24 @@ class AutoscaleSignal:
             self._hub.gauge("serve.fleet.goodput_slope", self.goodput_slope)
         return self.desired
 
+    def _journal(self, direction: str, now: float, pressure: float,
+                 slo_miss_rate: float) -> None:
+        """One AUTOSCALE decision with the state that triggered it —
+        the black-box record an incident review audits against the
+        thresholds."""
+        jr = get_journal()
+        if jr is not None:
+            jr.decision(
+                "AUTOSCALE", ts=now, direction=direction,
+                desired=self.desired,
+                queue_pressure=round(float(pressure), 4),
+                slo_miss_rate=round(float(slo_miss_rate), 4),
+                goodput_slope=round(self.goodput_slope, 4),
+                thresholds={"queue_high": self.queue_high,
+                            "queue_low": self.queue_low,
+                            "slo_miss_high": self.slo_miss_high,
+                            "hysteresis_rounds": self.hysteresis_rounds})
+
     def record_action(self, action: str, replica_id: int,
                       now: Optional[float] = None) -> None:
         """Log an *act* on the signal into the decision history — the
@@ -114,7 +136,7 @@ class AutoscaleSignal:
         same timeline as the desires that caused them. Action entries
         are ``(ts, desired, "action:rN")`` 3-tuples next to the
         ``(ts, desired)`` decision 2-tuples."""
-        now = time.time() if now is None else now
+        now = wall_time() if now is None else now
         self.history.append((now, self.desired, f"{action}:r{replica_id}"))
 
     def snapshot(self) -> Dict[str, Any]:

@@ -75,6 +75,8 @@ from typing import Any, Dict, List, Optional
 
 import numpy as np
 
+from deepspeed_tpu.observability.clocksync import wall_time
+from deepspeed_tpu.observability.journal import get_journal
 from deepspeed_tpu.serving.replica import ServingReplica, Submission
 
 
@@ -156,7 +158,9 @@ class _RequestRecord:
         self.done = False
         self.failovers = 0
         self.affinity_key = affinity_key
-        self.submitted_ts = time.time()  # display only (spans)
+        # wall_time(), not time.time(): _on_emissions derives TTFT from
+        # this stamp on the same clock domain as spans and the journal
+        self.submitted_ts = wall_time()
         self.submitted_mono = time.monotonic()
         self.first_emit_ts = 0.0
         self.last_emit_ts = 0.0
@@ -235,6 +239,10 @@ class FleetRouter:
         self.draining: set = set()
         self._last_policy = "least_loaded"
         self._last_predicted_ms: Optional[float] = None
+        # per-candidate forensics for the fleet journal's ROUTE records
+        # — populated by _pick only while a journal is installed, so
+        # the disabled path stays allocation-free
+        self._last_candidates: Optional[List[Dict[str, Any]]] = None
         # per-replica observations feeding the predictive policy:
         # service EWMA in seconds per completed request, and the
         # observed prefill token rate from first-token latencies
@@ -271,6 +279,11 @@ class FleetRouter:
         from deepspeed_tpu.observability.hub import get_hub
 
         self._hub = get_hub()
+        jr = get_journal()
+        if jr is not None:
+            # the router owns request identity, so it owns ADMIT/EMIT
+            # journaling; engines sharing this process defer to it
+            jr.claim_ingress("router")
 
     # -- fleet membership (supervisor spin-up / drain) -----------------
     def add_replica(self, replica: ServingReplica) -> None:
@@ -311,6 +324,11 @@ class FleetRouter:
         ever schedule — the fleet-wide analog of ``put()``'s never-fit
         contract; once accepted, completion is guaranteed."""
         toks = np.asarray(tokens, np.int32).ravel()
+        jr = get_journal()
+        if jr is not None:
+            # a journal installed after __init__ still belongs to the
+            # router: claim before any engine sees the request
+            jr.claim_ingress("router")
         with self._lock:
             if uid in self._requests:
                 raise ValueError(f"uid={uid} already in flight")
@@ -338,6 +356,13 @@ class FleetRouter:
                     + (1.0 - self._ewma_alpha) * self._avg_budget)
             route = self._route_fields(target, self._last_policy,
                                        self._last_predicted_ms, uid=uid)
+            if jr is not None:
+                jr.admit(uid, toks.tolist(), int(max_new_tokens))
+                jr.decision(
+                    "ROUTE", uid=uid, replica=target.replica_id,
+                    phase=phase, policy=self._last_policy,
+                    predicted_ttft_ms=self._last_predicted_ms,
+                    candidates=self._last_candidates)
         target.submit(Submission(
             uid=uid, tokens=toks, max_new_tokens=budget,
             span_notes=[("ROUTE", route)]))
@@ -452,6 +477,20 @@ class FleetRouter:
                     "request")
         pool_tag = id(pool)
         self._last_predicted_ms = None
+        if get_journal() is not None:
+            # decision forensics: every candidate's health / load /
+            # predicted-TTFT at decision time, not just the winner —
+            # computed only while the black box is recording
+            mono = time.monotonic()
+            self._last_candidates = [
+                {"replica": r.replica_id,
+                 "health": self._route_state(r.replica_id, mono),
+                 "load_score": round(float(r.load_score()), 4),
+                 "predicted_ttft_ms": round(
+                     self.predict_ttft(r, n_tokens) * 1e3, 3)}
+                for r in alive]
+        else:
+            self._last_candidates = None
         if key is not None:
             rid = self._affinity.get((pool_tag, key))
             if rid is not None and any(r.replica_id == rid for r in alive):
@@ -545,7 +584,8 @@ class FleetRouter:
     def _on_emissions(self, replica: ServingReplica,
                       emitted: Dict[int, List[int]]) -> None:
         handoffs = []
-        now = time.time()
+        now = wall_time()  # same clock domain as spans + journal
+        jr = get_journal()
         with self._lock:
             for uid, toks in emitted.items():
                 rec = self._requests.get(uid)
@@ -587,6 +627,11 @@ class FleetRouter:
                             + (1.0 - self._ewma_alpha) * prev)
                 if toks:
                     rec.last_emit_ts = now
+                    if jr is not None:
+                        # under the lock, after the ownership guards:
+                        # the checksum chain records exactly the tokens
+                        # the request adopted, in adoption order
+                        jr.emit(uid, toks)
                 rec.emitted.extend(int(t) for t in toks)
                 if rec.phase == "prefill":
                     handoffs.append(rec)  # budget-1 stage just finished
@@ -776,6 +821,14 @@ class FleetRouter:
                 rec.hedge_replica_id = target.replica_id
                 self.stats["hedged"] += 1
                 waited_ms = (now - rec.submitted_mono) * 1e3
+                jr = get_journal()
+                if jr is not None:
+                    jr.decision(
+                        "HEDGE", uid=rec.uid,
+                        from_replica=rec.replica_id,
+                        to_replica=target.replica_id,
+                        waited_ms=round(waited_ms, 3),
+                        hedge_ttft_factor=self.hedge_ttft_factor)
                 plans.append((rec, target,
                               self._route_fields(target, "hedge",
                                                  uid=rec.uid),
@@ -848,6 +901,14 @@ class FleetRouter:
                 rec.replica_id = target.replica_id
                 rec.failovers += 1
                 self.stats["failed_over_requests"] += 1
+                jr = get_journal()
+                if jr is not None:
+                    jr.decision(
+                        "FAILOVER", uid=rec.uid, from_replica=old,
+                        to_replica=target.replica_id,
+                        dead_replica=dead_rid,
+                        recovered_tokens=len(rec.emitted),
+                        failovers=rec.failovers)
                 tokens = np.concatenate(
                     [rec.tokens, np.asarray(rec.emitted, np.int32)]) \
                     if rec.emitted else rec.tokens
@@ -991,8 +1052,8 @@ class FleetRouter:
                 }
                 for rid in self.replicas}
         snap = {
-            "schema": "serving_fleet/v2",
-            "ts": time.time(),
+            "schema": "serving_fleet/v3",
+            "ts": wall_time(),  # fleet clock domain, not raw time.time
             "mode": "disagg" if self.disagg else "unified",
             "replicas": [r.load_report()
                          for r in self.replicas.values()],
@@ -1011,4 +1072,10 @@ class FleetRouter:
             is not None}
         if clock:
             snap["clock"] = clock
+        jr = get_journal()
+        if jr is not None:
+            # v3: the black-box handle — where the journal lives and
+            # how much it has captured, so an incident snapshot points
+            # straight at its own replay artifact
+            snap["journal"] = jr.snapshot()
         return snap

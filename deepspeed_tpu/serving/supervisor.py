@@ -66,6 +66,8 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
+from deepspeed_tpu.observability.clocksync import wall_time
+from deepspeed_tpu.observability.journal import get_journal
 from deepspeed_tpu.serving.replica import Submission
 from deepspeed_tpu.serving.transport import (ChannelError, FileChannel,
                                              connect_with_backoff,
@@ -472,6 +474,20 @@ class ReplicaSupervisor:
                                           total))
         return block_size, total, max_per_seq
 
+    # -- act log + black box -------------------------------------------
+    def _act(self, action: str, replica_id: int,
+             now: Optional[float] = None, **fields: Any) -> None:
+        """One supervisor act: appended to the in-memory decision
+        history (the fleet snapshot's ``supervisor.actions``) and, when
+        the black box is recording, journaled as a SUPERVISOR decision
+        with the state that triggered it."""
+        now = wall_time() if now is None else now
+        self.actions.append((now, action, replica_id))
+        jr = get_journal()
+        if jr is not None:
+            jr.decision("SUPERVISOR", ts=now, action=action,
+                        replica=replica_id, **fields)
+
     # -- spawn ---------------------------------------------------------
     def spawn(self, role: Optional[str] = None,
               replica_id: Optional[int] = None,
@@ -537,7 +553,8 @@ class ReplicaSupervisor:
                     chan.ping_clock()
                 except ChannelError:
                     break
-        self.actions.append((time.time(), action, rid))
+        self._act(action, rid, role=role,
+                  lineage=self._lineage.get(rid, rid))
         return remote
 
     def _connect(self, proc: subprocess.Popen, ready_path: str,
@@ -599,7 +616,7 @@ class ReplicaSupervisor:
         health-check cadence. ``now`` (wall clock) stamps the decision
         history only — scheduling runs on the monotonic clock. Returns
         counts of the actions taken."""
-        now = time.time() if now is None else now
+        now = wall_time() if now is None else now
         mono = time.monotonic()
         acted = {"restarted": 0, "spawned": 0, "drained": 0,
                  "quarantined": 0, "handoffs_expired": 0}
@@ -648,7 +665,9 @@ class ReplicaSupervisor:
                 # desired-vs-live path owns replacing its capacity
                 if lineage not in self.quarantined:
                     self.quarantined.add(lineage)
-                    self.actions.append((now, "quarantine", rid))
+                    self._act("quarantine", rid, now, lineage=lineage,
+                              crashes_in_window=attempt,
+                              window_s=self.restart_window_s)
                     if autoscale is not None:
                         autoscale.record_action("quarantine", rid, now)
                     acted["quarantined"] += 1
@@ -703,9 +722,10 @@ class ReplicaSupervisor:
         in-flight requests and exits 0. Refuses (returns False, with a
         ``drain_refused`` act recorded) when draining would leave the
         fleet below its ``min_healthy`` floor."""
-        if len(self._live_ids()) - 1 < self.min_healthy:
-            self.actions.append((time.time(), "drain_refused",
-                                 replica_id))
+        live = len(self._live_ids())
+        if live - 1 < self.min_healthy:
+            self._act("drain_refused", replica_id, live=live,
+                      min_healthy=self.min_healthy)
             return False
         remote = self.replicas[replica_id]
         remote.draining = True
@@ -716,7 +736,7 @@ class ReplicaSupervisor:
         except ChannelError:
             remote.transport_errors += 1
             remote._send_failed = True
-        self.actions.append((time.time(), "drain", replica_id))
+        self._act("drain", replica_id)
         return True
 
     def kill(self, replica_id: int,
@@ -773,9 +793,12 @@ class ReplicaSupervisor:
         if self.router is not None:
             snap = self.router.fleet_snapshot()
         else:
-            snap = {"schema": "serving_fleet/v2", "ts": time.time(),
+            snap = {"schema": "serving_fleet/v3", "ts": wall_time(),
                     "replicas": [r.load_report()
                                  for r in self.replicas.values()]}
+            jr = get_journal()
+            if jr is not None:
+                snap["journal"] = jr.snapshot()
         snap["supervisor"] = {
             "actions": [{"ts": ts, "action": act, "replica": rid}
                         for ts, act, rid in self.actions[-64:]],

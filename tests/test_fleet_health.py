@@ -156,13 +156,13 @@ class TestHealthStateMachine:
         assert router.check_health(base) == [0]
         assert 0 in router.dead
 
-    def test_snapshot_is_v2_with_health_block(self):
+    def test_snapshot_is_v3_with_health_block(self):
         router, reps = make_router()
         base = time.monotonic()
         reps[1]._hb_mono = base - 11.0
         router.check_health(base)
         snap = router.fleet_snapshot()
-        assert snap["schema"] == "serving_fleet/v2"
+        assert snap["schema"] == "serving_fleet/v3"
         assert snap["health"]["0"]["state"] == "healthy"
         assert snap["health"]["1"]["state"] == "dead"
         assert {"hedged", "hedge_wins"} <= set(snap["router"])

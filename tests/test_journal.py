@@ -1,0 +1,489 @@
+"""Fleet black box: deterministic traffic capture + incident replay.
+
+The journal (observability/journal.py) is an append-only, CRC-framed
+record of everything a serving session decided and emitted — run
+header with config fingerprint + re-drive recipe, every admission with
+its arrival offset, every routing decision WITH the per-candidate
+scores it weighed, chaos injections, and a per-request emitted-token
+checksum chain. ``tools/replay.py`` re-drives a fresh fleet from the
+journal alone and verifies the streams bit-identical.
+
+Covered here: checksum-chain primitives, record/replay round-trip on a
+real 2-replica in-process fleet, divergence naming (mutate one chain
+link -> exact uid + decode step), ROUTE candidate-scores schema,
+chaos-spec re-arming, torn-tail recovery (truncated final frame loads
+clean), the disabled-journal zero-overhead contract, and the
+skew-stepped one-clock regression (DSTPU_CLOCK_SKEW_S): router
+emission stamps, fleet_snapshot ts and journal stamps share
+``wall_time()``. The full subprocess record arm + corrupted-journal
+CLI exit ride the slow tier (tests/slow_tests.txt round-18 block) and
+``make replay-fleet``.
+"""
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+
+from deepspeed_tpu.models.zoo import get_model  # noqa: E402
+from deepspeed_tpu.observability.clocksync import wall_time  # noqa: E402
+from deepspeed_tpu.observability.journal import (  # noqa: E402
+    FleetJournal, admitted_requests, chain_tokens, config_fingerprint,
+    dump_journal, get_journal, journal_header, load_journal,
+    recorded_chains, render_incident_log, request_outcomes,
+    reset_journal, set_journal, token_chain, verify_streams)
+from deepspeed_tpu.serving import FleetRouter, ServingReplica  # noqa: E402
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_TOOLS = os.path.join(_REPO, "tools")
+if _TOOLS not in sys.path:
+    sys.path.insert(0, _TOOLS)
+
+
+@pytest.fixture(autouse=True)
+def _no_journal_leak():
+    yield
+    reset_journal()
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    model = get_model("tiny", dtype=jnp.float32, param_dtype=jnp.float32)
+    params = model.init(jax.random.PRNGKey(0))
+    return model, params
+
+
+ENGINE_DEFAULTS = dict(kv_blocks=64, kv_block_size=8,
+                       max_tokens_per_step=32, max_seqs_per_step=4,
+                       max_blocks_per_seq=8)
+
+# the re-drive recipe matching the `tiny` fixture + ENGINE_DEFAULTS:
+# what a journaled harness stamps into the HEADER so tools/replay.py
+# can rebuild the identical fleet from the journal alone
+RECIPE = {
+    "model": {"name": "tiny", "overrides": {"dtype": "float32",
+                                            "param_dtype": "float32"}},
+    "seed": 0,
+    "engine": dict(ENGINE_DEFAULTS, dtype="float32"),
+    "router": {"routing": "predictive"},
+    "eos_token_id": None,
+    "replicas": [{"replica_id": 0, "role": "unified"},
+                 {"replica_id": 1, "role": "unified"}],
+}
+
+
+def make_fleet(tiny, router_kw=None, **engine_kw):
+    model, params = tiny
+    for k, v in ENGINE_DEFAULTS.items():
+        engine_kw.setdefault(k, v)
+    replicas = [ServingReplica.create(model, i, role="unified",
+                                      params=params, dtype=jnp.float32,
+                                      **engine_kw)
+                for i in range(2)]
+    return FleetRouter(replicas, **(router_kw or {}))
+
+
+def prompts(n, prefix_len=16, tail=4):
+    base = ((np.arange(prefix_len) * 5 + 3) % 97).astype(np.int32)
+    return [np.concatenate(
+        [base, ((np.arange(tail) * 7 + 11 * i) % 89).astype(np.int32)])
+        for i in range(n)]
+
+
+@pytest.fixture(scope="module")
+def recorded(tiny, tmp_path_factory):
+    """The module's ground truth: a journaled 2-replica in-process run
+    (4 requests, predictive routing), its driver-side token streams,
+    and the fleet snapshot taken while the journal was installed."""
+    path = str(tmp_path_factory.mktemp("journal") / "fleet.journal")
+    jr = FleetJournal(path)
+    set_journal(jr)
+    jr.write_header(config_fingerprint(recipe=RECIPE), replay=RECIPE)
+    router = make_fleet(tiny, router_kw=dict(RECIPE["router"]))
+    ps = prompts(4)
+    for uid, p in enumerate(ps):
+        router.submit(uid, p, max_new_tokens=6)
+    router.run_until_complete()
+    results = {u: list(t) for u, t in router.results().items()}
+    snap = router.fleet_snapshot()
+    stats = jr.snapshot()
+    reset_journal()
+    return {"path": path, "results": results, "snapshot": snap,
+            "stats": stats, "n": len(ps), "gen": 6}
+
+
+# -- checksum-chain + fingerprint primitives -----------------------------
+
+
+def test_chain_is_deterministic_and_order_sensitive():
+    a = chain_tokens([5, 9, 7])
+    assert a == chain_tokens([5, 9, 7])
+    assert len(a) == 3
+    assert a != chain_tokens([9, 5, 7])
+    # chaining: each link folds the previous one in
+    assert a[1] == token_chain(a[0], 9)
+    # resumable from any prefix (the EMIT `start`/prev contract)
+    assert chain_tokens([7], prev=a[1]) == [a[2]]
+
+
+def test_config_fingerprint_stable_and_sensitive():
+    f1 = config_fingerprint(model={"name": "tiny"}, seed=0)
+    f2 = config_fingerprint(seed=0, model={"name": "tiny"})
+    assert f1 == f2  # kwarg order is not identity
+    assert f1["combined"] != config_fingerprint(
+        model={"name": "tiny"}, seed=1)["combined"]
+    assert set(f1) == {"model", "seed", "combined"}
+
+
+# -- journal file format -------------------------------------------------
+
+
+def _small_journal(path, n_emit=3):
+    jr = FleetJournal(path)
+    jr.write_header(config_fingerprint(x=1))
+    jr.admit(0, [1, 2, 3], 4, arrival_offset_s=0.0)
+    jr.decision("ROUTE", uid=0, replica=0, candidates=[])
+    for i in range(n_emit):
+        jr.emit(0, [10 + i])
+    jr.close()
+    return jr
+
+
+def test_torn_tail_loads_all_complete_frames(tmp_path):
+    """A crash mid-append must not cost the records already on disk:
+    the loader returns every complete frame and never raises."""
+    path = str(tmp_path / "torn.journal")
+    _small_journal(path)
+    whole = load_journal(path)
+    assert len(whole) == 6
+    with open(path, "rb") as f:
+        blob = f.read()
+    # cut mid-first-frame (nothing salvageable) and one byte short of
+    # the final frame (everything but the last record salvages)
+    for cut, expect in ((1, 0), (len(blob) - 1, 5)):
+        torn = str(tmp_path / f"torn{cut}.journal")
+        with open(torn, "wb") as f:
+            f.write(blob[:cut])
+        got = load_journal(torn)
+        assert len(got) == expect
+        assert [r["kind"] for r in got] == \
+            [r["kind"] for r in whole][:expect]
+
+
+def test_corrupt_frame_stops_salvage_cleanly(tmp_path):
+    path = str(tmp_path / "corrupt.journal")
+    _small_journal(path)
+    with open(path, "rb") as f:
+        blob = bytearray(f.read())
+    blob[len(blob) // 2] ^= 0xFF  # flip one mid-file payload byte
+    with open(path, "wb") as f:
+        f.write(bytes(blob))
+    got = load_journal(path)  # prefix only, no exception
+    assert 0 < len(got) < 6
+    assert got[0]["kind"] == "HEADER"
+
+
+def test_dump_journal_reframes_roundtrip(tmp_path):
+    path = str(tmp_path / "orig.journal")
+    _small_journal(path)
+    records = load_journal(path)
+    copy = str(tmp_path / "copy.journal")
+    assert dump_journal(copy, records) == len(records)
+    assert load_journal(copy) == records
+
+
+def test_byte_cap_drops_with_truncation_marker(tmp_path):
+    path = str(tmp_path / "capped.journal")
+    jr = FleetJournal(path, max_mb=0.0005)  # ~500 bytes
+    jr.write_header(config_fingerprint(x=1))
+    for i in range(200):
+        jr.admit(i, list(range(16)), 4, arrival_offset_s=0.0)
+    jr.close()
+    assert jr.n_dropped > 0
+    records = load_journal(path)
+    assert records[-1]["kind"] == "TRUNCATED"
+    assert os.path.getsize(path) < 2048
+
+
+# -- verification --------------------------------------------------------
+
+
+def test_verify_streams_names_exact_divergence(tmp_path):
+    path = str(tmp_path / "v.journal")
+    jr = FleetJournal(path)
+    jr.write_header(config_fingerprint(x=1))
+    jr.admit(7, [1, 2], 4, arrival_offset_s=0.0)
+    jr.emit(7, [11, 12])
+    jr.emit(7, [13, 14])
+    jr.close()
+    records = load_journal(path)
+    ok = verify_streams(records, {7: [11, 12, 13, 14]})
+    assert ok["bit_identical"] and ok["verified_tokens"] == 4
+
+    bad = verify_streams(records, {7: [11, 12, 99, 14]})
+    assert not bad["bit_identical"]
+    assert bad["first_divergence"]["uid"] == 7
+    assert bad["first_divergence"]["step"] == 2
+    assert bad["first_divergence"]["reason"] == "chain_mismatch"
+
+    short = verify_streams(records, {7: [11, 12, 13]})
+    assert short["first_divergence"]["step"] == 3
+    assert short["first_divergence"]["reason"] == "short_stream"
+    missing = verify_streams(records, {})
+    assert missing["first_divergence"]["reason"] == "missing_request"
+
+
+def test_emit_gap_truncates_chain_at_gap(tmp_path):
+    """A lost EMIT record (byte-cap drop, torn tail) must surface as a
+    verification failure at the gap, not silently verify around it."""
+    path = str(tmp_path / "gap.journal")
+    _small_journal(path, n_emit=3)
+    records = [r for r in load_journal(path)
+               if not (r["kind"] == "EMIT" and r["start"] == 1)]
+    chains = recorded_chains(records)
+    assert len(chains[0]) == 1  # verified prefix only
+    v = verify_streams(records, {0: [10, 11, 12]})
+    assert not v["bit_identical"]
+    assert v["first_divergence"]["reason"] == "long_stream"
+    assert v["first_divergence"]["step"] == 1
+
+
+# -- journaled in-process fleet ------------------------------------------
+
+
+def test_recorded_run_verifies_bit_identical(recorded):
+    records = load_journal(recorded["path"])
+    verdict = verify_streams(records, recorded["results"])
+    assert verdict["bit_identical"], verdict["first_divergence"]
+    assert verdict["requests"] == recorded["n"]
+    assert verdict["verified_tokens"] == sum(
+        len(t) for t in recorded["results"].values())
+
+
+def test_route_records_carry_all_candidate_scores(recorded):
+    """Decision forensics: ROUTE must record what every candidate
+    scored, not just the winner — else "why replica 1?" is
+    unanswerable post-hoc."""
+    records = load_journal(recorded["path"])
+    routes = [r for r in records if r["kind"] == "ROUTE"]
+    assert {r["uid"] for r in routes} == set(range(recorded["n"]))
+    for r in routes:
+        assert r["policy"] in ("predictive", "affinity", "least_loaded",
+                               "tier_affinity")
+        cands = r["candidates"]
+        assert len(cands) == 2  # both replicas scored
+        assert r["replica"] in {c["replica"] for c in cands}
+        for c in cands:
+            assert {"replica", "health", "load_score",
+                    "predicted_ttft_ms"} <= set(c)
+
+
+def test_header_fingerprint_and_recipe(recorded):
+    hdr = journal_header(load_journal(recorded["path"]))
+    assert hdr["schema"] == "fleet_journal/v1"
+    assert hdr["fingerprint"]["combined"] == config_fingerprint(
+        recipe=RECIPE)["combined"]
+    # weights ride as a derivable recipe (zoo name + init seed), never
+    # as serialized bytes
+    assert hdr["replay"]["model"]["name"] == "tiny"
+    assert "params" not in hdr["replay"]
+
+
+def test_fleet_snapshot_v3_embeds_journal(recorded):
+    snap = recorded["snapshot"]
+    assert snap["schema"] == "serving_fleet/v3"
+    assert snap["journal"]["records"] > 0
+    assert snap["journal"]["requests"] == recorded["n"]
+
+
+def test_incident_log_and_outcomes(recorded):
+    records = load_journal(recorded["path"])
+    log = "\n".join(render_incident_log(records))
+    for needle in ("HEADER", "ADMIT", "ROUTE", "EMIT", "uid=0",
+                   "candidates="):
+        assert needle in log
+    outcomes = request_outcomes(records)
+    assert len(outcomes) == recorded["n"]
+    for o in outcomes.values():
+        assert o["outcome"] == "complete"
+        assert o["decisions"].count("ROUTE") == 1
+
+
+def test_journal_overhead_accounted(recorded):
+    stats = recorded["stats"]
+    assert stats["requests"] == recorded["n"]
+    assert stats["bytes_per_request"] > 0
+    assert stats["append_us_per_request"] > 0
+    assert not stats["truncated"]
+    assert stats["ingress"] == "router"
+
+
+# -- replay (tools/replay.py) --------------------------------------------
+
+
+def test_replay_rebuilds_fleet_bit_identical(recorded):
+    """The tentpole contract: a fresh fleet rebuilt from the journal
+    alone re-emits every stream bit-identically."""
+    import replay as replay_tool
+
+    verdict = replay_tool.replay_journal(recorded["path"], mode="afap",
+                                         warm=False)
+    assert verdict["bit_identical"], verdict["first_divergence"]
+    assert verdict["requests"] == recorded["n"]
+    assert verdict["replayed_admissions"] == recorded["n"]
+    assert os.path.exists(recorded["path"] + ".verdict.json")
+    assert get_journal() is None  # replay itself records nothing
+
+
+def test_mutated_checksum_names_exact_uid_and_step(recorded, tmp_path):
+    records = load_journal(recorded["path"])
+    emits = [r for r in records if r["kind"] == "EMIT" and r["chain"]]
+    mut = emits[-1]
+    mut["chain"][-1] ^= 0x5A5A5A
+    step = mut["start"] + len(mut["chain"]) - 1
+    corrupt = str(tmp_path / "corrupt.journal")
+    dump_journal(corrupt, records)
+    v = verify_streams(load_journal(corrupt), recorded["results"])
+    assert not v["bit_identical"]
+    assert v["divergent_requests"] == 1
+    assert v["first_divergence"]["uid"] == mut["uid"]
+    assert v["first_divergence"]["step"] == step
+    assert v["first_divergence"]["reason"] == "chain_mismatch"
+
+
+def test_chaos_spec_note_rearms_injector(recorded, tmp_path):
+    """A recorded CHAOS_SPEC note re-arms the exact same injector spec
+    during replay (chaos-injection replay determinism: same spec, same
+    seed, same rank)."""
+    from deepspeed_tpu.resilience.chaos import (get_chaos_injector,
+                                                reset_chaos_injector)
+    import replay as replay_tool
+
+    records = load_journal(recorded["path"])
+    records.insert(1, {"kind": "CHAOS_SPEC",
+                       "spec": "net_drop_frac=0.25,net_seed=7",
+                       "rank": 0})
+    path = str(tmp_path / "chaos.journal")
+    dump_journal(path, records)
+    try:
+        spec = replay_tool._rearm_chaos(load_journal(path))
+        assert spec == "net_drop_frac=0.25,net_seed=7"
+        inj = get_chaos_injector()
+        assert inj is not None
+        assert inj.spec.net_drop_frac == 0.25
+        assert inj.spec.net_seed == 7
+    finally:
+        reset_chaos_injector()
+
+
+def test_replay_cli_corrupt_journal_exits_nonzero(recorded, tmp_path,
+                                                  capsys):
+    """End-to-end CLI contract (slow tier): replaying a journal with
+    one corrupted chain link re-runs the fleet, exits nonzero, and the
+    report names the exact diverging uid + decode step."""
+    import replay as replay_tool
+
+    records = load_journal(recorded["path"])
+    emits = [r for r in records if r["kind"] == "EMIT" and r["chain"]]
+    mut = emits[0]
+    mut["chain"][-1] ^= 0x77777
+    step = mut["start"] + len(mut["chain"]) - 1
+    corrupt = str(tmp_path / "corrupt.journal")
+    dump_journal(corrupt, records)
+    rc = replay_tool.main([corrupt, "--mode", "afap", "--no-warm"])
+    assert rc == 1
+    out = capsys.readouterr().out
+    assert "DIVERGED" in out
+    assert f"uid={mut['uid']} step={step}" in out
+    with open(corrupt + ".verdict.json") as f:
+        verdict = json.load(f)
+    assert verdict["first_divergence"]["uid"] == mut["uid"]
+    assert verdict["first_divergence"]["step"] == step
+
+
+def test_replay_fleet_bench_e2e(tmp_path, monkeypatch):
+    """Slow-tier e2e (tests/slow_tests.txt round 18): the full ``make
+    replay-fleet`` gate — a subprocess socket-fleet record arm with the
+    drop fault armed, a scheduled-mode replay that must come back
+    bit-identical, journal overhead/bytes-per-request bounds, and the
+    corrupted-journal replay naming its divergence."""
+    monkeypatch.setenv("REPLAY_FLEET_RUN_DIR", str(tmp_path))
+    monkeypatch.setenv("REPLAY_FLEET_REQUESTS", "4")
+    monkeypatch.setenv("REPLAY_FLEET_GEN", "6")
+    monkeypatch.setenv("REPLAY_FLEET_PERIOD_S", "2")
+    import serve_bench
+
+    payload = serve_bench.run_replay_fleet()
+    assert payload["ok"], payload["violations"]
+    assert payload["replay.bit_identical"] is True
+    assert payload["replay.corrupt_detected"] is True
+    assert payload["record"]["dropped"] == 0
+    assert payload["replay.journal_bytes_per_request"] > 0
+
+
+# -- disabled-journal zero-overhead contract -----------------------------
+
+
+def test_disabled_journal_records_nothing(tiny):
+    assert get_journal() is None
+    router = make_fleet(tiny)
+    router.submit(0, prompts(1)[0], max_new_tokens=4)
+    router.run_until_complete()
+    # the forensics scratch state stays un-allocated on the disabled
+    # path — no per-candidate dicts built for a journal nobody installed
+    assert router._last_candidates is None
+    assert len(router.results()[0]) == 4
+
+
+def test_append_after_close_is_dropped_not_raised(tmp_path):
+    jr = _small_journal(str(tmp_path / "closed.journal"))
+    before = jr.n_records
+    jr.emit(0, [1])  # closed: dropped, never raises into the serve path
+    assert jr.n_records == before
+
+
+# -- one clock: DSTPU_CLOCK_SKEW_S steps every wall stamp together -------
+
+
+def test_skewed_clock_keeps_one_time_domain(tiny, tmp_path, monkeypatch):
+    """Step the wall clock back 300s (DSTPU_CLOCK_SKEW_S): the journal
+    stamps, the router's emission stamps and fleet_snapshot ts must all
+    move together — a raw time.time() straggler shows up here as a
+    300s rift (or a negative TTFT)."""
+    monkeypatch.setenv("DSTPU_CLOCK_SKEW_S", "-300")
+    assert abs((time.time() - 300) - wall_time()) < 5.0
+    path = str(tmp_path / "skew.journal")
+    jr = FleetJournal(path)
+    set_journal(jr)
+    jr.write_header(config_fingerprint(x=1))
+    router = make_fleet(tiny)
+    router.submit(0, prompts(1)[0], max_new_tokens=4)
+    router.run_until_complete()
+    snap = router.fleet_snapshot()
+    reset_journal()
+    assert abs(snap["ts"] - wall_time()) < 60.0  # v3 ts is skew-aware
+    records = load_journal(path)
+    admit = admitted_requests(records)[0]
+    # offsets stay schedule-relative, not contaminated by the step
+    assert 0.0 <= admit["arrival_offset_s"] < 60.0
+    for rec in records:
+        assert abs(rec["ts"] - snap["ts"]) < 60.0
+
+
+def test_autoscale_default_clock_is_wall_time(monkeypatch):
+    from deepspeed_tpu.serving.autoscale import AutoscaleSignal
+
+    monkeypatch.setenv("DSTPU_CLOCK_SKEW_S", "-300")
+    pol = AutoscaleSignal(min_replicas=1, max_replicas=4)
+    pol.update(1, queue_wait_depth=0.0, slo_miss_rate=0.0,
+               goodput_tokens_per_s=10.0)
+    pol.record_action("spawn", 0)
+    assert pol.history
+    for entry in pol.history:
+        assert abs(entry[0] - wall_time()) < 60.0

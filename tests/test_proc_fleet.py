@@ -159,7 +159,7 @@ class TestProcFleetE2E:
         finally:
             sys.path.pop(0)
         snap = serve_top._load_run_dir_snapshot(run_dir)
-        assert snap["schema"] == "serving_fleet/v2"
+        assert snap["schema"] == "serving_fleet/v3"
         assert snap["supervisor"]["actions"]
         table = serve_top._fleet_table(snap)
         assert "worker processes up" in table and "transport:" in table
@@ -167,7 +167,7 @@ class TestProcFleetE2E:
         os.rename(path, path + ".bak")
         try:
             fallback = serve_top._load_run_dir_snapshot(run_dir)
-            assert fallback["schema"] == "serving_fleet/v2"
+            assert fallback["schema"] == "serving_fleet/v3"
             assert fallback["replicas"]
         finally:
             os.rename(path + ".bak", path)

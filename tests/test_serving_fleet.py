@@ -241,7 +241,7 @@ class TestDisaggFleet:
             router.submit(uid, p, max_new_tokens=4)
         router.run_until_complete()
         snap = router.fleet_snapshot(deadline_s=5.0)
-        assert snap["schema"] == "serving_fleet/v2"
+        assert snap["schema"] == "serving_fleet/v3"
         assert set(snap["health"]) == \
             {str(r["replica"]) for r in snap["replicas"]}
         assert snap["mode"] == "disagg"

@@ -102,6 +102,13 @@ DEFAULT_THRESHOLDS: Dict[str, Tuple[str, float]] = {
     "tier.sessions_per_gb": ("min_ratio", 0.85),
     "tier.warm_resume_ttft_ratio": ("max_ratio", 1.25),
     "spec.accept_rate": ("min_ratio", 0.9),
+    # fleet black box (BENCH_MODE=replay_fleet): journal append overhead
+    # and journal bytes per request may not silently balloon — a record
+    # kind that grew a verbose field shows up here before it shows up as
+    # a serving regression; the boolean replay.bit_identical certificate
+    # is checked unconditionally below
+    "replay.journal_overhead_us": ("max_ratio", 3.0),
+    "replay.journal_bytes_per_request": ("max_ratio", 1.5),
 }
 
 # units where a larger headline value is worse
@@ -251,6 +258,16 @@ def diff_reports(old: Dict[str, Any], new: Dict[str, Any],
             rise = nv - ov
             check("obs.offset_err_ms", rule, limit, ov, nv, rise,
                   rise <= limit)
+        # fleet black-box sentinels (replay_fleet payloads): journal
+        # append overhead and bytes-per-request trends
+        for key in ("replay.journal_overhead_us",
+                    "replay.journal_bytes_per_request"):
+            ov, nv = old.get(key), new.get(key)
+            if isinstance(ov, (int, float)) and \
+                    isinstance(nv, (int, float)) and ov > 0:
+                rule, limit = th[key]
+                ratio = nv / ov
+                check(key, rule, limit, ov, nv, ratio, ratio <= limit)
         # tiered-KV sentinels (serve_tier payloads): host-tier session
         # capacity, warm-resume TTFT trend, and drafter accept rate
         for key in ("tier.sessions_per_gb", "spec.accept_rate"):
@@ -288,7 +305,8 @@ def diff_reports(old: Dict[str, Any], new: Dict[str, Any],
     # round whose clock estimate escaped its own uncertainty bound, is
     # broken on its own, not relative to the old round)
     for cert in ("chaos.zero_drops", "chaos.bit_identical",
-                 "obs.trace_overhead_ok", "obs.offset_bound_ok"):
+                 "obs.trace_overhead_ok", "obs.offset_bound_ok",
+                 "replay.bit_identical"):
         if cert in new:
             check(cert, "must_stay_true", 1, old.get(cert),
                   new.get(cert), float(bool(new[cert])), bool(new[cert]))

@@ -1,0 +1,259 @@
+#!/usr/bin/env python
+"""replay — re-drive a recorded fleet session from its black-box journal.
+
+The fleet journal (observability/journal.py, written by any journaled
+router/supervisor run) captures the run header (config fingerprint +
+literal re-drive recipe), every admission with its scheduled arrival
+offset, every decision with its inputs, the armed chaos spec, and a
+per-request emitted-token checksum chain. This tool is the other half
+of the black box: it rebuilds a fresh in-process fleet from the header
+(same model zoo entry, same ``PRNGKey(seed)`` init — weights are
+re-derived from the fingerprinted recipe, never deserialized), re-arms
+the recorded chaos spec, re-drives the recorded admissions (at their
+recorded arrival offsets, or as fast as possible with ``--mode afap``),
+and verifies every replayed token stream against the recorded checksum
+chains — reporting the **first diverging request and decode step** on
+mismatch and exiting nonzero.
+
+Replay runs the fleet in-process (thread replicas, no sockets), so
+wire-level chaos faults re-arm but have no wire to bite — which is the
+point: greedy decoding makes token streams invariant to transport
+timing (the chaos bench certifies exactly that), so the journal's
+chains are comparable across the process/thread boundary, and a
+divergence means the *serving computation* changed, not the plumbing.
+
+``--perfetto`` additionally exports the replayed run's merged request
+traces next to the original journal for side-by-side forensics. A
+``<journal>.verdict.json`` lands next to the journal either way —
+``serve_top --replay-verdict`` renders it.
+
+Usage:
+  python tools/replay.py dstpu_journal/fleet.journal
+  python tools/replay.py fleet.journal --mode afap --perfetto
+  make replay-fleet        # record a chaos arm + replay it, gated
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+from typing import Any, Dict, List, Optional
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from deepspeed_tpu.observability.journal import (  # noqa: E402
+    admitted_requests, journal_header, load_journal, verify_streams)
+
+SCHEMA = "fleet_replay/v1"
+
+
+def build_fleet_from_header(header: Dict[str, Any], run_dir=None):
+    """A fresh in-process fleet from the journal's re-drive recipe:
+    the same constructors the recorded run used (model zoo + seeded
+    init + ServingReplica.create + FleetRouter kwargs), so the replay
+    serves bit-identical weights without any serialized state."""
+    replay = header.get("replay") or {}
+    if not replay:
+        raise ValueError(
+            "journal HEADER carries no replay recipe — recorded by a "
+            "run that only wanted forensics, not replay")
+    import jax
+
+    from deepspeed_tpu.models.zoo import get_model
+    from deepspeed_tpu.serving.proc_worker import _resolve_dtypes
+    from deepspeed_tpu.serving.replica import ServingReplica
+    from deepspeed_tpu.serving.router import FleetRouter
+
+    mspec = replay.get("model") or {"name": "tiny"}
+    model = get_model(mspec.get("name", "tiny"),
+                      **_resolve_dtypes(mspec.get("overrides") or {}))
+    params = model.init(jax.random.PRNGKey(int(replay.get("seed", 0))))
+    engine_kw = _resolve_dtypes(replay.get("engine") or {})
+    reps = replay.get("replicas") or [
+        {"replica_id": i, "role": "unified"}
+        for i in range(int(replay.get("n_replicas", 2)))]
+    replicas = [
+        ServingReplica.create(
+            model, int(r.get("replica_id", i)),
+            role=r.get("role", "unified"), run_dir=run_dir,
+            params=params, **engine_kw)
+        for i, r in enumerate(reps)]
+    router_kw = dict(replay.get("router") or {})
+    return FleetRouter(replicas, eos_token_id=replay.get("eos_token_id"),
+                       **router_kw)
+
+
+def _rearm_chaos(records: List[Dict[str, Any]]):
+    """Re-arm the recorded chaos spec (the CHAOS_SPEC note a journaled
+    harness writes when it arms the injector). Returns the armed spec
+    text or None."""
+    note = next((r for r in records if r.get("kind") == "CHAOS_SPEC"
+                 and r.get("spec")), None)
+    if note is None:
+        return None
+    from deepspeed_tpu.resilience.chaos import (ChaosInjector, ChaosSpec,
+                                                set_chaos_injector)
+    set_chaos_injector(ChaosInjector(ChaosSpec.parse(str(note["spec"])),
+                                     rank=int(note.get("rank") or 0)))
+    return str(note["spec"])
+
+
+def replay_journal(path: str, mode: str = "scheduled",
+                   speed: float = 1.0, perfetto: bool = False,
+                   warm: bool = True,
+                   drain_timeout_s: float = 180.0) -> Dict[str, Any]:
+    """Re-drive the journal at ``path`` and verify the token streams.
+
+    ``mode="scheduled"`` replays admissions at their recorded arrival
+    offsets (divided by ``speed``); ``"afap"`` submits everything
+    up front. Returns the verdict document (``bit_identical``,
+    ``first_divergence`` with the exact uid + decode step, overhead
+    stats, artifact paths)."""
+    import numpy as np
+
+    from deepspeed_tpu.resilience.chaos import reset_chaos_injector
+    from deepspeed_tpu.serving.replica import Submission
+
+    records = load_journal(path)
+    if not records:
+        raise ValueError(f"no complete journal records in {path!r}")
+    header = journal_header(records)
+    if header is None:
+        raise ValueError(f"{path!r} has no HEADER record")
+    admits = admitted_requests(records)
+
+    chaos_spec = _rearm_chaos(records)
+    try:
+        router = build_fleet_from_header(header)
+        if warm and admits:
+            # compile warm-up outside the replayed workload, mirroring
+            # the recorded harness: one direct probe per replica (uids
+            # far outside the journal's range, invisible to results())
+            probe = np.asarray(admits[0]["prompt_tokens"], np.int32)
+            for j, r in enumerate(router.replicas.values()):
+                r.submit(Submission(uid=(1 << 30) + j, tokens=probe,
+                                    max_new_tokens=2))
+            while any(len(r.engine.state.seqs) or len(r.engine._queue)
+                      for r in router.replicas.values()):
+                for r in router.replicas.values():
+                    r.pump(eos_token_id=router.eos_token_id)
+
+        t0 = time.perf_counter()
+        i = 0
+        deadline = t0 + drain_timeout_s
+        while (i < len(admits) or router.pending() > 0) \
+                and time.perf_counter() < deadline:
+            if i < len(admits):
+                due = (float(admits[i].get("arrival_offset_s") or 0.0)
+                       / max(speed, 1e-9)) if mode == "scheduled" else 0.0
+                if time.perf_counter() - t0 >= due:
+                    a = admits[i]
+                    router.submit(
+                        a["uid"],
+                        np.asarray(a["prompt_tokens"], np.int32),
+                        max_new_tokens=int(a["max_new_tokens"]))
+                    i += 1
+                    continue
+                if router.pending() == 0:
+                    time.sleep(min(due - (time.perf_counter() - t0),
+                                   0.01))
+            router.step()
+        wall = time.perf_counter() - t0
+    finally:
+        if chaos_spec is not None:
+            reset_chaos_injector()
+
+    streams = router.results()
+    verdict = verify_streams(records, streams)
+    verdict.update({
+        "schema": SCHEMA,
+        "journal": os.path.abspath(path),
+        "mode": mode,
+        "speed": speed,
+        "replayed_admissions": i,
+        "undrained": router.pending(),
+        "chaos_rearmed": chaos_spec,
+        "fingerprint": (header.get("fingerprint") or {}).get("combined"),
+        "wall_s": round(wall, 3),
+    })
+    if router.pending() > 0:
+        verdict["bit_identical"] = False
+        verdict.setdefault("first_divergence", {
+            "reason": "undrained_replay",
+            "uid": None, "step": None})
+    if perfetto:
+        out = f"{path}.replay.perfetto.json"
+        verdict["perfetto"] = router.export_perfetto(out)
+    verdict_path = f"{path}.verdict.json"
+    with open(verdict_path, "w") as f:
+        json.dump(verdict, f, indent=2, default=str)
+    verdict["verdict_path"] = verdict_path
+    return verdict
+
+
+def divergence_report(verdict: Dict[str, Any]) -> str:
+    """Human-readable verdict, naming the first diverging request and
+    decode step (the contract the bench's corrupted-journal check and
+    ``serve_top --replay-verdict`` both render)."""
+    lines = [f"replay verdict — {verdict.get('journal', '?')}",
+             f"  mode={verdict.get('mode')} "
+             f"requests={verdict.get('requests')} "
+             f"verified_tokens={verdict.get('verified_tokens')} "
+             f"wall_s={verdict.get('wall_s')}"]
+    if verdict.get("chaos_rearmed"):
+        lines.append(f"  chaos re-armed: {verdict['chaos_rearmed']}")
+    if verdict.get("bit_identical"):
+        lines.append("  BIT-IDENTICAL: every replayed stream matches "
+                     "the recorded checksum chains")
+    else:
+        d = verdict.get("first_divergence") or {}
+        lines.append(
+            f"  DIVERGED: {verdict.get('divergent_requests', '?')} "
+            f"request(s) differ — first divergence at uid="
+            f"{d.get('uid')} step={d.get('step')} "
+            f"({d.get('reason')}; expected chain "
+            f"{d.get('expected_chain')}, got {d.get('got_chain')})")
+    return "\n".join(lines)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="replay",
+        description="re-drive a fleet from a black-box journal and "
+                    "verify bit-identical token streams")
+    ap.add_argument("journal", help="path to a fleet journal file")
+    ap.add_argument("--mode", choices=("scheduled", "afap"),
+                    default="scheduled",
+                    help="replay admissions at recorded offsets "
+                         "(scheduled) or all at once (afap)")
+    ap.add_argument("--speed", type=float, default=1.0,
+                    help="time compression for scheduled mode "
+                         "(2.0 = replay at 2x)")
+    ap.add_argument("--perfetto", action="store_true",
+                    help="export the replayed run's merged trace next "
+                         "to the journal")
+    ap.add_argument("--no-warm", action="store_true",
+                    help="skip the per-replica compile warm-up probes")
+    ap.add_argument("--drain-timeout-s", type=float, default=180.0)
+    ap.add_argument("--json", action="store_true",
+                    help="print the verdict document instead of the "
+                         "report")
+    args = ap.parse_args(argv)
+
+    verdict = replay_journal(
+        args.journal, mode=args.mode, speed=args.speed,
+        perfetto=args.perfetto, warm=not args.no_warm,
+        drain_timeout_s=args.drain_timeout_s)
+    if args.json:
+        print(json.dumps(verdict, indent=2, default=str))
+    else:
+        print(divergence_report(verdict))
+    return 0 if verdict.get("bit_identical") else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -2138,6 +2138,307 @@ def run_obs_fleet() -> dict:
     }
 
 
+def _record_replay_arm(base_dir, journal_path, model_spec, engine_spec,
+                       prompts, arrivals, gen, knobs, fault_spec):
+    """Record arm of the replay bench: one chaos-fault pass of the
+    2-worker socket fleet with the fleet journal installed in THIS
+    (driver) process — so the router's ADMIT/ROUTE/EMIT ingress, the
+    supervisor's lifecycle acts and the injector's frame-level faults
+    all land in one journal, stamped with the config fingerprint and
+    the literal re-drive recipe ``tools/replay.py`` consumes."""
+    from deepspeed_tpu.observability.clocksync import wall_time
+    from deepspeed_tpu.observability.journal import (FleetJournal,
+                                                     config_fingerprint,
+                                                     reset_journal,
+                                                     set_journal)
+    from deepspeed_tpu.resilience.chaos import (ChaosInjector, ChaosSpec,
+                                                get_chaos_injector,
+                                                reset_chaos_injector,
+                                                set_chaos_injector)
+    from deepspeed_tpu.serving import FleetRouter, ReplicaSupervisor
+    from deepspeed_tpu.serving.replica import Submission
+
+    n = len(prompts)
+    n_rep = knobs["replicas"]
+    router_kw = dict(stale_after_s=knobs["stale_after_s"],
+                     affinity_blocks=0, routing="predictive",
+                     hedge_enabled=True, hedge_ttft_factor=3.0,
+                     hedge_min_s=1.0)
+    recipe = {"model": model_spec, "seed": knobs["seed"],
+              "engine": dict(engine_spec), "router": router_kw,
+              "eos_token_id": None,
+              "replicas": [{"replica_id": i, "role": "unified"}
+                           for i in range(n_rep)]}
+    jr = FleetJournal(journal_path, max_mb=64.0)
+    set_journal(jr)
+    jr.write_header(
+        config_fingerprint(model=model_spec, engine=engine_spec,
+                           router=router_kw, seed=knobs["seed"],
+                           fault=fault_spec),
+        replay=recipe, fault=fault_spec)
+
+    sup = ReplicaSupervisor(
+        os.path.join(base_dir, "record"), model=model_spec,
+        engine=dict(engine_spec), seed=knobs["seed"], min_healthy=1)
+    remotes = [sup.spawn(role="unified") for _ in range(n_rep)]
+    router = FleetRouter(remotes, **router_kw)
+    sup.router = router
+
+    # compile warm-up outside the recorded workload: direct probes
+    # (no router.submit, so nothing lands in the journal's admissions)
+    for j, r in enumerate(remotes):
+        r.submit(Submission(uid=1_000_000 + j, tokens=prompts[0],
+                            max_new_tokens=gen))
+    warm_deadline = time.time() + 180.0
+    while time.time() < warm_deadline and not all(
+            r.load_report().get("inflight", 0) == 0 for r in remotes):
+        sup.maintain()
+        router.check_health()
+        time.sleep(0.05)
+
+    if fault_spec:
+        # the replayer re-arms exactly this spec (CHAOS_SPEC note)
+        jr.note("CHAOS_SPEC", spec=fault_spec, rank=0)
+        set_chaos_injector(
+            ChaosInjector(ChaosSpec.parse(fault_spec), rank=0))
+    # rebase the journal clock to the workload start so ADMIT offsets
+    # encode the replayable arrival schedule, not spawn/warm-up time
+    jr.t0 = wall_time()
+    try:
+        t0 = time.perf_counter()
+        i = 0
+        last_maint = 0.0
+        while i < n:
+            now = time.perf_counter() - t0
+            if arrivals[i] <= now:
+                router.submit(i, prompts[i], max_new_tokens=gen)
+                i += 1
+                continue
+            if now - last_maint >= knobs["maintain_s"]:
+                sup.maintain()
+                router.check_health()
+                last_maint = now
+            time.sleep(min(max(arrivals[i] - now, 0.0), 0.01))
+        if fault_spec:
+            # bounded fault burst, same rationale as the chaos bench's
+            # corrupt arm: faults through the arrival window, clean
+            # wire for the drain. A fault armed FOREVER means each
+            # failover burst re-trips it, and on a loaded box the
+            # restart churn outruns the breaker window — that is a
+            # broken NIC, not a survivable incident. The journal gate
+            # certifies the capture of faults + recovery decisions and
+            # the replay's bit-identity, not a dead-wire verdict.
+            inj = get_chaos_injector()
+            if inj is not None:
+                jr.note("CHAOS_DISARM", stats=dict(inj.net_stats))
+            reset_chaos_injector()
+        deadline = time.time() + knobs["drain_timeout_s"]
+        while time.time() < deadline:
+            sup.maintain()
+            router.check_health()
+            if router.pending() == 0:
+                break
+            time.sleep(0.02)
+        wall = time.perf_counter() - t0
+    finally:
+        if fault_spec:
+            reset_chaos_injector()
+    sup.write_fleet_snapshot()  # serving_fleet/v3 with the journal block
+    results = router.results()
+    live_end = len(sup._live_ids())
+    sup.shutdown()
+    stats = jr.snapshot()
+    reset_journal()  # close + uninstall: the replay must not re-record
+
+    results = {uid: t for uid, t in results.items() if uid < n}
+    completed = sum(1 for t in results.values() if len(t) >= gen)
+    total_tokens = sum(len(t) for t in results.values())
+    acts = [a[1] for a in sup.actions]
+    return {
+        "requests": n,
+        "completed": completed,
+        "dropped": n - completed,
+        "wall_s": round(wall, 3),
+        "tokens_per_s": round(total_tokens / max(wall, 1e-9), 1),
+        "hedged": router.stats["hedged"],
+        "failed_over_requests": router.stats["failed_over_requests"],
+        "restarts": acts.count("restart"),
+        "quarantines": acts.count("quarantine"),
+        "live_at_end": live_end,
+        "journal": stats,
+    }
+
+
+def run_replay_fleet() -> dict:
+    """Fleet black-box certification (``BENCH_MODE=replay_fleet``,
+    ``make replay-fleet``): record one chaos-fault fleet arm into the
+    append-only journal (observability/journal.py), then (a) re-drive a
+    fresh in-process fleet from the journal alone (``tools/replay.py``,
+    scheduled-arrival mode) and require every replayed token stream
+    bit-identical to the recorded checksum chains; (b) corrupt exactly
+    one recorded chain link, replay again through the CLI path, and
+    require a nonzero exit naming the exact diverging uid + decode
+    step; (c) bound the recorder's cost — journal append overhead per
+    request and journal bytes per request.
+
+    Gates → bench_diff sentinels: ``replay.bit_identical``
+    (must_stay_true), ``replay.journal_overhead_us`` (max_ratio),
+    ``replay.journal_bytes_per_request`` (max_ratio).
+
+    Env knobs (CPU defaults in parens): REPLAY_FLEET_REQUESTS (6),
+    REPLAY_FLEET_PROMPT (32), REPLAY_FLEET_GEN (8), REPLAY_FLEET_RATE
+    (2.0/s), REPLAY_FLEET_PERIOD_S (4), REPLAY_FLEET_REPLICAS (2),
+    REPLAY_FLEET_STALE_S (1.0), REPLAY_FLEET_SEED (0),
+    REPLAY_FLEET_FAULT (drop | delay | dup | none | raw ChaosSpec
+    text), REPLAY_FLEET_MODE (scheduled | afap), REPLAY_FLEET_RUN_DIR
+    (/tmp/dstpu_replay_fleet), REPLAY_MAX_JOURNAL_US (2500),
+    REPLAY_MAX_JOURNAL_BYTES (8192),
+    REPLAY_FLEET_DRAIN_TIMEOUT_S (180)."""
+    import contextlib
+
+    import numpy as np
+
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    import replay as replay_tool
+
+    from deepspeed_tpu.observability.journal import (dump_journal,
+                                                     load_journal)
+
+    base_dir = os.environ.get("REPLAY_FLEET_RUN_DIR",
+                              "/tmp/dstpu_replay_fleet")
+    model_name = os.environ.get("REPLAY_FLEET_MODEL", "tiny")
+    n_req = int(os.environ.get("REPLAY_FLEET_REQUESTS", 6))
+    prompt_len = int(os.environ.get("REPLAY_FLEET_PROMPT", 32))
+    gen = int(os.environ.get("REPLAY_FLEET_GEN", 8))
+    rate = float(os.environ.get("REPLAY_FLEET_RATE", 2.0))
+    period_s = float(os.environ.get("REPLAY_FLEET_PERIOD_S", 4.0))
+    seed = int(os.environ.get("REPLAY_FLEET_SEED", 0))
+    mode = os.environ.get("REPLAY_FLEET_MODE", "scheduled")
+    fault = os.environ.get("REPLAY_FLEET_FAULT", "drop")
+    max_us = float(os.environ.get("REPLAY_MAX_JOURNAL_US", 2500.0))
+    max_bytes = float(os.environ.get("REPLAY_MAX_JOURNAL_BYTES",
+                                     8192.0))
+    # delay injects without recording (nothing to journal); drop is the
+    # default because every eaten frame lands as a CHAOS record
+    fault_specs = {"drop": "net_drop_frac=0.12,net_seed=7",
+                   "delay": "net_delay_ms=5", "dup": "net_dup=2",
+                   "none": ""}
+    fault_spec = fault_specs.get(fault, fault)
+    block = 8
+    blocks_per_seq = (prompt_len + gen) // block + 3
+    model_spec = {"name": model_name,
+                  "overrides": {"dtype": "float32",
+                                "param_dtype": "float32"}}
+    engine_spec = dict(
+        kv_blocks=blocks_per_seq * max(4, n_req) + 2,
+        kv_block_size=block, max_tokens_per_step=64,
+        max_seqs_per_step=8, max_blocks_per_seq=blocks_per_seq,
+        dtype="float32", request_trace={"sample_rate": 1.0})
+
+    rng = np.random.default_rng(seed)
+    vocab = 256
+    shared = rng.integers(0, vocab, (prompt_len * 3 // 4,))
+    prompts = []
+    for _ in range(n_req):
+        tail = rng.integers(0, vocab, (prompt_len - len(shared),))
+        prompts.append(np.concatenate([shared, tail]).astype(np.int32))
+    arrivals = _nhpp_arrivals(n_req, rate, period_s, 3.0, 0.2, rng)
+
+    knobs = {
+        "replicas": int(os.environ.get("REPLAY_FLEET_REPLICAS", 2)),
+        "stale_after_s": float(os.environ.get("REPLAY_FLEET_STALE_S",
+                                              1.0)),
+        "maintain_s": 0.05,
+        "drain_timeout_s": float(os.environ.get(
+            "REPLAY_FLEET_DRAIN_TIMEOUT_S", 180.0)),
+        "seed": seed,
+    }
+    os.makedirs(base_dir, exist_ok=True)
+    journal_path = os.path.join(base_dir, "fleet.journal")
+    record = _record_replay_arm(base_dir, journal_path, model_spec,
+                                engine_spec, prompts, arrivals, gen,
+                                knobs, fault_spec)
+
+    # (a) clean replay: fresh in-process fleet from the journal alone
+    with contextlib.redirect_stdout(sys.stderr):
+        verdict = replay_tool.replay_journal(
+            journal_path, mode=mode, perfetto=True,
+            drain_timeout_s=knobs["drain_timeout_s"])
+
+    # (b) corrupt one chain link mid-journal; the CLI replay must exit
+    # nonzero and name exactly that uid + decode step
+    records = load_journal(journal_path)
+    corrupt_path = os.path.join(base_dir, "fleet.corrupt.journal")
+    mut_uid = mut_step = None
+    for rec in records:
+        if rec.get("kind") == "EMIT" and rec.get("chain"):
+            rec["chain"][-1] = int(rec["chain"][-1]) ^ 0x5A5A5A
+            mut_uid = rec.get("uid")
+            mut_step = int(rec.get("start", 0)) + len(rec["chain"]) - 1
+            break
+    dump_journal(corrupt_path, records)
+    with contextlib.redirect_stdout(sys.stderr):
+        corrupt_rc = replay_tool.main(
+            [corrupt_path, "--mode", "afap", "--no-warm",
+             "--drain-timeout-s", str(knobs["drain_timeout_s"])])
+    try:
+        with open(corrupt_path + ".verdict.json") as f:
+            cd = json.load(f).get("first_divergence") or {}
+    except (OSError, ValueError):
+        cd = {}
+    corrupt_named = (corrupt_rc != 0
+                     and str(cd.get("uid")) == str(mut_uid)
+                     and cd.get("step") == mut_step)
+
+    overhead_us = record["journal"]["append_us_per_request"]
+    bytes_pr = record["journal"]["bytes_per_request"]
+    violations = []
+    if record["dropped"] > 0:
+        violations.append({"region": "record", "gate": "zero_drops",
+                           "limit": 0, "got": record["dropped"]})
+    if not verdict.get("bit_identical"):
+        violations.append({
+            "region": "replay", "gate": "bit_identical",
+            "limit": "replayed streams == recorded chains",
+            "got": verdict.get("first_divergence")})
+    if overhead_us > max_us:
+        violations.append({"region": "record",
+                           "gate": "journal_overhead_us",
+                           "limit": max_us, "got": overhead_us})
+    if bytes_pr > max_bytes:
+        violations.append({"region": "record",
+                           "gate": "journal_bytes_per_request",
+                           "limit": max_bytes, "got": bytes_pr})
+    if mut_uid is None or not corrupt_named:
+        violations.append({
+            "region": "corrupt", "gate": "divergence_named",
+            "limit": f"rc!=0 naming uid={mut_uid} step={mut_step}",
+            "got": {"rc": corrupt_rc, "first_divergence": cd}})
+
+    return {
+        "metric": f"{model_name} replay_fleet journal overhead "
+                  f"({n_req} req, {knobs['replicas']} worker procs, "
+                  f"fault={fault or 'none'}, {mode} replay)",
+        "value": overhead_us,
+        "unit": "us/request",
+        "replay.bit_identical": bool(verdict.get("bit_identical")),
+        "replay.journal_overhead_us": overhead_us,
+        "replay.journal_bytes_per_request": bytes_pr,
+        "replay.verified_tokens": verdict.get("verified_tokens"),
+        "replay.corrupt_detected": bool(corrupt_named),
+        "record": record,
+        "replay": {k: verdict.get(k) for k in
+                   ("bit_identical", "requests", "verified_tokens",
+                    "divergent_requests", "first_divergence", "mode",
+                    "chaos_rearmed", "wall_s", "perfetto")},
+        "corrupt": {"rc": corrupt_rc,
+                    "expected": {"uid": mut_uid, "step": mut_step},
+                    "first_divergence": cd},
+        "ok": not violations,
+        "violations": violations,
+    }
+
+
 if __name__ == "__main__":
     mode = os.environ.get("BENCH_MODE", "serve")
     if mode == "serve_fleet":
@@ -2157,6 +2458,11 @@ if __name__ == "__main__":
         _op = run_obs_fleet()
         print(json.dumps(_op))
         if not _op.get("ok", True):
+            raise SystemExit(1)
+    elif mode == "replay_fleet":
+        _rp = run_replay_fleet()
+        print(json.dumps(_rp))
+        if not _rp.get("ok", True):
             raise SystemExit(1)
     elif mode == "serve_quant":
         _qp = run_quant()

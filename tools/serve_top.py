@@ -17,11 +17,14 @@ tool is the read side — pure host code, no jax:
   python tools/serve_top.py --fleet SNAP.json           # fleet snapshot
   python tools/serve_top.py --fleet RUN_DIR             # cross-process run
   python tools/serve_top.py --fleet --demo              # 2-replica demo
+  python tools/serve_top.py --journal J                 # incident log
+  python tools/serve_top.py --replay-verdict V          # replay verdict
 
-``--fleet`` reads a ``serving_fleet/v2`` snapshot document
+``--fleet`` reads a ``serving_fleet/v3`` snapshot document
 (``FleetRouter.fleet_snapshot()``; ``make serve-fleet`` writes one per
-arm into FLEET_TRACE_DIR) — v1 documents from older runs still render,
-minus the health column — and prints the per-replica load-report table
+arm into FLEET_TRACE_DIR) — v1/v2 documents from older runs still
+render, minus newer columns — and prints the per-replica load-report
+table
 (including the PR 15 health state machine state and hedge counters),
 the router counters (handoffs, failovers, affinity hits, hedges), the
 autoscale state, the supervisor's restart/quarantine tallies, and the
@@ -34,6 +37,17 @@ The table decomposes each request's TTFT and e2e wall time into
 queue_wait / prefill / decode / preempted / spec_overhead phases and
 names the dominant phase of every missed request — the answer to "what
 do I fix first" (docs/serving.md "Request tracing & SLO attribution").
+
+``--journal`` reads a fleet black-box journal
+(observability/journal.py, recorded by any journaled router run or
+``make replay-fleet``) and prints the human-readable incident log —
+every admission, routing decision WITH its per-candidate scores,
+preemption/hedge/failover/autoscale/supervisor act with its triggering
+state, and chaos injection, on one wall-clock-offset timeline —
+followed by the per-request outcome table. ``--replay-verdict`` prints
+a ``tools/replay.py`` verdict (a ``*.verdict.json`` file, or a journal
+path whose verdict sits next to it) and exits nonzero on divergence
+(docs/observability.md "Fleet black box & incident replay").
 """
 
 from __future__ import annotations
@@ -79,6 +93,14 @@ def parse_args(argv=None):
                         "serve-procs) and print the per-replica fleet "
                         "view; with --demo, run a 2-replica in-process "
                         "fleet first")
+    p.add_argument("--journal", metavar="PATH",
+                   help="print the incident log + per-request outcome "
+                        "table from a fleet black-box journal "
+                        "(observability/journal.py)")
+    p.add_argument("--replay-verdict", metavar="PATH",
+                   help="print a tools/replay.py verdict (a "
+                        "*.verdict.json, or a journal path with one "
+                        "next to it); exits 1 on divergence")
     return p.parse_args(argv)
 
 
@@ -340,8 +362,63 @@ def _load_run_dir_snapshot(run_dir: str):
             "replicas": [reports[k] for k in sorted(reports)]}
 
 
+def _journal_report(path: str) -> str:
+    """Incident log + per-request outcome table from a black-box
+    journal: the decision timeline first (what the fleet did and what
+    state it saw when it did it), then one row per request with its
+    decision count and final outcome."""
+    from deepspeed_tpu.observability.journal import (load_journal,
+                                                     render_incident_log,
+                                                     request_outcomes)
+
+    records = load_journal(path)
+    if not records:
+        return f"serve_top: no complete journal records in {path}"
+    lines = list(render_incident_log(records)) + [""]
+    outcomes = request_outcomes(records)
+    if outcomes:
+        lines.append(f"{'uid':>8}  {'prompt':>6}  {'max_new':>7}  "
+                     f"{'arrival+s':>9}  {'emitted':>7}  "
+                     f"{'decisions':>9}  outcome")
+        for o in outcomes.values():
+            arr = o.get("arrival_offset_s")
+            lines.append(
+                f"{str(o['uid']):>8}  {o['prompt']:>6}  "
+                f"{o['max_new_tokens']:>7}  "
+                f"{(f'{arr:.3f}' if arr is not None else '-'):>9}  "
+                f"{o['emitted']:>7}  {len(o['decisions']):>9}  "
+                f"{o['outcome']}")
+    return "\n".join(lines)
+
+
+def _print_replay_verdict(path: str) -> int:
+    """Render a replay verdict document; accepts either the
+    ``*.verdict.json`` itself or the journal it sits next to."""
+    vpath = path
+    if not path.endswith(".verdict.json") and \
+            os.path.exists(path + ".verdict.json"):
+        vpath = path + ".verdict.json"
+    try:
+        with open(vpath) as f:
+            verdict = json.load(f)
+    except (OSError, ValueError) as e:
+        print(f"serve_top: cannot read replay verdict {vpath}: {e}",
+              file=sys.stderr)
+        return 2
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from replay import divergence_report
+
+    print(divergence_report(verdict))
+    return 0 if verdict.get("bit_identical") else 1
+
+
 def main(argv=None) -> int:
     args = parse_args(argv)
+    if args.journal:
+        print(_journal_report(args.journal))
+        return 0
+    if args.replay_verdict:
+        return _print_replay_verdict(args.replay_verdict)
     if args.fleet:
         if args.demo:
             return _run_fleet_demo()
@@ -359,9 +436,10 @@ def main(argv=None) -> int:
             with open(args.traces) as f:
                 snap = json.load(f)
         if snap.get("schema") not in ("serving_fleet/v1",
-                                      "serving_fleet/v2"):
+                                      "serving_fleet/v2",
+                                      "serving_fleet/v3"):
             print(f"serve_top: {args.traces} is not a serving_fleet "
-                  f"v1/v2 snapshot (schema={snap.get('schema')!r})",
+                  f"v1/v2/v3 snapshot (schema={snap.get('schema')!r})",
                   file=sys.stderr)
             return 1
         print(_fleet_table(snap))
