@@ -14,7 +14,7 @@ NPROC := $(shell nproc)
 XDIST ?= $(shell if [ $(NPROC) -gt 2 ] && python -c "import xdist" 2>/dev/null; then echo "-n $$(( $(NPROC) - 1 )) --dist loadfile"; fi)
 PYTEST ?= python -m pytest
 
-.PHONY: test smoke slow bench bench-real bench-proxy bench-hostgap bench-overlap bench-longctx bench-quant bench-kernels bench-diff quant-sweep fleet-demo chaos serve-slo serve-fleet serve-quant serve-tier serve-procs chaos-fleet obs-fleet replay-fleet
+.PHONY: test smoke slow bench bench-real bench-proxy bench-hostgap bench-overlap bench-longctx bench-quant bench-kernels bench-diff quant-sweep fleet-demo chaos serve-slo serve-fleet serve-quant serve-tier serve-procs chaos-fleet obs-fleet replay-fleet deploy-drill
 
 smoke:
 	$(PYTEST) tests/ -q -m "not slow" $(XDIST)
@@ -211,6 +211,22 @@ obs-fleet:
 # (docs/observability.md "Fleet black box & incident replay").
 replay-fleet:
 	BENCH_MODE=replay_fleet python bench.py
+
+# Zero-downtime operations certification (tools/serve_bench.py
+# run_deploy_drill): the diurnal-peak workload through a socket process
+# fleet while the whole playbook runs in ONE pass — a worker SIGKILLed
+# mid-request, a same-seed weight release rolled replica-by-replica
+# (live sessions migrate out WARM over the quantized wire before each
+# reload, A/B canary token parity gates each rejoin), an autoscale
+# swing up and back down (migration-backed drain), and a release with
+# deliberately corrupted canary chains whose parity gate must abort the
+# rollout and roll the replica back. Gated on zero dropped requests,
+# every stream bit-identical to a quiet reference fleet, bounded TTFT
+# p99.9 ratio, and >=1 warm migration (zero re-prefill). One JSON line
+# with drill.*/swap.*/migrate.* keys bench_diff sentinels consume
+# (docs/serving.md "Zero-downtime operations").
+deploy-drill:
+	BENCH_MODE=deploy_drill python bench.py
 
 # Fault-injection drill on the 8-device CPU sim: SIGKILL a training rank
 # mid-run, let the elastic agent restart it, and assert the auto-resumed

@@ -307,7 +307,8 @@ def main():
                                         "serve_fleet", "serve_quant",
                                         "serve_tier", "serve_procs",
                                         "chaos_fleet", "obs_fleet",
-                                        "replay_fleet"):
+                                        "replay_fleet",
+                                        "deploy_drill"):
         # serving benchmarks instead of the training headline
         # (tools/serve_bench.py): "serve" is the closed-loop v2-vs-v1
         # throughput comparison (SERVE_* env knobs); "serve_slo" is the
@@ -339,7 +340,12 @@ def main():
         # a chaos-fault arm into the append-only journal, re-drive a
         # fresh fleet from the journal alone and require bit-identical
         # token streams, bounded journal overhead, and a corrupted
-        # journal to be named by uid + decode step (REPLAY_* env knobs)
+        # journal to be named by uid + decode step (REPLAY_* env knobs);
+        # "deploy_drill" is the zero-downtime operations certification —
+        # a SIGKILL, a rolling weight swap (live sessions migrating out
+        # warm, canary parity gating each rejoin), an autoscale swing,
+        # and a corrupted-canary abort, all during the diurnal peak,
+        # gated on zero drops + bit-identical streams (DRILL_* knobs)
         import sys
 
         sys.path.insert(0, os.path.join(os.path.dirname(
@@ -383,6 +389,12 @@ def main():
             if not replay_payload.get("ok", True):
                 sys.exit(1)  # gates: bit-identical replay, journal
                 #             overhead/bytes, corrupt-journal naming
+        elif os.environ.get("BENCH_MODE") == "deploy_drill":
+            drill_payload = serve_bench.run_deploy_drill()
+            print(json.dumps(drill_payload))
+            if not drill_payload.get("ok", True):
+                sys.exit(1)  # gates: zero drops, bit-identical, warm
+                #             migration, swap parity + abort path
         else:
             print(json.dumps(serve_bench.run()))
         return

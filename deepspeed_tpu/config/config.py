@@ -811,7 +811,19 @@ class RouterConfig(ConfigModel):
     Crash-loop containment (serving/supervisor.py): a lineage crashing
     more than ``max_restarts_per_window`` times inside
     ``restart_window_seconds`` is quarantined instead of restarted;
-    ``min_healthy`` is the floor below which drains are refused."""
+    ``min_healthy`` is the floor below which drains are refused.
+
+    Live session migration (docs/serving.md "Zero-downtime
+    operations"): with ``migrate_sessions`` (the default), drains,
+    rolling weight swaps, and migration-backed scale-down move every
+    in-flight decode session off the leaving replica *warm* — KV
+    blocks + generated tokens + spec EWMA over the quantized wire,
+    zero re-prefill — degrading to host-tier page-out then legacy
+    fold-and-recompute, never an error. ``migrate_hedges`` extends
+    migrate-first to hedge promotion (off keeps the duplicate-stream
+    hedge race bit-exact); ``migrate_wire`` overrides the session wire
+    codec (empty = the engine's ``handoff_wire``; else raw/int8/int4/
+    fp8)."""
 
     replicas: int = 2
     mode: str = "unified"
@@ -840,6 +852,9 @@ class RouterConfig(ConfigModel):
     max_restarts_per_window: int = 3
     restart_window_seconds: float = 30.0
     min_healthy: int = 1
+    migrate_sessions: bool = True
+    migrate_hedges: bool = False
+    migrate_wire: str = ""  # "" => the engine's handoff_wire
     burn_rate: BurnRateConfig = field(default_factory=BurnRateConfig)
 
     def connect_retry_policy(self):
@@ -940,6 +955,12 @@ class RouterConfig(ConfigModel):
             raise ValueError(
                 f"serving.router.min_healthy must be >= 1, got "
                 f"{self.min_healthy}")
+        if self.migrate_wire not in ("", "auto", "raw", "int8", "int4",
+                                     "fp8"):
+            raise ValueError(
+                f"serving.router.migrate_wire must be empty (engine "
+                f"default) or one of auto/raw/int8/int4/fp8, got "
+                f"{self.migrate_wire!r}")
         self.burn_rate.validate()
 
 
@@ -973,8 +994,10 @@ class ServingConfig(ConfigModel):
     today's bf16 pool bit-exactly — the quantized pytree never enters
     the traced program. ``handoff_wire`` picks the disaggregated-prefill
     KV handoff codec: "auto" ships the pool's native format, "raw"
-    forces full precision, "int8"/"int4" quantize bf16 pools for the
-    wire (int4 packs two values per byte; dequantized on install).
+    forces full precision, "int8"/"int4"/"fp8" quantize bf16 pools for
+    the wire (int4 packs two values per byte, fp8 ships native e4m3
+    payloads + per-vector scales with no bf16 round-trip; both
+    converted pool-native on install).
 
     ``host_kv_tier`` attaches a ``host_tier_mb``-byte host-memory tier
     below the HBM pool (ragged/kv_tier.py): KV pressure PAGES cold
@@ -1015,10 +1038,11 @@ class ServingConfig(ConfigModel):
             raise ValueError(
                 f"serving.kv_quant_bits must be null, 4, 8 or \"fp8\", "
                 f"got {self.kv_quant_bits}")
-        if self.handoff_wire not in ("auto", "raw", "int8", "int4"):
+        if self.handoff_wire not in ("auto", "raw", "int8", "int4",
+                                     "fp8"):
             raise ValueError(
                 f"serving.handoff_wire must be one of auto/raw/int8/"
-                f"int4, got {self.handoff_wire!r}")
+                f"int4/fp8, got {self.handoff_wire!r}")
         if not (0.0 < self.spec_accept_alpha <= 1.0):
             raise ValueError(
                 f"serving.spec_accept_alpha must be in (0, 1], got "

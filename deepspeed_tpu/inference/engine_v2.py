@@ -149,6 +149,10 @@ class InferenceEngineV2:
                                    quantize_weights=quantize_weights)
         self.model, self.cfg = model, model.config
         self.mesh, self.params = self._v1.mesh, self._v1.params
+        # kept for reload_params: a hot-swap routes replacement weights
+        # through the same v1 placement/quantization path as boot
+        self._param_dtype = dtype
+        self._quantize_weights = quantize_weights
 
         kv_cfg = KVCacheConfig(
             num_layers=self.cfg.num_layers, kv_heads=self.cfg.kv_heads,
@@ -223,7 +227,14 @@ class InferenceEngineV2:
                       "spec_steps": 0, "spec_proposed": 0,
                       "spec_accepted": 0, "spec_backoff_rounds": 0,
                       "paged_out": 0, "paged_in": 0,
-                      "warm_resume_tokens": 0}
+                      "warm_resume_tokens": 0,
+                      # live-migration ladder (serving/disagg.py
+                      # serialize_session/install_session): warm resume /
+                      # parked-in-tier / folded-recompute install rungs,
+                      # plus the source-side captures
+                      "migrated_out": 0, "migrated_in": 0,
+                      "migrate_paged": 0, "migrate_recompute": 0,
+                      "migrate_resume_tokens": 0}
         # admission queue: put() never raises on a full KV pool — requests
         # wait FIFO here and admit as blocks free up; preemption victims
         # requeue at the FRONT with their generated tokens preserved
@@ -539,7 +550,8 @@ class InferenceEngineV2:
             max_new_tokens=seq.max_new_tokens,
             prior_generated=seq.prior_generated,
             payload=payload, scales=scales,
-            admit_time=self._admit_time.get(seq.uid))
+            admit_time=self._admit_time.get(seq.uid),
+            spec_accept_ewma=self._seq_accept_ewma.get(seq.uid))
         if not tier.put_session(sess):
             return False
         self.tracer.on_preempt(seq.uid, reason=reason,
@@ -599,6 +611,8 @@ class InferenceEngineV2:
         seq.kv_blocks = np.asarray(blocks, np.int64)
         self.kv_cache.write_blocks(blocks, sess.payload, sess.scales)
         seq.resumed_from_tier = keep
+        if sess.spec_accept_ewma is not None:
+            self._seq_accept_ewma[sess.uid] = float(sess.spec_accept_ewma)
         self.stats["paged_in"] += 1
         self.stats["admitted"] += 1
         self.stats["warm_resume_tokens"] += sess.seen_tokens
@@ -623,6 +637,188 @@ class InferenceEngineV2:
         if seq is None or seq.done:
             return False
         return self._page_out(seq, reason="explicit_page_out")
+
+    # -- live session migration (serving/disagg.py owns the wire codec) --
+
+    def migrate_out_session(self, uid: int) -> Optional[Dict[str, Any]]:
+        """Destructively capture a mid-stream session for live migration:
+        the committed KV blocks (partial tail block included, pool-native
+        format), the descriptor state that rebuilds the sequence on the
+        target, and the per-request spec-acceptance EWMA. The sequence is
+        RELEASED here — the caller owns shipping the capture (or falling
+        back to recompute on the target if the wire fails).
+
+        A session already parked in the host tier migrates warm straight
+        from host memory. Returns None when there is nothing warm to
+        capture (unknown uid, mid-prefill, queued-but-never-admitted):
+        the caller degrades to the legacy fold-and-resubmit path."""
+        tier = getattr(self.kv_cache, "host_tier", None)
+        seq = self.state.seqs.get(uid)
+        if seq is None or seq.done:
+            sess = tier.pop_session(uid) if tier is not None else None
+            if sess is None:
+                return None
+            # drop the paged queue entry: ownership moves with the bytes
+            if any(r.uid == uid for r in self._queue):
+                self._queue = deque(r for r in self._queue
+                                    if r.uid != uid)
+            self._seq_accept_ewma.pop(uid, None)
+            self.tracer.on_finish(uid, "migrated")
+            self.stats["migrated_out"] += 1
+            self._hub.counter_add("serve.migrated_out",
+                                  labels=self._metric_labels)
+            return {"uid": int(uid),
+                    "input_tokens": np.asarray(sess.input_tokens, np.int32),
+                    "generated": list(sess.generated),
+                    "seen_tokens": int(sess.seen_tokens),
+                    "max_new_tokens": int(sess.max_new_tokens),
+                    "prior_generated": int(sess.prior_generated),
+                    "payload": sess.payload, "scales": sess.scales,
+                    "spec_accept_ewma": sess.spec_accept_ewma}
+        if seq.pending_prefill or seq.seen_tokens <= 0:
+            return None
+        # trim to the blocks holding real KV (same rule as _page_out):
+        # rejected speculative drafts leave garbage past the frontier
+        keep = self.kv_cache.blocks_needed(seq.seen_tokens)
+        if keep <= 0 or keep > len(seq.kv_blocks):
+            return None
+        payload, scales = self.kv_cache.read_blocks_host(
+            np.asarray(seq.kv_blocks[:keep], np.int64))
+        cap = {"uid": int(uid),
+               "input_tokens": np.asarray(seq.input_tokens, np.int32),
+               "generated": list(seq.generated),
+               "seen_tokens": int(seq.seen_tokens),
+               "max_new_tokens": int(seq.max_new_tokens),
+               "prior_generated": int(seq.prior_generated),
+               "payload": payload, "scales": scales,
+               "spec_accept_ewma": self._seq_accept_ewma.get(uid)}
+        self.tracer.on_finish(uid, "migrated")
+        self._release_seq(uid)
+        self.stats["migrated_out"] += 1
+        self._hub.counter_add("serve.migrated_out",
+                              labels=self._metric_labels)
+        return cap
+
+    def install_migrated_session(self, sess) -> str:
+        """Install a migrated session whose ``payload`` is already in
+        THIS pool's native storage format (serving/disagg.py
+        install_session owns the wire→pool conversion). Walks the
+        degradation ladder and NEVER raises:
+
+        * ``"resumed"``    — blocks written, decode continues warm with
+          zero re-prefill FLOPs;
+        * ``"paged"``      — no HBM room right now: parked in the host
+          tier + queued ``paged`` (still warm — readmission restores the
+          blocks via the ordinary ``_try_page_in`` path);
+        * ``"recompute"``  — no payload / no tier room: the folded token
+          history queues for ordinary prefix-recompute admission;
+        * ``"duplicate"``  — uid already live or queued here (a raced
+          failover already owns it): installed nothing;
+        * ``"truncated"``  — the folded history can never fit this
+          engine (per-seq cap): counted and closed, mirroring
+          ``_requeue``'s cap-truncation contract.
+        """
+        uid = int(sess.uid)
+        if uid in self.state.seqs or any(r.uid == uid for r in self._queue):
+            return "duplicate"
+        tier = getattr(self.kv_cache, "host_tier", None)
+        n = 0 if sess.payload is None else sess.n_blocks
+        fold = np.concatenate(
+            [np.asarray(sess.input_tokens, np.int32),
+             np.asarray(sess.generated, np.int32)])
+        prior = int(sess.prior_generated) + len(sess.generated)
+        now = time.perf_counter()
+        if (n > 0 and n <= self.max_blocks_per_seq
+                and len(self.state.seqs) < self.max_seqs
+                and len(self.state.seqs) < self.state.max_tracked_sequences):
+            if n > self.kv_cache.free_blocks:
+                self.kv_cache.reclaim(n - self.kv_cache.free_blocks)
+            if n <= self.kv_cache.free_blocks:
+                seq = self.state.get_or_create(
+                    uid, np.asarray(sess.input_tokens, np.int32),
+                    sess.max_new_tokens)
+                seq.generated = list(sess.generated)
+                seq.prior_generated = int(sess.prior_generated)
+                seq.seen_tokens = int(sess.seen_tokens)
+                blocks = self.kv_cache.allocator.allocate(n)
+                seq.kv_blocks = np.asarray(blocks, np.int64)
+                self.kv_cache.write_blocks(blocks, sess.payload,
+                                           sess.scales)
+                seq.resumed_from_tier = n
+                if sess.spec_accept_ewma is not None:
+                    self._seq_accept_ewma[uid] = float(
+                        sess.spec_accept_ewma)
+                self.tracer.on_enqueue(uid, len(fold),
+                                       queue_depth=len(self._queue))
+                self.tracer.on_admit(uid, wait_s=0.0, requeued=True)
+                self.stats["migrated_in"] += 1
+                self.stats["admitted"] += 1
+                self.stats["migrate_resume_tokens"] += int(
+                    sess.seen_tokens)
+                self._hub.counter_add("serve.migrated_in",
+                                      labels=self._metric_labels)
+                self._hub.counter_add("serve.warm_resume_tokens",
+                                      int(sess.seen_tokens),
+                                      labels=self._metric_labels)
+                return "resumed"
+        if (n > 0 and tier is not None and n <= self.max_blocks_per_seq
+                and tier.put_session(sess)):
+            # target HBM is full RIGHT NOW: park the warm bytes in the
+            # host tier — readmission warm-resumes with zero re-prefill
+            self._queue.append(_QueuedRequest(
+                uid=uid, tokens=fold,
+                max_new_tokens=int(sess.max_new_tokens),
+                enqueue_time=now, prior_generated=prior,
+                requeued=True, paged=True))
+            self.tracer.on_enqueue(uid, len(fold),
+                                   queue_depth=len(self._queue))
+            self.stats["migrate_paged"] += 1
+            self.stats["queued"] += 1
+            self._hub.counter_add("serve.migrate_paged",
+                                  labels=self._metric_labels)
+            self._admit_from_queue()
+            return "paged"
+        blocks_needed = self.kv_cache.blocks_needed(len(fold) + 1)
+        if (blocks_needed > self.max_blocks_per_seq
+                or blocks_needed > self.kv_cache.allocator.total_blocks):
+            # can never fit this engine: close it loudly (the same
+            # contract as _requeue's per-seq-cap truncation) instead of
+            # wedging the admission queue head forever
+            self.stats["truncated"] += 1
+            self.tracer.on_finish(uid, "truncated")
+            return "truncated"
+        self._queue.append(_QueuedRequest(
+            uid=uid, tokens=fold, max_new_tokens=int(sess.max_new_tokens),
+            enqueue_time=now, prior_generated=prior, requeued=True))
+        self.tracer.on_enqueue(uid, len(fold),
+                               queue_depth=len(self._queue))
+        self.stats["migrate_recompute"] += 1
+        self.stats["queued"] += 1
+        self._hub.counter_add("serve.migrate_recompute",
+                              labels=self._metric_labels)
+        self._admit_from_queue()
+        return "recompute"
+
+    def reload_params(self, params: Optional[Dict[str, Any]] = None,
+                      seed: Optional[int] = None) -> None:
+        """Hot-swap the serving weights in place. Replacement params
+        route through the same v1 placement/quantization path as boot
+        (``params=None`` re-derives them from ``model.init(seed)``).
+        Every compiled step program takes params as an ARGUMENT, not a
+        capture, so the swap costs zero recompilation and the next step
+        serves the new weights — live KV blocks stay valid only if the
+        caller quiesced the engine first (supervisor.rolling_swap drains
+        and migrates sessions out before calling this)."""
+        from deepspeed_tpu.inference.engine import InferenceEngine
+
+        if params is None:
+            params = self.model.init(
+                jax.random.PRNGKey(int(seed or 0)))
+        self._v1 = InferenceEngine(
+            self.model, mesh=self.mesh, params=params,
+            dtype=self._param_dtype,
+            quantize_weights=self._quantize_weights)
+        self.params = self._v1.params
 
     def holds_prefix_blocks(self, tokens) -> int:
         """How many full prefix blocks of ``tokens`` this engine can

@@ -60,6 +60,10 @@ class PagedSession:
     payload: np.ndarray               # [L, n_blocks, bs, 2, H, W]
     scales: Optional[np.ndarray]      # [L, n_blocks, bs, 2, H] | None
     admit_time: Optional[float] = None  # pending-TTFT stamp, if any
+    # per-request spec-decode acceptance EWMA: the adaptive-k controller's
+    # learned signal survives page-out AND live migration — a resumed
+    # session speculates at its measured rate instead of cold-starting
+    spec_accept_ewma: Optional[float] = None
 
     @property
     def n_blocks(self) -> int:

@@ -74,7 +74,13 @@ def _framing():
 # accepts any kind string — this list is documentation plus the
 # incident-log ordering, not an allowlist.
 DECISION_KINDS = ("ROUTE", "PREEMPT", "PAGE_OUT", "HEDGE", "FAILOVER",
-                  "AUTOSCALE", "SUPERVISOR")
+                  "AUTOSCALE", "SUPERVISOR",
+                  # zero-downtime ops (ISSUE 20): live session
+                  # migration, rolling weight hot-swap stages (manifest
+                  # / quiesce / reload / parity / done), and
+                  # supervisor-acted scale decisions (desired vs
+                  # actual) — each carries the inputs that drove it
+                  "MIGRATE", "SWAP", "SCALE")
 
 
 def token_chain(prev: int, token: int) -> int:
@@ -567,6 +573,26 @@ def render_incident_log(records: Sequence[Dict[str, Any]],
         elif kind == "CHAOS":
             lines.append(f"{off}  CHAOS     fault={rec.get('fault')} "
                          + _fmt_fields(rec, ("fault",)))
+        elif kind == "MIGRATE":
+            lines.append(
+                f"{off}  MIGRATE   uid={rec.get('uid')} "
+                f"r{rec.get('from_replica')}->r{rec.get('to_replica')} "
+                f"rung={rec.get('rung')} "
+                + _fmt_fields(rec, ("uid", "from_replica",
+                                    "to_replica", "rung")))
+        elif kind == "SWAP":
+            lines.append(
+                f"{off}  SWAP      tag={rec.get('tag')} "
+                f"r{rec.get('replica')} stage={rec.get('stage')} "
+                f"ok={rec.get('ok')} "
+                + _fmt_fields(rec, ("tag", "replica", "stage", "ok")))
+        elif kind == "SCALE":
+            lines.append(
+                f"{off}  SCALE     {rec.get('action')} "
+                f"r{rec.get('replica')} desired={rec.get('desired')} "
+                f"live={rec.get('live')} "
+                + _fmt_fields(rec, ("action", "replica", "desired",
+                                    "live")))
         else:
             lines.append(f"{off}  {kind:<9} " + _fmt_fields(rec, ()))
     return lines

@@ -20,8 +20,11 @@ See docs/serving.md "Multi-replica fleet" and "Cross-process fleet".
 """
 
 from deepspeed_tpu.serving.autoscale import AutoscaleSignal
-from deepspeed_tpu.serving.disagg import (KVHandoff, install_prefix,
-                                          serialize_prefix)
+from deepspeed_tpu.serving.disagg import (KVHandoff, SessionHandoff,
+                                          install_prefix,
+                                          install_session,
+                                          serialize_prefix,
+                                          serialize_session)
 from deepspeed_tpu.serving.replica import ServingReplica, Submission
 from deepspeed_tpu.serving.router import FleetRouter, build_fleet
 from deepspeed_tpu.serving.supervisor import (RemoteReplica,
@@ -29,5 +32,6 @@ from deepspeed_tpu.serving.supervisor import (RemoteReplica,
 
 __all__ = ["AutoscaleSignal", "FleetRouter", "KVHandoff",
            "RemoteReplica", "ReplicaSupervisor", "ServingReplica",
-           "Submission", "build_fleet", "install_prefix",
-           "serialize_prefix"]
+           "SessionHandoff", "Submission", "build_fleet",
+           "install_prefix", "install_session", "serialize_prefix",
+           "serialize_session"]

@@ -129,15 +129,29 @@ class AutoscaleSignal:
                             "hysteresis_rounds": self.hysteresis_rounds})
 
     def record_action(self, action: str, replica_id: int,
-                      now: Optional[float] = None) -> None:
+                      now: Optional[float] = None,
+                      live: Optional[int] = None,
+                      **fields: Any) -> None:
         """Log an *act* on the signal into the decision history — the
         process supervisor is the first in-repo controller that actually
         provisions (spawn/drain/restart), and its acts belong on the
         same timeline as the desires that caused them. Action entries
         are ``(ts, desired, "action:rN")`` 3-tuples next to the
-        ``(ts, desired)`` decision 2-tuples."""
+        ``(ts, desired)`` decision 2-tuples.
+
+        Provisioning acts (spawn/drain) additionally journal a SCALE
+        decision carrying desired-vs-actual and whatever the caller
+        measured (e.g. how many sessions migrated out of a drained
+        victim) — the forensics record ``serve_top --journal`` renders
+        and ``tools/replay.py`` replays."""
         now = wall_time() if now is None else now
         self.history.append((now, self.desired, f"{action}:r{replica_id}"))
+        if action in ("spawn", "drain"):
+            jr = get_journal()
+            if jr is not None:
+                jr.decision("SCALE", ts=now, action=action,
+                            replica=replica_id, desired=self.desired,
+                            live=live, **fields)
 
     def snapshot(self) -> Dict[str, Any]:
         return {

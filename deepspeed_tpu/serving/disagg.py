@@ -45,7 +45,10 @@ import numpy as np
 #   int8 — bf16 pools quantize per head vector for the wire (~0.53x)
 #   int4 — as int8, then two nibbles pack per byte (~0.28x — the
 #          <=0.35x-of-bf16 acceptance mode)
-WIRE_MODES = ("auto", "raw", "int8", "int4")
+#   fp8  — e4m3 payload + per-vector scales (~0.53x, the quality
+#          midpoint between int8 and int4) shipped NATIVELY — no bf16
+#          round trip; matches the PR 17 fp8 KV pool rung
+WIRE_MODES = ("auto", "raw", "int8", "int4", "fp8")
 
 
 @dataclasses.dataclass
@@ -144,6 +147,92 @@ def _record_wire(engine, handoff: KVHandoff, where: str) -> None:
         quant_stats.publish([st], hub=hub)
 
 
+def _wire_quantize(data: np.ndarray, scales: Optional[np.ndarray],
+                   src_bits, wire: str):
+    """Wire-side quantization for bf16 pools: convert ``data`` (+
+    ``scales``) to the requested wire codec. A quantized pool ships its
+    native payload untouched (its bf16 original no longer exists), so
+    the conversion applies only when ``src_bits`` is None. Returns
+    ``(data, scales, wire_bits, packed, wire_snr_db)`` — the SNR is
+    measured HERE, the one place the full-precision original and the
+    wire payload coexist."""
+    # an int4 pool's native payload is already nibble-packed — mark it
+    # so head_dim geometry and the installer's unpack stay correct
+    wire_bits, packed, wire_snr = src_bits, src_bits == 4, None
+    if src_bits is None and wire in ("int8", "int4", "fp8"):
+        import jax.numpy as jnp
+
+        from deepspeed_tpu.ops.pallas.quantization import (kv_dequantize,
+                                                           kv_quantize,
+                                                           pack_int4)
+
+        bits = {"int8": 8, "int4": 4, "fp8": "fp8"}[wire]
+        if bits == 4 and data.shape[-1] % 2:
+            bits = 8  # nibble packing needs an even head_dim
+        q, s = kv_quantize(jnp.asarray(data), bits=bits)
+        err = (np.asarray(kv_dequantize(q, s, dtype=jnp.float32),
+                          np.float32) - np.asarray(data, np.float32))
+        sig = float(np.sum(np.asarray(data, np.float32) ** 2))
+        noise = float(np.sum(err ** 2))
+        wire_snr = (float("inf") if noise == 0.0
+                    else 10.0 * float(np.log10(max(sig, 1e-30) / noise)))
+        if bits == 4:
+            q = pack_int4(q)
+            packed = True
+        data, scales, wire_bits = np.asarray(q), np.asarray(s), bits
+    return data, scales, wire_bits, packed, wire_snr
+
+
+def _pool_convert(kvc, payload, ssel, wire_bits, packed: bool):
+    """Convert a wire payload (+ scales) into ``kvc``'s pool-native
+    storage: the install-side half of the codec, shared by
+    ``install_prefix`` and ``install_session``. ``payload``/``ssel``
+    are jnp arrays (scales fp32 or None); returns ``(q, s)`` with ``q``
+    in the pool dtype (nibble-packed when the pool is int4) and ``s``
+    the fp32 scales or None for a bf16 pool."""
+    import jax.numpy as jnp
+
+    from deepspeed_tpu.ops.pallas.quantization import (kv_dequantize,
+                                                       kv_quantize,
+                                                       pack_int4,
+                                                       unpack_int4)
+
+    dst_bits = getattr(kvc, "quant_bits", None)
+    if packed:
+        payload = unpack_int4(payload)
+    if dst_bits is not None:
+        if wire_bits is None:
+            # raw bf16 wire into a quantized pool: quantize-on-install
+            q, s = kv_quantize(payload, bits=dst_bits)
+        elif wire_bits == dst_bits:
+            # wire already in the pool's own format: install directly
+            q = payload if dst_bits == "fp8" else payload.astype(jnp.int8)
+            s = ssel
+        elif dst_bits == "fp8" or wire_bits == "fp8":
+            # int<->fp8: the stored codes don't reinterpret (int grids
+            # are scale*code on an integer lattice, e4m3 is a float
+            # format), so round-trip through f32 onto the destination's
+            # grid (the precision-mismatch warn above fired)
+            q, s = kv_quantize(
+                kv_dequantize(payload, ssel, dtype=jnp.float32),
+                bits=dst_bits)
+        elif dst_bits == 4 and wire_bits == 8:
+            # int8 wire values overflow the int4 grid: requantize on the
+            # coarser grid (the precision-mismatch warn above fired)
+            q, s = kv_quantize(
+                kv_dequantize(payload, ssel, dtype=jnp.float32), bits=4)
+        else:
+            # int4 values install into an int8 pool directly — dequant
+            # is q*s either way, just on a coarser grid
+            q, s = payload.astype(jnp.int8), ssel
+        if dst_bits == 4:
+            q = pack_int4(q.astype(jnp.int8))
+        return q.astype(kvc.data.dtype), s
+    if wire_bits is None:
+        return payload.astype(kvc.data.dtype), None
+    return kv_dequantize(payload, ssel, dtype=kvc.data.dtype), None
+
+
 def serialize_prefix(engine, tokens,
                      max_blocks: Optional[int] = None,
                      wire: Optional[str] = None
@@ -186,32 +275,8 @@ def serialize_prefix(engine, tokens,
                   if getattr(kvc, "scales", None) is not None else None)
     finally:
         cache.unref(keys)
-    # an int4 pool's native payload is already nibble-packed — mark it
-    # so head_dim geometry and the installer's unpack stay correct
-    wire_bits, packed, wire_snr = src_bits, src_bits == 4, None
-    if src_bits is None and wire in ("int8", "int4"):
-        import jax.numpy as jnp
-
-        from deepspeed_tpu.ops.pallas.quantization import (kv_dequantize,
-                                                           kv_quantize,
-                                                           pack_int4)
-
-        bits = 8 if wire == "int8" else 4
-        if bits == 4 and data.shape[-1] % 2:
-            bits = 8  # nibble packing needs an even head_dim
-        q, s = kv_quantize(jnp.asarray(data), bits=bits)
-        # this is the one place both the bf16 original and the wire
-        # payload coexist — measure the wire SNR here, report later
-        err = (np.asarray(kv_dequantize(q, s, dtype=jnp.float32),
-                          np.float32) - np.asarray(data, np.float32))
-        sig = float(np.sum(np.asarray(data, np.float32) ** 2))
-        noise = float(np.sum(err ** 2))
-        wire_snr = (float("inf") if noise == 0.0
-                    else 10.0 * float(np.log10(max(sig, 1e-30) / noise)))
-        if bits == 4:
-            q = pack_int4(q)
-            packed = True
-        data, scales, wire_bits = np.asarray(q), np.asarray(s), bits
+    data, scales, wire_bits, packed, wire_snr = _wire_quantize(
+        data, scales, src_bits, wire)
     handoff = KVHandoff(keys=keys, block_data=data,
                         block_size=cache.block_size, scales=scales,
                         wire_bits=wire_bits, packed=packed,
@@ -274,54 +339,16 @@ def install_prefix(engine, handoff: Optional[KVHandoff]
 
     import jax.numpy as jnp
 
-    from deepspeed_tpu.ops.pallas.quantization import (kv_dequantize,
-                                                       kv_quantize,
-                                                       pack_int4,
-                                                       unpack_int4)
-
     blocks = kvc.allocator.allocate(need)
     bidx = jnp.asarray(blocks)
     sel = handoff.block_data[:, to_install]
     ssel = (None if handoff.scales is None
             else jnp.asarray(handoff.scales[:, to_install], jnp.float32))
-    payload = jnp.asarray(sel)
-    if handoff.packed:
-        payload = unpack_int4(payload)
-    if dst_bits is not None:
-        if handoff.wire_bits is None:
-            # raw bf16 wire into a quantized pool: quantize-on-install
-            q, s = kv_quantize(payload, bits=dst_bits)
-        elif handoff.wire_bits == dst_bits:
-            # wire already in the pool's own format: install directly
-            q = payload if dst_bits == "fp8" else payload.astype(jnp.int8)
-            s = ssel
-        elif dst_bits == "fp8" or handoff.wire_bits == "fp8":
-            # int<->fp8: the stored codes don't reinterpret (int grids
-            # are scale*code on an integer lattice, e4m3 is a float
-            # format), so round-trip through f32 onto the destination's
-            # grid (the precision-mismatch warn above fired)
-            q, s = kv_quantize(
-                kv_dequantize(payload, ssel, dtype=jnp.float32),
-                bits=dst_bits)
-        elif dst_bits == 4 and handoff.wire_bits == 8:
-            # int8 wire values overflow the int4 grid: requantize on the
-            # coarser grid (the precision-mismatch warn above fired)
-            q, s = kv_quantize(
-                kv_dequantize(payload, ssel, dtype=jnp.float32), bits=4)
-        else:
-            # int4 values install into an int8 pool directly — dequant
-            # is q*s either way, just on a coarser grid
-            q, s = payload.astype(jnp.int8), ssel
-        if dst_bits == 4:
-            q = pack_int4(q.astype(jnp.int8))
-        kvc.data = kvc.data.at[:, bidx].set(q.astype(kvc.data.dtype))
+    q, s = _pool_convert(kvc, jnp.asarray(sel), ssel,
+                         handoff.wire_bits, handoff.packed)
+    kvc.data = kvc.data.at[:, bidx].set(q)
+    if s is not None:
         kvc.scales = kvc.scales.at[:, bidx].set(s)
-    else:
-        if handoff.wire_bits is None:
-            src = payload.astype(kvc.data.dtype)
-        else:
-            src = kv_dequantize(payload, ssel, dtype=kvc.data.dtype)
-        kvc.data = kvc.data.at[:, bidx].set(src)
     installed: List[str] = []
     for idx, blk in zip(to_install, blocks):
         if cache.register(handoff.keys[idx], int(blk)):
@@ -341,3 +368,153 @@ def install_prefix(engine, handoff: Optional[KVHandoff]
     if installed:
         _record_wire(engine, handoff, "install")
     return (len(installed), handoff.n_tokens)
+
+
+# -- live session migration (ISSUE 20) -----------------------------------
+
+
+@dataclasses.dataclass
+class SessionHandoff:
+    """A full mid-stream decode session on the wire: the committed KV
+    blocks (partial tail block included) in the same codec as
+    :class:`KVHandoff`, plus the descriptor state that resumes decode on
+    the target — generated tokens, budgets, and the per-request
+    spec-acceptance EWMA. Unlike a prefix handoff there is no chain-key
+    addressing: the blocks belong to ONE sequence and install by block
+    write, not cache registration."""
+
+    uid: int
+    input_tokens: np.ndarray
+    generated: List[int]
+    seen_tokens: int
+    max_new_tokens: int
+    prior_generated: int
+    block_data: np.ndarray            # [L, n_blocks, bs, 2, H, W]
+    block_size: int
+    scales: Optional[np.ndarray] = None
+    wire_bits: Optional[Any] = None   # None = full precision; 4/8/"fp8"
+    packed: bool = False              # int4 nibble packing along head_dim
+    src_quant_bits: Optional[Any] = None
+    wire_snr_db: Optional[float] = None
+    spec_accept_ewma: Optional[float] = None
+
+    @property
+    def n_blocks(self) -> int:
+        return int(self.block_data.shape[1])
+
+    @property
+    def head_dim(self) -> int:
+        hd = self.block_data.shape[-1]
+        return hd * 2 if self.packed else hd
+
+    @property
+    def wire_nbytes(self) -> int:
+        n = int(self.block_data.nbytes)
+        if self.scales is not None:
+            n += int(self.scales.nbytes)
+        return n
+
+    @property
+    def logical_nbytes(self) -> int:
+        if self.wire_bits is None:
+            return int(self.block_data.nbytes)
+        return int(np.prod(self.block_data.shape[:-1])) * self.head_dim * 2
+
+
+def serialize_session(engine, uid: int,
+                      wire: Optional[str] = None
+                      ) -> Optional[SessionHandoff]:
+    """Destructively capture ``uid``'s live decode state from ``engine``
+    for migration (engine.migrate_out_session owns the capture: the
+    sequence — or its host-tier parked copy — is RELEASED). The KV
+    payload rides the same quantized wire as a prefix handoff (``wire``
+    from :data:`WIRE_MODES`, defaulting to the engine's ``handoff_wire``
+    knob; a quantized pool ships its native payload as-is). Returns None
+    when nothing warm exists to capture — the caller degrades to the
+    legacy fold-and-resubmit recompute path."""
+    wire = wire or getattr(engine, "_handoff_wire", "auto") or "auto"
+    if wire not in WIRE_MODES:
+        raise ValueError(f"handoff wire mode {wire!r} "
+                         f"(choose from {WIRE_MODES})")
+    cap = engine.migrate_out_session(uid)
+    if cap is None:
+        return None
+    src_bits = getattr(engine.kv_cache, "quant_bits", None)
+    data, scales, wire_bits, packed, wire_snr = _wire_quantize(
+        cap["payload"], cap["scales"], src_bits, wire)
+    sess = SessionHandoff(
+        uid=cap["uid"], input_tokens=cap["input_tokens"],
+        generated=cap["generated"], seen_tokens=cap["seen_tokens"],
+        max_new_tokens=cap["max_new_tokens"],
+        prior_generated=cap["prior_generated"],
+        block_data=data, block_size=engine.kv_cache.config.block_size,
+        scales=scales, wire_bits=wire_bits, packed=packed,
+        src_quant_bits=src_bits, wire_snr_db=wire_snr,
+        spec_accept_ewma=cap["spec_accept_ewma"])
+    _record_wire(engine, sess, "serialize_session")
+    return sess
+
+
+def install_session(engine, sess: Optional[SessionHandoff]) -> str:
+    """Install a migrated session into ``engine`` and resume it. The
+    graceful-degradation ladder (never an error, never a drop):
+
+    * ``"resumed"``   — warm: blocks converted to the pool's native
+      format and written; decode continues with zero re-prefill FLOPs;
+    * ``"paged"``     — target HBM full: warm bytes park in the host
+      tier, readmission warm-resumes later (still zero re-prefill);
+    * ``"recompute"`` — geometry mismatch / unknown wire / no payload /
+      no tier room: the folded token history queues for ordinary
+      prefix-recompute admission;
+    * ``"duplicate"`` / ``"truncated"`` — see
+      ``engine.install_migrated_session``.
+
+    Must run on the thread that owns ``engine`` (the replica pump)."""
+    if sess is None:
+        return "recompute"
+    from deepspeed_tpu.inference.ragged.kv_tier import PagedSession
+
+    kvc = engine.kv_cache
+    dst_bits = getattr(kvc, "quant_bits", None)
+    pool_payload = pool_scales = None
+    geometry_ok = (
+        sess.block_data is not None and sess.n_blocks > 0
+        and sess.block_size == kvc.config.block_size
+        and sess.block_data.shape[0] == kvc.data.shape[0]
+        and sess.block_data.shape[2:5] == kvc.data.shape[2:5]
+        and sess.head_dim == kvc.config.head_dim
+        and sess.wire_bits in (None, 4, 8, "fp8"))
+    if geometry_ok:
+        if sess.src_quant_bits != dst_bits:
+            from deepspeed_tpu.observability.quant_stats import warn_once
+
+            warn_once(
+                f"handoff_precision:{sess.src_quant_bits}->{dst_bits}",
+                "disagg handoff precision mismatch: source pool "
+                f"quant_bits={sess.src_quant_bits} feeding destination "
+                f"quant_bits={dst_bits} — every transfer pays a "
+                "quantize/dequantize conversion on install; align "
+                "kv_quant_bits across the fleet (or set handoff_wire) "
+                "to make the wire format match the pools")
+        import jax.numpy as jnp
+
+        q, s = _pool_convert(
+            kvc, jnp.asarray(sess.block_data),
+            None if sess.scales is None
+            else jnp.asarray(sess.scales, jnp.float32),
+            sess.wire_bits, sess.packed)
+        pool_payload = np.asarray(q)
+        pool_scales = None if s is None else np.asarray(s, np.float32)
+    paged = PagedSession(
+        uid=sess.uid,
+        input_tokens=np.asarray(sess.input_tokens, np.int32),
+        generated=list(sess.generated),
+        seen_tokens=int(sess.seen_tokens),
+        max_new_tokens=int(sess.max_new_tokens),
+        prior_generated=int(sess.prior_generated),
+        payload=pool_payload, scales=pool_scales,
+        spec_accept_ewma=sess.spec_accept_ewma)
+    rung = engine.install_migrated_session(paged)
+    if rung in ("resumed", "paged"):
+        _record_wire(engine, sess, "install_session")
+    return rung

@@ -22,6 +22,20 @@ Message protocol (all dicts through transport/messages.py):
     {"type": "serialize", "req", "tokens"}
                                    serialize this worker's KV prefix;
                                    reply carries the same req id
+    {"type": "migrate_out", "req", "uid", "wire"}
+                                   capture + release a live session's
+                                   full decode state (ISSUE 20);
+                                   reply: session_payload (session may
+                                   be None = already finished/gone)
+    {"type": "install_session", "uid", "tokens", "max_new_tokens",
+     "span_notes", "session"}      install a migrated session (encoded
+                                   SessionHandoff); tokens carry the
+                                   recompute fallback
+    {"type": "reload", "req", "ckpt_dir", "seed"}
+                                   rolling weight hot-swap: validate
+                                   the manifest, reload params, run the
+                                   canary prompt set, reply reload_done
+                                   with the measured token chains
     {"type": "drain"}              stop = finish in-flight, then exit 0
     {"type": "ping"}               liveness probe -> {"type": "pong"}
 
@@ -30,7 +44,16 @@ Message protocol (all dicts through transport/messages.py):
                                    per-round emissions + load report
                                    (also sent bare as the heartbeat)
     {"type": "handoff_payload", "req", "handoff"}
+    {"type": "session_payload", "req", "session"}
+    {"type": "reload_done", "req", "ok", "error", "tag", "seed",
+     "canary_chains"}
     {"type": "exiting", "replica"} drain complete, about to exit
+
+Channel FIFO is what makes migrate-then-drain race-free: the
+supervisor sends every ``migrate_out`` before the ``drain`` flag, so
+the worker captures sessions while still serving; and every emission
+sent before a ``session_payload`` reply arrived first, so the
+supervisor's folded token state is complete when the capture lands.
 
 Graceful drain is SIGTERM *or* the drain message: both flip the same
 flag, the worker stops admitting, finishes what it holds, announces
@@ -148,7 +171,8 @@ class WorkerLoop:
     # -- inbound -------------------------------------------------------
     def _drain_channel(self) -> None:
         from deepspeed_tpu.serving.replica import Submission
-        from deepspeed_tpu.serving.transport import decode_handoff
+        from deepspeed_tpu.serving.transport import (decode_handoff,
+                                                     decode_session)
 
         while True:
             msg = self.channel.recv(timeout=0.0)
@@ -166,6 +190,19 @@ class WorkerLoop:
                     handoff=decode_handoff(msg.get("handoff"))))
             elif kind == "serialize":
                 self._serialize(msg)
+            elif kind == "migrate_out":
+                self._migrate_out(msg)
+            elif kind == "install_session":
+                self._received_submits += 1
+                notes = [(str(k), dict(f))
+                         for k, f in msg.get("span_notes") or []]
+                self.replica.submit(Submission(
+                    uid=int(msg["uid"]), tokens=msg["tokens"],
+                    max_new_tokens=int(msg["max_new_tokens"]),
+                    span_notes=notes,
+                    session=decode_session(msg.get("session"))))
+            elif kind == "reload":
+                self._reload(msg)
             elif kind == "drain":
                 self.draining = True
             elif kind == "ping":
@@ -180,6 +217,73 @@ class WorkerLoop:
         self.channel.send({"type": "handoff_payload",
                            "req": msg["req"],
                            "handoff": encode_handoff(payload)})
+
+    def _migrate_out(self, msg: Dict[str, Any]) -> None:
+        """Capture + release a live session on this (the pump) thread.
+        Runs directly — _drain_channel and pump share the worker main
+        thread, so the engine is quiescent here. Every emission this
+        session produced was sent before this reply (channel FIFO), so
+        the supervisor's folded token state is complete."""
+        from deepspeed_tpu.serving.disagg import serialize_session
+        from deepspeed_tpu.serving.transport import encode_session
+
+        try:
+            sess = serialize_session(self.replica.engine,
+                                     int(msg["uid"]),
+                                     wire=msg.get("wire"))
+        except Exception:
+            sess = None  # degrade to recompute, never wedge the worker
+        self.channel.send({"type": "session_payload",
+                           "req": msg["req"],
+                           "session": encode_session(sess)})
+
+    def _reload(self, msg: Dict[str, Any]) -> None:
+        """Rolling weight hot-swap, worker side: validate the published
+        checkpoint's manifest, rebuild params (zero recompilation — all
+        step functions take params as arguments), then re-measure the
+        canary prompt set and reply with its token checksum chains. The
+        supervisor compares them against the publisher's expected
+        chains (A/B token parity) before letting this replica rejoin.
+        The caller drained us first, so the engine is empty; canary
+        uids live in the 3_000_000+ range and are flushed after."""
+        from deepspeed_tpu.observability.journal import chain_tokens
+        from deepspeed_tpu.resilience.manifest import validate_manifest
+
+        req = msg.get("req")
+        reply: Dict[str, Any] = {"type": "reload_done", "req": req,
+                                 "ok": False, "error": None, "tag": None,
+                                 "seed": None, "canary_chains": {}}
+        try:
+            ckpt_dir = msg.get("ckpt_dir")
+            seed = msg.get("seed")
+            canary = {}
+            if ckpt_dir:
+                validate_manifest(ckpt_dir)  # raises on torn/corrupt
+                with open(os.path.join(ckpt_dir, "weights.json")) as f:
+                    wdoc = json.load(f)
+                reply["tag"] = wdoc.get("tag")
+                seed = wdoc.get("seed", seed)
+                canary = wdoc.get("canary") or {}
+            eng = self.replica.engine
+            eng.reload_params(seed=int(seed or 0))
+            reply["seed"] = int(seed or 0)
+            prompts = canary.get("prompts") or []
+            if prompts:
+                import numpy as np
+
+                gen = int(canary.get("gen", 8))
+                uids = [3_000_000 + i for i in range(len(prompts))]
+                eng.put(uids, [np.asarray(p, np.int32) for p in prompts],
+                        max_new_tokens=gen)
+                out = eng.generate_all(eos_token_id=self.eos_token_id)
+                eng.flush(uids)
+                reply["canary_chains"] = {
+                    str(i): chain_tokens(out.get(uid, []))
+                    for i, uid in enumerate(uids)}
+            reply["ok"] = True
+        except Exception as exc:  # parity gate aborts on any failure
+            reply["error"] = f"{type(exc).__name__}: {exc}"
+        self.channel.send(reply)
 
     # -- outbound ------------------------------------------------------
     def _geometry(self) -> Dict[str, Any]:

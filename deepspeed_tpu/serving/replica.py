@@ -50,6 +50,24 @@ class Submission:
     span_notes: List[Tuple[str, Dict[str, Any]]] = \
         dataclasses.field(default_factory=list)
     handoff: Optional[Any] = None  # disagg.KVHandoff
+    # disagg.SessionHandoff — a live-migrated mid-stream session. When
+    # set, install replaces put(): the migrated KV blocks, generated
+    # tokens, and spec EWMA land through install_session and decode
+    # resumes warm (zero re-prefill). ``tokens``/``max_new_tokens``
+    # then describe the RECOMPUTE fallback the installer degrades to
+    # if the payload can't land (pool full, geometry mismatch, ...).
+    session: Optional[Any] = None
+
+
+@dataclasses.dataclass
+class _MigrateOut:
+    """Inbox marker: capture+release session ``uid`` on the pump thread
+    (the only thread allowed to touch the engine) and hand the
+    SessionHandoff — or None if the session is gone — to ``cb``."""
+
+    uid: int
+    cb: Callable[[Optional[Any]], None]
+    wire: Optional[str] = None
 
 
 class ServingReplica:
@@ -137,7 +155,10 @@ class ServingReplica:
                 sub = self.inbox.get_nowait()
             except queue.Empty:
                 break
-            self._apply(sub)
+            if isinstance(sub, _MigrateOut):
+                self._migrate_out(sub)
+            else:
+                self._apply(sub)
         busy = bool(self.engine.state.seqs) or bool(self.engine._queue)
         emitted = self.engine.serve_step(eos_token_id=eos_token_id) \
             if busy else {}
@@ -156,6 +177,23 @@ class ServingReplica:
         return emitted
 
     def _apply(self, sub: Submission) -> None:
+        if sub.session is not None:
+            # live migration install: the payload carries the session's
+            # KV blocks + descriptor state, so install replaces put()
+            # entirely — install_session enqueues/admits internally and
+            # degrades (paged / recompute) on its own when the warm
+            # path can't land, using the folded tokens in the payload.
+            from deepspeed_tpu.serving.disagg import install_session
+
+            rung = install_session(self.engine, sub.session)
+            sub.span_notes.append(("MIGRATE", {
+                "stage": "install", "rung": rung,
+                "blocks": sub.session.n_blocks
+                if sub.session.block_data is not None else 0}))
+            for kind, fields in sub.span_notes:
+                fields.setdefault("replica_id", self.replica_id)
+                self.engine.tracer.note(sub.uid, kind, **fields)
+            return
         if sub.handoff is not None:
             from deepspeed_tpu.serving.disagg import install_prefix
 
@@ -188,6 +226,32 @@ class ServingReplica:
         from deepspeed_tpu.serving.disagg import serialize_prefix
 
         cb(serialize_prefix(self.engine, tokens))
+
+    def migrate_out(self, uid: int,
+                    cb: Callable[[Optional[Any]], None],
+                    wire: Optional[str] = None) -> None:
+        """Capture session ``uid``'s full decode state (committed KV
+        blocks, partial tail block, generated tokens, spec EWMA) as a
+        SessionHandoff, release it here, and hand the payload to ``cb``
+        (None = session gone or un-capturable; the caller degrades to
+        fold-and-resubmit recompute). The capture is enqueued as an
+        inbox marker so it runs on the pump thread — the engine is
+        single-threaded, and migrate-out both reads the KV pool and
+        mutates sequence state. A killed replica never pumps, so its
+        callbacks never fire; callers must pair this with the same
+        stale-heartbeat failover that covers ordinary requests.
+        RemoteReplica overrides with a migrate RPC (deadline-expired)."""
+        self.inbox.put(_MigrateOut(uid=int(uid), cb=cb, wire=wire))
+
+    def _migrate_out(self, mo: "_MigrateOut") -> None:
+        """Pump-thread half of migrate_out."""
+        from deepspeed_tpu.serving.disagg import serialize_session
+
+        try:
+            sess = serialize_session(self.engine, mo.uid, wire=mo.wire)
+        except Exception:
+            sess = None  # degrade, never wedge the pump
+        mo.cb(sess)
 
     # -- load report ---------------------------------------------------
     def load_report(self, now: Optional[float] = None) -> Dict[str, Any]:
@@ -232,6 +296,14 @@ class ServingReplica:
                                    else tier.session_count),
             "paged_out": e.stats.get("paged_out", 0),
             "paged_in": e.stats.get("paged_in", 0),
+            # live migration (ISSUE 20): warm sessions shipped out/in
+            # plus the degradation-ladder counters (host-tier page-out,
+            # legacy recompute) — the drill's "zero cold resumes" gate
+            # reads these across the fleet
+            "migrated_out": e.stats.get("migrated_out", 0),
+            "migrated_in": e.stats.get("migrated_in", 0),
+            "migrate_paged": e.stats.get("migrate_paged", 0),
+            "migrate_recompute": e.stats.get("migrate_recompute", 0),
         }
 
     def holds_prefix(self, tokens) -> int:

@@ -42,6 +42,17 @@ The router owns three decisions and one promise:
   bit-identical, so the loser is dropped by the existing stale-emission
   uid guard). Hedges are HEDGE spans on the request trace plus
   ``serve.hedged``/``serve.hedge_wins`` counters.
+* **Live migration** — ``migrate_sessions`` moves every in-flight
+  decode session off a replica *warm*: committed KV blocks, the
+  partial tail block, generated tokens, and the per-request
+  spec-acceptance EWMA ship over the quantized handoff wire and
+  resume on the target with zero re-prefill. Drains, rolling weight
+  swaps, and migration-backed scale-down all ride it; a capture that
+  can't happen degrades down the documented ladder (host-tier page-in
+  on the target -> fold-and-recompute -> finish in place), each rung
+  counted, never an error. ``migrate_hedges`` extends the same
+  machinery to hedge promotion (off by default — legacy duplicate-
+  stream hedging stays bit-exact).
 * **The promise** — every accepted request completes with its full
   token budget, through overload, handoff, and replica death alike.
 
@@ -124,6 +135,12 @@ def build_fleet(model, router_cfg=None, engine_kw=None,
                        hedge_ttft_factor=getattr(
                            cfg, "hedge_ttft_factor", 3.0),
                        hedge_min_s=getattr(cfg, "hedge_min_seconds", 0.25),
+                       migrate_enabled=getattr(cfg, "migrate_sessions",
+                                               True),
+                       migrate_hedges=getattr(cfg, "migrate_hedges",
+                                              False),
+                       migrate_wire=(getattr(cfg, "migrate_wire", None)
+                                     or None),
                        alerter=_build_alerter(
                            getattr(cfg, "burn_rate", None)))
 
@@ -194,6 +211,9 @@ class FleetRouter:
                  hedge_enabled: bool = False,
                  hedge_ttft_factor: float = 3.0,
                  hedge_min_s: float = 0.25,
+                 migrate_enabled: bool = True,
+                 migrate_hedges: bool = False,
+                 migrate_wire: Optional[str] = None,
                  alerter=None):
         if not replicas:
             raise ValueError("FleetRouter needs at least one replica")
@@ -228,6 +248,15 @@ class FleetRouter:
         self.hedge_enabled = bool(hedge_enabled)
         self.hedge_ttft_factor = float(hedge_ttft_factor)
         self.hedge_min_s = float(hedge_min_s)
+        # live session migration (ISSUE 20): drains and scale-downs
+        # move mid-stream decode state warm instead of recompute-
+        # requeueing. migrate_hedges extends migrate-first to hedge
+        # promotion — OFF by default so legacy hedge behavior (race a
+        # duplicate stream) stays bit-exact. migrate_wire picks the
+        # session wire codec (None = the engine's handoff_wire).
+        self.migrate_enabled = bool(migrate_enabled)
+        self.migrate_hedges = bool(migrate_hedges)
+        self.migrate_wire = migrate_wire
         # rid -> {"state", "since" (monotonic), "ok_checks",
         # "transitions"} — the per-replica health state machine
         self._health: Dict[int, Dict[str, Any]] = {}
@@ -266,7 +295,16 @@ class FleetRouter:
                       "handoff_recompute": 0, "failovers": 0,
                       "failed_over_requests": 0, "affinity_hits": 0,
                       "tier_affinity_hits": 0,
-                      "hedged": 0, "hedge_wins": 0, "stranded": 0}
+                      "hedged": 0, "hedge_wins": 0, "stranded": 0,
+                      # the migration ladder, router view: sessions
+                      # moved warm / degraded to fold-and-recompute /
+                      # left in place (no eligible target)
+                      "migrations": 0, "migrate_recompute": 0,
+                      "migrate_skipped": 0,
+                      # bytes actually shipped for warm migrations —
+                      # the deploy drill certifies bytes/session stays
+                      # near the quantized-wire budget, not bf16
+                      "migrate_wire_bytes": 0}
         # one BurnRateAlerter for the FLEET (observability/burn_rate.py):
         # every replica's finished traces feed it through the tracer
         # hook, and check_health runs its fire/clear state machine —
@@ -288,10 +326,13 @@ class FleetRouter:
     # -- fleet membership (supervisor spin-up / drain) -----------------
     def add_replica(self, replica: ServingReplica) -> None:
         """Wire a freshly spun-up replica into the pools (supervisor
-        scale-up / crash-restart path)."""
+        scale-up / crash-restart path), or READMIT one that was
+        quiesced with ``remove_replica`` — the rolling-swap rejoin:
+        same id, same channel, it just starts receiving work again."""
         with self._lock:
             rid = replica.replica_id
-            if rid in self.replicas and rid not in self.dead:
+            if (rid in self.replicas and rid not in self.dead
+                    and rid not in self.draining):
                 raise ValueError(f"replica id {rid} already in the fleet")
             self.replicas[rid] = replica
             self.dead.discard(rid)
@@ -797,6 +838,7 @@ class FleetRouter:
         if self.disagg:
             return  # prefill handoffs have their own recompute path
         plans = []
+        migrate_plans = []
         with self._lock:
             for rec in self._requests.values():
                 if (rec.done or rec.emitted or rec.phase != "decode"
@@ -828,11 +870,28 @@ class FleetRouter:
                         from_replica=rec.replica_id,
                         to_replica=target.replica_id,
                         waited_ms=round(waited_ms, 3),
+                        migrate=self.migrate_hedges,
                         hedge_ttft_factor=self.hedge_ttft_factor)
+                if self.migrate_hedges and self.migrate_enabled:
+                    # migrate-first hedge promotion: MOVE the stuck
+                    # request instead of racing a duplicate stream —
+                    # one stream, no loser to drop, and a mid-decode
+                    # victim carries its KV state along. Pre-first-
+                    # token captures degrade to recompute on the
+                    # target (the same outcome a hedge win delivers).
+                    src = self.replicas[rec.replica_id]
+                    migrate_plans.append(
+                        (rec, src,
+                         self._plan_migration(rec, src, target,
+                                              "hedge")))
+                    continue
                 plans.append((rec, target,
                               self._route_fields(target, "hedge",
                                                  uid=rec.uid),
                               waited_ms))
+        for rec, src, cb in migrate_plans:
+            src.migrate_out(rec.uid, cb, wire=self.migrate_wire)
+            self._hub.counter_add("serve.hedged")
         for rec, target, route, waited_ms in plans:
             target.submit(Submission(
                 uid=rec.uid, tokens=rec.tokens,
@@ -925,6 +984,125 @@ class FleetRouter:
                                   "recovered_tokens": recovered}),
                     ("ROUTE", route)]))
             self._hub.counter_add("serve.fleet.failed_over_requests")
+
+    # -- live session migration (ISSUE 20) -----------------------------
+    def migrate_sessions(self, src_rid: int,
+                         reason: str = "drain") -> Dict[str, int]:
+        """Move every in-flight decode session off ``src_rid`` warm:
+        each session's committed KV blocks + partial tail block +
+        generated tokens + spec-acceptance EWMA are captured on the
+        source (releasing it there), shipped over the quantized wire,
+        and installed on a picked target — decode resumes with zero
+        re-prefill. The graceful degradation ladder, never an error:
+
+        1. **warm** — capture lands, install resumes from the wire
+           blocks (or parks in the target's host KV tier until HBM
+           frees up: same zero-recompute outcome, deferred);
+        2. **recompute** — capture returned None (session mid-prefill,
+           already finished, transport death): fold emitted tokens into
+           the prompt and resubmit — PR 8's legacy path, bit-identical
+           output under greedy decoding;
+        3. **skip** — no eligible target (pool of one, all candidates
+           tainted): the session stays put and finishes on the source
+           (a draining worker finishes what it holds before exiting).
+
+        Call with the source already removed from the pools
+        (``remove_replica``) so no new work lands behind the captures.
+        Plans are built under the lock, capture RPCs sent outside it;
+        installs happen in the capture callbacks (receive/pump
+        threads). Returns plan counts — the rung each migration
+        actually landed on accumulates in ``stats`` as callbacks
+        fire."""
+        if not self.migrate_enabled:
+            return {"requested": 0, "skipped": 0}
+        plans = []
+        counts = {"requested": 0, "skipped": 0}
+        with self._lock:
+            src = self.replicas.get(src_rid)
+            if src is None:
+                return counts
+            for rec in self._requests.values():
+                if (rec.done or rec.replica_id != src_rid
+                        or rec.phase != "decode"):
+                    continue  # prefill-phase recs have the handoff path
+                try:
+                    target = self._pick(
+                        self.decode_pool, rec.affinity_key,
+                        len(rec.tokens),
+                        exclude={src_rid} | rec.stale_rids)
+                except RuntimeError:
+                    self.stats["migrate_skipped"] += 1
+                    counts["skipped"] += 1
+                    continue
+                plans.append((rec, target,
+                              self._plan_migration(rec, src, target,
+                                                   reason)))
+                counts["requested"] += 1
+        for rec, target, cb in plans:
+            src.migrate_out(rec.uid, cb, wire=self.migrate_wire)
+        return counts
+
+    def _plan_migration(self, rec: _RequestRecord, src, target,
+                        reason: str):
+        """Build the capture continuation for one migration. The
+        callback runs on the source's receive/pump thread when the
+        SessionHandoff (or None) lands; it transfers ownership, folds
+        the emitted tokens (the recompute fallback AND the guard
+        prompt), journals the MIGRATE decision with the inputs that
+        drove it, and submits to the target. Caller holds the lock."""
+        src_rid = src.replica_id
+        src_score = round(float(src.load_score()), 4)
+        tgt_score = round(float(target.load_score()), 4)
+
+        def _cb(sess) -> None:
+            with self._lock:
+                if rec.done or rec.replica_id != src_rid:
+                    # finished, or a failover/hedge raced the capture
+                    # and already owns the stream elsewhere — drop the
+                    # payload (its tokens are folded wherever it went)
+                    return
+                remaining = rec.max_new_tokens - len(rec.emitted)
+                if remaining <= 0:
+                    rec.done = True
+                    self.stats["completed"] += 1
+                    return
+                # the source released the session on capture (or still
+                # streams it after a None capture): either way it must
+                # never be picked again for this request
+                rec.stale_rids.add(src_rid)
+                rec.replica_id = target.replica_id
+                rec.hedge_replica_id = None  # migrate-first hedge done
+                tokens = np.concatenate(
+                    [rec.tokens, np.asarray(rec.emitted, np.int32)]) \
+                    if rec.emitted else rec.tokens
+                rung = "warm" if sess is not None else "recompute"
+                self.stats["migrations" if sess is not None
+                           else "migrate_recompute"] += 1
+                fields = {"from_replica": src_rid,
+                          "to_replica": target.replica_id,
+                          "reason": reason, "rung": rung,
+                          "recovered_tokens": len(rec.emitted),
+                          "source_score": src_score,
+                          "target_score": tgt_score}
+                if sess is not None:
+                    fields["wire_bytes"] = int(sess.wire_nbytes)
+                    fields["n_blocks"] = int(sess.n_blocks)
+                    self.stats["migrate_wire_bytes"] += \
+                        int(sess.wire_nbytes)
+                jr = get_journal()
+                if jr is not None:
+                    jr.decision("MIGRATE", uid=rec.uid, **fields)
+                route = self._route_fields(target, "migrate",
+                                           uid=rec.uid)
+                notes = [("MIGRATE", dict(fields)), ("ROUTE", route)]
+            target.submit(Submission(
+                uid=rec.uid, tokens=tokens, max_new_tokens=remaining,
+                session=sess, span_notes=notes))
+            self._hub.counter_add("serve.fleet.migrations"
+                                  if sess is not None
+                                  else "serve.fleet.migrate_recompute")
+
+        return _cb
 
     # -- driving -------------------------------------------------------
     def step(self) -> int:

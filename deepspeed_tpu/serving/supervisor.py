@@ -71,7 +71,8 @@ from deepspeed_tpu.observability.journal import get_journal
 from deepspeed_tpu.serving.replica import Submission
 from deepspeed_tpu.serving.transport import (ChannelError, FileChannel,
                                              connect_with_backoff,
-                                             decode_handoff, encode_handoff)
+                                             decode_handoff, decode_session,
+                                             encode_handoff, encode_session)
 
 
 _WARNED_LEGACY_CONNECT = False
@@ -202,6 +203,10 @@ class RemoteReplica:
         self._lock = threading.Lock()
         self._handoff_timeout_s = float(handoff_timeout_s)
         self._handoff_cbs: Dict[int, Tuple[Callable, float]] = {}
+        # live-migration + hot-swap RPCs share the handoff timeout/
+        # expiry discipline: an orphaned continuation fires with None
+        self._migrate_cbs: Dict[int, Tuple[Callable, float]] = {}
+        self._reload_cbs: Dict[int, Tuple[Callable, float]] = {}
         self._next_req = 0
 
     # -- the ServingReplica surface ------------------------------------
@@ -252,11 +257,23 @@ class RemoteReplica:
                     + self._unacked(r) + (1.0 - r["kv_free_frac"]))
 
     def submit(self, sub: Submission) -> None:
-        msg = {"type": "submit", "uid": int(sub.uid),
-               "tokens": np.asarray(sub.tokens, np.int32),
-               "max_new_tokens": int(sub.max_new_tokens),
-               "span_notes": [[k, dict(f)] for k, f in sub.span_notes],
-               "handoff": encode_handoff(sub.handoff)}
+        if sub.session is not None:
+            # live migration install: the SessionHandoff rides its own
+            # message type; tokens carry the recompute fallback the
+            # worker degrades to if the payload can't land
+            msg = {"type": "install_session", "uid": int(sub.uid),
+                   "tokens": np.asarray(sub.tokens, np.int32),
+                   "max_new_tokens": int(sub.max_new_tokens),
+                   "span_notes": [[k, dict(f)]
+                                  for k, f in sub.span_notes],
+                   "session": encode_session(sub.session)}
+        else:
+            msg = {"type": "submit", "uid": int(sub.uid),
+                   "tokens": np.asarray(sub.tokens, np.int32),
+                   "max_new_tokens": int(sub.max_new_tokens),
+                   "span_notes": [[k, dict(f)]
+                                  for k, f in sub.span_notes],
+                   "handoff": encode_handoff(sub.handoff)}
         try:
             self.channel.send(msg)
         except ChannelError:
@@ -286,6 +303,56 @@ class RemoteReplica:
             self._send_failed = True
             with self._lock:
                 self._handoff_cbs.pop(req, None)
+            cb(None)
+
+    def migrate_out(self, uid: int,
+                    cb: Callable[[Optional[Any]], None],
+                    wire: Optional[str] = None) -> None:
+        """Async live-migration capture RPC: the worker captures and
+        releases session ``uid``'s full decode state; the reply
+        (``session_payload``) invokes ``cb`` on the receive thread. A
+        dead channel or an expired wait degrades to ``cb(None)`` — the
+        router's fold-and-resubmit recompute path. Channel FIFO
+        guarantees every emission the session produced arrives before
+        the capture, so the caller's folded tokens are complete."""
+        with self._lock:
+            req = self._next_req
+            self._next_req += 1
+            self._migrate_cbs[req] = (
+                cb, time.monotonic() + self._handoff_timeout_s)
+        try:
+            self.channel.send({"type": "migrate_out", "req": req,
+                               "uid": int(uid), "wire": wire})
+        except ChannelError:
+            self.transport_errors += 1
+            self._send_failed = True
+            with self._lock:
+                self._migrate_cbs.pop(req, None)
+            cb(None)
+
+    def reload(self, cb: Callable[[Optional[Dict[str, Any]]], None],
+               ckpt_dir: Optional[str] = None,
+               seed: Optional[int] = None,
+               timeout_s: Optional[float] = None) -> None:
+        """Async weight hot-swap RPC: the worker validates the
+        checkpoint manifest, reloads params, runs the canary prompt
+        set, and replies ``reload_done`` (which invokes ``cb`` with the
+        reply dict). ``cb(None)`` = channel death or timeout — the
+        rolling-swap driver treats it like a failed parity gate."""
+        with self._lock:
+            req = self._next_req
+            self._next_req += 1
+            self._reload_cbs[req] = (
+                cb, time.monotonic()
+                + float(timeout_s or self._handoff_timeout_s))
+        try:
+            self.channel.send({"type": "reload", "req": req,
+                               "ckpt_dir": ckpt_dir, "seed": seed})
+        except ChannelError:
+            self.transport_errors += 1
+            self._send_failed = True
+            with self._lock:
+                self._reload_cbs.pop(req, None)
             cb(None)
 
     def transport_bytes(self) -> Tuple[int, int]:
@@ -344,20 +411,34 @@ class RemoteReplica:
                 entry = self._handoff_cbs.pop(int(msg["req"]), None)
             if entry is not None:
                 entry[0](decode_handoff(msg.get("handoff")))
+        elif kind == "session_payload":
+            with self._lock:
+                entry = self._migrate_cbs.pop(int(msg["req"]), None)
+            if entry is not None:
+                entry[0](decode_session(msg.get("session")))
+        elif kind == "reload_done":
+            with self._lock:
+                entry = self._reload_cbs.pop(int(msg["req"]), None)
+            if entry is not None:
+                entry[0](msg)
         elif kind == "exiting":
             self.exited = True
 
     def expire_handoffs(self, now: Optional[float] = None) -> int:
-        """Time out serialize RPCs whose worker died mid-reply: each
-        orphaned continuation fires with None (recompute). ``now`` is
-        monotonic. Returns how many expired."""
+        """Time out serialize/migrate/reload RPCs whose worker died
+        mid-reply: each orphaned continuation fires with None (the
+        caller's documented degraded path — recompute for handoffs and
+        migrations, swap-abort for reloads). ``now`` is monotonic.
+        Returns how many expired."""
         now = time.monotonic() if now is None else now
         expired = []
         with self._lock:
-            for req, (cb, deadline) in list(self._handoff_cbs.items()):
-                if now >= deadline:
-                    expired.append(cb)
-                    del self._handoff_cbs[req]
+            for cbs in (self._handoff_cbs, self._migrate_cbs,
+                        self._reload_cbs):
+                for req, (cb, deadline) in list(cbs.items()):
+                    if now >= deadline:
+                        expired.append(cb)
+                        del cbs[req]
         for cb in expired:
             cb(None)
         return len(expired)
@@ -706,22 +787,38 @@ class ReplicaSupervisor:
                 replacement = self.spawn(action="spawn")
                 self.router.add_replica(replacement)
                 autoscale.record_action("spawn",
-                                        replacement.replica_id, now)
+                                        replacement.replica_id, now,
+                                        live=len(live) + 1,
+                                        direction="up")
                 acted["spawned"] += 1
             elif autoscale.desired < len(live) and len(live) > 1:
                 victim = self.replicas[max(live)]
-                if self.drain(victim.replica_id):
-                    autoscale.record_action("drain", victim.replica_id,
-                                            now)
+                # migration-backed scale-down: the victim's live
+                # sessions move warm before the worker drains
+                if self.drain(victim.replica_id, reason="scale_down"):
+                    st = getattr(self.router, "stats", {})
+                    autoscale.record_action(
+                        "drain", victim.replica_id, now,
+                        live=len(live) - 1, direction="down",
+                        migrations=int(st.get("migrations", 0)))
                     acted["drained"] += 1
         self.write_fleet_snapshot()
         return acted
 
-    def drain(self, replica_id: int) -> bool:
-        """Graceful scale-down: no new admissions, worker finishes its
-        in-flight requests and exits 0. Refuses (returns False, with a
-        ``drain_refused`` act recorded) when draining would leave the
-        fleet below its ``min_healthy`` floor."""
+    def drain(self, replica_id: int, migrate: bool = True,
+              reason: str = "drain") -> bool:
+        """Graceful scale-down: no new admissions, live sessions
+        migrate out warm (when the router supports it), the worker
+        finishes whatever could not move and exits 0. Refuses (returns
+        False, with a ``drain_refused`` act recorded) when draining
+        would leave the fleet below its ``min_healthy`` floor.
+
+        Ordering is what makes this zero-drop: remove_replica stops new
+        admissions first, migrate_sessions then sends the capture RPCs,
+        and the ``drain`` flag goes on the SAME channel afterwards —
+        FIFO means the worker processes every capture while still
+        serving, and any session the migration ladder left behind is
+        simply finished in place before the clean exit."""
         live = len(self._live_ids())
         if live - 1 < self.min_healthy:
             self._act("drain_refused", replica_id, live=live,
@@ -729,14 +826,19 @@ class ReplicaSupervisor:
             return False
         remote = self.replicas[replica_id]
         remote.draining = True
+        migrated: Dict[str, int] = {}
         if self.router is not None:
             self.router.remove_replica(replica_id)
+            if migrate and hasattr(self.router, "migrate_sessions"):
+                migrated = self.router.migrate_sessions(
+                    replica_id, reason=reason)
         try:
             remote.channel.send({"type": "drain"})
         except ChannelError:
             remote.transport_errors += 1
             remote._send_failed = True
-        self._act("drain", replica_id)
+        self._act("drain", replica_id, **(
+            {"migrate": migrated} if migrated else {}))
         return True
 
     def kill(self, replica_id: int,
@@ -760,6 +862,237 @@ class ReplicaSupervisor:
         raise TimeoutError(
             f"process fleet did not drain in {timeout_s}s "
             f"({self.router.pending()} requests pending)")
+
+    # -- rolling weight hot-swap (ISSUE 20) ----------------------------
+    def compute_canary_chains(self, prompts: List[List[int]],
+                              gen: int = 8,
+                              seed: Optional[int] = None
+                              ) -> Dict[str, List[int]]:
+        """Expected A/B-parity chains for a canary prompt set: build a
+        throwaway replica from the SAME model+engine spec the workers
+        use (engine config affects numerics, so it must match), decode
+        the canaries greedily, and checksum-chain the streams. The
+        publisher bakes these into weights.json; each swapped worker
+        must reproduce them before it rejoins."""
+        import numpy as np  # noqa: F811 (module-level alias)
+
+        from deepspeed_tpu.observability.journal import chain_tokens
+        from deepspeed_tpu.serving.proc_worker import build_replica
+
+        rep = build_replica({"replica_id": 9_999, "role": "unified",
+                             "model": self.model, "engine": self.engine,
+                             "seed": int(self.seed if seed is None
+                                         else seed)})
+        eng = rep.engine
+        uids = [3_000_000 + i for i in range(len(prompts))]
+        eng.put(uids, [np.asarray(p, np.int32) for p in prompts],
+                max_new_tokens=int(gen))
+        out = eng.generate_all(eos_token_id=self.eos_token_id)
+        return {str(i): chain_tokens(out.get(uid, []))
+                for i, uid in enumerate(uids)}
+
+    def publish_weights(self, tag: str,
+                        seed: Optional[int] = None,
+                        canary_prompts: Optional[List[List[int]]] = None,
+                        canary_gen: int = 8,
+                        canary_chains: Optional[Dict[str, List[int]]]
+                        = None) -> str:
+        """Publish a weight release the fleet can roll onto:
+        ``<run_dir>/weights/<tag>/weights.json`` (seed + canary prompt
+        set + expected token chains) sealed by a checksum manifest
+        (resilience/manifest.py — a torn or tampered release fails
+        validation before any worker touches it). ``canary_chains``
+        overrides the computed expectation — tests use it to publish a
+        release whose parity gate MUST fail. Returns the release dir."""
+        ckpt_dir = os.path.join(self.run_dir, "weights", str(tag))
+        os.makedirs(ckpt_dir, exist_ok=True)
+        seed = int(self.seed if seed is None else seed)
+        canary: Dict[str, Any] = {}
+        if canary_prompts:
+            if canary_chains is None:
+                canary_chains = self.compute_canary_chains(
+                    canary_prompts, gen=canary_gen, seed=seed)
+            canary = {"prompts": [[int(t) for t in p]
+                                  for p in canary_prompts],
+                      "gen": int(canary_gen),
+                      "chains": {str(k): [int(c) for c in v]
+                                 for k, v in canary_chains.items()}}
+        _atomic_write_json(os.path.join(ckpt_dir, "weights.json"),
+                           {"tag": str(tag), "seed": seed,
+                            "canary": canary})
+        from deepspeed_tpu.resilience.manifest import write_manifest
+
+        write_manifest(ckpt_dir, str(tag))
+        self._act("publish", -1, tag=str(tag), seed=seed,
+                  canaries=len(canary_prompts or []))
+        return ckpt_dir
+
+    def _reload_sync(self, remote: RemoteReplica,
+                     ckpt_dir: Optional[str], seed: Optional[int],
+                     timeout_s: float) -> Optional[Dict[str, Any]]:
+        """Blocking wrapper over the async reload RPC (None = channel
+        death or timeout)."""
+        box: Dict[str, Any] = {}
+        ev = threading.Event()
+
+        def _cb(reply):
+            box["reply"] = reply
+            ev.set()
+
+        remote.reload(_cb, ckpt_dir=ckpt_dir, seed=seed,
+                      timeout_s=timeout_s)
+        ev.wait(timeout_s + 5.0)
+        return box.get("reply")
+
+    def _quiesce(self, remote: RemoteReplica, timeout_s: float) -> bool:
+        """Wait for a router-removed replica to go empty (live sessions
+        migrated or finished, queue drained)."""
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            r = remote.load_report()
+            if int(r.get("inflight", 0)) == 0:
+                return True
+            if remote._send_failed:
+                return False
+            time.sleep(0.01)
+        return False
+
+    def rolling_swap(self, tag: str,
+                     timeout_s: float = 60.0) -> Dict[str, Any]:
+        """Zero-downtime weight rollout, replica by replica: quiesce
+        (admissions off + live sessions migrate out warm) -> reload the
+        manifest-validated release -> A/B token-parity gate on the
+        published canary chains -> rejoin. A parity failure (or reload
+        error / timeout) ABORTS the rollout: the failing replica rolls
+        back to the running weights and rejoins, and no further replica
+        is touched. The ``min_healthy`` floor is respected throughout —
+        at most one replica is ever out of the fleet.
+
+        Only after EVERY replica swaps does ``self.seed`` advance, so
+        crash restarts spawn with the new weights; an aborted rollout
+        leaves restarts on the old ones — the fleet stays coherent
+        either way."""
+        from deepspeed_tpu.resilience.manifest import (
+            CheckpointCorruptError, validate_manifest)
+
+        jr = get_journal()
+        result: Dict[str, Any] = {"tag": str(tag), "swapped": 0,
+                                  "rolled_back": 0, "refused": 0,
+                                  "aborted": False, "parity_ok": True,
+                                  "error": None}
+
+        def _swap_rec(stage: str, rid: int, **fields: Any) -> None:
+            if jr is not None:
+                jr.decision("SWAP", ts=wall_time(), tag=str(tag),
+                            replica=rid, stage=stage, **fields)
+
+        ckpt_dir = os.path.join(self.run_dir, "weights", str(tag))
+        try:
+            # supervisor-side gate: a torn/tampered release aborts the
+            # rollout before any replica is touched
+            validate_manifest(ckpt_dir)
+            with open(os.path.join(ckpt_dir, "weights.json")) as f:
+                wdoc = json.load(f)
+        except (CheckpointCorruptError, OSError, ValueError) as exc:
+            result["aborted"] = True
+            result["error"] = f"{type(exc).__name__}: {exc}"
+            _swap_rec("manifest", -1, ok=False, error=result["error"])
+            self._act("swap_abort", -1, tag=str(tag),
+                      error=result["error"])
+            return result
+        expected = {str(k): [int(c) for c in v] for k, v in
+                    ((wdoc.get("canary") or {}).get("chains")
+                     or {}).items()}
+        new_seed = int(wdoc.get("seed", self.seed))
+        _swap_rec("manifest", -1, ok=True, seed=new_seed,
+                  canaries=len(expected))
+
+        for rid in sorted(self._live_ids()):
+            remote = self.replicas.get(rid)
+            if remote is None or remote.draining or remote.exited:
+                continue
+            live = len(self._live_ids())
+            if live - 1 < self.min_healthy:
+                result["refused"] += 1
+                result["aborted"] = True
+                self._act("swap_refused", rid, live=live,
+                          min_healthy=self.min_healthy)
+                _swap_rec("quiesce", rid, ok=False,
+                          reason="min_healthy")
+                break
+            # quiesce: admissions off, live sessions migrate out warm
+            self._act("swap_quiesce", rid, tag=str(tag))
+            migrated: Dict[str, int] = {}
+            if self.router is not None:
+                self.router.remove_replica(rid)
+                if hasattr(self.router, "migrate_sessions"):
+                    migrated = self.router.migrate_sessions(
+                        rid, reason="swap")
+            quiet = self._quiesce(remote, timeout_s)
+            _swap_rec("quiesce", rid, ok=quiet, migrate=migrated)
+            reply = self._reload_sync(remote, ckpt_dir, None, timeout_s)
+            if reply is None or not reply.get("ok"):
+                # reload failed (corrupt release seen worker-side,
+                # channel death, timeout): abort + roll this replica
+                # back to the running weights before it rejoins
+                err = None if reply is None else reply.get("error")
+                _swap_rec("reload", rid, ok=False, error=err)
+                result["aborted"] = True
+                result["error"] = err or "reload timeout"
+                if reply is not None:
+                    rb = self._reload_sync(remote, None, self.seed,
+                                           timeout_s)
+                    if rb is not None and rb.get("ok"):
+                        result["rolled_back"] += 1
+                        if self.router is not None:
+                            self.router.add_replica(remote)
+                        self._act("swap_rollback", rid, tag=str(tag))
+                else:
+                    remote._send_failed = True  # crash containment
+                break
+            measured = {str(k): [int(c) for c in v] for k, v in
+                        (reply.get("canary_chains") or {}).items()}
+            parity = measured == expected
+            divergent = sorted(k for k in expected
+                               if measured.get(k) != expected[k])
+            _swap_rec("parity", rid, ok=parity,
+                      canaries=len(expected),
+                      divergent=divergent[:8])
+            if not parity:
+                # THE gate: the new weights do not reproduce the
+                # published canary streams on this replica — abort the
+                # rollout and put the old weights back before rejoin
+                result["aborted"] = True
+                result["parity_ok"] = False
+                result["error"] = (f"canary parity failed on r{rid}: "
+                                   f"canaries {divergent[:8]} diverged")
+                rb = self._reload_sync(remote, None, self.seed,
+                                       timeout_s)
+                if rb is not None and rb.get("ok"):
+                    result["rolled_back"] += 1
+                    if self.router is not None:
+                        self.router.add_replica(remote)
+                    self._act("swap_rollback", rid, tag=str(tag),
+                              divergent=divergent[:8])
+                else:
+                    remote._send_failed = True
+                break
+            if self.router is not None:
+                self.router.add_replica(remote)
+            result["swapped"] += 1
+            self._act("swap", rid, tag=str(tag))
+            _swap_rec("done", rid, ok=True)
+
+        if not result["aborted"] and result["swapped"] > 0:
+            self.seed = new_seed  # restarts now reproduce the release
+        _swap_rec("rollout", -1, ok=not result["aborted"],
+                  swapped=result["swapped"],
+                  rolled_back=result["rolled_back"])
+        self._act("swap_done" if not result["aborted"]
+                  else "swap_abort", -1, tag=str(tag),
+                  swapped=result["swapped"],
+                  rolled_back=result["rolled_back"])
+        return result
 
     def shutdown(self, timeout_s: float = 10.0) -> None:
         """SIGTERM everyone, wait, SIGKILL stragglers, stop rx threads."""

@@ -32,12 +32,15 @@ from deepspeed_tpu.serving.transport.framing import (DEFAULT_MAX_FRAME_BYTES,
                                                      encode_frame)
 from deepspeed_tpu.serving.transport.messages import (decode_handoff,
                                                       decode_message,
+                                                      decode_session,
                                                       encode_handoff,
-                                                      encode_message)
+                                                      encode_message,
+                                                      encode_session)
 
 __all__ = [
     "ChannelError", "DEFAULT_MAX_FRAME_BYTES", "FileChannel", "FrameError",
     "FrameReader", "SocketChannel", "SocketServer", "TransportError",
     "connect_with_backoff", "decode_handoff", "decode_message",
-    "encode_frame", "encode_handoff", "encode_message",
+    "decode_session", "encode_frame", "encode_handoff", "encode_message",
+    "encode_session",
 ]

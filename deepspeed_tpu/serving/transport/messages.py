@@ -147,3 +147,51 @@ def decode_handoff(doc: Optional[Dict[str, Any]]):
         wire_bits=doc.get("wire_bits"), packed=bool(doc.get("packed")),
         src_quant_bits=doc.get("src_quant_bits"),
         wire_snr_db=doc.get("wire_snr_db"))
+
+
+# -- SessionHandoff mapping (live migration, ISSUE 20) -------------------
+
+
+def encode_session(sess) -> Optional[Dict[str, Any]]:
+    """SessionHandoff -> message-dict form (None passes through: a
+    capture that degraded to the fold-and-resubmit recompute path)."""
+    if sess is None:
+        return None
+    return {
+        "uid": int(sess.uid),
+        "input_tokens": np.asarray(sess.input_tokens, np.int32),
+        "generated": [int(t) for t in sess.generated],
+        "seen_tokens": int(sess.seen_tokens),
+        "max_new_tokens": int(sess.max_new_tokens),
+        "prior_generated": int(sess.prior_generated),
+        "block_data": sess.block_data,
+        "block_size": int(sess.block_size),
+        "scales": sess.scales,
+        "wire_bits": sess.wire_bits,
+        "packed": bool(sess.packed),
+        "src_quant_bits": sess.src_quant_bits,
+        "wire_snr_db": sess.wire_snr_db,
+        "spec_accept_ewma": (None if sess.spec_accept_ewma is None
+                             else float(sess.spec_accept_ewma)),
+    }
+
+
+def decode_session(doc: Optional[Dict[str, Any]]):
+    if doc is None:
+        return None
+    from deepspeed_tpu.serving.disagg import SessionHandoff
+
+    return SessionHandoff(
+        uid=int(doc["uid"]),
+        input_tokens=np.asarray(doc["input_tokens"], np.int32),
+        generated=[int(t) for t in doc["generated"]],
+        seen_tokens=int(doc["seen_tokens"]),
+        max_new_tokens=int(doc["max_new_tokens"]),
+        prior_generated=int(doc["prior_generated"]),
+        block_data=doc["block_data"],
+        block_size=int(doc["block_size"]),
+        scales=doc.get("scales"),
+        wire_bits=doc.get("wire_bits"), packed=bool(doc.get("packed")),
+        src_quant_bits=doc.get("src_quant_bits"),
+        wire_snr_db=doc.get("wire_snr_db"),
+        spec_accept_ewma=doc.get("spec_accept_ewma"))

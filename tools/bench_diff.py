@@ -109,6 +109,14 @@ DEFAULT_THRESHOLDS: Dict[str, Tuple[str, float]] = {
     # is checked unconditionally below
     "replay.journal_overhead_us": ("max_ratio", 3.0),
     "replay.journal_bytes_per_request": ("max_ratio", 1.5),
+    # deploy-drill sentinels: the rush-hour deploy's TTFT tail may not
+    # creep vs its own quiet arm across rounds, and a warm migration's
+    # wire cost per session must stay near the quantized budget (a 1.5x
+    # jump means someone fell back to a fatter rung / bf16 payloads);
+    # drill.zero_drops / drill.bit_identical / swap.parity_ok ride the
+    # unconditional must_stay_true block below
+    "drill.ttft_p999_ratio": ("max_ratio", 2.0),
+    "migrate.wire_bytes_per_session": ("max_ratio", 1.5),
 }
 
 # units where a larger headline value is worse
@@ -268,6 +276,16 @@ def diff_reports(old: Dict[str, Any], new: Dict[str, Any],
                 rule, limit = th[key]
                 ratio = nv / ov
                 check(key, rule, limit, ov, nv, ratio, ratio <= limit)
+        # zero-downtime deploy sentinels (deploy_drill payloads): the
+        # deploy-vs-quiet TTFT tail and the warm-migration wire cost
+        for key in ("drill.ttft_p999_ratio",
+                    "migrate.wire_bytes_per_session"):
+            ov, nv = old.get(key), new.get(key)
+            if isinstance(ov, (int, float)) and \
+                    isinstance(nv, (int, float)) and ov > 0:
+                rule, limit = th[key]
+                ratio = nv / ov
+                check(key, rule, limit, ov, nv, ratio, ratio <= limit)
         # tiered-KV sentinels (serve_tier payloads): host-tier session
         # capacity, warm-resume TTFT trend, and drafter accept rate
         for key in ("tier.sessions_per_gb", "spec.accept_rate"):
@@ -306,7 +324,12 @@ def diff_reports(old: Dict[str, Any], new: Dict[str, Any],
     # broken on its own, not relative to the old round)
     for cert in ("chaos.zero_drops", "chaos.bit_identical",
                  "obs.trace_overhead_ok", "obs.offset_bound_ok",
-                 "replay.bit_identical"):
+                 "replay.bit_identical",
+                 # a deploy that dropped or mutated a stream, or a
+                 # rollout that rejoined a parity-failing replica, is
+                 # broken on its own, not relative to the old round
+                 "drill.zero_drops", "drill.bit_identical",
+                 "swap.parity_ok", "swap.abort_ok"):
         if cert in new:
             check(cert, "must_stay_true", 1, old.get(cert),
                   new.get(cert), float(bool(new[cert])), bool(new[cert]))

@@ -2439,6 +2439,477 @@ def run_replay_fleet() -> dict:
     }
 
 
+def _drive_deploy_arm(arm, base_dir, model_spec, engine_spec, prompts,
+                      arrivals, gen, knobs):
+    """One deploy-drill arm. ``quiet`` is the reference: the diurnal
+    peak workload through a plain 2-worker socket fleet, no events.
+    ``drill`` serves the SAME workload and arrival schedule while the
+    whole zero-downtime playbook runs against it in one pass:
+
+    * one worker SIGKILLs itself mid-request (``DSTPU_CHAOS``) — the
+      supervisor restarts it, the router fails the stream over;
+    * a same-seed weight release rolls across the fleet
+      (``rolling_swap``) while a designated long decode session is
+      mid-stream — quiescing its owner migrates it out WARM (committed
+      KV over the quantized wire, zero re-prefill on the target);
+    * the autoscale signal swings desired up one (supervisor spawns)
+      then back down (migration-backed drain of the newest worker);
+    * after the drain, a release with deliberately corrupted canary
+      chains is rolled — the A/B parity gate must abort the rollout,
+      roll the replica back, and leave the fleet serving.
+
+    Every event is gated later in ``run_deploy_drill``: zero drops,
+    token streams bit-identical to the quiet arm, p99.9 TTFT ratio
+    bounded, >=1 warm migration, parity-abort observed. The drill arm
+    records a fleet journal so MIGRATE/SWAP/SCALE decisions land as
+    replayable forensics."""
+    import threading
+
+    from deepspeed_tpu.observability.journal import (FleetJournal,
+                                                     config_fingerprint,
+                                                     reset_journal,
+                                                     set_journal)
+    from deepspeed_tpu.serving import FleetRouter, ReplicaSupervisor
+    from deepspeed_tpu.serving.autoscale import AutoscaleSignal
+    from deepspeed_tpu.serving.replica import Submission
+
+    drill = arm == "drill"
+    run_dir = os.path.join(base_dir, arm)
+    os.makedirs(run_dir, exist_ok=True)
+    jr = None
+    if drill:
+        jr = FleetJournal(os.path.join(run_dir, "journal.bin"),
+                          max_mb=64.0)
+        set_journal(jr)
+        jr.write_header(config_fingerprint(
+            model=model_spec, engine=engine_spec, seed=knobs["seed"],
+            drill=True))
+    sup = ReplicaSupervisor(
+        run_dir, model=model_spec, engine=dict(engine_spec),
+        seed=knobs["seed"], min_healthy=1)
+    remotes = [sup.spawn(role="unified")]
+    if drill:
+        # the rush-hour casualty: SIGKILLs itself on its second busy
+        # round (same self-kill the chaos bench certifies); its respawn
+        # carries a different rank, so the kill fires exactly once
+        remotes.append(sup.spawn(role="unified", env_extra={
+            "DSTPU_CHAOS": "kill_rank=1,kill_step=2,kill_signal=SIGKILL"}))
+    else:
+        remotes += [sup.spawn(role="unified")
+                    for _ in range(max(1, knobs["replicas"] - 1))]
+    router = FleetRouter(
+        remotes, stale_after_s=knobs["stale_after_s"],
+        affinity_blocks=0, routing="predictive",
+        hedge_enabled=drill, hedge_ttft_factor=3.0, hedge_min_s=1.0)
+    sup.router = router
+    auto = None
+    if drill:
+        # scripted swing: the drill drives ``desired`` directly (the
+        # signal's own thresholds are certified in unit tests) — what
+        # is certified HERE is that the supervisor closes the
+        # desired-vs-live loop with spawn and migration-backed drain
+        auto = AutoscaleSignal(min_replicas=knobs["replicas"],
+                               max_replicas=knobs["replicas"] + 1)
+        router.autoscale = auto
+
+    n = len(prompts)
+    mig_uid = 900_000  # the long session the swap must move warm
+    first_tok = {}
+    tlock = threading.Lock()
+    t0_box = [None]
+
+    def _wrap_new():
+        for r in router.replicas.values():
+            if getattr(r, "_bench_wrapped", False):
+                continue
+            orig_cb = r.emit_callback
+
+            def cb(replica, emitted, _orig=orig_cb):
+                if t0_box[0] is not None:
+                    tnow = time.perf_counter() - t0_box[0]
+                    with tlock:
+                        for uid in emitted:
+                            if uid not in first_tok:
+                                first_tok[uid] = tnow
+                _orig(replica, emitted)
+
+            r.emit_callback = cb
+            r._bench_wrapped = True
+
+    _wrap_new()
+
+    probed = set()
+
+    def _probe_chaos_workers():
+        for rid, remote in list(sup.replicas.items()):
+            if rid in probed or remote.draining or remote.exited:
+                continue
+            if "DSTPU_CHAOS" not in (sup._env_extra.get(rid) or {}):
+                continue
+            probed.add(rid)
+            remote.submit(Submission(uid=2_000_000 + rid,
+                                     tokens=prompts[0],
+                                     max_new_tokens=4))
+
+    # warm-up outside the timed window, skipping the chaos victim (its
+    # busy-round budget belongs to the drill)
+    warm = [r for r in remotes
+            if "DSTPU_CHAOS" not in (
+                sup._env_extra.get(r.replica_id) or {})]
+    for j, r in enumerate(warm):
+        r.submit(Submission(uid=1_000_000 + j, tokens=prompts[0],
+                            max_new_tokens=gen))
+    warm_deadline = time.time() + 180.0
+    while time.time() < warm_deadline and not all(
+            r.load_report().get("inflight", 0) == 0 for r in warm):
+        sup.maintain()
+        router.check_health()
+        time.sleep(0.05)
+
+    if drill:
+        # publish both releases before the clock starts: "v2" is the
+        # honest same-seed release (bit-identical weights, so swapped
+        # replicas keep producing the reference streams); "bad" seals a
+        # VALID manifest around deliberately wrong canary chains — the
+        # parity gate, not the checksum gate, must catch it
+        sup.publish_weights("v2", seed=knobs["seed"],
+                            canary_prompts=knobs["canary_prompts"],
+                            canary_gen=knobs["canary_gen"])
+        sup.publish_weights("bad", seed=knobs["seed"],
+                            canary_prompts=knobs["canary_prompts"],
+                            canary_gen=knobs["canary_gen"],
+                            canary_chains={"0": [12345]})
+
+    st = {"swap": None, "scaled_up": False, "scaled_down": False}
+
+    def _events():
+        if not drill:
+            return
+        if st["swap"] is None:
+            # deploy mid-rush, but only after the SIGKILL casualty has
+            # been restarted (the rollout walks LIVE replicas) and the
+            # long session is provably mid-decode — that is what makes
+            # the warm migration deterministic, not a timing race
+            rec = router._requests.get(mig_uid)
+            acts = [a[1] for a in sup.actions]
+            if (rec is not None and not rec.done
+                    and len(rec.emitted) >= 2
+                    and "restart" in acts
+                    and len(sup._live_ids()) >= knobs["replicas"]):
+                st["swap"] = sup.rolling_swap(
+                    "v2", timeout_s=knobs["swap_timeout_s"])
+            return
+        if not st["scaled_up"]:
+            auto.desired = knobs["replicas"] + 1
+            st["scaled_up"] = True
+            return
+        if (not st["scaled_down"]
+                and len(sup._live_ids()) >= knobs["replicas"] + 1):
+            auto.desired = knobs["replicas"]
+            st["scaled_down"] = True
+
+    t0 = time.perf_counter()
+    t0_box[0] = t0
+    # the designated migration victim: a decode stream long enough to
+    # still be mid-flight when its owner quiesces for the swap; the
+    # quiet arm runs it too, so its tokens are reference-compared
+    router.submit(mig_uid, prompts[0],
+                  max_new_tokens=knobs["mig_gen"])
+    i = 0
+    last_maint = 0.0
+    while i < n:
+        now = time.perf_counter() - t0
+        if arrivals[i] <= now:
+            router.submit(i, prompts[i], max_new_tokens=gen)
+            i += 1
+            continue
+        if now - last_maint >= knobs["maintain_s"]:
+            sup.maintain()
+            router.check_health()
+            _wrap_new()
+            _probe_chaos_workers()
+            _events()
+            last_maint = now
+        time.sleep(min(max(arrivals[i] - now, 0.0), 0.01))
+    deadline = time.time() + knobs["drain_timeout_s"]
+    while time.time() < deadline:
+        sup.maintain()
+        router.check_health()
+        _wrap_new()
+        _probe_chaos_workers()
+        _events()
+        if router.pending() == 0 and (not drill
+                                      or st["scaled_down"]):
+            break
+        time.sleep(0.02)
+    wall = time.perf_counter() - t0
+
+    swap_bad = None
+    post_abort_ok = None
+    if drill:
+        # parity-abort sub-drill on the live (now idle) fleet: the
+        # corrupted release must abort, roll back, and leave the fleet
+        # able to serve — certified by a probe request afterwards
+        swap_bad = sup.rolling_swap("bad",
+                                    timeout_s=knobs["swap_timeout_s"])
+        router.submit(910_000, prompts[0], max_new_tokens=4)
+        probe_deadline = time.time() + 60.0
+        while time.time() < probe_deadline:
+            sup.maintain()
+            router.check_health()
+            if router.pending() == 0:
+                break
+            time.sleep(0.02)
+        post_abort_ok = len(router.results().get(910_000, [])) >= 4
+
+    sup.write_fleet_snapshot()
+    results = router.results()
+    live_end = len(sup._live_ids())
+    migrated_in = 0
+    for r in sup.replicas.values():
+        if r.exited or r._send_failed:
+            continue
+        try:
+            migrated_in += int(r.load_report().get("migrated_in", 0))
+        except Exception:
+            pass
+    sup.shutdown()
+    journal_stats = None
+    journal_warm = 0
+    if jr is not None:
+        journal_stats = jr.snapshot()
+        jpath = jr.path
+        reset_journal()
+        # the durable evidence of a warm move: worker-side migrated_in
+        # counters are wiped when the target itself gets swapped
+        # (reload = fresh engine), so certify from the decision journal
+        try:
+            from deepspeed_tpu.observability.journal import load_journal
+            journal_warm = sum(
+                1 for rec in load_journal(jpath)
+                if rec.get("kind") == "MIGRATE"
+                and rec.get("rung") == "warm")
+        except Exception:
+            journal_warm = 0
+
+    tokens = {str(uid): results[uid] for uid in sorted(results)
+              if uid < n or uid == mig_uid}
+    completed = sum(1 for uid in results
+                    if uid < n and len(results[uid]) >= gen)
+    mig_done = len(results.get(mig_uid, [])) >= knobs["mig_gen"]
+    ttfts = {uid: t - arrivals[uid] for uid, t in first_tok.items()
+             if uid < n}
+    acts = [a[1] for a in sup.actions]
+    rs = router.stats
+    out = {
+        "arm": arm,
+        "requests": n + 1,
+        "completed": completed + (1 if mig_done else 0),
+        "dropped": (n - completed) + (0 if mig_done else 1),
+        "wall_s": round(wall, 3),
+        **_percentiles_ms(list(ttfts.values())),
+        "tokens": tokens,
+        "restarts": acts.count("restart"),
+        "spawns": acts.count("spawn"),
+        "drains": acts.count("drain"),
+        "drain_refused": acts.count("drain_refused"),
+        "live_at_end": live_end,
+        "failed_over_requests": rs["failed_over_requests"],
+        "migrations": rs["migrations"],
+        "migrate_recompute": rs["migrate_recompute"],
+        "migrate_skipped": rs["migrate_skipped"],
+        "migrate_wire_bytes": rs["migrate_wire_bytes"],
+        "migrated_in_workers": migrated_in,
+        "supervisor_actions": [[round(ts - t0, 3), act, rid]
+                               for ts, act, rid in sup.actions],
+    }
+    if drill:
+        out["swap"] = st["swap"]
+        out["swap_bad"] = swap_bad
+        out["post_abort_probe_ok"] = post_abort_ok
+        out["journal"] = journal_stats
+        out["journal_warm_migrations"] = journal_warm
+    return out
+
+
+def run_deploy_drill() -> dict:
+    """Deploy-during-rush-hour certification (``BENCH_MODE=
+    deploy_drill``, ``make deploy-drill``): the PR-13 diurnal peak
+    workload through a socket process fleet while the ENTIRE
+    zero-downtime playbook runs in one pass — a worker SIGKILLed
+    mid-request, a same-seed weight release rolled replica-by-replica
+    (live sessions migrating out warm ahead of each reload, A/B canary
+    parity gating each rejoin), an autoscale swing up and back down
+    (migration-backed drain), and a corrupted-canary release whose
+    parity gate must abort the rollout and roll back — against a quiet
+    2-worker reference arm serving the same schedule.
+
+    Gates: zero dropped requests in both arms (``drill.zero_drops``);
+    every stream — including the deliberately migrated long session —
+    bit-identical to the quiet arm (``drill.bit_identical``); drill
+    TTFT p99.9 within DRILL_MAX_P999_RATIO of quiet
+    (``drill.ttft_p999_ratio``); at least one session moved WARM with
+    its wire bytes accounted (``migrate.wire_bytes_per_session``); the
+    good rollout swaps every replica with parity intact
+    (``swap.parity_ok``); the corrupted rollout aborts, rolls back,
+    and the fleet still serves (``swap.abort_ok``); the autoscale
+    swing both spawned and drained, ending at the floor.
+
+    Env knobs (CPU defaults in parens): DRILL_REQUESTS (8),
+    DRILL_PROMPT (32), DRILL_GEN (8), DRILL_MIG_GEN (48), DRILL_RATE
+    (2.0/s), DRILL_PERIOD_S (4), DRILL_REPLICAS (2), DRILL_STALE_S
+    (1.0), DRILL_MAX_P999_RATIO (80), DRILL_SEED (0), DRILL_RUN_DIR,
+    DRILL_DRAIN_TIMEOUT_S (180), DRILL_SWAP_TIMEOUT_S (60)."""
+    import numpy as np
+
+    base_dir = os.environ.get("DRILL_RUN_DIR", "/tmp/dstpu_deploy_drill")
+    model_name = os.environ.get("DRILL_MODEL", "tiny")
+    n_req = int(os.environ.get("DRILL_REQUESTS", 8))
+    prompt_len = int(os.environ.get("DRILL_PROMPT", 32))
+    gen = int(os.environ.get("DRILL_GEN", 8))
+    mig_gen = int(os.environ.get("DRILL_MIG_GEN", 48))
+    rate = float(os.environ.get("DRILL_RATE", 2.0))
+    period_s = float(os.environ.get("DRILL_PERIOD_S", 4.0))
+    seed = int(os.environ.get("DRILL_SEED", 0))
+    max_ratio = float(os.environ.get("DRILL_MAX_P999_RATIO", 80.0))
+    n_rep = int(os.environ.get("DRILL_REPLICAS", 2))
+    block = 8
+    blocks_per_seq = (prompt_len + max(gen, mig_gen)) // block + 3
+
+    model_spec = {"name": model_name,
+                  "overrides": {"dtype": "float32",
+                                "param_dtype": "float32"}}
+    engine_spec = dict(
+        kv_blocks=blocks_per_seq * max(4, n_req + 1) + 2,
+        kv_block_size=block, max_tokens_per_step=64,
+        max_seqs_per_step=8, max_blocks_per_seq=blocks_per_seq,
+        dtype="float32", request_trace={"sample_rate": 1.0})
+
+    rng = np.random.default_rng(seed)
+    vocab = 256
+    shared = rng.integers(0, vocab, (prompt_len * 3 // 4,))
+    prompts = []
+    for _ in range(n_req):
+        tail = rng.integers(0, vocab, (prompt_len - len(shared),))
+        prompts.append(np.concatenate([shared, tail]).astype(np.int32))
+    arrivals = _nhpp_arrivals(n_req, rate, period_s, 3.0, 0.2, rng)
+    canary_prompts = [
+        [int(t) for t in rng.integers(0, vocab, (prompt_len // 2,))]
+        for _ in range(2)]
+
+    knobs = {
+        "replicas": n_rep,
+        "stale_after_s": float(os.environ.get("DRILL_STALE_S", 1.0)),
+        "maintain_s": 0.05,
+        "drain_timeout_s": float(os.environ.get(
+            "DRILL_DRAIN_TIMEOUT_S", 180.0)),
+        "swap_timeout_s": float(os.environ.get(
+            "DRILL_SWAP_TIMEOUT_S", 60.0)),
+        "seed": seed,
+        "mig_gen": mig_gen,
+        "canary_prompts": canary_prompts,
+        "canary_gen": 8,
+    }
+    quiet = _drive_deploy_arm("quiet", base_dir, model_spec,
+                              engine_spec, prompts, arrivals, gen,
+                              knobs)
+    drill = _drive_deploy_arm("drill", base_dir, model_spec,
+                              engine_spec, prompts, arrivals, gen,
+                              knobs)
+
+    violations = []
+    for r in (quiet, drill):
+        if r["dropped"] > 0:
+            violations.append({"region": r["arm"], "gate": "zero_drops",
+                               "limit": 0, "got": r["dropped"]})
+    bit_identical = drill["tokens"] == quiet["tokens"]
+    if not bit_identical:
+        diff = [u for u in quiet["tokens"]
+                if drill["tokens"].get(u) != quiet["tokens"][u]]
+        violations.append({
+            "region": "drill", "gate": "bit_identical",
+            "limit": "tokens == quiet reference",
+            "got": f"streams differ for uids {diff[:8]}"})
+    p999_ratio = None
+    if quiet.get("ttft_p999_ms") and drill.get("ttft_p999_ms"):
+        p999_ratio = round(drill["ttft_p999_ms"]
+                           / quiet["ttft_p999_ms"], 3)
+        if p999_ratio > max_ratio:
+            violations.append({
+                "region": "drill", "gate": "ttft_p999_ratio",
+                "limit": max_ratio, "got": p999_ratio})
+    if drill["migrations"] < 1:
+        violations.append({
+            "region": "drill", "gate": "warm_migrations",
+            "limit": ">=1", "got": drill["migrations"]})
+    # worker-side migrated_in counters die with the target's own swap
+    # reload, so the warm-install proof comes from the decision journal
+    if drill.get("journal_warm_migrations", 0) < 1:
+        violations.append({
+            "region": "drill", "gate": "journal_warm_migrations",
+            "limit": ">=1", "got": drill.get("journal_warm_migrations")})
+    wire_per_session = (
+        round(drill["migrate_wire_bytes"]
+              / max(1, drill["migrations"]), 1)
+        if drill["migrations"] else None)
+    swap = drill.get("swap") or {}
+    parity_ok = bool(swap and not swap.get("aborted")
+                     and swap.get("parity_ok")
+                     and swap.get("swapped", 0) >= 1)
+    if not parity_ok:
+        violations.append({
+            "region": "swap", "gate": "parity_ok",
+            "limit": "rollout completes with canary parity",
+            "got": swap or "swap never ran"})
+    bad = drill.get("swap_bad") or {}
+    abort_ok = bool(bad.get("aborted")
+                    and bad.get("parity_ok") is False
+                    and bad.get("rolled_back", 0) >= 1
+                    and drill.get("post_abort_probe_ok"))
+    if not abort_ok:
+        violations.append({
+            "region": "swap", "gate": "abort_ok",
+            "limit": "corrupt canary aborts + rolls back + serves",
+            "got": {"swap_bad": bad,
+                    "post_abort_probe_ok":
+                        drill.get("post_abort_probe_ok")}})
+    if drill["spawns"] < 1 or drill["drains"] < 1:
+        violations.append({
+            "region": "autoscale", "gate": "swing",
+            "limit": ">=1 spawn and >=1 migration-backed drain",
+            "got": {"spawns": drill["spawns"],
+                    "drains": drill["drains"]}})
+    if drill["live_at_end"] != n_rep:
+        violations.append({
+            "region": "autoscale", "gate": "settled_at_floor",
+            "limit": n_rep, "got": drill["live_at_end"]})
+    for r in (quiet, drill):
+        r.pop("tokens", None)  # compared above; too bulky to print
+
+    total_tokens_s = None
+    if quiet["wall_s"]:
+        total_tokens_s = round(
+            (quiet["requests"] - 1) * gen / quiet["wall_s"], 1)
+    return {
+        "metric": f"{model_name} deploy_drill "
+                  f"({n_rep} worker procs, {n_req}+1 req, kill + "
+                  f"rolling swap + autoscale swing, socket transport)",
+        "value": total_tokens_s,
+        "unit": "tokens/s",
+        "drill.zero_drops": all(r["dropped"] == 0
+                                for r in (quiet, drill)),
+        "drill.bit_identical": bit_identical,
+        "drill.ttft_p999_ratio": p999_ratio,
+        "drill.warm_migrations": drill["migrations"],
+        "swap.parity_ok": parity_ok,
+        "swap.abort_ok": abort_ok,
+        "migrate.wire_bytes_per_session": wire_per_session,
+        "arms": {"quiet": quiet, "drill": drill},
+        "ok": not violations,
+        "violations": violations,
+    }
+
+
 if __name__ == "__main__":
     mode = os.environ.get("BENCH_MODE", "serve")
     if mode == "serve_fleet":
@@ -2458,6 +2929,11 @@ if __name__ == "__main__":
         _op = run_obs_fleet()
         print(json.dumps(_op))
         if not _op.get("ok", True):
+            raise SystemExit(1)
+    elif mode == "deploy_drill":
+        _dp = run_deploy_drill()
+        print(json.dumps(_dp))
+        if not _dp.get("ok", True):
             raise SystemExit(1)
     elif mode == "replay_fleet":
         _rp = run_replay_fleet()

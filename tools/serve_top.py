@@ -43,7 +43,10 @@ do I fix first" (docs/serving.md "Request tracing & SLO attribution").
 ``make replay-fleet``) and prints the human-readable incident log —
 every admission, routing decision WITH its per-candidate scores,
 preemption/hedge/failover/autoscale/supervisor act with its triggering
-state, and chaos injection, on one wall-clock-offset timeline —
+state, live-migration/weight-swap/scale act (MIGRATE with source and
+target scores + landed rung, SWAP with its parity verdict per stage,
+SCALE with desired-vs-actual), and chaos injection, on one
+wall-clock-offset timeline —
 followed by the per-request outcome table. ``--replay-verdict`` prints
 a ``tools/replay.py`` verdict (a ``*.verdict.json`` file, or a journal
 path whose verdict sits next to it) and exits nonzero on divergence
@@ -225,7 +228,9 @@ def _fleet_table(snap: dict) -> str:
                                  "handoff_recompute", "failovers",
                                  "failed_over_requests", "affinity_hits",
                                  "tier_affinity_hits",
-                                 "hedged", "hedge_wins")
+                                 "hedged", "hedge_wins",
+                                 "migrations", "migrate_recompute",
+                                 "migrate_skipped")
         if k in st)]
     auto = snap.get("autoscale")
     if auto:
